@@ -1,0 +1,27 @@
+"""paddle_tpu: a TPU-native framework with PaddlePaddle-Fluid capabilities.
+
+Public surface mirrors `paddle.fluid` (reference: python/paddle/fluid/
+__init__.py) so reference-era programs port by changing the import:
+
+    import paddle_tpu as fluid
+    x = fluid.layers.data("x", [784])
+    ...
+    exe = fluid.Executor(fluid.TPUPlace(0))
+"""
+from . import ops  # registers all op lowerings  # noqa: F401
+from . import layers  # noqa: F401
+from . import optimizer  # noqa: F401
+from .core import initializer, regularizer, unique_name  # noqa: F401
+from .core.autodiff import append_backward, calc_gradient  # noqa: F401
+from .core.executor import CPUPlace, CUDAPlace, Executor, TPUPlace  # noqa: F401
+from .core.param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from .core.program import (  # noqa: F401
+    Program,
+    Variable,
+    default_main_program,
+    default_startup_program,
+    program_guard,
+)
+from .core.scope import Scope, global_scope  # noqa: F401
+
+__version__ = "0.1.0"

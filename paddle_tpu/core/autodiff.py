@@ -1,0 +1,99 @@
+"""append_backward / calc_gradient.
+
+Reference: python/paddle/fluid/backward.py (append_backward:432) walks ops in
+reverse emitting grad OpDescs from per-op GradOpMakers.
+
+TPU-first redesign: there are no grad ops.  `append_backward` records ONE
+`backward` op in the program naming (loss, params, grad vars); at lowering
+time the executor wraps the forward segment in `jax.vjp`
+(core/lowering.py:run_block_with_backward), so the gradient program is
+derived by a functional transform, is always consistent with the forward
+lowering, and fuses with it in XLA.  The user-visible contract is identical:
+after append_backward, `<param>@GRAD` variables exist and optimizer ops can
+read them.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from .program import Parameter, Variable
+
+GRAD_SUFFIX = "@GRAD"
+
+
+def _grad_name(name: str) -> str:
+    return name + GRAD_SUFFIX
+
+
+def append_backward(
+    loss: Variable,
+    parameter_list: Optional[Sequence] = None,
+    no_grad_set: Optional[set] = None,
+    callbacks=None,
+) -> List[Tuple[Variable, Variable]]:
+    block = loss.block
+    program = block.program
+    no_grad = set()
+    for item in no_grad_set or ():
+        no_grad.add(item.name if isinstance(item, Variable) else str(item))
+
+    if parameter_list is not None:
+        params = []
+        for p in parameter_list:
+            params.append(block.var(p) if isinstance(p, str) else p)
+    else:
+        params = [p for p in program.all_parameters() if p.trainable]
+    params = [p for p in params if p.name not in no_grad]
+    if not params:
+        raise ValueError("append_backward: no trainable parameters found")
+
+    param_names = [p.name for p in params]
+    grad_names = [_grad_name(n) for n in param_names]
+    grads = []
+    for p, gname in zip(params, grad_names):
+        g = block.create_var(gname, shape=p.shape, dtype=p.dtype)
+        grads.append(g)
+
+    block.append_op(
+        "backward",
+        inputs={"Loss": [loss.name]},
+        outputs={"Grads": grad_names},
+        attrs={
+            "loss_name": loss.name,
+            "param_names": param_names,
+            "grad_names": grad_names,
+        },
+    )
+    return list(zip(params, grads))
+
+
+def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Gradients of `targets` w.r.t. arbitrary `inputs` (backward.py:672).
+
+    Implemented with the same single-backward-op mechanism; restricted (like
+    the executor) to one backward region per program for now.
+    """
+    if isinstance(targets, Variable):
+        targets = [targets]
+    if isinstance(inputs, Variable):
+        inputs = [inputs]
+    if len(targets) != 1:
+        raise NotImplementedError("calc_gradient currently supports a single target")
+    loss = targets[0]
+    block = loss.block
+    param_names = [v.name for v in inputs]
+    grad_names = [_grad_name(n) for n in param_names]
+    grads = []
+    for v, gname in zip(inputs, grad_names):
+        grads.append(block.create_var(gname, shape=v.shape, dtype=v.dtype))
+    block.append_op(
+        "backward",
+        inputs={"Loss": [loss.name]},
+        outputs={"Grads": grad_names},
+        attrs={
+            "loss_name": loss.name,
+            "param_names": param_names,
+            "grad_names": grad_names,
+        },
+    )
+    return grads

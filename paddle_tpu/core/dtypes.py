@@ -1,0 +1,75 @@
+"""Dtype vocabulary for the program IR.
+
+The reference keeps a VarType.Type enum in framework.proto:105 (LOD_TENSOR,
+FP32, INT64, ...).  We keep a string dtype vocabulary that maps 1:1 onto JAX
+dtypes; bf16 is first-class because it is the native TPU matmul type.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+try:  # jax.numpy gives us bfloat16
+    import jax.numpy as jnp
+
+    _BFLOAT16 = jnp.bfloat16
+except Exception:  # pragma: no cover
+    _BFLOAT16 = np.float32
+
+# canonical name -> numpy-compatible dtype object
+_DTYPES = {
+    "float16": np.float16,
+    "bfloat16": _BFLOAT16,
+    "float32": np.float32,
+    "float64": np.float64,
+    "int8": np.int8,
+    "uint8": np.uint8,
+    "int16": np.int16,
+    "int32": np.int32,
+    "int64": np.int64,
+    "bool": np.bool_,
+}
+
+_ALIASES = {
+    "fp16": "float16",
+    "bf16": "bfloat16",
+    "fp32": "float32",
+    "fp64": "float64",
+    "float": "float32",
+    "double": "float64",
+    "int": "int32",
+    "long": "int64",
+}
+
+
+def canonical_dtype(dtype) -> str:
+    """Normalise any dtype spec (str, np.dtype, jnp dtype) to a canonical name."""
+    if dtype is None:
+        return "float32"
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name in _DTYPES:
+            return name
+        # fall through to numpy parsing for things like 'float32'
+    try:
+        name = np.dtype(dtype).name
+    except TypeError:
+        name = str(dtype)
+    if name == "bfloat16" or "bfloat16" in name:
+        return "bfloat16"
+    name = _ALIASES.get(name, name)
+    if name not in _DTYPES:
+        raise ValueError(f"unsupported dtype: {dtype!r}")
+    return name
+
+
+def as_np_dtype(dtype):
+    """Return the numpy/jax dtype object for a canonical or loose dtype spec."""
+    return _DTYPES[canonical_dtype(dtype)]
+
+
+def is_floating(dtype) -> bool:
+    return canonical_dtype(dtype) in ("float16", "bfloat16", "float32", "float64")
+
+
+def is_integer(dtype) -> bool:
+    return canonical_dtype(dtype) in ("int8", "uint8", "int16", "int32", "int64")

@@ -1,0 +1,226 @@
+"""Executor: compile-and-run of whole programs.
+
+Reference counterparts: `python/paddle/fluid/executor.py` (Executor:292,
+run:564) and `framework/executor.cc:150` (per-op interpreter).
+
+TPU-first redesign: `run()` does NOT interpret ops.  It lowers the program's
+global block to ONE jax function (forward + vjp backward + optimizer update),
+jit-compiles it, caches the executable keyed by (program version, feed
+signature, state signature, fetch names) — the role the reference's
+`use_program_cache` played — and executes it.  Persistent state (parameters,
+optimizer accumulators, RNG key) lives in a Scope as device arrays and is
+donated to the executable each step, so parameter updates are in-place in HBM.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .dtypes import as_np_dtype
+from .lowering import LoweringContext, run_block_with_backward
+from .program import Program, Variable, default_main_program
+from .scope import RNG_STATE_VAR, Scope, global_scope
+
+
+class Place:
+    pass
+
+
+class TPUPlace(Place):
+    """Device handle (reference: platform/place.h CUDAPlace:37)."""
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"TPUPlace({self.device_id})"
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if d.platform != "cpu"] or jax.devices()
+        return devs[self.device_id % len(devs)]
+
+
+class CPUPlace(Place):
+    def __init__(self):
+        self.device_id = 0
+
+    def __repr__(self):
+        return "CPUPlace()"
+
+    def jax_device(self):
+        try:
+            return jax.devices("cpu")[0]
+        except RuntimeError:
+            return jax.devices()[0]
+
+
+# CUDAPlace alias keeps reference-era scripts importable; it is a TPU device.
+CUDAPlace = TPUPlace
+
+
+def _runnable_ops(block):
+    return [op for op in block.ops if op.type not in ("feed", "fetch")]
+
+
+class _CompiledStep:
+    """One jitted executable for (program, feed sig, fetch names, state sig)."""
+
+    def __init__(self, program: Program, feed_names: Sequence[str], fetch_names: Sequence[str], scope: Scope):
+        block = program.global_block()
+        ops = _runnable_ops(block)
+
+        persistable = {
+            v.name for v in program.list_vars() if v.persistable
+        }
+        ops = self._prune(ops, fetch_names, persistable)
+        read_names = set()
+        written = []
+        written_set = set()
+        for op in ops:
+            read_names.update(op.input_arg_names)
+            if op.type == "backward":
+                read_names.update(op.attrs.get("param_names", []))
+            for n in op.output_arg_names:
+                if n in persistable and n not in written_set:
+                    written_set.add(n)
+                    written.append(n)
+        # grads of params: backward writes grad vars which may be persistable? no.
+        needed = (read_names | set(fetch_names)) & persistable
+        self.state_in_names = sorted(n for n in needed if scope.has_var(n))
+        self.written_names = written
+        self.fetch_names = list(fetch_names)
+        self.feed_names = list(feed_names)
+
+        # Donate only buffers the step overwrites (params/accumulators under
+        # an optimizer); read-only state is passed undonated.
+        self.rw_names = [n for n in self.state_in_names if n in written_set]
+        self.ro_names = [n for n in self.state_in_names if n not in written_set]
+
+        def step(state_rw: Dict[str, jnp.ndarray], state_ro: Dict[str, jnp.ndarray],
+                 feeds: Dict[str, jnp.ndarray], key):
+            ctx = LoweringContext(key)
+            env = dict(state_ro)
+            env.update(state_rw)
+            env.update(feeds)
+            env = run_block_with_backward(ctx, ops, env)
+            new_state = {n: env[n] for n in written if n in env}
+            fetches = [env[n] for n in self.fetch_names]
+            return fetches, new_state, ctx.key
+
+        self.jfn = jax.jit(step, donate_argnums=(0,))
+
+    @staticmethod
+    def _prune(ops, fetch_names, persistable):
+        """Fetch-driven dead-op elimination (the reference prunes programs to
+        feed/fetch targets at io.py save_inference_model:915; here it runs on
+        every compile so eval programs don't demand training-only feeds).
+        Ops are kept if they (transitively) contribute to a fetch or write a
+        persistable var."""
+        needed = set(fetch_names)
+        kept = []
+        for op in reversed(ops):
+            outs = op.output_arg_names
+            writes_state = any(o in persistable for o in outs)
+            if writes_state or any(o in needed for o in outs):
+                kept.append(op)
+                needed.update(op.input_arg_names)
+                if op.type == "backward":
+                    needed.add(op.attrs["loss_name"])
+                    needed.update(op.attrs.get("param_names", []))
+        kept.reverse()
+        return kept
+
+    def __call__(self, scope: Scope, feeds: Dict[str, jnp.ndarray], key):
+        state_rw = {n: scope.find_var(n) for n in self.rw_names}
+        state_ro = {n: scope.find_var(n) for n in self.ro_names}
+        fetches, new_state, new_key = self.jfn(state_rw, state_ro, feeds, key)
+        for n, v in new_state.items():
+            scope.set_var(n, v)
+        return fetches, new_key
+
+
+class Executor:
+    """Reference: executor.py:292.  `run` signature kept source-compatible."""
+
+    def __init__(self, place: Optional[Place] = None):
+        self.place = place if place is not None else TPUPlace(0)
+        self._cache: Dict[tuple, _CompiledStep] = {}
+
+    def close(self):
+        self._cache.clear()
+
+    # -- main entry ------------------------------------------------------
+    def run(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, np.ndarray]] = None,
+        fetch_list: Optional[Sequence[Union[str, Variable]]] = None,
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+        use_program_cache: bool = True,  # parity arg; caching is always on
+    ):
+        program = program if program is not None else default_main_program()
+        scope = scope if scope is not None else global_scope()
+        feed = feed or {}
+        fetch_names = [f.name if isinstance(f, Variable) else str(f) for f in (fetch_list or [])]
+
+        device = self.place.jax_device()
+        block = program.global_block()
+
+        # Convert feeds to device arrays with the declared var dtype.
+        jfeeds = {}
+        for name, value in feed.items():
+            dtype = None
+            if block.has_var(name):
+                dtype = as_np_dtype(block.var(name).dtype)
+            arr = jnp.asarray(np.asarray(value), dtype=dtype)
+            jfeeds[name] = jax.device_put(arr, device)
+
+        key = scope.find_var(RNG_STATE_VAR)
+        if key is None:
+            seed = program.random_seed if program.random_seed is not None else 0
+            key = jax.random.PRNGKey(seed)
+        key = jax.device_put(key, device)
+
+        def _sig(v):
+            shape = getattr(v, "shape", None)
+            dtype = getattr(v, "dtype", None)
+            if shape is None or dtype is None:
+                a = np.asarray(v)
+                shape, dtype = a.shape, a.dtype
+            return tuple(shape), str(dtype)
+
+        cache_key = (
+            program._uuid,
+            program.version,
+            tuple(sorted((n, v.shape, str(v.dtype)) for n, v in jfeeds.items())),
+            tuple(fetch_names),
+            tuple(sorted((n,) + _sig(scope.find_var(n)) for n in self._persistable_in_scope(program, scope))),
+            scope._uuid,
+        )
+        compiled = self._cache.get(cache_key)
+        if compiled is None:
+            compiled = _CompiledStep(program, list(jfeeds), fetch_names, scope)
+            self._cache[cache_key] = compiled
+            if len(self._cache) > 128:  # drop oldest executable (LRU-ish)
+                self._cache.pop(next(iter(self._cache)))
+
+        # Move any host-resident state onto the device once.
+        for n in compiled.state_in_names:
+            v = scope.find_var(n)
+            if not isinstance(v, jax.Array):
+                scope.set_var(n, jax.device_put(jnp.asarray(v), device))
+
+        fetches, new_key = compiled(scope, jfeeds, key)
+        scope.set_var(RNG_STATE_VAR, new_key)
+
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    @staticmethod
+    def _persistable_in_scope(program: Program, scope: Scope) -> List[str]:
+        return [v.name for v in program.list_vars() if v.persistable and scope.has_var(v.name)]

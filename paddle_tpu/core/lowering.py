@@ -1,0 +1,139 @@
+"""Block -> JAX lowering.
+
+This replaces the reference's per-op interpreter hot loop
+(`framework/executor.cc:416-421`: `for op in ctx->ops_: op->Run(...)`).
+Instead of running kernels, `run_ops` symbolically interprets the op list
+once inside a jax trace, producing a single XLA computation per block —
+the seam SURVEY.md identifies at `executor.cc:337` (nGraph subgraph engine)
+taken to its limit: the *whole* block is the subgraph.
+
+The `backward` op (emitted by core/autodiff.py) splits the op list into a
+forward segment and an update segment; gradients are obtained with `jax.vjp`
+over the re-interpreted forward segment, so XLA sees forward+backward+update
+as one fused program.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .program import Block, Operator
+from .registry import get_op_def
+
+
+class LoweringContext:
+    """Per-trace state: RNG threading, train/eval mode, mesh info.
+
+    JAX PRNG is explicit; the reference's global curand state maps to a key
+    threaded through the trace.  Each RNG-consuming op calls `next_key()`.
+    The final key is returned from the compiled function and stored back in
+    the scope, so randomness advances across `Executor.run` calls.
+    """
+
+    def __init__(self, key, is_test: bool = False, mesh=None):
+        self.key = key
+        self.is_test = is_test
+        self.mesh = mesh
+
+    def next_key(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+
+# Ops handled by the executor itself, not by a registered lowering.
+_STRUCTURAL_OPS = ("feed", "fetch", "backward")
+
+
+def run_ops(ctx: LoweringContext, ops: List[Operator], env: Dict[str, Any]) -> Dict[str, Any]:
+    """Interpret `ops` over `env` (var name -> traced jax value), in order."""
+    for op in ops:
+        if op.type in _STRUCTURAL_OPS:
+            raise RuntimeError(
+                f"structural op {op.type!r} reached the lowering interpreter; "
+                "the executor must handle it"
+            )
+        lower_one(ctx, op, env)
+    return env
+
+
+def lower_one(ctx: LoweringContext, op: Operator, env: Dict[str, Any]) -> None:
+    opdef = get_op_def(op.type)
+    ins = {}
+    for slot, names in op.inputs.items():
+        vals = []
+        for n in names:
+            if n not in env:
+                raise KeyError(
+                    f"op {op.type!r} reads {n!r} which is not defined; "
+                    "feed it, initialize it via the startup program, or check op order"
+                )
+            vals.append(env[n])
+        ins[slot] = vals
+    outs = opdef.lower(ctx, op, ins)
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot)
+        if vals is None:
+            continue
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        if len(vals) != len(names):
+            raise RuntimeError(
+                f"op {op.type!r} slot {slot!r}: lowering returned {len(vals)} "
+                f"values for {len(names)} outputs"
+            )
+        for n, v in zip(names, vals):
+            env[n] = v
+
+
+def find_backward_split(ops: List[Operator]) -> Optional[int]:
+    for i, op in enumerate(ops):
+        if op.type == "backward":
+            return i
+    return None
+
+
+def run_block_with_backward(ctx: LoweringContext, ops: List[Operator], env: Dict[str, Any]) -> Dict[str, Any]:
+    """Interpret a block that may contain one `backward` op.
+
+    Forward ops re-run inside jax.vjp so forward+backward fuse into one XLA
+    program; the aux env carries every forward intermediate out of the vjp
+    (XLA keeps only what is actually used downstream).
+    """
+    split = find_backward_split(ops)
+    if split is None:
+        return run_ops(ctx, ops, env)
+
+    bw = ops[split]
+    loss_name = bw.attrs["loss_name"]
+    param_names: List[str] = list(bw.attrs["param_names"])
+    grad_names: List[str] = list(bw.attrs["grad_names"])
+    fwd_ops = ops[:split]
+    tail_ops = ops[split + 1 :]
+
+    base_env = dict(env)
+
+    def fwd(params: Dict[str, Any]):
+        e = dict(base_env)
+        e.update(params)
+        e = run_ops(ctx, fwd_ops, e)
+        loss = e[loss_name]
+        return loss, e
+
+    primal_params = {}
+    for p in param_names:
+        if p not in env:
+            raise KeyError(f"backward: parameter {p!r} not initialized (run the startup program)")
+        primal_params[p] = env[p]
+
+    loss, vjp_fn, env_after = jax.vjp(fwd, primal_params, has_aux=True)
+    (grads,) = vjp_fn(jnp.ones_like(loss))
+
+    env = env_after
+    for p, g in zip(param_names, grad_names):
+        gval = grads[p]
+        if gval is None:  # non-float param leaked in; treat as zero
+            gval = jnp.zeros_like(env[p])
+        env[g] = gval
+    return run_ops(ctx, tail_ops, env)

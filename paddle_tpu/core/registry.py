@@ -1,0 +1,71 @@
+"""Op registry: lowering rules from program ops to JAX.
+
+Reference counterpart: `framework/op_registry.h:66` + `framework/op_info.cc`
+(static registration of ops, kernels, grad makers).  The TPU rebuild needs no
+per-device kernel table and no grad makers:
+
+  * every op registers ONE `lower` function that emits jax.numpy / lax calls;
+    XLA does the per-backend codegen the reference's CPU/CUDA/MKLDNN kernels
+    did by hand;
+  * gradients come from `jax.vjp` over the lowered forward segment
+    (core/autodiff.py), so there is no grad-op vocabulary to register.
+
+`lower(ctx, op, ins)` receives `ins` as {slot: [jax values]} and returns
+{slot: [jax values]}.  `ctx` is a LoweringContext (core/lowering.py) giving
+RNG keys, train/eval mode and mesh info.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+OpLowerFn = Callable  # (ctx, op, ins) -> {slot: [values]}
+InferFn = Callable  # (op, block) -> None (sets output var shapes/dtypes)
+
+
+class OpDef:
+    def __init__(self, type: str, lower: OpLowerFn, infer: Optional[InferFn] = None):
+        self.type = type
+        self.lower = lower
+        self.infer = infer
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(type: str, infer: Optional[InferFn] = None):
+    """Decorator: @register_op("relu") def _relu(ctx, op, ins): ..."""
+
+    def deco(fn: OpLowerFn):
+        _REGISTRY[type] = OpDef(type, fn, infer)
+        return fn
+
+    return deco
+
+
+def get_op_def(type: str) -> OpDef:
+    try:
+        return _REGISTRY[type]
+    except KeyError:
+        raise NotImplementedError(
+            f"op {type!r} has no registered lowering; registered ops: "
+            f"{sorted(_REGISTRY)}"
+        ) from None
+
+
+def has_op(type: str) -> bool:
+    return type in _REGISTRY
+
+
+def registered_ops():
+    return sorted(_REGISTRY)
+
+
+def infer_and_check(op, block):
+    """Run build-time shape/dtype inference if the op registered one.
+
+    Mirrors the reference's compile-time InferShape (shape_inference.h); ops
+    the framework appends (feed/fetch/backward) are exempt.
+    """
+    d = _REGISTRY.get(op.type)
+    if d is not None and d.infer is not None:
+        d.infer(op, block)

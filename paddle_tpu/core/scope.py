@@ -1,0 +1,72 @@
+"""Scope: name -> device array store (reference: framework/scope.h:45).
+
+The reference's Scope maps names to Variables holding LoDTensors on some
+Place; kernels mutate them in place.  Here the executor is functional — a
+compiled step returns new arrays — and the Scope is just the persistent
+name->jax.Array dictionary those results are written back to between runs.
+Hierarchy (kid scopes) is kept for API parity with `Scope::NewScope`.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+RNG_STATE_VAR = "__rng_state__"
+
+
+class Scope:
+    def __init__(self, parent: Optional["Scope"] = None):
+        import uuid
+
+        self._uuid = uuid.uuid4().hex
+        self._vars: Dict[str, object] = {}
+        self.parent = parent
+        self.kids: List["Scope"] = []
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self.kids.append(kid)
+        return kid
+
+    def set_var(self, name: str, value) -> None:
+        self._vars[name] = value
+
+    def find_var(self, name: str):
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name: str) -> bool:
+        return self.find_var(name) is not None
+
+    def erase(self, names) -> None:
+        for n in names:
+            self._vars.pop(n, None)
+
+    def local_var_names(self) -> List[str]:
+        return list(self._vars)
+
+    def var_names(self) -> List[str]:
+        names = set()
+        s: Optional[Scope] = self
+        while s is not None:
+            names.update(s._vars)
+            s = s.parent
+        return sorted(names)
+
+    def to_numpy(self, name: str) -> np.ndarray:
+        v = self.find_var(name)
+        if v is None:
+            raise KeyError(f"variable {name!r} not in scope")
+        return np.asarray(v)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope

@@ -1,0 +1,39 @@
+"""Unique name generator (reference: python/paddle/fluid/unique_name.py).
+
+Keeps per-prefix counters inside a guard-able generator so cloned programs and
+tests get reproducible names.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+
+class UniqueNameGenerator:
+    def __init__(self, prefix: str = ""):
+        self.prefix = prefix
+        self.ids = defaultdict(int)
+
+    def __call__(self, key: str) -> str:
+        tmp = self.ids[key]
+        self.ids[key] += 1
+        return f"{self.prefix}{key}_{tmp}"
+
+
+generator = UniqueNameGenerator()
+
+
+def generate(key: str) -> str:
+    return generator(key)
+
+
+@contextlib.contextmanager
+def guard(new_prefix: str = ""):
+    """Swap in a fresh generator (used by Program.clone and tests)."""
+    global generator
+    old = generator
+    generator = UniqueNameGenerator(new_prefix)
+    try:
+        yield
+    finally:
+        generator = old

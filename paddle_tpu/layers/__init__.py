@@ -1,0 +1,17 @@
+"""fluid.layers-compatible namespace."""
+from .io import data  # noqa: F401
+from .metric import accuracy  # noqa: F401
+from .nn import *  # noqa: F401,F403
+from .tensor import (  # noqa: F401
+    argmax,
+    argmin,
+    assign,
+    cast,
+    concat,
+    create_global_var,
+    fill_constant,
+    ones,
+    sums,
+    zeros,
+    zeros_like,
+)

@@ -1,0 +1,24 @@
+"""Data-entry layers (reference: python/paddle/fluid/layers/io.py data:39)."""
+from __future__ import annotations
+
+from ..core.layer_helper import LayerHelper
+from ..core.program import default_main_program
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True, stop_gradient=True):
+    """Declare an input variable.  append_batch_size=True prefixes -1, like
+    the reference; the concrete batch size binds at feed time and is part of
+    the executor's compile-cache key."""
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    block = default_main_program().current_block()
+    var = block.create_var(
+        name,
+        shape=shape,
+        dtype=dtype,
+        lod_level=lod_level,
+        is_data=True,
+        stop_gradient=stop_gradient,
+    )
+    return var

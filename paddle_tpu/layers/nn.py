@@ -1,0 +1,640 @@
+"""NN layers (reference: python/paddle/fluid/layers/nn.py — fc:213,
+conv2d:1991, batch_norm:3036, etc.).  Builders only: each appends program
+ops; all numerics live in ops/ lowerings."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dtypes import canonical_dtype
+from ..core.layer_helper import LayerHelper
+from ..core.program import Variable
+
+
+def _out(helper, dtype, shape=None):
+    return helper.create_variable_for_type_inference(dtype, shape=shape)
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("fc", name=name, act=act)
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    mul_results = []
+    for inp in inputs:
+        in_shape = inp.shape
+        fan_in = int(np.prod(in_shape[num_flatten_dims:]))
+        w = helper.create_parameter(param_attr, [fan_in, size], inp.dtype)
+        out = _out(helper, inp.dtype, shape=tuple(in_shape[:num_flatten_dims]) + (size,))
+        helper.append_op(
+            "mul",
+            inputs={"X": [inp.name], "Y": [w.name]},
+            outputs={"Out": [out.name]},
+            attrs={"x_num_col_dims": num_flatten_dims, "y_num_col_dims": 1},
+        )
+        mul_results.append(out)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = _out(helper, mul_results[0].dtype, shape=mul_results[0].shape)
+        helper.append_op(
+            "sum", inputs={"X": [v.name for v in mul_results]}, outputs={"Out": [pre_bias.name]}
+        )
+    pre_act = helper.append_bias_op(pre_bias, bias_attr, [size], dim_start=num_flatten_dims)
+    return helper.append_activation(pre_act)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    helper = LayerHelper("embedding")
+    w = helper.create_parameter(param_attr, size, dtype)
+    in_shape = input.shape
+    out_shape = None
+    if in_shape is not None:
+        base = in_shape[:-1] if in_shape[-1] == 1 else in_shape
+        out_shape = tuple(base) + (size[1],)
+    out = _out(helper, dtype, shape=out_shape)
+    helper.append_op(
+        "lookup_table",
+        inputs={"Ids": [input.name], "W": [w.name]},
+        outputs={"Out": [out.name]},
+        attrs={
+            "is_sparse": is_sparse,
+            "is_distributed": is_distributed,
+            "padding_idx": padding_idx,
+        },
+    )
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1, groups=None,
+           param_attr=None, bias_attr=None, use_cudnn=True, act=None, name=None):
+    helper = LayerHelper("conv2d", name=name, act=act)
+    groups = groups or 1
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    if isinstance(stride, int):
+        stride = [stride, stride]
+    if isinstance(padding, int):
+        padding = [padding, padding]
+    if isinstance(dilation, int):
+        dilation = [dilation, dilation]
+    num_channels = input.shape[1]
+    filter_shape = [num_filters, num_channels // groups, filter_size[0], filter_size[1]]
+    from ..core.initializer import NormalInitializer
+
+    fan_in = (num_channels // groups) * filter_size[0] * filter_size[1]
+    default_init = NormalInitializer(0.0, float(np.sqrt(2.0 / fan_in)))
+    w = helper.create_parameter(param_attr, filter_shape, input.dtype, default_initializer=default_init)
+    out_shape = None
+    if input.shape is not None and input.shape[2] is not None:
+        def _osz(i, k, p, s, d):
+            if i is None or i < 0:
+                return -1
+            return (i + 2 * p - (d * (k - 1) + 1)) // s + 1
+        out_shape = (
+            input.shape[0],
+            num_filters,
+            _osz(input.shape[2], filter_size[0], padding[0], stride[0], dilation[0]),
+            _osz(input.shape[3], filter_size[1], padding[1], stride[1], dilation[1]),
+        )
+    pre_bias = _out(helper, input.dtype, shape=out_shape)
+    helper.append_op(
+        "conv2d",
+        inputs={"Input": [input.name], "Filter": [w.name]},
+        outputs={"Output": [pre_bias.name]},
+        attrs={
+            "strides": stride,
+            "paddings": padding,
+            "dilations": dilation,
+            "groups": groups,
+        },
+    )
+    pre_act = helper.append_bias_op(pre_bias, bias_attr, [num_filters], dim_start=1)
+    return helper.append_activation(pre_act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None, stride=1, padding=0,
+                     dilation=1, groups=None, param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("conv2d_transpose", name=name, act=act)
+    groups = groups or 1
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    if isinstance(stride, int):
+        stride = [stride, stride]
+    if isinstance(padding, int):
+        padding = [padding, padding]
+    if isinstance(dilation, int):
+        dilation = [dilation, dilation]
+    num_channels = input.shape[1]
+    filter_shape = [num_channels, num_filters // groups, filter_size[0], filter_size[1]]
+    w = helper.create_parameter(param_attr, filter_shape, input.dtype)
+    pre_bias = _out(helper, input.dtype)
+    helper.append_op(
+        "conv2d_transpose",
+        inputs={"Input": [input.name], "Filter": [w.name]},
+        outputs={"Output": [pre_bias.name]},
+        attrs={"strides": stride, "paddings": padding, "dilations": dilation, "groups": groups},
+    )
+    pre_act = helper.append_bias_op(pre_bias, bias_attr, [num_filters], dim_start=1)
+    return helper.append_activation(pre_act)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
+           global_pooling=False, use_cudnn=True, ceil_mode=False, exclusive=True, name=None):
+    helper = LayerHelper("pool2d", name=name)
+    if isinstance(pool_size, int):
+        pool_size = [pool_size, pool_size]
+    if isinstance(pool_stride, int):
+        pool_stride = [pool_stride, pool_stride]
+    if isinstance(pool_padding, int):
+        pool_padding = [pool_padding, pool_padding]
+    out_shape = None
+    if input.shape is not None and not global_pooling:
+        def _osz(i, k, p, s):
+            if i is None or i < 0:
+                return -1
+            return (i + 2 * p - k) // s + 1
+        out_shape = (
+            input.shape[0],
+            input.shape[1],
+            _osz(input.shape[2], pool_size[0], pool_padding[0], pool_stride[0]),
+            _osz(input.shape[3], pool_size[1], pool_padding[1], pool_stride[1]),
+        )
+    elif input.shape is not None:
+        out_shape = (input.shape[0], input.shape[1], 1, 1)
+    out = _out(helper, input.dtype, shape=out_shape)
+    helper.append_op(
+        "pool2d",
+        inputs={"X": [input.name]},
+        outputs={"Out": [out.name]},
+        attrs={
+            "pooling_type": pool_type,
+            "ksize": pool_size,
+            "strides": pool_stride,
+            "paddings": pool_padding,
+            "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode,
+            "exclusive": exclusive,
+        },
+    )
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5, param_attr=None,
+               bias_attr=None, data_layout="NCHW", name=None, moving_mean_name=None,
+               moving_variance_name=None, use_global_stats=False):
+    helper = LayerHelper("batch_norm", name=name, act=act)
+    ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    dtype = input.dtype
+    from ..core.initializer import ConstantInitializer
+    from ..core.param_attr import ParamAttr
+
+    scale = helper.create_parameter(param_attr, [ch], dtype, default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(bias_attr, [ch], dtype, is_bias=True)
+    # moving stats: persistable, not trainable
+    mean_attr = ParamAttr(name=moving_mean_name, initializer=ConstantInitializer(0.0), trainable=False)
+    var_attr = ParamAttr(name=moving_variance_name, initializer=ConstantInitializer(1.0), trainable=False)
+    mean = helper.create_parameter(mean_attr, [ch], dtype)
+    variance = helper.create_parameter(var_attr, [ch], dtype)
+    mean.stop_gradient = True
+    variance.stop_gradient = True
+
+    saved_mean = _out(helper, dtype, shape=(ch,))
+    saved_var = _out(helper, dtype, shape=(ch,))
+    out = _out(helper, dtype, shape=input.shape)
+    helper.append_op(
+        "batch_norm",
+        inputs={
+            "X": [input.name],
+            "Scale": [scale.name],
+            "Bias": [bias.name],
+            "Mean": [mean.name],
+            "Variance": [variance.name],
+        },
+        outputs={
+            "Y": [out.name],
+            "MeanOut": [mean.name],
+            "VarianceOut": [variance.name],
+            "SavedMean": [saved_mean.name],
+            "SavedVariance": [saved_var.name],
+        },
+        attrs={
+            "momentum": momentum,
+            "epsilon": epsilon,
+            "is_test": is_test,
+            "data_layout": data_layout,
+            "use_global_stats": use_global_stats,
+        },
+    )
+    return helper.append_activation(out)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,
+               param_attr=None, bias_attr=None, act=None, name=None):
+    helper = LayerHelper("layer_norm", name=name, act=act)
+    dtype = input.dtype
+    norm_size = int(np.prod(input.shape[begin_norm_axis:]))
+    inputs = {"X": [input.name]}
+    from ..core.initializer import ConstantInitializer
+
+    if scale:
+        s = helper.create_parameter(param_attr, [norm_size], dtype, default_initializer=ConstantInitializer(1.0))
+        inputs["Scale"] = [s.name]
+    if shift:
+        b = helper.create_parameter(bias_attr, [norm_size], dtype, is_bias=True)
+        inputs["Bias"] = [b.name]
+    out = _out(helper, dtype, shape=input.shape)
+    mean = _out(helper, dtype)
+    var = _out(helper, dtype)
+    helper.append_op(
+        "layer_norm",
+        inputs=inputs,
+        outputs={"Y": [out.name], "Mean": [mean.name], "Variance": [var.name]},
+        attrs={"epsilon": epsilon, "begin_norm_axis": begin_norm_axis},
+    )
+    return helper.append_activation(out)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper("dropout", name=name)
+    out = _out(helper, x.dtype, shape=x.shape)
+    mask = _out(helper, x.dtype, shape=x.shape)
+    helper.append_op(
+        "dropout",
+        inputs={"X": [x.name]},
+        outputs={"Out": [out.name], "Mask": [mask.name]},
+        attrs={
+            "dropout_prob": dropout_prob,
+            "is_test": is_test,
+            "fix_seed": seed is not None,
+            "seed": seed if seed is not None else 0,
+            "dropout_implementation": dropout_implementation,
+        },
+    )
+    return out
+
+
+def softmax(input, use_cudnn=False, name=None, axis=-1):
+    helper = LayerHelper("softmax", name=name)
+    out = _out(helper, input.dtype, shape=input.shape)
+    helper.append_op(
+        "softmax", inputs={"X": [input.name]}, outputs={"Out": [out.name]}, attrs={"axis": axis}
+    )
+    return out
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    helper = LayerHelper("cross_entropy")
+    shape = None
+    if input.shape is not None:
+        shape = tuple(input.shape[:-1]) + (1,)
+    out = _out(helper, input.dtype, shape=shape)
+    helper.append_op(
+        "cross_entropy",
+        inputs={"X": [input.name], "Label": [label.name]},
+        outputs={"Y": [out.name]},
+        attrs={"soft_label": soft_label, "ignore_index": ignore_index},
+    )
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    loss_shape = tuple(logits.shape[:-1]) + (1,) if logits.shape is not None else None
+    softmax_out = _out(helper, logits.dtype, shape=logits.shape)
+    loss = _out(helper, logits.dtype, shape=loss_shape)
+    helper.append_op(
+        "softmax_with_cross_entropy",
+        inputs={"Logits": [logits.name], "Label": [label.name]},
+        outputs={"Loss": [loss.name], "Softmax": [softmax_out.name]},
+        attrs={"soft_label": soft_label, "ignore_index": ignore_index},
+    )
+    if return_softmax:
+        return loss, softmax_out
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100, name=None, normalize=False):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = _out(helper, x.dtype, shape=x.shape)
+    helper.append_op(
+        "sigmoid_cross_entropy_with_logits",
+        inputs={"X": [x.name], "Label": [label.name]},
+        outputs={"Out": [out.name]},
+        attrs={"ignore_index": ignore_index, "normalize": normalize},
+    )
+    return out
+
+
+def square_error_cost(input, label):
+    helper = LayerHelper("square_error_cost")
+    out = _out(helper, input.dtype, shape=input.shape)
+    helper.append_op(
+        "square_error_cost",
+        inputs={"X": [input.name], "Y": [label.name]},
+        outputs={"Out": [out.name]},
+    )
+    return out
+
+
+def mean(x, name=None):
+    helper = LayerHelper("mean", name=name)
+    out = _out(helper, x.dtype, shape=(1,))
+    helper.append_op("mean", inputs={"X": [x.name]}, outputs={"Out": [out.name]})
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1):
+    helper = LayerHelper("mul")
+    out = _out(helper, x.dtype)
+    helper.append_op(
+        "mul",
+        inputs={"X": [x.name], "Y": [y.name]},
+        outputs={"Out": [out.name]},
+        attrs={"x_num_col_dims": x_num_col_dims, "y_num_col_dims": y_num_col_dims},
+    )
+    return out
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    helper = LayerHelper("matmul", name=name)
+    out = _out(helper, x.dtype)
+    helper.append_op(
+        "matmul",
+        inputs={"X": [x.name], "Y": [y.name]},
+        outputs={"Out": [out.name]},
+        attrs={"transpose_X": transpose_x, "transpose_Y": transpose_y, "alpha": float(alpha)},
+    )
+    return out
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False, name=None):
+    helper = LayerHelper("reshape2", name=name)
+    out_shape = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            out_shape.append(x.shape[i] if x.shape is not None else -1)
+        else:
+            out_shape.append(s)
+    out = _out(helper, x.dtype, shape=tuple(out_shape))
+    xshape = _out(helper, x.dtype)
+    helper.append_op(
+        "reshape2",
+        inputs={"X": [x.name]},
+        outputs={"Out": [out.name], "XShape": [xshape.name]},
+        attrs={"shape": list(shape)},
+    )
+    return out
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose2", name=name)
+    shape = tuple(x.shape[p] for p in perm) if x.shape is not None else None
+    out = _out(helper, x.dtype, shape=shape)
+    xshape = _out(helper, x.dtype)
+    helper.append_op(
+        "transpose2",
+        inputs={"X": [x.name]},
+        outputs={"Out": [out.name], "XShape": [xshape.name]},
+        attrs={"axis": list(perm)},
+    )
+    return out
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", name=name)
+    dim = dim if dim >= 0 else len(input.shape) + dim
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        attrs = {"num": n, "sections": [], "axis": dim}
+    else:
+        n = len(num_or_sections)
+        attrs = {"num": 0, "sections": list(num_or_sections), "axis": dim}
+    outs = [_out(helper, input.dtype) for _ in range(n)]
+    helper.append_op(
+        "split", inputs={"X": [input.name]}, outputs={"Out": [o.name for o in outs]}, attrs=attrs
+    )
+    return outs
+
+
+def _reduce_layer(op_type):
+    def f(input, dim=None, keep_dim=False, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = _out(helper, input.dtype)
+        if dim is None:
+            attrs = {"dim": [0], "keep_dim": keep_dim, "reduce_all": True}
+        else:
+            attrs = {
+                "dim": [dim] if isinstance(dim, int) else list(dim),
+                "keep_dim": keep_dim,
+                "reduce_all": False,
+            }
+        helper.append_op(op_type, inputs={"X": [input.name]}, outputs={"Out": [out.name]}, attrs=attrs)
+        return out
+
+    f.__name__ = op_type
+    return f
+
+
+reduce_sum = _reduce_layer("reduce_sum")
+reduce_mean = _reduce_layer("reduce_mean")
+reduce_max = _reduce_layer("reduce_max")
+reduce_min = _reduce_layer("reduce_min")
+reduce_prod = _reduce_layer("reduce_prod")
+
+
+def topk(input, k, name=None):
+    helper = LayerHelper("top_k", name=name)
+    shape = tuple(input.shape[:-1]) + (k,) if input.shape is not None else None
+    values = _out(helper, input.dtype, shape=shape)
+    indices = _out(helper, "int64", shape=shape)
+    helper.append_op(
+        "top_k",
+        inputs={"X": [input.name]},
+        outputs={"Out": [values.name], "Indices": [indices.name]},
+        attrs={"k": k},
+    )
+    return values, indices
+
+
+def one_hot(input, depth):
+    helper = LayerHelper("one_hot")
+    out = _out(helper, "float32")
+    helper.append_op(
+        "one_hot", inputs={"X": [input.name]}, outputs={"Out": [out.name]}, attrs={"depth": depth}
+    )
+    return out
+
+
+def clip(x, min, max, name=None):
+    helper = LayerHelper("clip", name=name)
+    out = _out(helper, x.dtype, shape=x.shape)
+    helper.append_op(
+        "clip", inputs={"X": [x.name]}, outputs={"Out": [out.name]}, attrs={"min": min, "max": max}
+    )
+    return out
+
+
+def clip_by_norm(x, max_norm, name=None):
+    helper = LayerHelper("clip_by_norm", name=name)
+    out = _out(helper, x.dtype, shape=x.shape)
+    helper.append_op(
+        "clip_by_norm",
+        inputs={"X": [x.name]},
+        outputs={"Out": [out.name]},
+        attrs={"max_norm": max_norm},
+    )
+    return out
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32", name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    out = _out(helper, dtype, shape=label.shape)
+    inputs = {"X": [label.name]}
+    if prior_dist is not None:
+        inputs["PriorDist"] = [prior_dist.name]
+    helper.append_op(
+        "label_smooth", inputs=inputs, outputs={"Out": [out.name]}, attrs={"epsilon": float(epsilon)}
+    )
+    return out
+
+
+def _elementwise_layer(op_type):
+    def f(x, y, axis=-1, act=None, name=None):
+        helper = LayerHelper(op_type, name=name, act=act)
+        out = _out(helper, x.dtype, shape=x.shape)
+        helper.append_op(
+            op_type,
+            inputs={"X": [x.name], "Y": [y.name]},
+            outputs={"Out": [out.name]},
+            attrs={"axis": axis},
+        )
+        return helper.append_activation(out)
+
+    f.__name__ = op_type
+    return f
+
+
+elementwise_add = _elementwise_layer("elementwise_add")
+elementwise_sub = _elementwise_layer("elementwise_sub")
+elementwise_mul = _elementwise_layer("elementwise_mul")
+elementwise_div = _elementwise_layer("elementwise_div")
+elementwise_max = _elementwise_layer("elementwise_max")
+elementwise_min = _elementwise_layer("elementwise_min")
+elementwise_pow = _elementwise_layer("elementwise_pow")
+
+
+def _act_layer(op_type):
+    def f(x, name=None):
+        helper = LayerHelper(op_type, name=name)
+        out = _out(helper, x.dtype, shape=x.shape)
+        helper.append_op(op_type, inputs={"X": [x.name]}, outputs={"Out": [out.name]})
+        return out
+
+    f.__name__ = op_type
+    return f
+
+
+relu = _act_layer("relu")
+relu6 = _act_layer("relu6")
+sigmoid = _act_layer("sigmoid")
+logsigmoid = _act_layer("logsigmoid")
+tanh = _act_layer("tanh")
+exp = _act_layer("exp")
+log = _act_layer("log")
+sqrt = _act_layer("sqrt")
+abs = _act_layer("abs")
+square = _act_layer("square")
+softplus = _act_layer("softplus")
+softsign = _act_layer("softsign")
+gelu = _act_layer("gelu")
+erf = _act_layer("erf")
+floor = _act_layer("floor")
+ceil = _act_layer("ceil")
+round = _act_layer("round")
+reciprocal = _act_layer("reciprocal")
+sin = _act_layer("sin")
+cos = _act_layer("cos")
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    helper = LayerHelper("leaky_relu", name=name)
+    out = _out(helper, x.dtype, shape=x.shape)
+    helper.append_op(
+        "leaky_relu", inputs={"X": [x.name]}, outputs={"Out": [out.name]}, attrs={"alpha": alpha}
+    )
+    return out
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper("scale", name=name, act=act)
+    out = _out(helper, x.dtype, shape=x.shape)
+    helper.append_op(
+        "scale",
+        inputs={"X": [x.name]},
+        outputs={"Out": [out.name]},
+        attrs={"scale": float(scale), "bias": float(bias), "bias_after_scale": bias_after_scale},
+    )
+    return helper.append_activation(out)
+
+
+def pow(x, factor=1.0, name=None):
+    helper = LayerHelper("pow", name=name)
+    out = _out(helper, x.dtype, shape=x.shape)
+    helper.append_op(
+        "pow", inputs={"X": [x.name]}, outputs={"Out": [out.name]}, attrs={"factor": float(factor)}
+    )
+    return out
+
+
+def squeeze(input, axes, name=None):
+    helper = LayerHelper("squeeze2", name=name)
+    out = _out(helper, input.dtype)
+    xshape = _out(helper, input.dtype)
+    helper.append_op(
+        "squeeze2",
+        inputs={"X": [input.name]},
+        outputs={"Out": [out.name], "XShape": [xshape.name]},
+        attrs={"axes": list(axes)},
+    )
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper("unsqueeze2", name=name)
+    out = _out(helper, input.dtype)
+    xshape = _out(helper, input.dtype)
+    helper.append_op(
+        "unsqueeze2",
+        inputs={"X": [input.name]},
+        outputs={"Out": [out.name], "XShape": [xshape.name]},
+        attrs={"axes": list(axes)},
+    )
+    return out
+
+
+def stack(x, axis=0):
+    helper = LayerHelper("stack")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out = _out(helper, xs[0].dtype)
+    helper.append_op(
+        "stack", inputs={"X": [v.name for v in xs]}, outputs={"Y": [out.name]}, attrs={"axis": axis}
+    )
+    return out
+
+
+def slice(input, axes, starts, ends):
+    helper = LayerHelper("slice")
+    out = _out(helper, input.dtype)
+    helper.append_op(
+        "slice",
+        inputs={"Input": [input.name]},
+        outputs={"Out": [out.name]},
+        attrs={"axes": list(axes), "starts": list(starts), "ends": list(ends)},
+    )
+    return out
+
+
+def dropout_prob_check(p):
+    if not 0 <= p < 1:
+        raise ValueError("dropout prob must be in [0,1)")

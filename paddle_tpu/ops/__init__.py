@@ -1,0 +1,2 @@
+"""Importing this package registers all op lowerings."""
+from . import math_ops, nn_ops, optimizer_ops, tensor_ops  # noqa: F401

@@ -1,0 +1,38 @@
+"""Shared helpers for op lowerings."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtypes import as_np_dtype
+
+
+def first(ins, slot, default=None):
+    vals = ins.get(slot)
+    if not vals:
+        return default
+    return vals[0]
+
+
+def bcast_y_to_x(x, y, axis: int):
+    """Fluid elementwise broadcasting (reference: operators/elementwise/
+    elementwise_op_function.h): Y's dims align to X starting at `axis`
+    (axis=-1 => align trailing, i.e. plain numpy broadcasting)."""
+    if axis == -1 or x.ndim == y.ndim:
+        return y
+    pad_right = x.ndim - axis - y.ndim
+    if pad_right < 0:
+        return y
+    return jnp.reshape(y, (1,) * axis + y.shape + (1,) * pad_right)
+
+
+def np_dtype(attr_dtype):
+    return as_np_dtype(attr_dtype)
+
+
+def normalize_axes(dim, ndim):
+    if dim is None:
+        return tuple(range(ndim))
+    if isinstance(dim, (int, np.integer)):
+        dim = [dim]
+    return tuple(sorted(d % ndim for d in dim))

@@ -1,0 +1,250 @@
+"""Math op lowerings: elementwise, activations, matmul, reductions, compare.
+
+Reference kernels: operators/elementwise/ (4.4k LoC of broadcast+grad code —
+here broadcasting is `bcast_y_to_x` + jnp and grads come from vjp),
+activation_op.cc, mul_op.cc / matmul_op.cc (math/blas.h:81 cuBLAS facade —
+here one jnp call that XLA tiles onto the MXU), reduce_ops/, compare ops
+(operators/controlflow/compare_op.cc).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import bcast_y_to_x, first, normalize_axes
+
+
+# --- elementwise binary ops ------------------------------------------------
+
+def _ew(fn):
+    def lower(ctx, op, ins):
+        x = first(ins, "X")
+        y = bcast_y_to_x(x, first(ins, "Y"), op.attr("axis", -1))
+        return {"Out": fn(x, y)}
+
+    return lower
+
+
+for _name, _fn in {
+    "elementwise_add": jnp.add,
+    "elementwise_sub": jnp.subtract,
+    "elementwise_mul": jnp.multiply,
+    "elementwise_div": jnp.divide,
+    "elementwise_max": jnp.maximum,
+    "elementwise_min": jnp.minimum,
+    "elementwise_pow": jnp.power,
+    "elementwise_mod": jnp.mod,
+    "elementwise_floordiv": jnp.floor_divide,
+}.items():
+    register_op(_name)(_ew(_fn))
+
+
+@register_op("sum")
+def _sum(ctx, op, ins):
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": out}
+
+
+# --- activations -----------------------------------------------------------
+
+_UNARY = {
+    "relu": jax.nn.relu,
+    "relu6": lambda x: jnp.clip(x, 0.0, 6.0),
+    "sigmoid": jax.nn.sigmoid,
+    "logsigmoid": jax.nn.log_sigmoid,
+    "tanh": jnp.tanh,
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "sqrt": jnp.sqrt,
+    "rsqrt": jax.lax.rsqrt,
+    "abs": jnp.abs,
+    "square": jnp.square,
+    "reciprocal": jnp.reciprocal,
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "round": jnp.round,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "gelu": jax.nn.gelu,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "softshrink": lambda x: jnp.where(x > 0.5, x - 0.5, jnp.where(x < -0.5, x + 0.5, 0.0)),
+    "tanh_shrink": lambda x: x - jnp.tanh(x),
+    "erf": jax.lax.erf,
+    "sign": jnp.sign,
+}
+
+def _unary(fn):
+    def lower(ctx, op, ins):
+        return {"Out": fn(first(ins, "X"))}
+
+    return lower
+
+
+for _name, _fn in _UNARY.items():
+    register_op(_name)(_unary(_fn))
+
+
+@register_op("leaky_relu")
+def _leaky_relu(ctx, op, ins):
+    x = first(ins, "X")
+    alpha = op.attr("alpha", 0.02)
+    return {"Out": jnp.where(x >= 0, x, alpha * x)}
+
+
+@register_op("elu")
+def _elu(ctx, op, ins):
+    return {"Out": jax.nn.elu(first(ins, "X"), alpha=op.attr("alpha", 1.0))}
+
+
+@register_op("hard_sigmoid")
+def _hard_sigmoid(ctx, op, ins):
+    x = first(ins, "X")
+    slope = op.attr("slope", 0.2)
+    offset = op.attr("offset", 0.5)
+    return {"Out": jnp.clip(slope * x + offset, 0.0, 1.0)}
+
+
+@register_op("swish")
+def _swish(ctx, op, ins):
+    x = first(ins, "X")
+    beta = op.attr("beta", 1.0)
+    return {"Out": x * jax.nn.sigmoid(beta * x)}
+
+
+@register_op("pow")
+def _pow(ctx, op, ins):
+    return {"Out": jnp.power(first(ins, "X"), op.attr("factor", 1.0))}
+
+
+@register_op("clip")
+def _clip(ctx, op, ins):
+    x = first(ins, "X")
+    return {"Out": jnp.clip(x, op.attr("min"), op.attr("max"))}
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ctx, op, ins):
+    x = first(ins, "X")
+    max_norm = op.attr("max_norm")
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return {"Out": jnp.where(norm > max_norm, x * (max_norm / norm), x)}
+
+
+# --- matmul family (the MXU path) -----------------------------------------
+
+@register_op("mul")
+def _mul(ctx, op, ins):
+    """reference operators/mul_op.cc: flatten x to 2-D at x_num_col_dims,
+    y at y_num_col_dims, then GEMM."""
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    xd = op.attr("x_num_col_dims", 1)
+    yd = op.attr("y_num_col_dims", 1)
+    import numpy as _np
+
+    xs, ys = x.shape, y.shape
+    x2 = x if x.ndim == 2 else jnp.reshape(x, (int(_np.prod(xs[:xd])), int(_np.prod(xs[xd:]))))
+    y2 = y if y.ndim == 2 else jnp.reshape(y, (int(_np.prod(ys[:yd])), int(_np.prod(ys[yd:]))))
+    out = jnp.matmul(x2, y2)
+    out_shape = xs[:xd] + ys[yd:]
+    return {"Out": jnp.reshape(out, out_shape)}
+
+
+@register_op("matmul")
+def _matmul(ctx, op, ins):
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    if op.attr("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2)
+    if op.attr("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    alpha = op.attr("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": out}
+
+
+# --- reductions ------------------------------------------------------------
+
+def _reduce(fn):
+    def lower(ctx, op, ins):
+        x = first(ins, "X")
+        if op.attr("reduce_all", False):
+            axes = tuple(range(x.ndim))
+        else:
+            axes = normalize_axes(op.attr("dim", [0]), x.ndim)
+        keep = op.attr("keep_dim", False)
+        return {"Out": fn(x, axis=axes, keepdims=keep)}
+
+    return lower
+
+
+for _name, _fn in {
+    "reduce_sum": jnp.sum,
+    "reduce_mean": jnp.mean,
+    "reduce_max": jnp.max,
+    "reduce_min": jnp.min,
+    "reduce_prod": jnp.prod,
+}.items():
+    register_op(_name)(_reduce(_fn))
+
+
+@register_op("mean")
+def _mean(ctx, op, ins):
+    # reference mean_op.cc produces a (1,) tensor
+    return {"Out": jnp.mean(first(ins, "X")).reshape((1,))}
+
+
+@register_op("frobenius_norm")
+def _frobenius_norm(ctx, op, ins):
+    x = first(ins, "X")
+    return {"Out": jnp.sqrt(jnp.sum(jnp.square(x)))}
+
+
+# --- compare / logical -----------------------------------------------------
+
+def _cmp(fn):
+    def lower(ctx, op, ins):
+        x = first(ins, "X")
+        y = bcast_y_to_x(x, first(ins, "Y"), op.attr("axis", -1))
+        return {"Out": fn(x, y)}
+
+    return lower
+
+
+for _name, _fn in {
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+    "less_than": jnp.less,
+    "less_equal": jnp.less_equal,
+    "greater_than": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+}.items():
+    register_op(_name)(_cmp(_fn))
+
+
+@register_op("logical_and")
+def _logical_and(ctx, op, ins):
+    return {"Out": jnp.logical_and(first(ins, "X"), first(ins, "Y"))}
+
+
+@register_op("logical_or")
+def _logical_or(ctx, op, ins):
+    return {"Out": jnp.logical_or(first(ins, "X"), first(ins, "Y"))}
+
+
+@register_op("logical_not")
+def _logical_not(ctx, op, ins):
+    return {"Out": jnp.logical_not(first(ins, "X"))}
+
+
+@register_op("isfinite")
+def _isfinite(ctx, op, ins):
+    # reference isfinite_op.cc reduces to a single bool
+    return {"Out": jnp.all(jnp.isfinite(first(ins, "X"))).reshape((1,))}

@@ -1,0 +1,366 @@
+"""NN op lowerings: conv, pool, norms, dropout, losses, embedding, topk.
+
+Reference kernels: conv_cudnn_op.cu.cc / conv_op.cc, pool_op.cc,
+batch_norm_op.cc, layer_norm_op.cc, dropout_op.cc, cross_entropy_op.cc,
+softmax_with_cross_entropy_op.cc, lookup_table_op.cc, top_k_op.cc.
+
+Convs use lax.conv_general_dilated with NCHW logical layout (the public
+fluid layout); XLA relayouts to what the MXU wants, so no manual NHWC
+shuffling is needed at this level.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .common import first
+
+
+@register_op("conv2d")
+def _conv2d(ctx, op, ins):
+    x = first(ins, "Input")
+    w = first(ins, "Filter")
+    strides = tuple(op.attr("strides", [1, 1]))
+    pads = op.attr("paddings", [0, 0])
+    dilations = tuple(op.attr("dilations", [1, 1]))
+    groups = op.attr("groups", 1) or 1
+    padding = [(pads[0], pads[0]), (pads[1], pads[1])]
+    out = jax.lax.conv_general_dilated(
+        x,
+        w,
+        window_strides=strides,
+        padding=padding,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+    return {"Output": out}
+
+
+@register_op("depthwise_conv2d")
+def _depthwise_conv2d(ctx, op, ins):
+    return _conv2d(ctx, op, ins)
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ctx, op, ins):
+    x = first(ins, "Input")
+    w = first(ins, "Filter")  # fluid layout: (in, out, kh, kw)
+    strides = tuple(op.attr("strides", [1, 1]))
+    pads = op.attr("paddings", [0, 0])
+    dilations = tuple(op.attr("dilations", [1, 1]))
+    groups = op.attr("groups", 1) or 1
+    kh, kw = w.shape[2], w.shape[3]
+    # conv_transpose == lhs-dilated conv with flipped kernel
+    pad_h = dilations[0] * (kh - 1) - pads[0]
+    pad_w = dilations[1] * (kw - 1) - pads[1]
+    wt = jnp.flip(w, axis=(2, 3))
+    if groups > 1:
+        # fluid filter layout (in, out/groups, kh, kw) -> grouped OIHW:
+        # per group swap (in/groups, out/groups) then stack groups on O
+        cin, cog = w.shape[0], w.shape[1]
+        wt = wt.reshape(groups, cin // groups, cog, kh, kw)
+        wt = jnp.swapaxes(wt, 1, 2)  # (g, out/g, in/g, kh, kw)
+        wt = wt.reshape(groups * cog, cin // groups, kh, kw)
+    else:
+        wt = jnp.swapaxes(wt, 0, 1)  # -> (out, in, kh, kw)
+    out = jax.lax.conv_general_dilated(
+        x,
+        wt,
+        window_strides=(1, 1),
+        padding=[(pad_h, pad_h), (pad_w, pad_w)],
+        lhs_dilation=strides,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+    return {"Output": out}
+
+
+@register_op("pool2d")
+def _pool2d(ctx, op, ins):
+    x = first(ins, "X")
+    ptype = op.attr("pooling_type", "max")
+    ksize = list(op.attr("ksize", [2, 2]))
+    strides = list(op.attr("strides", [1, 1]))
+    pads = list(op.attr("paddings", [0, 0]))
+    if op.attr("global_pooling", False):
+        ksize = [x.shape[2], x.shape[3]]
+        strides = [1, 1]
+        pads = [0, 0]
+    window = (1, 1, ksize[0], ksize[1])
+    strides4 = (1, 1, strides[0], strides[1])
+    pad_hi = [pads[0], pads[1]]
+    if op.attr("ceil_mode", False):
+        # extra low-side... high-side padding so the window count rounds up
+        for d in (0, 1):
+            in_sz = x.shape[2 + d]
+            out_floor = (in_sz + 2 * pads[d] - ksize[d]) // strides[d] + 1
+            out_ceil = -(-(in_sz + 2 * pads[d] - ksize[d]) // strides[d]) + 1
+            pad_hi[d] += (out_ceil - out_floor) * strides[d]
+    padding = ((0, 0), (0, 0), (pads[0], pad_hi[0]), (pads[1], pad_hi[1]))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides4, padding)
+    else:
+        summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides4, padding)
+        if op.attr("exclusive", True) and (pads[0] or pads[1]):
+            ones = jnp.ones_like(x)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides4, padding)
+            out = summed / counts
+        else:
+            out = summed / float(ksize[0] * ksize[1])
+    return {"Out": out}
+
+
+@register_op("batch_norm")
+def _batch_norm(ctx, op, ins):
+    x = first(ins, "X")
+    scale = first(ins, "Scale")
+    bias = first(ins, "Bias")
+    mean_in = first(ins, "Mean")
+    var_in = first(ins, "Variance")
+    eps = op.attr("epsilon", 1e-5)
+    momentum = op.attr("momentum", 0.9)
+    is_test = op.attr("is_test", False)
+    layout = op.attr("data_layout", "NCHW")
+    ch_axis = 1 if layout == "NCHW" else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x.shape[ch_axis]
+
+    if is_test or op.attr("use_global_stats", False):
+        mean, var = mean_in, var_in
+        saved_mean, saved_var = mean_in, var_in
+        mean_out, var_out = mean_in, var_in
+    else:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        mean_out = momentum * mean_in + (1.0 - momentum) * mean
+        var_out = momentum * var_in + (1.0 - momentum) * var
+        saved_mean, saved_var = mean, var
+
+    inv = jax.lax.rsqrt(var.reshape(bshape) + eps)
+    y = (x - mean.reshape(bshape)) * inv * scale.reshape(bshape) + bias.reshape(bshape)
+    return {
+        "Y": y,
+        "MeanOut": mean_out,
+        "VarianceOut": var_out,
+        "SavedMean": saved_mean,
+        "SavedVariance": saved_var,
+    }
+
+
+@register_op("layer_norm")
+def _layer_norm(ctx, op, ins):
+    x = first(ins, "X")
+    scale = first(ins, "Scale")
+    bias = first(ins, "Bias")
+    eps = op.attr("epsilon", 1e-5)
+    begin = op.attr("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    import numpy as _np
+
+    norm_shape = (1,) * begin + tuple(x.shape[begin:])
+    if scale is not None:
+        y = y * scale.reshape(norm_shape)
+    if bias is not None:
+        y = y + bias.reshape(norm_shape)
+    return {
+        "Y": y,
+        "Mean": mean.reshape(x.shape[:begin]),
+        "Variance": var.reshape(x.shape[:begin]),
+    }
+
+
+@register_op("dropout")
+def _dropout(ctx, op, ins):
+    x = first(ins, "X")
+    p = op.attr("dropout_prob", 0.5)
+    impl = op.attr("dropout_implementation", "downgrade_in_infer")
+    if op.attr("is_test", False):
+        if impl == "upscale_in_train":
+            return {"Out": x, "Mask": jnp.ones_like(x)}
+        return {"Out": x * (1.0 - p), "Mask": jnp.ones_like(x)}
+    key = ctx.next_key() if not op.attr("fix_seed", False) else jax.random.PRNGKey(op.attr("seed", 0))
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    mask = keep.astype(x.dtype)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    else:
+        out = x * mask
+    return {"Out": out, "Mask": mask}
+
+
+@register_op("softmax")
+def _softmax(ctx, op, ins):
+    x = first(ins, "X")
+    axis = op.attr("axis", -1)
+    return {"Out": jax.nn.softmax(x, axis=axis)}
+
+
+@register_op("log_softmax")
+def _log_softmax(ctx, op, ins):
+    return {"Out": jax.nn.log_softmax(first(ins, "X"), axis=op.attr("axis", -1))}
+
+
+@register_op("cross_entropy")
+def _cross_entropy(ctx, op, ins):
+    """reference cross_entropy_op.cc: input is a probability distribution."""
+    x = first(ins, "X")
+    label = first(ins, "Label")
+    if op.attr("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(jnp.clip(x, 1e-20)), axis=-1, keepdims=True)
+        return {"Y": loss}
+    idx = label if label.ndim == x.ndim and label.shape[-1] == 1 else label[..., None]
+    picked = jnp.take_along_axis(x, idx.astype(jnp.int32), axis=-1)
+    loss = -jnp.log(jnp.clip(picked, 1e-20))
+    ignore = op.attr("ignore_index", -100)
+    loss = jnp.where(idx == ignore, 0.0, loss)
+    return {"Y": loss}
+
+
+@register_op("softmax_with_cross_entropy")
+def _softmax_with_cross_entropy(ctx, op, ins):
+    logits = first(ins, "Logits")
+    label = first(ins, "Label")
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    softmax = jnp.exp(logp)
+    if op.attr("soft_label", False):
+        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+    else:
+        idx = label if label.shape[-1] == 1 else label[..., None]
+        picked = jnp.take_along_axis(logp, idx.astype(jnp.int32), axis=-1)
+        loss = -picked
+        ignore = op.attr("ignore_index", -100)
+        loss = jnp.where(idx == ignore, 0.0, loss)
+    return {"Loss": loss, "Softmax": softmax}
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def _sigmoid_ce(ctx, op, ins):
+    x = first(ins, "X")
+    label = first(ins, "Label")
+    # max(x,0) - x*z + log(1+exp(-|x|))
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore = op.attr("ignore_index", -100)
+    loss = jnp.where(label == ignore, 0.0, loss)
+    if op.attr("normalize", False):
+        n = jnp.maximum(jnp.sum((label != ignore).astype(x.dtype)), 1.0)
+        loss = loss / n
+    return {"Out": loss}
+
+
+@register_op("square_error_cost")
+def _square_error_cost(ctx, op, ins):
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    return {"Out": jnp.square(x - y)}
+
+
+@register_op("huber_loss")
+def _huber_loss(ctx, op, ins):
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    d = op.attr("delta", 1.0)
+    r = y - x
+    a = jnp.abs(r)
+    loss = jnp.where(a <= d, 0.5 * r * r, d * (a - 0.5 * d))
+    return {"Out": loss, "Residual": r}
+
+
+@register_op("lookup_table")
+def _lookup_table(ctx, op, ins):
+    """reference lookup_table_op.cc; ids have trailing dim 1."""
+    w = first(ins, "W")
+    ids = first(ins, "Ids")
+    flat = ids.reshape(ids.shape[:-1]) if ids.shape and ids.shape[-1] == 1 else ids
+    out = jnp.take(w, flat.astype(jnp.int32), axis=0)
+    pad = op.attr("padding_idx", None)
+    if pad is not None:
+        real_pad = pad if pad >= 0 else w.shape[0] + pad
+        out = jnp.where((flat == real_pad)[..., None], 0.0, out)
+    return {"Out": out}
+
+
+register_op("lookup_table_v2")(_lookup_table)
+
+
+@register_op("top_k")
+def _top_k(ctx, op, ins):
+    x = first(ins, "X")
+    k = op.attr("k", 1)
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("arg_max")
+def _arg_max(ctx, op, ins):
+    x = first(ins, "X")
+    axis = op.attr("axis", -1)
+    return {"Out": jnp.argmax(x, axis=axis).astype(jnp.int64)}
+
+
+@register_op("arg_min")
+def _arg_min(ctx, op, ins):
+    x = first(ins, "X")
+    return {"Out": jnp.argmin(x, axis=op.attr("axis", -1)).astype(jnp.int64)}
+
+
+@register_op("accuracy")
+def _accuracy(ctx, op, ins):
+    """reference metrics/accuracy_op.cc: Out/Indices from top_k + Label."""
+    indices = first(ins, "Indices")
+    label = first(ins, "Label")
+    correct_any = jnp.any(indices == label.astype(indices.dtype), axis=-1)
+    num_correct = jnp.sum(correct_any.astype(jnp.int32))
+    total = indices.shape[0]
+    acc = num_correct.astype(jnp.float32) / float(total)
+    return {
+        "Accuracy": acc.reshape((1,)),
+        "Correct": num_correct.reshape((1,)),
+        "Total": jnp.full((1,), total, dtype=jnp.int32),
+    }
+
+
+@register_op("label_smooth")
+def _label_smooth(ctx, op, ins):
+    x = first(ins, "X")
+    eps = op.attr("epsilon", 0.1)
+    prior = first(ins, "PriorDist")
+    if prior is not None:
+        out = (1.0 - eps) * x + eps * prior
+    else:
+        out = (1.0 - eps) * x + eps / x.shape[-1]
+    return {"Out": out}
+
+
+@register_op("smooth_l1_loss")
+def _smooth_l1(ctx, op, ins):
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    sigma = op.attr("sigma", 1.0)
+    s2 = sigma * sigma
+    d = x - y
+    a = jnp.abs(d)
+    elem = jnp.where(a < 1.0 / s2, 0.5 * s2 * d * d, a - 0.5 / s2)
+    return {"Out": jnp.sum(elem, axis=tuple(range(1, x.ndim)), keepdims=False).reshape(-1, 1), "Diff": d}
+
+
+@register_op("prelu")
+def _prelu(ctx, op, ins):
+    x = first(ins, "X")
+    alpha = first(ins, "Alpha")
+    mode = op.attr("mode", "all")
+    if mode == "channel":
+        a = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    elif mode == "element":
+        a = alpha.reshape((1,) + x.shape[1:])
+    else:
+        a = alpha.reshape(())
+    return {"Out": jnp.where(x > 0, x, a * x)}

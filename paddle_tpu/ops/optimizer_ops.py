@@ -1,0 +1,187 @@
+"""Optimizer update op lowerings.
+
+Reference kernels: operators/optimizers/{sgd,momentum,adam,adagrad,rmsprop,
+adamax,adadelta,ftrl,lamb}_op.cc.  Each is a pure function from
+(param, grad, accumulators, lr) to updated values; the executor fuses all
+per-param updates into the same XLA program as the backward pass, which is
+what the reference's fuse_sgd/fuse_adam build passes approximated.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first
+
+
+def _lr(ins):
+    lr = first(ins, "LearningRate")
+    return lr.reshape(()) if lr.ndim else lr
+
+
+@register_op("sgd")
+def _sgd(ctx, op, ins):
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    return {"ParamOut": p - _lr(ins) * g}
+
+
+@register_op("momentum")
+def _momentum(ctx, op, ins):
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    v = first(ins, "Velocity")
+    mu = op.attr("mu", 0.9)
+    lr = _lr(ins)
+    v_new = mu * v + g
+    if op.attr("use_nesterov", False):
+        p_new = p - lr * (g + mu * v_new)
+    else:
+        p_new = p - lr * v_new
+    return {"ParamOut": p_new, "VelocityOut": v_new}
+
+
+@register_op("adam")
+def _adam(ctx, op, ins):
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    m1 = first(ins, "Moment1")
+    m2 = first(ins, "Moment2")
+    b1p = first(ins, "Beta1Pow").reshape(())
+    b2p = first(ins, "Beta2Pow").reshape(())
+    beta1 = op.attr("beta1", 0.9)
+    beta2 = op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-8)
+    lr = _lr(ins)
+    m1n = beta1 * m1 + (1.0 - beta1) * g
+    m2n = beta2 * m2 + (1.0 - beta2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+    p_new = p - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+    return {
+        "ParamOut": p_new,
+        "Moment1Out": m1n,
+        "Moment2Out": m2n,
+        "Beta1PowOut": (b1p * beta1).reshape((1,)),
+        "Beta2PowOut": (b2p * beta2).reshape((1,)),
+    }
+
+
+@register_op("adagrad")
+def _adagrad(ctx, op, ins):
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    moment = first(ins, "Moment")
+    eps = op.attr("epsilon", 1e-6)
+    lr = _lr(ins)
+    m_new = moment + jnp.square(g)
+    p_new = p - lr * g / (jnp.sqrt(m_new) + eps)
+    return {"ParamOut": p_new, "MomentOut": m_new}
+
+
+@register_op("rmsprop")
+def _rmsprop(ctx, op, ins):
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    ms = first(ins, "MeanSquare")
+    mg = first(ins, "MeanGrad")
+    mom = first(ins, "Moment")
+    rho = op.attr("decay", 0.95)
+    eps = op.attr("epsilon", 1e-6)
+    momentum = op.attr("momentum", 0.0)
+    centered = op.attr("centered", False)
+    lr = _lr(ins)
+    ms_new = rho * ms + (1.0 - rho) * jnp.square(g)
+    if centered:
+        mg_new = rho * mg + (1.0 - rho) * g
+        denom = jnp.sqrt(ms_new - jnp.square(mg_new) + eps)
+    else:
+        mg_new = mg
+        denom = jnp.sqrt(ms_new + eps)
+    mom_new = momentum * mom + lr * g / denom
+    return {
+        "ParamOut": p - mom_new,
+        "MeanSquareOut": ms_new,
+        "MeanGradOut": mg_new,
+        "MomentOut": mom_new,
+    }
+
+
+@register_op("adamax")
+def _adamax(ctx, op, ins):
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    m = first(ins, "Moment")
+    inf_norm = first(ins, "InfNorm")
+    b1p = first(ins, "Beta1Pow").reshape(())
+    beta1 = op.attr("beta1", 0.9)
+    beta2 = op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-8)
+    lr = _lr(ins)
+    m_new = beta1 * m + (1.0 - beta1) * g
+    inf_new = jnp.maximum(beta2 * inf_norm, jnp.abs(g))
+    lr_t = lr / (1.0 - b1p)
+    p_new = p - lr_t * m_new / (inf_new + eps)
+    return {"ParamOut": p_new, "MomentOut": m_new, "InfNormOut": inf_new}
+
+
+@register_op("adadelta")
+def _adadelta(ctx, op, ins):
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    avg_sq_grad = first(ins, "AvgSquaredGrad")
+    avg_sq_upd = first(ins, "AvgSquaredUpdate")
+    rho = op.attr("rho", 0.95)
+    eps = op.attr("epsilon", 1e-6)
+    g2 = rho * avg_sq_grad + (1.0 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_upd + eps) / (g2 + eps)) * g
+    u2 = rho * avg_sq_upd + (1.0 - rho) * jnp.square(update)
+    return {"ParamOut": p + update, "AvgSquaredGradOut": g2, "AvgSquaredUpdateOut": u2}
+
+
+@register_op("lamb")
+def _lamb(ctx, op, ins):
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    m1 = first(ins, "Moment1")
+    m2 = first(ins, "Moment2")
+    b1p = first(ins, "Beta1Pow").reshape(())
+    b2p = first(ins, "Beta2Pow").reshape(())
+    beta1 = op.attr("beta1", 0.9)
+    beta2 = op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-6)
+    wd = op.attr("weight_decay", 0.0)
+    lr = _lr(ins)
+    m1n = beta1 * m1 + (1.0 - beta1) * g
+    m2n = beta2 * m2 + (1.0 - beta2) * jnp.square(g)
+    mhat = m1n / (1.0 - b1p)
+    vhat = m2n / (1.0 - b2p)
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * p
+    w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    return {
+        "ParamOut": p - lr * ratio * r,
+        "Moment1Out": m1n,
+        "Moment2Out": m2n,
+        "Beta1PowOut": (b1p * beta1).reshape((1,)),
+        "Beta2PowOut": (b2p * beta2).reshape((1,)),
+    }
+
+
+@register_op("ftrl")
+def _ftrl(ctx, op, ins):
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    sq = first(ins, "SquaredAccumulator")
+    lin = first(ins, "LinearAccumulator")
+    l1 = op.attr("l1", 0.0)
+    l2 = op.attr("l2", 0.0)
+    lr_power = op.attr("lr_power", -0.5)
+    lr = _lr(ins)
+    new_sq = sq + jnp.square(g)
+    sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    new_lin = lin + g - sigma * p
+    quad = jnp.power(new_sq, -lr_power) / lr + 2.0 * l2
+    pre = jnp.clip(new_lin, -l1, l1) - new_lin
+    p_new = jnp.where(jnp.abs(new_lin) > l1, pre / quad, jnp.zeros_like(p))
+    return {"ParamOut": p_new, "SquaredAccumOut": new_sq, "LinearAccumOut": new_lin}

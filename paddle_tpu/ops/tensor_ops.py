@@ -1,0 +1,260 @@
+"""Tensor creation / manipulation op lowerings.
+
+Reference kernels: operators/fill_constant_op.cc, uniform_random_op.cc,
+gaussian_random_op.cc, cast_op.cc, reshape_op.cc, transpose_op.cc,
+concat_op.cc, split_op.cc, assign_op.cc, scale_op.cc, slice_op.cc, etc.
+Each maps to a jnp/lax call; XLA owns codegen.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .common import first, np_dtype
+
+
+@register_op("fill_constant")
+def _fill_constant(ctx, op, ins):
+    shape = tuple(op.attr("shape", []))
+    dtype = np_dtype(op.attr("dtype", "float32"))
+    value = op.attr("value", 0.0)
+    return {"Out": jnp.full(shape, value, dtype=dtype)}
+
+
+@register_op("uniform_random")
+def _uniform_random(ctx, op, ins):
+    shape = tuple(op.attr("shape"))
+    dtype = np_dtype(op.attr("dtype", "float32"))
+    lo = op.attr("min", -1.0)
+    hi = op.attr("max", 1.0)
+    key = _op_key(ctx, op)
+    return {"Out": jax.random.uniform(key, shape, dtype=jnp.float32, minval=lo, maxval=hi).astype(dtype)}
+
+
+@register_op("gaussian_random")
+def _gaussian_random(ctx, op, ins):
+    shape = tuple(op.attr("shape"))
+    dtype = np_dtype(op.attr("dtype", "float32"))
+    mean = op.attr("mean", 0.0)
+    std = op.attr("std", 1.0)
+    key = _op_key(ctx, op)
+    return {"Out": (mean + std * jax.random.normal(key, shape, dtype=jnp.float32)).astype(dtype)}
+
+
+@register_op("truncated_gaussian_random")
+def _truncated_gaussian_random(ctx, op, ins):
+    shape = tuple(op.attr("shape"))
+    dtype = np_dtype(op.attr("dtype", "float32"))
+    mean = op.attr("mean", 0.0)
+    std = op.attr("std", 1.0)
+    key = _op_key(ctx, op)
+    # reference truncates at 2 std (truncated_gaussian_random_op.cc)
+    z = jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype=jnp.float32)
+    return {"Out": (mean + std * z).astype(dtype)}
+
+
+def _op_key(ctx, op):
+    """Per-op RNG: an op-level seed attr pins the stream (reference ops all
+    take a `seed` attr); otherwise consume the threaded scope key."""
+    seed = op.attr("seed", 0)
+    if seed:
+        return jax.random.PRNGKey(seed)
+    return ctx.next_key()
+
+
+@register_op("cast")
+def _cast(ctx, op, ins):
+    x = first(ins, "X")
+    return {"Out": x.astype(np_dtype(op.attr("out_dtype", op.attr("dtype", "float32"))))}
+
+
+@register_op("reshape2")
+def _reshape2(ctx, op, ins):
+    x = first(ins, "X")
+    shape = list(op.attr("shape"))
+    # fluid semantics: 0 copies the input dim, -1 infers (reshape_op.cc)
+    out_shape = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            out_shape.append(x.shape[i])
+        else:
+            out_shape.append(s)
+    return {"Out": jnp.reshape(x, out_shape), "XShape": jnp.zeros((0,) + x.shape, dtype=x.dtype)}
+
+
+@register_op("reshape")
+def _reshape(ctx, op, ins):
+    out = _reshape2(ctx, op, ins)
+    return {"Out": out["Out"]}
+
+
+@register_op("transpose2")
+def _transpose2(ctx, op, ins):
+    x = first(ins, "X")
+    axis = op.attr("axis")
+    return {"Out": jnp.transpose(x, axis), "XShape": jnp.zeros((0,) + x.shape, dtype=x.dtype)}
+
+
+@register_op("transpose")
+def _transpose(ctx, op, ins):
+    return {"Out": _transpose2(ctx, op, ins)["Out"]}
+
+
+@register_op("concat")
+def _concat(ctx, op, ins):
+    xs = ins["X"]
+    return {"Out": jnp.concatenate(xs, axis=op.attr("axis", 0))}
+
+
+@register_op("split")
+def _split(ctx, op, ins):
+    x = first(ins, "X")
+    axis = op.attr("axis", 0)
+    num = op.attr("num", 0)
+    sections = op.attr("sections", [])
+    if num:
+        parts = jnp.split(x, num, axis=axis)
+    else:
+        idx = np.cumsum(sections[:-1]).tolist()
+        parts = jnp.split(x, idx, axis=axis)
+    return {"Out": parts}
+
+
+@register_op("assign")
+def _assign(ctx, op, ins):
+    return {"Out": first(ins, "X")}
+
+
+@register_op("scale")
+def _scale(ctx, op, ins):
+    x = first(ins, "X")
+    scale = op.attr("scale", 1.0)
+    bias = op.attr("bias", 0.0)
+    if op.attr("bias_after_scale", True):
+        return {"Out": x * scale + bias}
+    return {"Out": (x + bias) * scale}
+
+
+@register_op("shape")
+def _shape(ctx, op, ins):
+    x = first(ins, "Input")
+    return {"Out": jnp.asarray(x.shape, dtype=jnp.int32)}
+
+
+@register_op("slice")
+def _slice(ctx, op, ins):
+    x = first(ins, "Input")
+    axes = op.attr("axes")
+    starts = op.attr("starts")
+    ends = op.attr("ends")
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = slice(st, en)
+    return {"Out": x[tuple(idx)]}
+
+
+@register_op("expand")
+def _expand(ctx, op, ins):
+    x = first(ins, "X")
+    times = op.attr("expand_times")
+    return {"Out": jnp.tile(x, times)}
+
+
+@register_op("stack")
+def _stack(ctx, op, ins):
+    return {"Y": jnp.stack(ins["X"], axis=op.attr("axis", 0))}
+
+
+@register_op("unstack")
+def _unstack(ctx, op, ins):
+    x = first(ins, "X")
+    axis = op.attr("axis", 0)
+    n = x.shape[axis]
+    parts = [jnp.squeeze(p, axis=axis) for p in jnp.split(x, n, axis=axis)]
+    return {"Y": parts}
+
+
+@register_op("squeeze2")
+def _squeeze2(ctx, op, ins):
+    x = first(ins, "X")
+    axes = op.attr("axes", [])
+    if axes:
+        out = jnp.squeeze(x, axis=tuple(a % x.ndim for a in axes))
+    else:
+        out = jnp.squeeze(x)
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, dtype=x.dtype)}
+
+
+@register_op("squeeze")
+def _squeeze(ctx, op, ins):
+    return {"Out": _squeeze2(ctx, op, ins)["Out"]}
+
+
+@register_op("unsqueeze2")
+def _unsqueeze2(ctx, op, ins):
+    x = first(ins, "X")
+    out = x
+    for a in sorted(op.attr("axes")):
+        out = jnp.expand_dims(out, a)
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, dtype=x.dtype)}
+
+
+@register_op("unsqueeze")
+def _unsqueeze(ctx, op, ins):
+    return {"Out": _unsqueeze2(ctx, op, ins)["Out"]}
+
+
+@register_op("gather")
+def _gather(ctx, op, ins):
+    x = first(ins, "X")
+    index = first(ins, "Index")
+    return {"Out": jnp.take(x, index.reshape(-1), axis=0)}
+
+
+@register_op("one_hot")
+def _one_hot(ctx, op, ins):
+    x = first(ins, "X")
+    depth = op.attr("depth")
+    flat = x.reshape(x.shape[:-1]) if x.shape and x.shape[-1] == 1 else x
+    return {"Out": jax.nn.one_hot(flat, depth, dtype=jnp.float32)}
+
+
+@register_op("pad")
+def _pad(ctx, op, ins):
+    x = first(ins, "X")
+    paddings = op.attr("paddings")  # flat [before0, after0, before1, ...]
+    value = op.attr("pad_value", 0.0)
+    cfg = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": jnp.pad(x, cfg, constant_values=value)}
+
+
+@register_op("assign_value")
+def _assign_value(ctx, op, ins):
+    values = op.attr("values")
+    dtype = np_dtype(op.attr("dtype", "float32"))
+    arr = np.asarray(values).astype(dtype)
+    shape = op.attr("shape")
+    if shape:
+        arr = arr.reshape(shape)
+    return {"Out": jnp.asarray(arr)}
+
+
+@register_op("fill_zeros_like")
+def _fill_zeros_like(ctx, op, ins):
+    return {"Out": jnp.zeros_like(first(ins, "X"))}
+
+
+@register_op("range")
+def _range(ctx, op, ins):
+    start = first(ins, "Start")
+    end = first(ins, "End")
+    step = first(ins, "Step")
+    # static-shape path: attrs carry python scalars when available
+    s = op.attr("start_v", None)
+    e = op.attr("end_v", None)
+    st = op.attr("step_v", None)
+    if s is not None:
+        return {"Out": jnp.arange(s, e, st, dtype=start.dtype if start is not None else jnp.int64)}
+    return {"Out": jnp.arange(int(start), int(end), int(step))}

@@ -1,0 +1,398 @@
+"""Optimizers (reference: python/paddle/fluid/optimizer.py:50).
+
+`minimize()` = append_backward (one functional-vjp backward op) + one update
+op per parameter; accumulators are persistable vars initialized in the
+startup program.  The whole fwd+bwd+update chain lowers to a single XLA
+program, so the reference's fuse_adam/fuse_sgd/fuse_all_reduce build passes
+have no equivalent here — XLA fusion subsumes them.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .core import unique_name
+from .core.autodiff import append_backward
+from .core.dtypes import canonical_dtype
+from .core.program import Parameter, Program, Variable, default_main_program, default_startup_program
+from .core.regularizer import append_regularization_ops
+
+
+class Optimizer:
+    _accumulator_prefix = "accum"
+
+    def __init__(self, learning_rate, regularization=None, name=None):
+        self.regularization = regularization
+        self._name = name
+        self._learning_rate = learning_rate
+        self._lr_var: Optional[Variable] = None
+        self._accumulators: Dict[str, Dict[str, Variable]] = {}
+
+    # --- learning rate ---------------------------------------------------
+    def _create_global_learning_rate(self):
+        if self._lr_var is not None:
+            return
+        if isinstance(self._learning_rate, Variable):
+            self._lr_var = self._learning_rate
+            return
+        name = unique_name.generate("learning_rate")
+        main_block = default_main_program().global_block()
+        self._lr_var = main_block.create_var(name, shape=(1,), dtype="float32", persistable=True)
+        startup = default_startup_program().global_block()
+        startup.create_var(name, shape=(1,), dtype="float32", persistable=True)
+        startup.append_op(
+            "fill_constant",
+            outputs={"Out": [name]},
+            attrs={"shape": [1], "dtype": "float32", "value": float(self._learning_rate)},
+        )
+
+    @property
+    def lr_var(self):
+        return self._lr_var
+
+    # --- accumulators ----------------------------------------------------
+    def _add_accumulator(self, name: str, param: Parameter, fill_value: float = 0.0,
+                         shape=None, dtype=None):
+        if name in self._accumulators and param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        var_name = f"{param.name}_{name}_0"
+        shape = list(shape if shape is not None else param.shape)
+        dtype = canonical_dtype(dtype or param.dtype)
+        main_block = default_main_program().global_block()
+        v = main_block.create_var(var_name, shape=shape, dtype=dtype, persistable=True)
+        startup = default_startup_program().global_block()
+        startup.create_var(var_name, shape=shape, dtype=dtype, persistable=True)
+        startup.append_op(
+            "fill_constant",
+            outputs={"Out": [var_name]},
+            attrs={"shape": shape, "dtype": dtype, "value": float(fill_value)},
+        )
+        self._accumulators.setdefault(name, {})[param.name] = v
+        return v
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # --- hooks subclasses implement --------------------------------------
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _finish_update(self, block, params_grads):
+        pass
+
+    # --- public API -------------------------------------------------------
+    def apply_gradients(self, params_grads) -> List:
+        block = default_main_program().global_block()
+        self._create_global_learning_rate()
+        params_grads = append_regularization_ops(params_grads, self.regularization)
+        self._create_accumulators(block, [p for p, _ in params_grads])
+        ops = []
+        for pg in params_grads:
+            ops.append(self._append_optimize_op(block, pg))
+        self._finish_update(block, params_grads)
+        return ops
+
+    def backward(self, loss, startup_program=None, parameter_list=None, no_grad_set=None,
+                 callbacks=None):
+        return append_backward(loss, parameter_list, no_grad_set, callbacks)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        params_grads = self.backward(loss, startup_program, parameter_list, no_grad_set)
+        optimize_ops = self.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "sgd",
+            inputs={"Param": [p.name], "Grad": [g.name], "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name]},
+        )
+
+
+class MomentumOptimizer(Optimizer):
+    def __init__(self, learning_rate, momentum, use_nesterov=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("velocity", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "momentum",
+            inputs={
+                "Param": [p.name],
+                "Grad": [g.name],
+                "Velocity": [v.name],
+                "LearningRate": [self._lr_var.name],
+            },
+            outputs={"ParamOut": [p.name], "VelocityOut": [v.name]},
+            attrs={"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+
+
+class AdamOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 lazy_mode=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment1", p)
+            self._add_accumulator("moment2", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1])
+            self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        b2p = self._get_accumulator("beta2_pow_acc", p)
+        return block.append_op(
+            "adam",
+            inputs={
+                "Param": [p.name],
+                "Grad": [g.name],
+                "Moment1": [m1.name],
+                "Moment2": [m2.name],
+                "Beta1Pow": [b1p.name],
+                "Beta2Pow": [b2p.name],
+                "LearningRate": [self._lr_var.name],
+            },
+            outputs={
+                "ParamOut": [p.name],
+                "Moment1Out": [m1.name],
+                "Moment2Out": [m2.name],
+                "Beta1PowOut": [b1p.name],
+                "Beta2PowOut": [b2p.name],
+            },
+            attrs={"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon},
+        )
+
+
+class AdagradOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, initial_accumulator_value=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p, fill_value=self._initial)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "adagrad",
+            inputs={
+                "Param": [p.name],
+                "Grad": [g.name],
+                "Moment": [m.name],
+                "LearningRate": [self._lr_var.name],
+            },
+            outputs={"ParamOut": [p.name], "MomentOut": [m.name]},
+            attrs={"epsilon": self._epsilon},
+        )
+
+
+class RMSPropOptimizer(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False, **kw):
+        super().__init__(learning_rate, **kw)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("mean_square", p)
+            self._add_accumulator("mean_grad", p)
+            self._add_accumulator("momentum", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        ms = self._get_accumulator("mean_square", p)
+        mg = self._get_accumulator("mean_grad", p)
+        mom = self._get_accumulator("momentum", p)
+        return block.append_op(
+            "rmsprop",
+            inputs={
+                "Param": [p.name],
+                "Grad": [g.name],
+                "MeanSquare": [ms.name],
+                "MeanGrad": [mg.name],
+                "Moment": [mom.name],
+                "LearningRate": [self._lr_var.name],
+            },
+            outputs={
+                "ParamOut": [p.name],
+                "MeanSquareOut": [ms.name],
+                "MeanGradOut": [mg.name],
+                "MomentOut": [mom.name],
+            },
+            attrs={
+                "decay": self._rho,
+                "epsilon": self._epsilon,
+                "momentum": self._momentum,
+                "centered": self._centered,
+            },
+        )
+
+
+class AdamaxOptimizer(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw):
+        super().__init__(learning_rate, **kw)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+            self._add_accumulator("inf_norm", p)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1])
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        inf = self._get_accumulator("inf_norm", p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        op = block.append_op(
+            "adamax",
+            inputs={
+                "Param": [p.name],
+                "Grad": [g.name],
+                "Moment": [m.name],
+                "InfNorm": [inf.name],
+                "Beta1Pow": [b1p.name],
+                "LearningRate": [self._lr_var.name],
+            },
+            outputs={"ParamOut": [p.name], "MomentOut": [m.name], "InfNormOut": [inf.name]},
+            attrs={"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon},
+        )
+        # beta1_pow update (reference does this in _finish_update via scale op)
+        block.append_op(
+            "scale",
+            inputs={"X": [b1p.name]},
+            outputs={"Out": [b1p.name]},
+            attrs={"scale": self._beta1},
+        )
+        return op
+
+
+class AdadeltaOptimizer(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kw):
+        super().__init__(learning_rate, **kw)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        asg = self._get_accumulator("avg_squared_grad", p)
+        asu = self._get_accumulator("avg_squared_update", p)
+        return block.append_op(
+            "adadelta",
+            inputs={
+                "Param": [p.name],
+                "Grad": [g.name],
+                "AvgSquaredGrad": [asg.name],
+                "AvgSquaredUpdate": [asu.name],
+                "LearningRate": [self._lr_var.name],
+            },
+            outputs={
+                "ParamOut": [p.name],
+                "AvgSquaredGradOut": [asg.name],
+                "AvgSquaredUpdateOut": [asu.name],
+            },
+            attrs={"epsilon": self._epsilon, "rho": self._rho},
+        )
+
+
+class FtrlOptimizer(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("squared", p)
+            self._add_accumulator("linear", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        sq = self._get_accumulator("squared", p)
+        lin = self._get_accumulator("linear", p)
+        return block.append_op(
+            "ftrl",
+            inputs={
+                "Param": [p.name],
+                "Grad": [g.name],
+                "SquaredAccumulator": [sq.name],
+                "LinearAccumulator": [lin.name],
+                "LearningRate": [self._lr_var.name],
+            },
+            outputs={"ParamOut": [p.name], "SquaredAccumOut": [sq.name], "LinearAccumOut": [lin.name]},
+            attrs={"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power},
+        )
+
+
+class LambOptimizer(AdamOptimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, **kw)
+        self._weight_decay = lamb_weight_decay
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._get_accumulator("moment1", p)
+        m2 = self._get_accumulator("moment2", p)
+        b1p = self._get_accumulator("beta1_pow_acc", p)
+        b2p = self._get_accumulator("beta2_pow_acc", p)
+        return block.append_op(
+            "lamb",
+            inputs={
+                "Param": [p.name],
+                "Grad": [g.name],
+                "Moment1": [m1.name],
+                "Moment2": [m2.name],
+                "Beta1Pow": [b1p.name],
+                "Beta2Pow": [b2p.name],
+                "LearningRate": [self._lr_var.name],
+            },
+            outputs={
+                "ParamOut": [p.name],
+                "Moment1Out": [m1.name],
+                "Moment2Out": [m2.name],
+                "Beta1PowOut": [b1p.name],
+                "Beta2PowOut": [b2p.name],
+            },
+            attrs={
+                "beta1": self._beta1,
+                "beta2": self._beta2,
+                "epsilon": self._epsilon,
+                "weight_decay": self._weight_decay,
+            },
+        )
+
+
+# reference exports both Xxx and XxxOptimizer names
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adam = AdamOptimizer
+Adagrad = AdagradOptimizer
+RMSProp = RMSPropOptimizer
+Adamax = AdamaxOptimizer
+Adadelta = AdadeltaOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer

@@ -1,0 +1,36 @@
+"""Test config: run on an 8-device virtual CPU mesh (SURVEY.md §4.8 — the
+always-on 'fake TPU'); real-TPU runs happen via bench.py."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+# Persistent XLA compile cache: repeated test runs skip recompiles.
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_xla_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Each test builds into fresh default programs and a fresh scope."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import program as prog_mod
+    from paddle_tpu.core import scope as scope_mod
+    from paddle_tpu.core import unique_name
+
+    old_main, old_startup = prog_mod._main_program, prog_mod._startup_program
+    old_scope = scope_mod._global_scope
+    prog_mod._main_program = fluid.Program()
+    prog_mod._startup_program = fluid.Program()
+    scope_mod._global_scope = scope_mod.Scope()
+    with unique_name.guard():
+        yield
+    prog_mod._main_program, prog_mod._startup_program = old_main, old_startup
+    scope_mod._global_scope = old_scope

@@ -1,0 +1,142 @@
+"""OpTest harness (reference: python/paddle/fluid/tests/unittests/op_test.py:134).
+
+Same contract as the reference: a test declares `op_type`, numpy inputs,
+attrs, and numpy-computed expected outputs; `check_output()` builds a one-op
+program and compares; `check_grad()` compares the autodiff gradient (here:
+jax.vjp over the lowering) against numeric finite differences.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.program import Program, program_guard
+from paddle_tpu.core.scope import Scope
+
+
+class OpTest:
+    """Subclass, implement setUp() setting self.op_type/self.inputs/
+    self.outputs/self.attrs, then call check_output()/check_grad()."""
+
+    op_type: str = ""
+    inputs: dict = {}
+    outputs: dict = {}
+    attrs: dict = {}
+
+    # -- helpers ----------------------------------------------------------
+    def _build_program(self):
+        prog = Program()
+        startup = Program()
+        feed = {}
+        with program_guard(prog, startup):
+            block = prog.global_block()
+            in_io = {}
+            for slot, val in self.inputs.items():
+                if isinstance(val, list):  # multi-var slot: [(name, array), ...]
+                    names = []
+                    for name, arr in val:
+                        arr = np.asarray(arr)
+                        block.create_var(name, shape=arr.shape, dtype=str(arr.dtype), is_data=True)
+                        feed[name] = arr
+                        names.append(name)
+                    in_io[slot] = names
+                else:
+                    arr = np.asarray(val)
+                    name = f"in_{slot}"
+                    block.create_var(name, shape=arr.shape, dtype=_canon(arr.dtype), is_data=True)
+                    feed[name] = arr
+                    in_io[slot] = [name]
+            out_io = {}
+            fetch = []
+            for slot, val in self.outputs.items():
+                if isinstance(val, list):
+                    names = []
+                    for name, arr in val:
+                        block.create_var(name, dtype=_canon(np.asarray(arr).dtype))
+                        names.append(name)
+                        fetch.append((slot, name, np.asarray(arr)))
+                    out_io[slot] = names
+                else:
+                    name = f"out_{slot}"
+                    block.create_var(name, dtype=_canon(np.asarray(val).dtype))
+                    out_io[slot] = [name]
+                    fetch.append((slot, name, np.asarray(val)))
+            block.append_op(self.op_type, inputs=in_io, outputs=out_io, attrs=dict(self.attrs))
+        return prog, feed, fetch
+
+    def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=None):
+        self.setUp()
+        no_check = set(no_check_set or ())
+        prog, feed, fetch = self._build_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = Scope()
+        names = [name for _, name, _ in fetch]
+        outs = exe.run(prog, feed=feed, fetch_list=names, scope=scope)
+        for (slot, name, expected), got in zip(fetch, outs):
+            if slot in no_check:
+                continue
+            np.testing.assert_allclose(
+                got.astype(np.float64) if got.dtype != np.bool_ else got,
+                expected.astype(np.float64) if expected.dtype != np.bool_ else expected,
+                atol=atol,
+                rtol=rtol,
+                err_msg=f"op {self.op_type} output {slot}/{name} mismatch",
+            )
+
+    def check_grad(self, inputs_to_check, output_name, max_relative_error=0.005,
+                   numeric_grad_delta=1e-3, atol=1e-4):
+        """Compare vjp-gradients against central finite differences
+        (reference: gradient_checker.py)."""
+        self.setUp()
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.lowering import LoweringContext, lower_one
+        from paddle_tpu.core.program import Operator
+
+        prog, feed, fetch = self._build_program()
+        op = prog.global_block().ops[-1]
+        out_slot = next(slot for slot, name, _ in fetch if name == f"out_{output_name}" or slot == output_name)
+
+        feed64 = {k: np.asarray(v) for k, v in feed.items()}
+
+        def run_fn(varying):
+            env = {k: jnp.asarray(v) for k, v in feed64.items()}
+            env.update({k: v for k, v in varying.items()})
+            ctx = LoweringContext(jax.random.PRNGKey(0))
+            lower_one(ctx, op, env)
+            outs = []
+            for slot, name, _ in fetch:
+                if slot == out_slot:
+                    outs.append(env[name])
+            return sum(jnp.sum(o) for o in outs)
+
+        check_names = [f"in_{s}" for s in inputs_to_check]
+        varying0 = {n: jnp.asarray(feed64[n]) for n in check_names}
+        analytic = jax.grad(run_fn)(varying0)
+
+        for n in check_names:
+            base = feed64[n].astype(np.float64)
+            num_grad = np.zeros_like(base)
+            flat = base.reshape(-1)
+            ng_flat = num_grad.reshape(-1)
+            for i in range(flat.size):
+                orig = flat[i]
+                flat[i] = orig + numeric_grad_delta
+                plus = float(run_fn({**varying0, n: jnp.asarray(base.reshape(feed64[n].shape).astype(feed64[n].dtype))}))
+                flat[i] = orig - numeric_grad_delta
+                minus = float(run_fn({**varying0, n: jnp.asarray(base.reshape(feed64[n].shape).astype(feed64[n].dtype))}))
+                flat[i] = orig
+                ng_flat[i] = (plus - minus) / (2 * numeric_grad_delta)
+            a = np.asarray(analytic[n], dtype=np.float64)
+            denom = np.maximum(np.abs(num_grad), np.maximum(np.abs(a), 1e-3))
+            rel = np.abs(a - num_grad) / denom
+            assert rel.max() <= max_relative_error or np.allclose(a, num_grad, atol=atol), (
+                f"grad mismatch for {n}: max rel err {rel.max()}"
+            )
+
+
+def _canon(dt):
+    from paddle_tpu.core.dtypes import canonical_dtype
+
+    return canonical_dtype(dt)

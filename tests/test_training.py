@@ -1,0 +1,157 @@
+"""End-to-end training tests (reference: tests/book/test_fit_a_line.py,
+test_recognize_digits.py — train until loss threshold)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _fit_a_line(opt):
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = 42
+    rng = np.random.RandomState(0)
+    true_w = rng.rand(13, 1).astype("float32")
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [13], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for i in range(120):
+        xv = rng.rand(32, 13).astype("float32")
+        yv = xv @ true_w
+        (lv,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss])
+        losses.append(float(lv[0]))
+    assert losses[-1] < losses[0] * 0.2, f"no convergence: {losses[0]} -> {losses[-1]}"
+    return losses
+
+
+def test_fit_a_line_sgd():
+    _fit_a_line(fluid.optimizer.SGD(learning_rate=0.05))
+
+
+def test_fit_a_line_momentum():
+    _fit_a_line(fluid.optimizer.Momentum(learning_rate=0.02, momentum=0.9))
+
+
+def test_fit_a_line_adam():
+    losses = _fit_a_line(fluid.optimizer.Adam(learning_rate=0.05))
+    assert losses[-1] < 0.1
+
+
+def test_fit_a_line_other_optimizers():
+    for opt in [
+        fluid.optimizer.Adagrad(learning_rate=0.3),
+        fluid.optimizer.RMSProp(learning_rate=0.02),
+        fluid.optimizer.Adamax(learning_rate=0.05),
+        fluid.optimizer.Adadelta(learning_rate=1.0),
+        fluid.optimizer.Lamb(learning_rate=0.02),
+    ]:
+        _fit_a_line(opt)
+
+
+def test_regularization_changes_grads():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1, bias_attr=False)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.SGD(
+            learning_rate=0.1, regularization=fluid.regularizer.L2Decay(0.5)
+        )
+        opt.minimize(loss)
+    # regularization must have inserted scale+sum ops before sgd
+    types = [op.type for op in main.global_block().ops]
+    assert "sum" in types and "backward" in types
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    exe.run(main, feed={"x": np.ones((2, 4), "float32"), "y": np.ones((2, 1), "float32")},
+            fetch_list=[loss])
+
+
+def test_mnist_mlp_converges():
+    """Digit-recognition-style MLP on a synthetic separable task
+    (reference: tests/book/test_recognize_digits.py mlp variant)."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [64], dtype="float32")
+        label = fluid.layers.data("label", [1], dtype="int64")
+        h = fluid.layers.fc(img, size=32, act="relu")
+        logits = fluid.layers.fc(h, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label)
+        )
+        acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(3)
+    protos = rng.randn(4, 64).astype("float32") * 2
+    accs = []
+    for i in range(100):
+        lab = rng.randint(0, 4, size=(64, 1))
+        xv = protos[lab[:, 0]] + rng.randn(64, 64).astype("float32") * 0.5
+        lv, av = exe.run(main, feed={"img": xv, "label": lab}, fetch_list=[loss, acc])
+        accs.append(float(av[0]))
+    assert np.mean(accs[-10:]) > 0.9, f"poor accuracy: {np.mean(accs[-10:])}"
+
+
+def test_conv_net_trains():
+    """Small conv net (reference: test_recognize_digits.py conv variant)."""
+    main = fluid.Program()
+    startup = fluid.Program()
+    startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [1, 12, 12], dtype="float32")
+        label = fluid.layers.data("label", [1], dtype="int64")
+        c = fluid.layers.conv2d(img, num_filters=8, filter_size=3, act="relu")
+        p = fluid.layers.pool2d(c, pool_size=2, pool_stride=2)
+        bn = fluid.layers.batch_norm(p)
+        flat = fluid.layers.reshape(bn, [-1, 8 * 5 * 5])
+        logits = fluid.layers.fc(flat, size=2)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label)
+        )
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(5)
+    losses = []
+    for i in range(60):
+        lab = rng.randint(0, 2, size=(16, 1))
+        xv = rng.randn(16, 1, 12, 12).astype("float32") + lab[:, :, None, None] * 1.5
+        (lv,) = exe.run(main, feed={"img": xv, "label": lab}, fetch_list=[loss])
+        losses.append(float(lv[0]))
+    assert losses[-1] < losses[0] * 0.5, f"{losses[0]} -> {losses[-1]}"
+
+
+def test_clone_for_test_drops_optimizer():
+    main = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h = fluid.layers.dropout(fluid.layers.fc(x, size=8), dropout_prob=0.5)
+        pred = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    types = [op.type for op in test_prog.global_block().ops]
+    assert "backward" not in types and "sgd" not in types
+    # dropout must be in inference mode
+    dropout_ops = [op for op in test_prog.global_block().ops if op.type == "dropout"]
+    assert dropout_ops and dropout_ops[0].attr("is_test") is True
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    xv = np.ones((3, 4), "float32")
+    (a,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[pred])
+    (b,) = exe.run(test_prog, feed={"x": xv}, fetch_list=[pred])
+    np.testing.assert_allclose(a, b)  # deterministic in test mode
