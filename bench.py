@@ -1,0 +1,85 @@
+"""Benchmark driver: prints ONE JSON line with the flagship metric.
+
+Flagship: ResNet-50 ImageNet training throughput on one TPU chip, bf16
+compute (reference harness: benchmark/fluid/fluid_benchmark.py, which
+printed `Throughput` per pass; BASELINE.md target is >=50% MFU).
+vs_baseline is vs the reference's published numbers — it published none
+(BASELINE.md), so 1.0 marks parity-by-default and the absolute value is
+the series to track across rounds.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bench_resnet50(batch_size=64, warmup=3, iters=20):
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+
+    main, startup, feeds, fetches = resnet.build(
+        dtype="bfloat16", class_dim=1000, learning_rate=0.1, with_optimizer=True
+    )
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup, scope=scope)
+
+    rng = np.random.RandomState(0)
+    img = rng.rand(batch_size, 3, 224, 224).astype("float32")
+    label = rng.randint(0, 1000, size=(batch_size, 1)).astype(np.int32)
+    # device-resident synthetic batch (reference harness: --use_fake_data in
+    # benchmark/fluid/fluid_benchmark.py) so the tunnel's H2D bandwidth
+    # doesn't pollute the compute measurement
+    import jax.numpy as jnp
+
+    dev = fluid.TPUPlace(0).jax_device()
+    feed = {
+        "img": jax.device_put(jnp.asarray(img), dev),
+        "label": jax.device_put(jnp.asarray(label), dev),
+    }
+    loss_name = fetches["loss"].name
+
+    for _ in range(warmup):
+        out = exe.run(main, feed=feed, fetch_list=[loss_name], scope=scope, return_numpy=False)
+    loss0 = float(np.asarray(out[0])[0])  # hard sync (block_until_ready is
+    # advisory on the axon tunnel backend)
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = exe.run(main, feed=feed, fetch_list=[loss_name], scope=scope, return_numpy=False)
+    lossN = float(np.asarray(out[0])[0])  # hard sync: value read drains the chain
+    dt = (time.perf_counter() - t0) / iters
+
+    imgs_per_sec = batch_size / dt
+    # ResNet-50 fwd ~4.09 GFLOP/img at 224^2; train ~3x fwd.
+    train_flops_per_img = 3 * 4.089e9
+    achieved = imgs_per_sec * train_flops_per_img
+    peak = 197e12  # v5e bf16 peak FLOP/s
+    mfu = achieved / peak
+    print(f"step {dt*1e3:.1f} ms  loss {lossN:.3f}  mfu {mfu:.3f}", file=sys.stderr)
+    return imgs_per_sec, mfu
+
+
+def main():
+    batch = 128
+    imgs_per_sec, mfu = bench_resnet50(batch_size=batch)
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_imgs_per_sec_per_chip",
+                "value": round(imgs_per_sec, 2),
+                "unit": "imgs/sec",
+                "vs_baseline": 1.0,
+                "extra": {"mfu_bf16": round(mfu, 4), "batch_size": batch},
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

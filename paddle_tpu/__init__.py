@@ -23,5 +23,13 @@ from .core.program import (  # noqa: F401
     program_guard,
 )
 from .core.scope import Scope, global_scope  # noqa: F401
+from . import parallel  # noqa: F401
+from .parallel import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
+from . import parallel as compiler  # reference exposes fluid.compiler.CompiledProgram  # noqa: F401
+from . import clip  # noqa: F401
+from . import io  # noqa: F401
+from . import models  # noqa: F401
+from . import reader  # noqa: F401
+from .reader import DataFeeder, DataLoader, PyReader  # noqa: F401
 
 __version__ = "0.1.0"

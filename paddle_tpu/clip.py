@@ -1,0 +1,91 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py —
+GradientClipByValue, GradientClipByNorm, GradientClipByGlobalNorm,
+set_gradient_clip, ErrorClipByValue).
+
+Clip ops append into the main program between backward and the optimizer
+update, exactly like the reference; XLA fuses them into the step."""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from .core.program import default_main_program
+from .layers import nn, tensor
+
+
+class BaseGradientClipAttr:
+    def _append_clip_op(self, params_grads):
+        raise NotImplementedError
+
+
+class ErrorClipByValue:
+    """Kept for API parity (clips activation gradients in the reference);
+    with vjp-derived gradients only the param-grad clips apply."""
+
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = min if min is not None else -max
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _append_clip_op(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            out.append((p, nn.clip(g, self.min, self.max)))
+        return out
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _append_clip_op(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            out.append((p, nn.clip_by_norm(g, self.clip_norm)))
+        return out
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _append_clip_op(self, params_grads):
+        sq_sums = []
+        for _, g in params_grads:
+            sq_sums.append(nn.reduce_sum(nn.square(g)))
+        total = tensor.sums(sq_sums) if len(sq_sums) > 1 else sq_sums[0]
+        global_norm = nn.sqrt(total)
+        max_norm = tensor.fill_constant([1], "float32", self.clip_norm)
+        denom = nn.elementwise_max(global_norm, max_norm)
+        scale = nn.elementwise_div(max_norm, denom)
+        out = []
+        for p, g in params_grads:
+            out.append((p, nn.elementwise_mul(g, scale)))
+        return out
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    """reference clip.py:333 — records the clip strategy on the program;
+    Optimizer.apply_gradients applies it."""
+    program = program or default_main_program()
+    program._grad_clip = clip
+    program._grad_clip_params = (
+        {p if isinstance(p, str) else p.name for p in param_list} if param_list else None
+    )
+
+
+def append_gradient_clip_ops(params_grads):
+    program = default_main_program()
+    clip = getattr(program, "_grad_clip", None)
+    if clip is None:
+        return params_grads
+    only = getattr(program, "_grad_clip_params", None)
+    if only is None:
+        return clip._append_clip_op(params_grads)
+    subset = [(p, g) for p, g in params_grads if p.name in only]
+    rest = [(p, g) for p, g in params_grads if p.name not in only]
+    return clip._append_clip_op(subset) + rest

@@ -68,7 +68,11 @@ def _runnable_ops(block):
 class _CompiledStep:
     """One jitted executable for (program, feed sig, fetch names, state sig)."""
 
-    def __init__(self, program: Program, feed_names: Sequence[str], fetch_names: Sequence[str], scope: Scope):
+    def __init__(self, program: Program, feed_names: Sequence[str], fetch_names: Sequence[str], scope: Scope,
+                 mesh=None, batch_axis: str = "dp", feed_shapes: Optional[Dict[str, tuple]] = None):
+        self.mesh = mesh
+        self.batch_axis = batch_axis
+        feed_shapes = feed_shapes or {}
         block = program.global_block()
         ops = _runnable_ops(block)
 
@@ -101,7 +105,7 @@ class _CompiledStep:
 
         def step(state_rw: Dict[str, jnp.ndarray], state_ro: Dict[str, jnp.ndarray],
                  feeds: Dict[str, jnp.ndarray], key):
-            ctx = LoweringContext(key)
+            ctx = LoweringContext(key, mesh=mesh)
             env = dict(state_ro)
             env.update(state_rw)
             env.update(feeds)
@@ -110,7 +114,47 @@ class _CompiledStep:
             fetches = [env[n] for n in self.fetch_names]
             return fetches, new_state, ctx.key
 
-        self.jfn = jax.jit(step, donate_argnums=(0,))
+        if mesh is None:
+            self.jfn = jax.jit(step, donate_argnums=(0,))
+            self.feed_specs = None
+        else:
+            # SPMD: feeds batch-sharded on dim 0, state placed per program
+            # sharding hints (default replicated) — GSPMD inserts the
+            # gradient all-reduces the reference emitted as NCCL op handles.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            hints = dict(program.sharding_hints)
+
+            def state_spec(n):
+                return NamedSharding(mesh, P(*hints[n]) if n in hints else P())
+
+            repl = NamedSharding(mesh, P())
+            batch_sharded = NamedSharding(mesh, P(batch_axis))
+            n_dp = mesh.shape[batch_axis]
+
+            def feed_spec(n):
+                shape = feed_shapes.get(n, ())
+                if len(shape) >= 1 and shape[0] % n_dp == 0:
+                    return batch_sharded
+                return repl  # scalars / indivisible feeds replicate
+
+            rw_specs = {n: state_spec(n) for n in self.rw_names}
+            ro_specs = {n: state_spec(n) for n in self.ro_names}
+            feed_specs = {n: feed_spec(n) for n in self.feed_names}
+            self.feed_specs = feed_specs
+            self.state_specs = {**rw_specs, **ro_specs}
+            self.key_spec = repl
+            out_specs = (
+                [repl] * len(self.fetch_names),
+                {n: state_spec(n) for n in written},
+                repl,
+            )
+            self.jfn = jax.jit(
+                step,
+                donate_argnums=(0,),
+                in_shardings=(rw_specs, ro_specs, feed_specs, repl),
+                out_shardings=out_specs,
+            )
 
     @staticmethod
     def _prune(ops, fetch_names, persistable):
@@ -134,6 +178,15 @@ class _CompiledStep:
         return kept
 
     def __call__(self, scope: Scope, feeds: Dict[str, jnp.ndarray], key):
+        if self.mesh is not None:
+            # Reshard state committed elsewhere (e.g. by a single-device
+            # startup run) onto the mesh layout the step expects.
+            for n, spec in self.state_specs.items():
+                v = scope.find_var(n)
+                if getattr(v, "sharding", None) != spec:
+                    scope.set_var(n, jax.device_put(v, spec))
+            if getattr(key, "sharding", None) != self.key_spec:
+                key = jax.device_put(key, self.key_spec)
         state_rw = {n: scope.find_var(n) for n in self.rw_names}
         state_ro = {n: scope.find_var(n) for n in self.ro_names}
         fetches, new_state, new_key = self.jfn(state_rw, state_ro, feeds, key)
@@ -163,6 +216,12 @@ class Executor:
         use_program_cache: bool = True,  # parity arg; caching is always on
     ):
         program = program if program is not None else default_main_program()
+        mesh = None
+        batch_axis = "dp"
+        if hasattr(program, "program") and hasattr(program, "mesh"):  # CompiledProgram
+            mesh = program.mesh
+            batch_axis = getattr(program, "batch_axis", "dp")
+            program = program.program
         scope = scope if scope is not None else global_scope()
         feed = feed or {}
         fetch_names = [f.name if isinstance(f, Variable) else str(f) for f in (fetch_list or [])]
@@ -170,49 +229,78 @@ class Executor:
         device = self.place.jax_device()
         block = program.global_block()
 
-        # Convert feeds to device arrays with the declared var dtype.
+        # Convert feeds to host arrays with the declared var dtype.
         jfeeds = {}
         for name, value in feed.items():
+            if isinstance(value, jax.Array):
+                # device-resident feed: trust caller's placement (a
+                # DataLoader prefetched it, or fake-data benchmarking)
+                jfeeds[name] = value
+                continue
             dtype = None
             if block.has_var(name):
                 dtype = as_np_dtype(block.var(name).dtype)
-            arr = jnp.asarray(np.asarray(value), dtype=dtype)
-            jfeeds[name] = jax.device_put(arr, device)
+            arr = np.asarray(value)
+            if dtype is not None and arr.dtype != dtype:
+                arr = arr.astype(dtype)
+            # x32 canonicalization at the feed boundary (silences jax's
+            # per-call int64-truncation warning)
+            if not jax.config.jax_enable_x64:
+                if arr.dtype == np.int64:
+                    arr = arr.astype(np.int32)
+                elif arr.dtype == np.float64:
+                    arr = arr.astype(np.float32)
+                elif arr.dtype == np.uint64:
+                    arr = arr.astype(np.uint32)
+            jfeeds[name] = arr
 
         key = scope.find_var(RNG_STATE_VAR)
         if key is None:
             seed = program.random_seed if program.random_seed is not None else 0
             key = jax.random.PRNGKey(seed)
-        key = jax.device_put(key, device)
+        if mesh is None:
+            key = jax.device_put(key, device)
+        # (mesh path: _CompiledStep reshards the key onto the mesh itself)
 
-        def _sig(v):
-            shape = getattr(v, "shape", None)
-            dtype = getattr(v, "dtype", None)
-            if shape is None or dtype is None:
-                a = np.asarray(v)
-                shape, dtype = a.shape, a.dtype
-            return tuple(shape), str(dtype)
-
+        # NOTE: state shapes/dtypes are deliberately NOT in the key — the
+        # inner jax.jit retraces on aval changes anyway; keying on them
+        # would cost a walk over every persistable per step.
         cache_key = (
             program._uuid,
             program.version,
             tuple(sorted((n, v.shape, str(v.dtype)) for n, v in jfeeds.items())),
             tuple(fetch_names),
-            tuple(sorted((n,) + _sig(scope.find_var(n)) for n in self._persistable_in_scope(program, scope))),
             scope._uuid,
+            (tuple(mesh.shape.items()), batch_axis) if mesh is not None else None,
         )
         compiled = self._cache.get(cache_key)
         if compiled is None:
-            compiled = _CompiledStep(program, list(jfeeds), fetch_names, scope)
+            compiled = _CompiledStep(
+                program, list(jfeeds), fetch_names, scope,
+                mesh=mesh, batch_axis=batch_axis,
+                feed_shapes={n: v.shape for n, v in jfeeds.items()},
+            )
             self._cache[cache_key] = compiled
             if len(self._cache) > 128:  # drop oldest executable (LRU-ish)
                 self._cache.pop(next(iter(self._cache)))
 
-        # Move any host-resident state onto the device once.
-        for n in compiled.state_in_names:
-            v = scope.find_var(n)
-            if not isinstance(v, jax.Array):
-                scope.set_var(n, jax.device_put(jnp.asarray(v), device))
+        if mesh is None:
+            # Single-device: pin feeds and any host-resident state.
+            jfeeds = {
+                n: v if isinstance(v, jax.Array) else jax.device_put(jnp.asarray(v), device)
+                for n, v in jfeeds.items()
+            }
+            for n in compiled.state_in_names:
+                v = scope.find_var(n)
+                if not isinstance(v, jax.Array):
+                    scope.set_var(n, jax.device_put(jnp.asarray(v), device))
+        else:
+            # SPMD: shard feeds up front; jit's in_shardings places state.
+            jfeeds = {
+                n: v if isinstance(v, jax.Array) and v.sharding == compiled.feed_specs[n]
+                else jax.device_put(v, compiled.feed_specs[n])
+                for n, v in jfeeds.items()
+            }
 
         fetches, new_key = compiled(scope, jfeeds, key)
         scope.set_var(RNG_STATE_VAR, new_key)
@@ -220,7 +308,3 @@ class Executor:
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
-
-    @staticmethod
-    def _persistable_in_scope(program: Program, scope: Scope) -> List[str]:
-        return [v.name for v in program.list_vars() if v.persistable and scope.has_var(v.name)]

@@ -1,0 +1,140 @@
+"""Checkpoint save/load + inference-model serialization.
+
+Reference: python/paddle/fluid/io.py (save_persistables:472, save_params:240,
+load_vars:524, save_inference_model:915, load_inference_model) over
+save_op/load_op C++ kernels (SURVEY.md §5.4).
+
+TPU-first redesign: checkpoints are directory-per-checkpoint with one .npy
+per persistable variable (device arrays fetched from the Scope) plus a JSON
+manifest — the sharded-array analogue; save_inference_model serializes the
+pruned Program (JSON form of the IR) next to the params, exactly the role
+of the reference's `__model__` ProgramDesc binary.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .core.program import Program, Variable, default_main_program
+from .core.scope import Scope, global_scope
+
+MODEL_FILENAME = "__model__.json"
+MANIFEST = "__manifest__.json"
+
+
+def _persistables(program: Program) -> List[Variable]:
+    return [v for v in program.list_vars() if v.persistable]
+
+
+def save_vars(dirname: str, var_names: Sequence[str], scope: Optional[Scope] = None):
+    scope = scope or global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    saved = []
+    for name in var_names:
+        v = scope.find_var(name)
+        if v is None:
+            raise KeyError(f"save_vars: {name!r} not found in scope")
+        arr = np.asarray(v)
+        fname = name.replace("/", "%2F") + ".npy"
+        np.save(os.path.join(dirname, fname), arr)
+        saved.append({"name": name, "file": fname, "shape": list(arr.shape), "dtype": str(arr.dtype)})
+    with open(os.path.join(dirname, MANIFEST), "w") as f:
+        json.dump({"vars": saved}, f, indent=1)
+    return saved
+
+
+def save_persistables(executor, dirname: str, main_program: Optional[Program] = None,
+                      scope: Optional[Scope] = None):
+    """reference io.py:472 — saves every persistable var (params + optimizer
+    accumulators + LR), so training resumes bit-exactly."""
+    program = main_program or default_main_program()
+    return save_vars(dirname, [v.name for v in _persistables(program)], scope)
+
+
+def save_params(executor, dirname: str, main_program: Optional[Program] = None,
+                scope: Optional[Scope] = None):
+    """reference io.py:240 — parameters only."""
+    program = main_program or default_main_program()
+    names = [p.name for p in program.all_parameters()]
+    return save_vars(dirname, names, scope)
+
+
+def load_vars(dirname: str, var_names: Optional[Sequence[str]] = None,
+              scope: Optional[Scope] = None):
+    scope = scope or global_scope()
+    with open(os.path.join(dirname, MANIFEST)) as f:
+        manifest = json.load(f)
+    want = set(var_names) if var_names is not None else None
+    loaded = []
+    for entry in manifest["vars"]:
+        if want is not None and entry["name"] not in want:
+            continue
+        arr = np.load(os.path.join(dirname, entry["file"]))
+        scope.set_var(entry["name"], arr)
+        loaded.append(entry["name"])
+    if want is not None:
+        missing = want - set(loaded)
+        if missing:
+            raise KeyError(f"load_vars: checkpoint lacks {sorted(missing)}")
+    return loaded
+
+
+def load_persistables(executor, dirname: str, main_program: Optional[Program] = None,
+                      scope: Optional[Scope] = None):
+    program = main_program or default_main_program()
+    return load_vars(dirname, [v.name for v in _persistables(program)], scope)
+
+
+def load_params(executor, dirname: str, main_program: Optional[Program] = None,
+                scope: Optional[Scope] = None):
+    program = main_program or default_main_program()
+    return load_vars(dirname, [p.name for p in program.all_parameters()], scope)
+
+
+def save_inference_model(
+    dirname: str,
+    feeded_var_names: Sequence[str],
+    target_vars: Sequence[Variable],
+    executor,
+    main_program: Optional[Program] = None,
+    scope: Optional[Scope] = None,
+):
+    """reference io.py:915 — prune to the feed->fetch subgraph, switch to
+    test mode, serialize program + params."""
+    program = main_program or default_main_program()
+    inference = program.clone(for_test=True)
+    target_names = [t.name if isinstance(t, Variable) else str(t) for t in target_vars]
+
+    # prune ops not contributing to targets (same slice the executor takes)
+    from .core.executor import _CompiledStep, _runnable_ops
+
+    block = inference.global_block()
+    block.ops = _CompiledStep._prune(_runnable_ops(block), target_names, set())
+
+    used = set()
+    for op in block.ops:
+        used.update(op.input_arg_names)
+        used.update(op.output_arg_names)
+
+    os.makedirs(dirname, exist_ok=True)
+    doc = inference.to_dict()
+    doc["feed_names"] = list(feeded_var_names)
+    doc["fetch_names"] = target_names
+    with open(os.path.join(dirname, MODEL_FILENAME), "w") as f:
+        json.dump(doc, f)
+
+    param_names = [v.name for v in _persistables(inference) if v.name in used]
+    save_vars(dirname, param_names, scope)
+    return target_names
+
+
+def load_inference_model(dirname: str, executor, scope: Optional[Scope] = None):
+    """Returns (program, feed_names, fetch_names); params land in scope."""
+    with open(os.path.join(dirname, MODEL_FILENAME)) as f:
+        doc = json.load(f)
+    program = Program.from_dict(doc)
+    load_vars(dirname, None, scope)
+    return program, doc["feed_names"], doc["fetch_names"]

@@ -1,5 +1,15 @@
 """fluid.layers-compatible namespace."""
 from .io import data  # noqa: F401
+from .learning_rate_scheduler import (  # noqa: F401
+    cosine_decay,
+    exponential_decay,
+    inverse_time_decay,
+    linear_lr_warmup,
+    natural_exp_decay,
+    noam_decay,
+    piecewise_decay,
+    polynomial_decay,
+)
 from .metric import accuracy  # noqa: F401
 from .nn import *  # noqa: F401,F403
 from .tensor import (  # noqa: F401

@@ -1,0 +1,166 @@
+"""LR schedulers (reference: python/paddle/fluid/layers/
+learning_rate_scheduler.py — noam/exponential/natural_exp/inverse_time/
+polynomial/piecewise/cosine decay + linear warmup).
+
+Each returns a Variable computed each step from a persistable global-step
+counter; the optimizer takes that Variable as its learning rate.  The
+decay math lowers into the same XLA program as the train step, so a
+schedule costs nothing (the reference ran these as separate ops each
+iteration)."""
+from __future__ import annotations
+
+import math
+
+from ..core import unique_name
+from ..core.layer_helper import LayerHelper
+from ..core.program import default_main_program, default_startup_program
+from . import nn, tensor
+
+_COUNTER_NAME = "@LR_DECAY_COUNTER@"
+
+
+def _global_step():
+    """Persistable float32 step counter, incremented once per program run."""
+    main_block = default_main_program().global_block()
+    if main_block.has_var(_COUNTER_NAME):
+        return main_block.var(_COUNTER_NAME)
+    var = main_block.create_var(_COUNTER_NAME, shape=(1,), dtype="float32", persistable=True)
+    startup = default_startup_program().global_block()
+    startup.create_var(_COUNTER_NAME, shape=(1,), dtype="float32", persistable=True)
+    # init to -1 so the first run's schedules see step 0 (reference
+    # _decay_step_counter begins at begin-1 for the same reason)
+    startup.append_op(
+        "fill_constant",
+        outputs={"Out": [_COUNTER_NAME]},
+        attrs={"shape": [1], "dtype": "float32", "value": -1.0},
+    )
+    main_block.append_op(
+        "increment",
+        inputs={"X": [_COUNTER_NAME]},
+        outputs={"Out": [_COUNTER_NAME]},
+        attrs={"step": 1.0},
+    )
+    return var
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    step = _global_step()
+    a = nn.pow(step, -0.5)
+    b = step * (warmup_steps ** -1.5)
+    return nn.elementwise_min(a, b) * (d_model ** -0.5) * learning_rate
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _global_step()
+    div = step / float(decay_steps)
+    if staircase:
+        div = nn.floor(div)
+    return _exp_decay(learning_rate, div, decay_rate)
+
+
+def _exp_decay(learning_rate, div, decay_rate):
+    # lr * decay_rate^div  == lr * exp(div * ln(decay_rate))
+    return nn.exp(div * math.log(decay_rate)) * learning_rate
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _global_step()
+    div = step / float(decay_steps)
+    if staircase:
+        div = nn.floor(div)
+    return nn.exp(div * (-decay_rate)) * learning_rate
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _global_step()
+    div = step / float(decay_steps)
+    if staircase:
+        div = nn.floor(div)
+    denom = div * decay_rate + 1.0
+    return _reciprocal(denom) * learning_rate
+
+
+def _reciprocal(x):
+    helper = LayerHelper("reciprocal")
+    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    helper.append_op("reciprocal", inputs={"X": [x.name]}, outputs={"Out": [out.name]})
+    return out
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001, power=1.0, cycle=False):
+    step = _global_step()
+    if cycle:
+        ratio = step / float(decay_steps)
+        ceil_ratio = nn.ceil(ratio)
+        one = tensor.fill_constant([1], "float32", 1.0)
+        mult = nn.elementwise_max(ceil_ratio, one)
+        decay_var = mult * float(decay_steps)
+        frac = step / decay_var
+    else:
+        capped = nn.elementwise_min(step, tensor.fill_constant([1], "float32", float(decay_steps)))
+        frac = capped / float(decay_steps)
+    base = (1.0 - frac)
+    poly = nn.pow(base, power)
+    return poly * (learning_rate - end_learning_rate) + end_learning_rate
+
+
+def piecewise_decay(boundaries, values):
+    """lr = values[i] for boundaries[i-1] <= step < boundaries[i], built from
+    mask arithmetic instead of the reference's conditional blocks."""
+    assert len(values) == len(boundaries) + 1
+    step = _global_step()
+    helper = LayerHelper("piecewise_decay")
+    lr = tensor.fill_constant([1], "float32", values[-1])
+    prev_bound = None
+    for i, b in enumerate(boundaries):
+        bound = tensor.fill_constant([1], "float32", float(b))
+        below = _cast_bool(_less_than(step, bound))
+        if prev_bound is None:
+            mask = below
+        else:
+            above_prev = _cast_bool(_greater_equal(step, prev_bound))
+            mask = nn.elementwise_mul(below, above_prev)
+        lr = lr + mask * (values[i] - values[-1])
+        prev_bound = bound
+    return lr
+
+
+def _less_than(x, y):
+    helper = LayerHelper("less_than")
+    out = helper.create_variable_for_type_inference("bool", shape=x.shape)
+    helper.append_op("less_than", inputs={"X": [x.name], "Y": [y.name]}, outputs={"Out": [out.name]})
+    return out
+
+
+def _greater_equal(x, y):
+    helper = LayerHelper("greater_equal")
+    out = helper.create_variable_for_type_inference("bool", shape=x.shape)
+    helper.append_op("greater_equal", inputs={"X": [x.name], "Y": [y.name]}, outputs={"Out": [out.name]})
+    return out
+
+
+def _cast_bool(x):
+    return tensor.cast(x, "float32")
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    step = _global_step()
+    epoch = nn.floor(step / float(step_each_epoch))
+    inner = epoch * (math.pi / float(epochs))
+    return (nn.cos(inner) + 1.0) * 0.5 * learning_rate
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    """Warmup then hand off to `learning_rate` (float or schedule Variable)."""
+    step = _global_step()
+    wsteps = tensor.fill_constant([1], "float32", float(warmup_steps))
+    in_warmup = _cast_bool(_less_than(step, wsteps))
+    frac = step / float(warmup_steps)
+    warm = frac * (end_lr - start_lr) + start_lr
+    from ..core.program import Variable
+
+    if isinstance(learning_rate, Variable):
+        after = learning_rate
+    else:
+        after = tensor.fill_constant([1], "float32", float(learning_rate))
+    return in_warmup * warm + (1.0 - in_warmup) * after

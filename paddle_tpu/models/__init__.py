@@ -1,0 +1,5 @@
+"""Model zoo mirroring the reference benchmark models
+(reference: benchmark/fluid/models/__init__.py:16-19 — machine_translation,
+resnet, vgg, mnist, stacked_dynamic_lstm, se_resnext + BERT/Transformer
+targets from BASELINE.md)."""
+from . import mnist, resnet, transformer  # noqa: F401

@@ -1,0 +1,91 @@
+"""ResNet (reference: benchmark/fluid/models/resnet.py — conv_bn_layer /
+shortcut / bottleneck structure; ResNet-50 = depth [3,4,6,3]).
+
+The builder emits plain conv2d/batch_norm/pool2d program ops; XLA fuses
+BN+ReLU into the convs, which is what made the reference need cuDNN fused
+kernels.  Default dtype float32; pass dtype="bfloat16" for the MXU-native
+path (loss/metrics stay fp32 via the final cast).
+"""
+from __future__ import annotations
+
+from .. import layers, optimizer
+from ..core.program import Program, program_guard
+
+
+def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu", is_test=False):
+    conv = layers.conv2d(input, num_filters=ch_out, filter_size=filter_size, stride=stride,
+                         padding=padding, bias_attr=False)
+    return layers.batch_norm(conv, act=act, is_test=is_test)
+
+
+def shortcut(input, ch_out, stride, is_test=False):
+    ch_in = input.shape[1]
+    if ch_in != ch_out or stride != 1:
+        return conv_bn_layer(input, ch_out, 1, stride, 0, act=None, is_test=is_test)
+    return input
+
+
+def bottleneck(input, ch_out, stride, is_test=False):
+    short = shortcut(input, ch_out * 4, stride, is_test=is_test)
+    conv1 = conv_bn_layer(input, ch_out, 1, 1, 0, is_test=is_test)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, stride, 1, is_test=is_test)
+    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None, is_test=is_test)
+    return layers.elementwise_add(short, conv3, act="relu")
+
+
+def basicblock(input, ch_out, stride, is_test=False):
+    short = shortcut(input, ch_out, stride, is_test=is_test)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, is_test=is_test)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None, is_test=is_test)
+    return layers.elementwise_add(short, conv2, act="relu")
+
+
+def layer_warp(block_fn, input, ch_out, count, stride, is_test=False):
+    res = block_fn(input, ch_out, stride, is_test=is_test)
+    for _ in range(1, count):
+        res = block_fn(res, ch_out, 1, is_test=is_test)
+    return res
+
+
+_DEPTH = {
+    18: (basicblock, [2, 2, 2, 2]),
+    34: (basicblock, [3, 4, 6, 3]),
+    50: (bottleneck, [3, 4, 6, 3]),
+    101: (bottleneck, [3, 4, 23, 3]),
+    152: (bottleneck, [3, 8, 36, 3]),
+}
+
+
+def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False):
+    block_fn, stages = _DEPTH[depth]
+    conv = conv_bn_layer(input, 64, 7, 2, 3, is_test=is_test)
+    pool = layers.pool2d(conv, pool_size=3, pool_stride=2, pool_padding=1, pool_type="max")
+    res = pool
+    for i, count in enumerate(stages):
+        res = layer_warp(block_fn, res, 64 * (2 ** i), count, 1 if i == 0 else 2, is_test=is_test)
+    pool2 = layers.pool2d(res, pool_type="avg", global_pooling=True)
+    flat_ch = pool2.shape[1]
+    flat = layers.reshape(pool2, [-1, int(flat_ch)])
+    return layers.fc(flat, size=class_dim)
+
+
+def build(depth=50, class_dim=1000, image_shape=(3, 224, 224), learning_rate=0.1,
+          momentum=0.9, with_optimizer=True, dtype="float32", is_test=False):
+    """Returns (main, startup, feeds, fetches) for ImageNet-style training.
+
+    dtype="bfloat16" casts the input into bf16 so every conv/matmul hits the
+    MXU in its native type; master weights stay fp32 (XLA upcasts per-op
+    operands as needed) and the loss is computed in fp32.
+    """
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        img = layers.data("img", list(image_shape), dtype="float32")
+        label = layers.data("label", [1], dtype="int64")
+        net_in = layers.cast(img, dtype) if dtype != "float32" else img
+        logits = resnet_imagenet(net_in, class_dim=class_dim, depth=depth, is_test=is_test)
+        logits = layers.cast(logits, "float32") if dtype != "float32" else logits
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
+        acc = layers.accuracy(layers.softmax(logits), label)
+        if with_optimizer:
+            optimizer.Momentum(learning_rate=learning_rate, momentum=momentum).minimize(loss)
+    return main, startup, {"img": img, "label": label}, {"loss": loss, "acc": acc, "logits": logits}

@@ -30,6 +30,20 @@ def np_dtype(attr_dtype):
     return as_np_dtype(attr_dtype)
 
 
+def match_dtype(x, y):
+    """Harmonize a parameter/second operand to the activation dtype for
+    mixed precision: when both are floats of different width, y follows x
+    (so bf16 activations keep convs/matmuls on the MXU in bf16 while master
+    weights stay fp32)."""
+    if (
+        x.dtype != y.dtype
+        and jnp.issubdtype(x.dtype, jnp.floating)
+        and jnp.issubdtype(y.dtype, jnp.floating)
+    ):
+        return y.astype(x.dtype)
+    return y
+
+
 def normalize_axes(dim, ndim):
     if dim is None:
         return tuple(range(ndim))

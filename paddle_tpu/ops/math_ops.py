@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.registry import register_op
-from .common import bcast_y_to_x, first, normalize_axes
+from .common import bcast_y_to_x, first, match_dtype, normalize_axes
 
 
 # --- elementwise binary ops ------------------------------------------------
@@ -20,7 +20,7 @@ from .common import bcast_y_to_x, first, normalize_axes
 def _ew(fn):
     def lower(ctx, op, ins):
         x = first(ins, "X")
-        y = bcast_y_to_x(x, first(ins, "Y"), op.attr("axis", -1))
+        y = match_dtype(x, bcast_y_to_x(x, first(ins, "Y"), op.attr("axis", -1)))
         return {"Out": fn(x, y)}
 
     return lower
@@ -147,6 +147,7 @@ def _mul(ctx, op, ins):
     yd = op.attr("y_num_col_dims", 1)
     import numpy as _np
 
+    y = match_dtype(x, y)
     xs, ys = x.shape, y.shape
     x2 = x if x.ndim == 2 else jnp.reshape(x, (int(_np.prod(xs[:xd])), int(_np.prod(xs[xd:]))))
     y2 = y if y.ndim == 2 else jnp.reshape(y, (int(_np.prod(ys[:yd])), int(_np.prod(ys[yd:]))))
@@ -158,7 +159,7 @@ def _mul(ctx, op, ins):
 @register_op("matmul")
 def _matmul(ctx, op, ins):
     x = first(ins, "X")
-    y = first(ins, "Y")
+    y = match_dtype(x, first(ins, "Y"))
     if op.attr("transpose_X", False):
         x = jnp.swapaxes(x, -1, -2)
     if op.attr("transpose_Y", False):

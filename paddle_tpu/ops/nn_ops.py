@@ -15,13 +15,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.registry import register_op
-from .common import first
+from .common import first, match_dtype
 
 
 @register_op("conv2d")
 def _conv2d(ctx, op, ins):
     x = first(ins, "Input")
-    w = first(ins, "Filter")
+    w = match_dtype(x, first(ins, "Filter"))
     strides = tuple(op.attr("strides", [1, 1]))
     pads = op.attr("paddings", [0, 0])
     dilations = tuple(op.attr("dilations", [1, 1]))
@@ -47,7 +47,7 @@ def _depthwise_conv2d(ctx, op, ins):
 @register_op("conv2d_transpose")
 def _conv2d_transpose(ctx, op, ins):
     x = first(ins, "Input")
-    w = first(ins, "Filter")  # fluid layout: (in, out, kh, kw)
+    w = match_dtype(x, first(ins, "Filter"))  # fluid layout: (in, out, kh, kw)
     strides = tuple(op.attr("strides", [1, 1]))
     pads = op.attr("paddings", [0, 0])
     dilations = tuple(op.attr("dilations", [1, 1]))
@@ -118,6 +118,11 @@ def _pool2d(ctx, op, ins):
 @register_op("batch_norm")
 def _batch_norm(ctx, op, ins):
     x = first(ins, "X")
+    # normalize in fp32 regardless of activation dtype (bf16 batch stats
+    # lose too much precision); output returns to the activation dtype
+    orig_dtype = x.dtype
+    if x.dtype in (jnp.bfloat16, jnp.float16):
+        x = x.astype(jnp.float32)
     scale = first(ins, "Scale")
     bias = first(ins, "Bias")
     mean_in = first(ins, "Mean")
@@ -145,7 +150,7 @@ def _batch_norm(ctx, op, ins):
     inv = jax.lax.rsqrt(var.reshape(bshape) + eps)
     y = (x - mean.reshape(bshape)) * inv * scale.reshape(bshape) + bias.reshape(bshape)
     return {
-        "Y": y,
+        "Y": y.astype(orig_dtype),
         "MeanOut": mean_out,
         "VarianceOut": var_out,
         "SavedMean": saved_mean,

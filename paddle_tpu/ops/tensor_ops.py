@@ -241,6 +241,12 @@ def _assign_value(ctx, op, ins):
     return {"Out": jnp.asarray(arr)}
 
 
+@register_op("increment")
+def _increment(ctx, op, ins):
+    x = first(ins, "X")
+    return {"Out": x + op.attr("step", 1.0)}
+
+
 @register_op("fill_zeros_like")
 def _fill_zeros_like(ctx, op, ins):
     return {"Out": jnp.zeros_like(first(ins, "X"))}

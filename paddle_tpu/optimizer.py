@@ -84,8 +84,11 @@ class Optimizer:
 
     # --- public API -------------------------------------------------------
     def apply_gradients(self, params_grads) -> List:
+        from .clip import append_gradient_clip_ops
+
         block = default_main_program().global_block()
         self._create_global_learning_rate()
+        params_grads = append_gradient_clip_ops(params_grads)
         params_grads = append_regularization_ops(params_grads, self.regularization)
         self._create_accumulators(block, [p for p, _ in params_grads])
         ops = []

@@ -1,0 +1,12 @@
+"""Parallel execution: SPMD over a jax device mesh.
+
+Replaces the reference's ParallelExecutor machinery (SURVEY.md §2a #10-15:
+multi-device SSA graph builder, per-gradient NCCL allreduce op handles,
+dep-counter thread pools) with ONE jit-compiled SPMD program: feeds are
+batch-sharded over the `dp` mesh axis, parameters are replicated (or sharded
+over `tp`/`mp` axes by sharding hints), and XLA inserts the collectives the
+reference emitted as c_allreduce ops.  `ring_id` -> named mesh axis.
+"""
+from .compiled_program import CompiledProgram, ExecutionStrategy, BuildStrategy  # noqa: F401
+from .mesh import make_mesh  # noqa: F401
+from .sharding import shard_parameters  # noqa: F401

@@ -1,0 +1,79 @@
+"""CompiledProgram: the data-parallel façade.
+
+Reference: python/paddle/fluid/compiler.py (CompiledProgram:48,
+with_data_parallel:116) — there it builds the per-device SSA graph with
+NCCL allreduce nodes; here it just records a mesh + sharding choice and the
+executor jits ONE SPMD program.  BuildStrategy/ExecutionStrategy are kept
+as accepted-and-mostly-ignored config carriers: their reference knobs
+(fuse_all_reduce, num_threads, ...) are XLA's job now.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .mesh import make_mesh
+
+
+class ExecutionStrategy:
+    """reference: framework/details/execution_strategy.h"""
+
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 1
+        self.use_experimental_executor = False
+
+
+class BuildStrategy:
+    """reference: framework/details/build_strategy.h:36 — knobs map to XLA:
+    fuse_all_reduce_ops ≈ allreduce combining (automatic), reduce_strategy
+    kReduce ≈ ZeRO-style sharded update (future), memory_optimize ≈ XLA
+    buffer assignment."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.fuse_all_reduce_ops = False
+        self.memory_optimize = False
+        self.enable_inplace = False
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy: Optional[BuildStrategy] = None):
+        self.program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+        self.mesh = None
+        self.loss_name = None
+        self.batch_axis = "dp"
+
+    def with_data_parallel(
+        self,
+        loss_name: Optional[str] = None,
+        build_strategy: Optional[BuildStrategy] = None,
+        exec_strategy: Optional[ExecutionStrategy] = None,
+        share_vars_from=None,
+        places=None,
+    ) -> "CompiledProgram":
+        """Mark for SPMD data-parallel execution over all (or `places`)
+        devices.  Batch-dim-0 feeds are sharded over the `dp` axis;
+        gradients allreduce automatically under GSPMD."""
+        if build_strategy is not None:
+            self.build_strategy = build_strategy
+        self.loss_name = loss_name
+        n = len(places) if places is not None else None
+        import jax
+
+        devices = jax.devices()
+        if n is not None:
+            devices = devices[:n]
+        self.mesh = make_mesh((len(devices),), ("dp",), devices)
+        return self
+
+    def with_mesh(self, mesh, batch_axis: str = "dp") -> "CompiledProgram":
+        """Explicit-mesh variant (new capability: dp x tp x ... meshes).
+        Parameter placement comes from program.sharding_hints."""
+        self.mesh = mesh
+        self.batch_axis = batch_axis
+        return self

@@ -1,0 +1,33 @@
+"""Device-mesh helpers.
+
+The reference builds NCCL rings over explicit gpu lists
+(platform/nccl_helper.h:90 NCCLContextMap); here a mesh is the single
+topology object and collectives ride ICI/DCN via XLA.  Hierarchical
+allreduce (nccl_helper.h:246) needs no equivalent: multi-host meshes get
+ICI-then-DCN reduction from the compiler.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    axis_sizes: Optional[Sequence[int]] = None,
+    axis_names: Sequence[str] = ("dp",),
+    devices=None,
+) -> Mesh:
+    """Build a Mesh.  Default: 1-D `dp` mesh over all devices."""
+    if devices is None:
+        devices = jax.devices()
+    if axis_sizes is None:
+        axis_sizes = (len(devices),)
+    n = int(np.prod(axis_sizes))
+    if n > len(devices):
+        raise ValueError(f"mesh wants {n} devices, only {len(devices)} available")
+    arr = np.asarray(devices[:n]).reshape(axis_sizes)
+    return Mesh(arr, tuple(axis_names))

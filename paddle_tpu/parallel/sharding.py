@@ -1,0 +1,34 @@
+"""Parameter-sharding hints.
+
+A Program carries `sharding_hints`: var name -> PartitionSpec-style tuple of
+mesh-axis names (None = replicated dim).  The executor turns hints into
+`in_shardings`/`out_shardings` for the jitted step, so tensor-parallel
+layouts are declarative — GSPMD inserts the all-gathers/reduce-scatters.
+The reference has no TP (SURVEY.md §2c: absent in 2019); this is the
+documented new capability.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Sequence, Tuple
+
+
+def shard_parameters(program, rules: Dict[str, Tuple[Optional[str], ...]]):
+    """Attach sharding hints by param-name regex.
+
+    rules: {name_regex: partition_spec_tuple}, e.g.
+        {r".*ffn1.w.*": (None, "tp"), r".*ffn2.w.*": ("tp", None)}
+    First matching rule wins.  Returns the number of params annotated.
+    """
+    count = 0
+    compiled = [(re.compile(pat), spec) for pat, spec in rules.items()]
+    for v in program.list_vars():
+        if not v.persistable:
+            continue
+        for pat, spec in compiled:
+            if pat.fullmatch(v.name):
+                program.sharding_hints[v.name] = tuple(spec)
+                count += 1
+                break
+    program._bump()
+    return count

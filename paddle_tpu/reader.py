@@ -1,0 +1,291 @@
+"""Data feeding: reader decorators + prefetching DataLoader.
+
+Reference counterparts:
+  * python/paddle/reader decorators (shuffle/batch/xmap) — pure-python;
+  * reader.py:45 PyReader + operators/reader/buffered_reader.cc — the
+    lock-free queue + double-buffer (async H2D) pipeline;
+  * framework/data_feed.cc Dataset — multithreaded file parsing.
+
+TPU-first shape: a background thread converts numpy batches and
+`jax.device_put`s them ahead of consumption (double/triple buffering), so
+host->device transfer overlaps the device step exactly like
+buffered_reader.cc overlapped cudaMemcpyAsync.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+# --- reader decorators (reference: python/paddle/reader/decorator.py) ------
+
+def shuffle(reader: Callable, buf_size: int):
+    def reader_():
+        import random
+
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        random.shuffle(buf)
+        yield from buf
+
+    return reader_
+
+
+def batch(reader: Callable, batch_size: int, drop_last: bool = False):
+    def reader_():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return reader_
+
+
+def chain(*readers):
+    def reader_():
+        for r in readers:
+            yield from r()
+
+    return reader_
+
+
+def map_readers(func, *readers):
+    def reader_():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return reader_
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader via worker threads (decorator.py xmap)."""
+
+    def reader_():
+        in_q: "queue.Queue" = queue.Queue(buffer_size)
+        out_q: "queue.Queue" = queue.Queue(buffer_size)
+        END = object()
+
+        def feed():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(END)
+
+        def work():
+            while True:
+                s = in_q.get()
+                if s is END:
+                    out_q.put(END)
+                    return
+                i, sample = s
+                out_q.put((i, mapper(sample)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        workers = [threading.Thread(target=work, daemon=True) for _ in range(process_num)]
+        for w in workers:
+            w.start()
+        done = 0
+        if not order:
+            while done < process_num:
+                item = out_q.get()
+                if item is END:
+                    done += 1
+                    continue
+                yield item[1]
+            return
+        pending = {}
+        next_idx = 0
+        while done < process_num:
+            item = out_q.get()
+            if item is END:
+                done += 1
+                continue
+            pending[item[0]] = item[1]
+            while next_idx in pending:
+                yield pending.pop(next_idx)
+                next_idx += 1
+        while next_idx in pending:
+            yield pending.pop(next_idx)
+            next_idx += 1
+
+    return reader_
+
+
+def cache(reader):
+    """Materializes the full reader exactly once, up front, so a partially
+    consumed first epoch can't truncate later epochs."""
+    state = {"data": None}
+
+    def reader_():
+        if state["data"] is None:
+            state["data"] = list(reader())
+        yield from state["data"]
+
+    return reader_
+
+
+def firstn(reader, n):
+    def reader_():
+        for i, item in enumerate(reader()):
+            if i >= n:
+                return
+            yield item
+
+    return reader_
+
+
+# --- DataFeeder (reference: data_feeder.py) --------------------------------
+
+class DataFeeder:
+    """Converts a list of per-sample tuples into a feed dict of batched
+    numpy arrays keyed by the given feed variables."""
+
+    def __init__(self, feed_list: Sequence, place=None, program=None):
+        self.feed_vars = list(feed_list)
+
+    def feed(self, samples: Iterable) -> Dict[str, np.ndarray]:
+        cols = None
+        for sample in samples:
+            if cols is None:
+                cols = [[] for _ in sample]
+            for i, v in enumerate(sample):
+                cols[i].append(np.asarray(v))
+        out = {}
+        for var, col in zip(self.feed_vars, cols):
+            arr = np.stack(col)
+            from .core.dtypes import as_np_dtype
+
+            want = as_np_dtype(var.dtype)
+            if arr.dtype != want:
+                arr = arr.astype(want)
+            shape = var.shape
+            if shape is not None and len(shape) == arr.ndim + 1 and shape[-1] == 1:
+                arr = arr[..., None]  # fluid's trailing label dim
+            out[var.name] = arr
+        return out
+
+
+# --- prefetching loader (PyReader / buffered_reader equivalent) ------------
+
+class DataLoader:
+    """Background-thread device prefetcher.
+
+    `from_generator` mirrors fluid.io.DataLoader/PyReader: wrap a batch
+    generator (yielding feed dicts or tuples), get an iterator of
+    device-resident feed dicts, `capacity` batches deep.
+    """
+
+    def __init__(self, feed_list: Sequence, capacity: int = 2, device=None, sharding=None):
+        self.feed_vars = list(feed_list)
+        self.capacity = capacity
+        self.device = device
+        self.sharding = sharding  # optional dict name->Sharding for SPMD
+        self._gen: Optional[Callable] = None
+
+    @staticmethod
+    def from_generator(feed_list: Sequence, capacity: int = 2, device=None, sharding=None,
+                       iterable: bool = True):
+        return DataLoader(feed_list, capacity, device, sharding)
+
+    def set_batch_generator(self, gen: Callable):
+        self._gen = gen
+        return self
+
+    def set_sample_list_generator(self, gen: Callable):
+        feeder = DataFeeder(self.feed_vars)
+
+        def batches():
+            for sample_list in gen():
+                yield feeder.feed(sample_list)
+
+        self._gen = batches
+        return self
+
+    def _place(self, arr):
+        if self.sharding is not None:
+            return jax.device_put(arr, self.sharding)
+        if self.device is not None:
+            return jax.device_put(arr, self.device)
+        return jax.device_put(arr)
+
+    def __iter__(self) -> Iterator[Dict[str, jax.Array]]:
+        if self._gen is None:
+            raise RuntimeError("DataLoader: call set_batch_generator first")
+        q: "queue.Queue" = queue.Queue(self.capacity)
+        END = object()
+        name_dtypes = {}
+        from .core.dtypes import as_np_dtype
+
+        for v in self.feed_vars:
+            name_dtypes[v.name] = as_np_dtype(v.dtype)
+
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            """put that gives up when the consumer abandoned the iterator,
+            so the producer can't block forever holding device buffers."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for item in self._gen():
+                    if stop.is_set():
+                        return
+                    if not isinstance(item, dict):
+                        item = {v.name: a for v, a in zip(self.feed_vars, item)}
+                    placed = {}
+                    for n, a in item.items():
+                        a = np.asarray(a)
+                        want = name_dtypes.get(n)
+                        if want is not None and a.dtype != want:
+                            a = a.astype(want)
+                        if a.dtype == np.int64:
+                            a = a.astype(np.int32)
+                        elif a.dtype == np.float64:
+                            a = a.astype(np.float32)
+                        placed[n] = self._place(a)
+                    if not _put(placed):
+                        return
+            except BaseException as e:  # propagate to the consumer thread
+                _put(("__error__", e))
+            finally:
+                _put(END)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is END:
+                    return
+                if isinstance(item, tuple) and len(item) == 2 and item[0] == "__error__":
+                    raise RuntimeError("DataLoader generator raised") from item[1]
+                yield item
+        finally:
+            # consumer exited (break/exception/GC): release the producer
+            stop.set()
+
+
+# PyReader is the reference's older name for the same machinery.
+PyReader = DataLoader

@@ -2,7 +2,7 @@
 always-on 'fake TPU'); real-TPU runs happen via bench.py."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # override axon: tests run on virtual mesh
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
@@ -13,6 +13,7 @@ import pytest  # noqa: E402
 # Persistent XLA compile cache: repeated test runs skip recompiles.
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")  # in case jax was imported pre-conftest
 jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_xla_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 

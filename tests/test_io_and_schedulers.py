@@ -1,0 +1,132 @@
+"""Checkpoint save/load, inference model, LR schedulers, grad clipping
+(reference: test_dist_save_load.py checkpoint equivalence;
+test_learning_rate_scheduler.py; test_gradient_clip.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _toy_model():
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    return main, startup, pred, loss
+
+
+def test_save_load_persistables_roundtrip(tmp_path):
+    main, startup, pred, loss = _toy_model()
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    for _ in range(5):
+        xv = rng.rand(8, 4).astype("f4")
+        exe.run(main, feed={"x": xv, "y": xv.sum(1, keepdims=True)}, fetch_list=[loss], scope=scope)
+    ckpt = str(tmp_path / "ckpt")
+    fluid.io.save_persistables(exe, ckpt, main, scope=scope)
+
+    # fresh scope: load and continue — step must be bit-comparable
+    scope2 = fluid.Scope()
+    fluid.io.load_persistables(exe, ckpt, main, scope=scope2)
+    xv = rng.rand(8, 4).astype("f4")
+    feed = {"x": xv, "y": xv.sum(1, keepdims=True)}
+    (a,) = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+    (b,) = exe.run(main, feed=feed, fetch_list=[loss], scope=scope2)
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_save_load_inference_model(tmp_path):
+    main, startup, pred, loss = _toy_model()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [pred], exe, main, scope=scope)
+
+    scope2 = fluid.Scope()
+    prog, feed_names, fetch_names = fluid.io.load_inference_model(d, exe, scope=scope2)
+    assert feed_names == ["x"]
+    xv = np.random.rand(2, 4).astype("f4")
+    (a,) = exe.run(main, feed={"x": xv}, fetch_list=[pred], scope=scope)
+    (b,) = exe.run(prog, feed={"x": xv}, fetch_list=fetch_names, scope=scope2)
+    np.testing.assert_allclose(a, b, rtol=1e-6)
+    # inference program must not require labels
+    types = [op.type for op in prog.global_block().ops]
+    assert "square_error_cost" not in types
+
+
+@pytest.mark.parametrize(
+    "make_lr,expect",
+    [
+        (lambda: fluid.layers.exponential_decay(0.1, 10, 0.5), lambda s: 0.1 * 0.5 ** (s / 10)),
+        (lambda: fluid.layers.natural_exp_decay(0.1, 10, 0.5), lambda s: 0.1 * np.exp(-0.5 * s / 10)),
+        (lambda: fluid.layers.inverse_time_decay(0.1, 10, 0.5), lambda s: 0.1 / (1 + 0.5 * s / 10)),
+        (lambda: fluid.layers.polynomial_decay(0.1, 100, 0.01, 1.0), lambda s: 0.01 + (0.1 - 0.01) * (1 - s / 100)),
+        (lambda: fluid.layers.cosine_decay(0.1, 1, 100), lambda s: 0.1 * 0.5 * (np.cos(np.floor(s) * np.pi / 100) + 1)),
+    ],
+)
+def test_lr_schedules(make_lr, expect):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(fluid.layers.fc(x, 1), y))
+        lr = make_lr()
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    feed = {"x": np.ones((2, 4), "f4"), "y": np.ones((2, 1), "f4")}
+    # first run computes with step 0 (reference _decay_step_counter semantics)
+    for step in range(5):
+        (lv,) = exe.run(main, feed=feed, fetch_list=[lr], scope=scope)
+        np.testing.assert_allclose(lv[0], expect(step), rtol=1e-5, err_msg=f"step {step}")
+
+
+def test_piecewise_decay():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(fluid.layers.fc(x, 1), y))
+        lr = fluid.layers.piecewise_decay([3, 6], [0.1, 0.05, 0.01])
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    feed = {"x": np.ones((2, 4), "f4"), "y": np.ones((2, 1), "f4")}
+    got = []
+    for step in range(8):
+        (lv,) = exe.run(main, feed=feed, fetch_list=[lr], scope=scope)
+        got.append(float(lv[0]))
+    expect = [0.1, 0.1, 0.1, 0.05, 0.05, 0.05, 0.01, 0.01]
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+
+def test_grad_clip_by_global_norm():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1, bias_attr=False,
+                               param_attr=fluid.ParamAttr(initializer=fluid.initializer.Constant(0.0)))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.clip.set_gradient_clip(fluid.clip.GradientClipByGlobalNorm(0.01))
+        opt = fluid.optimizer.SGD(learning_rate=1.0)
+        _, pg = opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    # huge targets -> unclipped grad would be enormous; update must be <= lr*clip_norm
+    xv = np.ones((4, 4), "f4")
+    yv = np.full((4, 1), 1000.0, "f4")
+    exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss], scope=scope)
+    w = scope.to_numpy(pg[0][0].name)
+    assert np.linalg.norm(w) <= 0.0101, np.linalg.norm(w)

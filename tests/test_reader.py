@@ -1,0 +1,63 @@
+"""Reader decorators + DataLoader prefetch (reference: reader decorators,
+PyReader tests)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import reader as rd
+
+
+def test_decorators():
+    def r():
+        yield from range(10)
+
+    b = rd.batch(r, 3)
+    batches = list(b())
+    assert batches[0] == [0, 1, 2] and len(batches) == 4
+    b2 = rd.batch(r, 3, drop_last=True)
+    assert len(list(b2())) == 3
+    s = rd.shuffle(r, 5)
+    assert sorted(list(s())) == list(range(10))
+    f = rd.firstn(r, 4)
+    assert list(f()) == [0, 1, 2, 3]
+    m = rd.map_readers(lambda a, b: a + b, r, r)
+    assert list(m())[:3] == [0, 2, 4]
+    x = rd.xmap_readers(lambda v: v * 2, r, 2, 4)
+    assert sorted(list(x())) == [v * 2 for v in range(10)]
+
+
+def test_dataloader_trains():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(x, 1), y)
+        )
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+
+    rng = np.random.RandomState(0)
+
+    def gen():
+        for _ in range(12):
+            xv = rng.rand(8, 4).astype("f4")
+            yield {"x": xv, "y": xv.sum(1, keepdims=True)}
+
+    loader = fluid.DataLoader.from_generator([x, y], capacity=3).set_batch_generator(gen)
+    losses = [float(exe.run(main, feed=f, fetch_list=[loss], scope=scope)[0][0]) for f in loader]
+    assert len(losses) == 12
+    assert losses[-1] < losses[0]
+
+
+def test_datafeeder_shapes():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [3, 8, 8], dtype="float32")
+        label = fluid.layers.data("label", [1], dtype="int64")
+    feeder = fluid.DataFeeder([img, label])
+    samples = [(np.zeros((3, 8, 8)), 7), (np.ones((3, 8, 8)), 2)]
+    feed = feeder.feed(samples)
+    assert feed["img"].shape == (2, 3, 8, 8) and feed["img"].dtype == np.float32
+    assert feed["label"].shape == (2, 1) and feed["label"].dtype == np.int64
