@@ -157,20 +157,36 @@ class _CompiledStep:
             )
 
     @staticmethod
+    def _effective_io(op):
+        """(reads, writes) including sub-block effects for control flow."""
+        reads = list(op.input_arg_names)
+        writes = list(op.output_arg_names)
+        if op.type in ("while", "conditional_block"):
+            idx = op.attrs.get("sub_block")
+            if idx is not None:
+                sub = op.block.program.blocks[idx]
+                for sop in sub.ops:
+                    r, w = _CompiledStep._effective_io(sop)
+                    reads.extend(r)
+                    writes.extend(w)
+        return reads, writes
+
+    @staticmethod
     def _prune(ops, fetch_names, persistable):
         """Fetch-driven dead-op elimination (the reference prunes programs to
         feed/fetch targets at io.py save_inference_model:915; here it runs on
         every compile so eval programs don't demand training-only feeds).
         Ops are kept if they (transitively) contribute to a fetch or write a
-        persistable var."""
+        persistable var.  Control-flow ops count their sub-block reads and
+        writes."""
         needed = set(fetch_names)
         kept = []
         for op in reversed(ops):
-            outs = op.output_arg_names
+            reads, outs = _CompiledStep._effective_io(op)
             writes_state = any(o in persistable for o in outs)
             if writes_state or any(o in needed for o in outs):
                 kept.append(op)
-                needed.update(op.input_arg_names)
+                needed.update(reads)
                 if op.type == "backward":
                     needed.add(op.attrs["loss_name"])
                     needed.update(op.attrs.get("param_names", []))

@@ -36,6 +36,9 @@ class LoweringContext:
         self.key = key
         self.is_test = is_test
         self.mesh = mesh
+        # current var env, set by run_ops; control-flow lowerings read it to
+        # capture outer values and compute loop-carried state
+        self.env: Dict[str, Any] = {}
 
     def next_key(self):
         self.key, sub = jax.random.split(self.key)
@@ -71,7 +74,10 @@ def lower_one(ctx: LoweringContext, op: Operator, env: Dict[str, Any]) -> None:
                 )
             vals.append(env[n])
         ins[slot] = vals
+    ctx.env = env
     outs = opdef.lower(ctx, op, ins)
+    if "__env_update__" in outs:  # control-flow ops write vars wholesale
+        env.update(outs.pop("__env_update__"))
     for slot, names in op.outputs.items():
         vals = outs.get(slot)
         if vals is None:

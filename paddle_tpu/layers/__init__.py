@@ -1,4 +1,16 @@
 """fluid.layers-compatible namespace."""
+from .control_flow import (  # noqa: F401
+    While,
+    array_length,
+    array_read,
+    array_write,
+    cond,
+    create_array,
+    equal,
+    greater_than,
+    increment,
+    less_than,
+)
 from .io import data  # noqa: F401
 from .learning_rate_scheduler import (  # noqa: F401
     cosine_decay,

@@ -635,6 +635,21 @@ def slice(input, axes, starts, ends):
     return out
 
 
+def ring_attention(q, k, v, causal=False, sp_axis="sp", batch_axis="dp", name=None):
+    """Sequence-parallel attention over (B, H, L, dh) tensors; L shards over
+    the `sp` mesh axis when the program runs on a mesh carrying it (new
+    capability vs the reference — SURVEY.md §5.7)."""
+    helper = LayerHelper("ring_attention", name=name)
+    out = _out(helper, q.dtype, shape=q.shape)
+    helper.append_op(
+        "ring_attention",
+        inputs={"Q": [q.name], "K": [k.name], "V": [v.name]},
+        outputs={"Out": [out.name]},
+        attrs={"causal": causal, "sp_axis": sp_axis, "batch_axis": batch_axis},
+    )
+    return out
+
+
 def dropout_prob_check(p):
     if not 0 <= p < 1:
         raise ValueError("dropout prob must be in [0,1)")

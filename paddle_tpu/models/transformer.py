@@ -23,7 +23,8 @@ def _attr(name):
     return ParamAttr(name=name, initializer=NormalInitializer(0.0, 0.02))
 
 
-def multi_head_attention(x, seq_len, d_model, n_heads, prefix, dropout_prob=0.1, is_test=False):
+def multi_head_attention(x, seq_len, d_model, n_heads, prefix, dropout_prob=0.1, is_test=False,
+                         use_ring_attention=False, causal=False):
     d_head = d_model // n_heads
     q = layers.fc(x, d_model, num_flatten_dims=2, param_attr=_attr(f"{prefix}.q.w"), bias_attr=_attr(f"{prefix}.q.b"))
     k = layers.fc(x, d_model, num_flatten_dims=2, param_attr=_attr(f"{prefix}.k.w"), bias_attr=_attr(f"{prefix}.k.b"))
@@ -34,21 +35,31 @@ def multi_head_attention(x, seq_len, d_model, n_heads, prefix, dropout_prob=0.1,
         return layers.transpose(t, [0, 2, 1, 3])  # (B, H, L, dh)
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
-    scores = layers.matmul(q, k, transpose_y=True, alpha=1.0 / np.sqrt(d_head))
-    attn = layers.softmax(scores)
-    if dropout_prob and not is_test:
-        attn = layers.dropout(attn, dropout_prob, is_test=is_test,
-                              dropout_implementation="upscale_in_train")
-    ctx = layers.matmul(attn, v)  # (B, H, L, dh)
+    if use_ring_attention:
+        # sequence-parallel blockwise attention (L shards over the sp axis);
+        # attention-prob dropout can't be applied inside the ring, so the
+        # equivalent regularization goes on the attention output instead
+        ctx = layers.ring_attention(q, k, v, causal=causal)
+        if dropout_prob and not is_test:
+            ctx = layers.dropout(ctx, dropout_prob, is_test=is_test,
+                                 dropout_implementation="upscale_in_train")
+    else:
+        scores = layers.matmul(q, k, transpose_y=True, alpha=1.0 / np.sqrt(d_head))
+        attn = layers.softmax(scores)
+        if dropout_prob and not is_test:
+            attn = layers.dropout(attn, dropout_prob, is_test=is_test,
+                                  dropout_implementation="upscale_in_train")
+        ctx = layers.matmul(attn, v)  # (B, H, L, dh)
     ctx = layers.transpose(ctx, [0, 2, 1, 3])
     ctx = layers.reshape(ctx, [-1, seq_len, d_model])
     return layers.fc(ctx, d_model, num_flatten_dims=2,
                      param_attr=_attr(f"{prefix}.out.w"), bias_attr=_attr(f"{prefix}.out.b"))
 
 
-def encoder_layer(x, seq_len, d_model, n_heads, d_ff, prefix, dropout_prob=0.1, is_test=False):
+def encoder_layer(x, seq_len, d_model, n_heads, d_ff, prefix, dropout_prob=0.1, is_test=False,
+                  use_ring_attention=False, causal=False):
     attn_out = multi_head_attention(x, seq_len, d_model, n_heads, f"{prefix}.attn",
-                                    dropout_prob, is_test)
+                                    dropout_prob, is_test, use_ring_attention, causal)
     x = layers.layer_norm(layers.elementwise_add(x, attn_out), begin_norm_axis=2,
                           param_attr=_attr(f"{prefix}.ln1.w"), bias_attr=_attr(f"{prefix}.ln1.b"))
     ffn1 = layers.fc(x, d_ff, num_flatten_dims=2, act="gelu",
@@ -73,6 +84,8 @@ def build_bert(
     learning_rate=1e-4,
     with_optimizer=True,
     is_test=False,
+    use_ring_attention=False,
+    causal=False,
 ):
     """BERT-base-style masked-LM pretraining program.
 
@@ -90,7 +103,7 @@ def build_bert(
                               bias_attr=_attr("bert.emb_ln.b"))
         for i in range(n_layers):
             x = encoder_layer(x, seq_len, d_model, n_heads, d_ff, f"bert.l{i}",
-                              dropout_prob, is_test)
+                              dropout_prob, is_test, use_ring_attention, causal)
         logits = layers.fc(x, vocab_size, num_flatten_dims=2,
                            param_attr=_attr("bert.lm_head.w"), bias_attr=_attr("bert.lm_head.b"))
         flat_logits = layers.reshape(logits, [-1, vocab_size])

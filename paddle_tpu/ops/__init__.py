@@ -1,2 +1,2 @@
 """Importing this package registers all op lowerings."""
-from . import math_ops, nn_ops, optimizer_ops, tensor_ops  # noqa: F401
+from . import control_flow_ops, math_ops, nn_ops, optimizer_ops, tensor_ops  # noqa: F401

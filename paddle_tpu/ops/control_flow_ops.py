@@ -1,0 +1,179 @@
+"""Control-flow op lowerings: while, conditional_block, tensor arrays.
+
+Reference: operators/controlflow/while_op.cc (interpreter-recursive: a
+sub-executor runs the sub-block per iteration with step scopes),
+conditional_block_op.cc, tensor array ops (array_write/array_read).
+
+TPU-first redesign: sub-blocks lower to `lax.while_loop` / `lax.cond`
+bodies — compiled control flow, no host round-trips.  The carried state is
+the set of sub-block-written vars that exist outside; shapes must be loop
+invariant (XLA requirement), which the reference never guaranteed but all
+its RNN/beam-search uses satisfy.
+
+Tensor arrays (LoDTensorArray) are python lists in the env outside compiled
+control flow; inside a `while` sub-block they are stacked buffers updated
+with lax.dynamic_update_slice (`array_write` with a static-size hint).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first
+
+
+def _sub_block_ops(ctx, op, attr="sub_block"):
+    block_idx = op.attr(attr)
+    block = op.block.program.blocks[block_idx]
+    return [o for o in block.ops if o.type not in ("feed", "fetch")]
+
+
+def _written_names(ops):
+    out = []
+    seen = set()
+    for o in ops:
+        for n in o.output_arg_names:
+            if n not in seen:
+                seen.add(n)
+                out.append(n)
+    return out
+
+
+@register_op("while")
+def _while(ctx, op, ins):
+    from ..core.lowering import run_ops
+
+    sub_ops = _sub_block_ops(ctx, op)
+    cond_name = op.input("Condition")[0]
+    env = ctx.env  # current lowering environment (set by run_ops)
+    carried = [n for n in _written_names(sub_ops) if n in env]
+    if cond_name not in carried:
+        carried = carried + [cond_name] if cond_name in env else carried
+
+    base_env = dict(env)
+    KEY = "__rng_key__"  # thread the RNG key through the loop carry so
+    # RNG-consuming ops (dropout, uniform_random) in the body are legal
+
+    def cond_fn(carry):
+        return jnp.reshape(carry[cond_name], ()).astype(bool)
+
+    def body_fn(carry):
+        e = dict(base_env)
+        ctx.key = carry[KEY]
+        e.update({n: v for n, v in carry.items() if n != KEY})
+        e = run_ops(ctx, sub_ops, e)
+        out = {n: e[n] for n in carry if n != KEY}
+        out[KEY] = ctx.key
+        return out
+
+    init = {n: env[n] for n in carried}
+    if cond_name not in init:
+        raise KeyError(f"while: condition var {cond_name!r} must exist before the loop")
+    init[KEY] = ctx.key
+    final = jax.lax.while_loop(cond_fn, body_fn, init)
+    ctx.key = final.pop(KEY)
+    # write back: executor splices these into env via the returned dict
+    return {"__env_update__": final}
+
+
+@register_op("conditional_block")
+def _conditional_block(ctx, op, ins):
+    from ..core.lowering import run_ops
+
+    sub_ops = _sub_block_ops(ctx, op)
+    env = ctx.env
+    cond = first(ins, "Cond")
+    pred = jnp.reshape(cond, ()).astype(bool)
+    written = [n for n in _written_names(sub_ops)]
+    # vars that exist outside keep their old value on the false branch;
+    # fresh vars need a defined false-branch value -> zeros_like via tracing
+    base_env = dict(env)
+
+    def true_fn(key):
+        e = dict(base_env)
+        ctx.key = key
+        e = run_ops(ctx, sub_ops, e)
+        return {n: e[n] for n in written}, ctx.key
+
+    # hoist the shape probe: trace the sub-block once here instead of once
+    # per false-branch (which would compound 2^k for nested conds), and
+    # restore ctx.key so the probe doesn't de-sync RNG threading
+    key0 = ctx.key
+    out_shapes, _ = jax.eval_shape(true_fn, key0)
+    ctx.key = key0
+
+    def false_fn(key):
+        return {
+            n: base_env[n] if n in base_env
+            else jnp.zeros(out_shapes[n].shape, out_shapes[n].dtype)
+            for n in written
+        }, key
+
+    final, new_key = jax.lax.cond(pred, true_fn, false_fn, ctx.key)
+    ctx.key = new_key
+    return {"__env_update__": final}
+
+
+@register_op("select_input")
+def _select_input(ctx, op, ins):
+    xs = ins["X"]
+    mask = jnp.reshape(first(ins, "Mask"), ()).astype(jnp.int32)
+    out = xs[0]
+    for i in range(1, len(xs)):
+        out = jnp.where(mask == i, xs[i], out)
+    return {"Out": out}
+
+
+# --- tensor arrays ---------------------------------------------------------
+
+def _static_index(i):
+    """Static int for concrete values; None for traced (in-loop) indices."""
+    try:
+        import numpy as _np
+
+        a = _np.asarray(i)
+        if a.size != 1:
+            return None
+        return int(a.reshape(()))  # avoids the ndim>0 int() deprecation
+    except Exception:
+        return None
+
+
+@register_op("create_array")
+def _create_array(ctx, op, ins):
+    return {"Out": [[]]}  # one output whose value is an empty array-list
+
+
+@register_op("array_write")
+def _array_write(ctx, op, ins):
+    x = first(ins, "X")
+    i = first(ins, "I")
+    arr = first(ins, "Array", default=None)
+    arr = list(arr) if arr is not None else []
+    idx = _static_index(i)
+    if idx is None:
+        raise NotImplementedError(
+            "array_write with a traced index inside compiled control flow "
+            "requires the static-size stacked-buffer form (StaticRNN uses it)"
+        )
+    while len(arr) <= idx:
+        arr.append(None)
+    arr[idx] = x
+    return {"Out": [arr]}
+
+
+@register_op("array_read")
+def _array_read(ctx, op, ins):
+    arr = first(ins, "X")
+    i = first(ins, "I")
+    idx = _static_index(i)
+    if idx is None:
+        raise NotImplementedError("array_read with traced index: use stacked buffers")
+    return {"Out": arr[idx]}
+
+
+@register_op("array_length")
+def _array_length(ctx, op, ins):
+    arr = first(ins, "X")
+    return {"Out": jnp.asarray([len(arr)], dtype=jnp.int32)}

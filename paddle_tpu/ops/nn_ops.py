@@ -296,6 +296,25 @@ def _lookup_table(ctx, op, ins):
 register_op("lookup_table_v2")(_lookup_table)
 
 
+@register_op("ring_attention")
+def _ring_attention(ctx, op, ins):
+    """Sequence-parallel attention (parallel/ring_attention.py); falls back
+    to single-device blockwise attention without an `sp` mesh axis."""
+    from ..parallel.ring_attention import ring_attention
+
+    q = first(ins, "Q")
+    k = first(ins, "K")
+    v = first(ins, "V")
+    out = ring_attention(
+        q, k, v,
+        mesh=ctx.mesh,
+        axis_name=op.attr("sp_axis", "sp"),
+        causal=op.attr("causal", False),
+        batch_axis=op.attr("batch_axis", "dp"),
+    )
+    return {"Out": out}
+
+
 @register_op("top_k")
 def _top_k(ctx, op, ins):
     x = first(ins, "X")

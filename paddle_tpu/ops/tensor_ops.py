@@ -20,7 +20,10 @@ def _fill_constant(ctx, op, ins):
     shape = tuple(op.attr("shape", []))
     dtype = np_dtype(op.attr("dtype", "float32"))
     value = op.attr("value", 0.0)
-    return {"Out": jnp.full(shape, value, dtype=dtype)}
+    # host-side constant: stays concrete through the trace so tensor-array
+    # indices built from constants remain static; jnp coerces on use and
+    # XLA constant-folds either way
+    return {"Out": np.full(shape, value, dtype=dtype)}
 
 
 @register_op("uniform_random")
@@ -244,7 +247,8 @@ def _assign_value(ctx, op, ins):
 @register_op("increment")
 def _increment(ctx, op, ins):
     x = first(ins, "X")
-    return {"Out": x + op.attr("step", 1.0)}
+    step = np.asarray(op.attr("step", 1.0)).astype(x.dtype)  # keep int counters int
+    return {"Out": x + step}
 
 
 @register_op("fill_zeros_like")

@@ -1,0 +1,50 @@
+"""Embedding parallelism: row-sharded tables (distributed lookup_table).
+
+Reference: the pserver-sharded lookup table + remote prefetch
+(SURVEY.md §2c "Distributed lookup table": ids split over pservers,
+`parameter_prefetch.cc`).  TPU-first: the table is row-sharded over a mesh
+axis in HBM; lookup = local gather of in-range rows + `psum` combine over
+the axis (XLA emits the same all-to-all-ish traffic NCCL/pserver RPC
+carried).  Gradients scatter-add back into the local shard via autodiff.
+
+Two ways to use it:
+  * declarative: `shard_parameters(program, {"emb_table": ("ep", None)})` —
+    GSPMD partitions the plain lookup_table gather automatically;
+  * explicit: `sharded_lookup` below inside shard_map when you need the
+    collective pattern pinned (e.g. out-of-HBM staging, later rounds).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _lookup_local(ids, table_local, axis_name: str):
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    rows = table_local.shape[0]
+    lo = my * rows
+    local_ids = ids - lo
+    in_range = jnp.logical_and(local_ids >= 0, local_ids < rows)
+    safe = jnp.clip(local_ids, 0, rows - 1)
+    vals = jnp.take(table_local, safe, axis=0)
+    vals = jnp.where(in_range[..., None], vals, 0)
+    return jax.lax.psum(vals, axis_name)
+
+
+def sharded_lookup(ids, table, mesh: Mesh, axis_name: str = "ep"):
+    """ids: int (...,) replicated; table: (V, D) row-sharded over axis_name.
+    Returns (..., D) replicated embeddings."""
+    fn = functools.partial(_lookup_local, axis_name=axis_name)
+    shard = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P(), P(axis_name, None)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return shard(ids, table)

@@ -1,0 +1,83 @@
+"""Control flow tests (reference: test_while_op.py, test_cond / conditional
+block tests, tensor array tests)."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def test_while_loop_sum():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant([1], "float32", 0.0)
+        n = fluid.layers.fill_constant([1], "float32", 10.0)
+        acc = fluid.layers.fill_constant([1], "float32", 0.0)
+        i.stop_gradient = True
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with w.block():
+            new_acc = fluid.layers.elementwise_add(acc, i)
+            fluid.layers.assign(new_acc, acc)
+            fluid.layers.increment(i, 1.0)
+            fluid.layers.less_than(i, n, cond=cond)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (out,) = exe.run(main, fetch_list=[acc])
+    assert float(out[0]) == 45.0  # 0+1+...+9
+
+
+def test_while_matmul_power():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        i = fluid.layers.fill_constant([1], "float32", 0.0)
+        n = fluid.layers.fill_constant([1], "float32", 3.0)
+        cond = fluid.layers.less_than(i, n)
+        w = fluid.layers.While(cond)
+        with w.block():
+            doubled = fluid.layers.scale(x, scale=2.0)
+            fluid.layers.assign(doubled, x)
+            fluid.layers.increment(i, 1.0)
+            fluid.layers.less_than(i, n, cond=cond)
+        out = fluid.layers.scale(x, scale=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((2, 4), "f4")
+    (r,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(r, xv * 8.0)
+
+
+def test_cond_branches():
+    for flag, expect in [(1.0, 30.0), (-1.0, 10.0)]:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("flag", [1], dtype="float32", append_batch_size=False)
+            zero = fluid.layers.fill_constant([1], "float32", 0.0)
+            pred = fluid.layers.greater_than(x, zero)
+            t = fluid.layers.fill_constant([1], "float32", 30.0)
+            f = fluid.layers.fill_constant([1], "float32", 10.0)
+            out = fluid.layers.cond(
+                pred,
+                lambda: fluid.layers.scale(t, 1.0),
+                lambda: fluid.layers.scale(f, 1.0),
+            )
+        exe = fluid.Executor(fluid.CPUPlace())
+        (r,) = exe.run(main, feed={"flag": np.array([flag], "f4")}, fetch_list=[out])
+        assert float(r[0]) == expect, (flag, r)
+
+
+def test_tensor_array_outside_loop():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [3], dtype="float32")
+        i0 = fluid.layers.fill_constant([1], "int32", 0)
+        i1 = fluid.layers.fill_constant([1], "int32", 1)
+        arr = fluid.layers.array_write(x, i0)
+        y = fluid.layers.scale(x, 2.0)
+        fluid.layers.array_write(y, i1, array=arr)
+        ln = fluid.layers.array_length(arr)
+        r0 = fluid.layers.array_read(arr, i0)
+        r1 = fluid.layers.array_read(arr, i1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.ones((2, 3), "f4")
+    l, a, b = exe.run(main, feed={"x": xv}, fetch_list=[ln, r0, r1])
+    assert int(l[0]) == 2
+    np.testing.assert_allclose(a, xv)
+    np.testing.assert_allclose(b, xv * 2)

@@ -1,0 +1,62 @@
+"""GPipe pipeline: S-stage pipelined result must equal sequential
+application of the stages (reference: PipelineTrainer semantics)."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.parallel import make_mesh
+from paddle_tpu.parallel.pipeline import gpipe
+
+
+def _stage(params, x):
+    w, b = params["w"], params["b"]
+    return jnp.tanh(x @ w + b)
+
+
+def test_gpipe_matches_sequential():
+    rng = np.random.RandomState(0)
+    S, M, mb, d = 4, 8, 4, 16
+    ws = rng.randn(S, d, d).astype("f4") * 0.3
+    bs = rng.randn(S, d).astype("f4") * 0.1
+    xs = rng.randn(M, mb, d).astype("f4")
+
+    # sequential reference
+    ref = xs.copy()
+    out = []
+    for m in range(M):
+        h = xs[m]
+        for s in range(S):
+            h = np.tanh(h @ ws[s] + bs[s])
+        out.append(h)
+    ref = np.stack(out)
+
+    mesh = make_mesh((S,), ("pp",))
+    got = gpipe(_stage, {"w": jnp.asarray(ws), "b": jnp.asarray(bs)}, jnp.asarray(xs), mesh)
+    np.testing.assert_allclose(np.asarray(got), ref, atol=1e-5, rtol=1e-5)
+
+
+def test_gpipe_differentiable():
+    """Backward through the pipeline (vjp of ppermute) gives usable grads."""
+    rng = np.random.RandomState(1)
+    S, M, mb, d = 2, 4, 2, 8
+    params = {
+        "w": jnp.asarray(rng.randn(S, d, d).astype("f4") * 0.3),
+        "b": jnp.asarray(rng.randn(S, d).astype("f4") * 0.1),
+    }
+    xs = jnp.asarray(rng.randn(M, mb, d).astype("f4"))
+    mesh = make_mesh((S,), ("pp",), jax.devices()[:S])
+
+    def loss_fn(p):
+        ys = gpipe(_stage, p, xs, mesh)
+        return jnp.sum(ys ** 2)
+
+    g = jax.grad(loss_fn)(params)
+    assert np.isfinite(np.asarray(g["w"])).all()
+    assert np.abs(np.asarray(g["w"])).sum() > 0
+    # numeric check on one coordinate
+    eps = 1e-3
+    p2 = {"w": params["w"].at[0, 0, 0].add(eps), "b": params["b"]}
+    p3 = {"w": params["w"].at[0, 0, 0].add(-eps), "b": params["b"]}
+    num = (loss_fn(p2) - loss_fn(p3)) / (2 * eps)
+    np.testing.assert_allclose(float(g["w"][0, 0, 0]), float(num), rtol=2e-2)
