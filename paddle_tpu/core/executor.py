@@ -318,7 +318,17 @@ class Executor:
                 for n, v in jfeeds.items()
             }
 
-        fetches, new_key = compiled(scope, jfeeds, key)
+        from .. import profiler as _prof
+
+        if _prof.is_profiler_enabled():
+            import time as _time
+
+            t0 = _time.perf_counter()
+            fetches, new_key = compiled(scope, jfeeds, key)
+            jax.block_until_ready(fetches)
+            _prof.record_run(f"executor.run[{program._uuid[:8]}]", _time.perf_counter() - t0)
+        else:
+            fetches, new_key = compiled(scope, jfeeds, key)
         scope.set_var(RNG_STATE_VAR, new_key)
 
         if return_numpy:

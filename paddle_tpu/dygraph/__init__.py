@@ -1,0 +1,16 @@
+"""Dygraph (imperative) mode — reference: python/paddle/fluid/dygraph/ +
+paddle/fluid/imperative/ (SURVEY.md §2e)."""
+from .base import VarBase, Tape, enabled, guard, to_variable  # noqa: F401
+from .checkpoint import load_persistables, save_persistables  # noqa: F401
+from .layers import Layer  # noqa: F401
+from .nn import (  # noqa: F401
+    FC,
+    BatchNorm,
+    Conv2D,
+    Dropout,
+    Embedding,
+    LayerNorm,
+    Linear,
+    Pool2D,
+)
+from .parallel import DataParallel  # noqa: F401

@@ -1,0 +1,203 @@
+"""Dygraph core: VarBase + tape autograd + guard.
+
+Reference: paddle/fluid/imperative/ (Tracer `tracer.h:41`, VarBase
+`layer.h:133`, OpBase grad graph + `Engine` reverse pass) and
+python/paddle/fluid/dygraph/base.py.
+
+TPU-first redesign: eager ops execute as jax calls on device arrays; the
+tape records (fn, inputs, outputs) and `backward()` replays it in reverse
+with per-entry `jax.vjp` — the grad graph the reference assembled from
+registered GradOpMakers falls out of jax's functional AD.  Each eager call
+dispatches like the reference's dygraph (per-op), so this mode is for
+flexibility/debugging; `to_static`-style capture into a Program (and thus
+one XLA computation) is the performance path.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_dygraph_tracer: Optional["Tape"] = None
+
+
+def enabled() -> bool:
+    return _dygraph_tracer is not None
+
+
+def _tape() -> Optional["Tape"]:
+    return _dygraph_tracer
+
+
+class VarBase:
+    """Eager tensor: device array + grad slot (reference: layer.h:133)."""
+
+    def __init__(self, value, stop_gradient: bool = False, name: Optional[str] = None,
+                 persistable: bool = False):
+        if isinstance(value, VarBase):
+            value = value.value
+        self.value = jnp.asarray(value)
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.name = name
+        self.grad: Optional[jnp.ndarray] = None
+
+    # --- introspection ---------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return str(self.value.dtype)
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self.value)
+
+    def gradient(self) -> Optional[np.ndarray]:
+        return None if self.grad is None else np.asarray(self.grad)
+
+    def clear_gradient(self):
+        self.grad = None
+
+    def set_value(self, value):
+        self.value = jnp.asarray(value)
+
+    def detach(self) -> "VarBase":
+        return VarBase(self.value, stop_gradient=True, name=self.name)
+
+    def astype(self, dtype) -> "VarBase":
+        from ..core.dtypes import as_np_dtype
+
+        return _apply("cast", lambda x: x.astype(as_np_dtype(dtype)), self)
+
+    # --- autograd --------------------------------------------------------
+    def backward(self, retain_graph: bool = False):
+        tape = _tape()
+        if tape is None:
+            raise RuntimeError("backward() outside fluid.dygraph.guard()")
+        tape.backward(self, retain_graph=retain_graph)
+
+    # --- operator sugar --------------------------------------------------
+    def _bin(self, other, fn, name):
+        if not isinstance(other, VarBase):
+            other = VarBase(jnp.asarray(other, dtype=self.value.dtype), stop_gradient=True)
+        return _apply(name, fn, self, other)
+
+    def __add__(self, o):
+        return self._bin(o, jnp.add, "add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._bin(o, jnp.subtract, "sub")
+
+    def __rsub__(self, o):
+        return VarBase(o, stop_gradient=True)._bin(self, jnp.subtract, "sub") if not isinstance(o, VarBase) else o._bin(self, jnp.subtract, "sub")
+
+    def __mul__(self, o):
+        return self._bin(o, jnp.multiply, "mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._bin(o, jnp.divide, "div")
+
+    def __matmul__(self, o):
+        return self._bin(o, jnp.matmul, "matmul")
+
+    def __neg__(self):
+        return _apply("neg", jnp.negative, self)
+
+    def __repr__(self):
+        return f"VarBase(shape={self.shape}, dtype={self.dtype}, stop_gradient={self.stop_gradient})\n{self.value}"
+
+
+class _TapeEntry:
+    __slots__ = ("fn", "inputs", "outputs")
+
+    def __init__(self, fn, inputs, outputs):
+        self.fn = fn
+        self.inputs = inputs
+        self.outputs = outputs
+
+
+class Tape:
+    """Records eager ops; replays reversed with jax.vjp (reference: Engine
+    `imperative/engine.cc` sorted-sum backward)."""
+
+    def __init__(self):
+        self.entries: List[_TapeEntry] = []
+
+    def record(self, fn, inputs: Sequence[VarBase], outputs: Sequence[VarBase]):
+        if any(not i.stop_gradient for i in inputs):
+            self.entries.append(_TapeEntry(fn, list(inputs), list(outputs)))
+            for o in outputs:
+                o.stop_gradient = False
+        else:
+            for o in outputs:
+                o.stop_gradient = True
+
+    def backward(self, loss: VarBase, retain_graph: bool = False):
+        grads: Dict[int, jnp.ndarray] = {id(loss): jnp.ones_like(loss.value)}
+        for entry in reversed(self.entries):
+            cots = []
+            needed = False
+            for o in entry.outputs:
+                g = grads.get(id(o))
+                if g is None:
+                    g = jnp.zeros_like(o.value)
+                else:
+                    needed = True
+                cots.append(g)
+            if not needed:
+                continue
+            primals = [i.value for i in entry.inputs]
+            _, vjp_fn = jax.vjp(entry.fn, *primals)
+            in_grads = vjp_fn(cots[0] if len(cots) == 1 else tuple(cots))
+            for iv, g in zip(entry.inputs, in_grads):
+                if iv.stop_gradient or g is None:
+                    continue
+                prev = grads.get(id(iv))
+                grads[id(iv)] = g if prev is None else prev + g
+                iv.grad = grads[id(iv)]
+        if not retain_graph:
+            self.entries.clear()
+
+
+def _apply(name: str, fn: Callable, *inputs: VarBase, n_out: int = 1) -> VarBase:
+    """Run fn eagerly on VarBase inputs, record on the tape."""
+    vals = [i.value for i in inputs]
+    out_vals = fn(*vals)
+    multi = isinstance(out_vals, (tuple, list))
+    outs = [VarBase(v) for v in (out_vals if multi else [out_vals])]
+    tape = _tape()
+    if tape is not None:
+        tape.record(fn, inputs, outs)
+    else:
+        for o in outs:
+            o.stop_gradient = True
+    return tuple(outs) if multi else outs[0]
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """reference: fluid.dygraph.guard() — enables eager mode."""
+    global _dygraph_tracer
+    old = _dygraph_tracer
+    _dygraph_tracer = Tape()
+    try:
+        yield
+    finally:
+        _dygraph_tracer = old
+
+
+def to_variable(value, name=None, zero_copy=None) -> VarBase:
+    if isinstance(value, VarBase):
+        return value
+    return VarBase(np.asarray(value), stop_gradient=True, name=name)

@@ -1,0 +1,152 @@
+"""Eager implementations of stateless fluid.layers functions.
+
+The reference routes layers.* through the imperative Tracer in dygraph
+mode; here layers/nn.py dispatches to these when `dygraph.enabled()`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import VarBase, _apply
+
+
+def _v(x):
+    return x if isinstance(x, VarBase) else VarBase(x, stop_gradient=True)
+
+
+def mean(x, **kw):
+    return _apply("mean", lambda v: jnp.mean(v).reshape((1,)), _v(x))
+
+
+def relu(x, **kw):
+    return _apply("relu", jax.nn.relu, _v(x))
+
+
+def sigmoid(x, **kw):
+    return _apply("sigmoid", jax.nn.sigmoid, _v(x))
+
+
+def tanh(x, **kw):
+    return _apply("tanh", jnp.tanh, _v(x))
+
+
+def sqrt(x, **kw):
+    return _apply("sqrt", jnp.sqrt, _v(x))
+
+
+def square(x, **kw):
+    return _apply("square", jnp.square, _v(x))
+
+
+def exp(x, **kw):
+    return _apply("exp", jnp.exp, _v(x))
+
+
+def log(x, **kw):
+    return _apply("log", jnp.log, _v(x))
+
+
+def softmax(x, axis=-1, **kw):
+    return _apply("softmax", lambda v: jax.nn.softmax(v, axis=axis), _v(x))
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, **kw):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b) * alpha
+
+    return _apply("matmul", fn, _v(x), _v(y))
+
+
+def reshape(x, shape, **kw):
+    def fn(v):
+        out_shape = [v.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+        return jnp.reshape(v, out_shape)
+
+    return _apply("reshape", fn, _v(x))
+
+
+def transpose(x, perm, **kw):
+    return _apply("transpose", lambda v: jnp.transpose(v, perm), _v(x))
+
+
+def concat(xs, axis=0, **kw):
+    vars_ = [_v(x) for x in xs]
+    return _apply("concat", lambda *vs: jnp.concatenate(vs, axis=axis), *vars_)
+
+
+def reduce_sum(x, dim=None, keep_dim=False, **kw):
+    axes = None if dim is None else tuple(dim) if isinstance(dim, (list, tuple)) else (dim,)
+    return _apply("reduce_sum", lambda v: jnp.sum(v, axis=axes, keepdims=keep_dim), _v(x))
+
+
+def reduce_mean(x, dim=None, keep_dim=False, **kw):
+    axes = None if dim is None else tuple(dim) if isinstance(dim, (list, tuple)) else (dim,)
+    return _apply("reduce_mean", lambda v: jnp.mean(v, axis=axes, keepdims=keep_dim), _v(x))
+
+
+def square_error_cost(input, label, **kw):
+    return _apply("square_error_cost", lambda a, b: jnp.square(a - b), _v(input), _v(label))
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100, **kw):
+    lbl = _v(label)
+
+    def fn(x):
+        if soft_label:
+            return -jnp.sum(lbl.value * jnp.log(jnp.clip(x, 1e-20)), axis=-1, keepdims=True)
+        idx = lbl.value
+        if idx.ndim != x.ndim or idx.shape[-1] != 1:
+            idx = idx[..., None]
+        picked = jnp.take_along_axis(x, idx.astype(jnp.int32), axis=-1)
+        loss = -jnp.log(jnp.clip(picked, 1e-20))
+        return jnp.where(idx == ignore_index, 0.0, loss)
+
+    return _apply("cross_entropy", fn, _v(input))
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               return_softmax=False, **kw):
+    lbl = _v(label)
+
+    def fn(x):
+        logp = jax.nn.log_softmax(x, axis=-1)
+        if soft_label:
+            return -jnp.sum(lbl.value * logp, axis=-1, keepdims=True)
+        idx = lbl.value
+        if idx.ndim != x.ndim or idx.shape[-1] != 1:
+            idx = idx[..., None]
+        picked = jnp.take_along_axis(logp, idx.astype(jnp.int32), axis=-1)
+        return jnp.where(idx == ignore_index, 0.0, -picked)
+
+    loss = _apply("softmax_with_cross_entropy", fn, _v(logits))
+    if return_softmax:
+        sm = softmax(logits)
+        return loss, sm
+    return loss
+
+
+def accuracy(input, label, k=1, **kw):
+    x = _v(input)
+    l = _v(label)
+    vals, idx = jax.lax.top_k(x.value, k)
+    correct = (idx == l.value.astype(idx.dtype)).any(axis=-1)
+    return VarBase(jnp.mean(correct.astype(jnp.float32)).reshape((1,)), stop_gradient=True)
+
+
+def dropout(x, dropout_prob, is_test=False, dropout_implementation="downgrade_in_infer", **kw):
+    import numpy as np
+
+    xv = _v(x)
+    if is_test:
+        if dropout_implementation == "upscale_in_train":
+            return xv
+        return _apply("dropout", lambda v: v * (1.0 - dropout_prob), xv)
+    mask = (np.random.rand(*xv.shape) >= dropout_prob).astype("float32")
+    if dropout_implementation == "upscale_in_train":
+        return _apply("dropout", lambda v: v * mask / (1.0 - dropout_prob), xv)
+    return _apply("dropout", lambda v: v * mask, xv)

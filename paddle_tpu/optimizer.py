@@ -102,12 +102,43 @@ class Optimizer:
         return append_backward(loss, parameter_list, no_grad_set, callbacks)
 
     def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        from .dygraph import base as _dy
+
+        if _dy.enabled():
+            return self._dygraph_minimize(loss, parameter_list)
         params_grads = self.backward(loss, startup_program, parameter_list, no_grad_set)
         optimize_ops = self.apply_gradients(params_grads)
         return optimize_ops, params_grads
 
+    # --- dygraph (eager) path --------------------------------------------
+    def _dygraph_minimize(self, loss, parameter_list):
+        """Applies the update rule eagerly from each param's .grad
+        (reference: optimizer.py dygraph branch — grads come from
+        loss.backward(), which the caller invokes first)."""
+        if parameter_list is None:
+            raise ValueError("dygraph minimize() needs parameter_list")
+        if not hasattr(self, "_eager_state"):
+            self._eager_state: Dict[int, dict] = {}
+        lr = self._learning_rate() if callable(self._learning_rate) else self._learning_rate
+        updated = []
+        for p in parameter_list:
+            if p.grad is None or p.stop_gradient:
+                continue
+            st = self._eager_state.setdefault(id(p), {})
+            p.value = self._eager_update(p.value, p.grad, float(lr), st)
+            updated.append(p)
+        return [], [(p, p.grad) for p in updated]
+
+    def _eager_update(self, p, g, lr, state):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no eager (dygraph) update rule yet"
+        )
+
 
 class SGDOptimizer(Optimizer):
+    def _eager_update(self, p, g, lr, state):
+        return p - lr * g
+
     def _append_optimize_op(self, block, param_and_grad):
         p, g = param_and_grad
         return block.append_op(
@@ -122,6 +153,19 @@ class MomentumOptimizer(Optimizer):
         super().__init__(learning_rate, **kw)
         self._momentum = momentum
         self._use_nesterov = use_nesterov
+
+    def _eager_update(self, p, g, lr, state):
+        import jax.numpy as jnp
+
+        v = state.get("velocity")
+        v = jnp.zeros_like(p) if v is None else v
+        v_new = self._momentum * v + g
+        if self._use_nesterov:
+            p_new = p - lr * (g + self._momentum * v_new)
+        else:
+            p_new = p - lr * v_new
+        state["velocity"] = v_new
+        return p_new
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
@@ -148,6 +192,21 @@ class AdamOptimizer(Optimizer):
                  lazy_mode=False, **kw):
         super().__init__(learning_rate, **kw)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _eager_update(self, p, g, lr, state):
+        import jax.numpy as jnp
+
+        m1 = state.get("m1")
+        m1 = jnp.zeros_like(p) if m1 is None else m1
+        m2 = state.get("m2")
+        m2 = jnp.zeros_like(p) if m2 is None else m2
+        b1p = state.get("b1p", 1.0) * self._beta1
+        b2p = state.get("b2p", 1.0) * self._beta2
+        m1 = self._beta1 * m1 + (1 - self._beta1) * g
+        m2 = self._beta2 * m2 + (1 - self._beta2) * jnp.square(g)
+        lr_t = lr * (1 - b2p) ** 0.5 / (1 - b1p)
+        state.update(m1=m1, m2=m2, b1p=b1p, b2p=b2p)
+        return p - lr_t * m1 / (jnp.sqrt(m2) + self._epsilon)
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:

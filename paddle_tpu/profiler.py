@@ -1,0 +1,97 @@
+"""Profiler (reference: python/paddle/fluid/profiler.py + platform/profiler
+RecordEvent/DeviceTracer, SURVEY.md §5.1).
+
+Two layers, mirroring the reference:
+  * host-side per-run records: the executor reports (program, wall time,
+    cache hit) per `run()`; `stop_profiler` prints the aggregate table the
+    reference printed from EventList;
+  * device-side: `jax.profiler` traces (xprof) exported to a directory —
+    Chrome/perfetto-compatible, the role tools/timeline.py played.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Optional
+
+import jax
+
+_records = defaultdict(lambda: {"calls": 0, "total_s": 0.0, "max_s": 0.0, "min_s": float("inf")})
+_enabled = False
+_trace_dir: Optional[str] = None
+
+
+def is_profiler_enabled() -> bool:
+    return _enabled
+
+
+def record_run(tag: str, seconds: float):
+    if not _enabled:
+        return
+    r = _records[tag]
+    r["calls"] += 1
+    r["total_s"] += seconds
+    r["max_s"] = max(r["max_s"], seconds)
+    r["min_s"] = min(r["min_s"], seconds)
+
+
+def reset_profiler():
+    _records.clear()
+
+
+def start_profiler(state: str = "All", tracer_option: Optional[str] = None,
+                   trace_dir: Optional[str] = None):
+    """state: CPU | GPU | All (kept for parity; device tracing needs
+    trace_dir)."""
+    global _enabled, _trace_dir
+    _enabled = True
+    _trace_dir = trace_dir
+    if trace_dir is not None:
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None):
+    global _enabled, _trace_dir
+    _enabled = False
+    if _trace_dir is not None:
+        jax.profiler.stop_trace()
+        _trace_dir = None
+    table = summary(sorted_key)
+    if profile_path:
+        with open(profile_path, "w") as f:
+            f.write(table)
+    else:
+        print(table)
+    return table
+
+
+def summary(sorted_key: str = "total") -> str:
+    keyfn = {
+        "total": lambda kv: -kv[1]["total_s"],
+        "calls": lambda kv: -kv[1]["calls"],
+        "max": lambda kv: -kv[1]["max_s"],
+        "min": lambda kv: kv[1]["min_s"],
+        "ave": lambda kv: -(kv[1]["total_s"] / max(kv[1]["calls"], 1)),
+    }.get(sorted_key, lambda kv: -kv[1]["total_s"])
+    lines = [
+        f"{'Event':<40} {'Calls':>8} {'Total(ms)':>12} {'Avg(ms)':>10} {'Max(ms)':>10} {'Min(ms)':>10}"
+    ]
+    for tag, r in sorted(_records.items(), key=keyfn):
+        avg = r["total_s"] / max(r["calls"], 1)
+        lines.append(
+            f"{tag:<40} {r['calls']:>8} {r['total_s']*1e3:>12.3f} {avg*1e3:>10.3f} "
+            f"{r['max_s']*1e3:>10.3f} {(0 if r['min_s']==float('inf') else r['min_s'])*1e3:>10.3f}"
+        )
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: str = "total", profile_path: Optional[str] = None,
+             trace_dir: Optional[str] = None):
+    """reference: fluid.profiler.profiler context manager (profiler.py:222)."""
+    start_profiler(state, trace_dir=trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

@@ -1,0 +1,140 @@
+"""Dygraph tests (reference: test_imperative_*.py — including the
+dygraph == static-graph loss parity pattern, SURVEY.md §4.6)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph
+
+
+class MLP(dygraph.Layer):
+    def __init__(self):
+        super().__init__("mlp")
+        self.fc1 = dygraph.Linear(16, 32, act="relu")
+        self.fc2 = dygraph.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(self.fc1(x))
+
+
+def test_eager_forward_backward():
+    with dygraph.guard():
+        model = MLP()
+        x = dygraph.to_variable(np.random.rand(8, 16).astype("f4"))
+        label = dygraph.to_variable(np.random.randint(0, 4, (8, 1)))
+        logits = model(x)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label)
+        )
+        loss.backward()
+        grads = [p.gradient() for p in model.parameters()]
+        assert all(g is not None for g in grads)
+        assert any(np.abs(g).sum() > 0 for g in grads)
+
+
+def test_eager_training_converges():
+    rng = np.random.RandomState(0)
+    protos = rng.randn(4, 16).astype("f4") * 2
+    with dygraph.guard():
+        model = MLP()
+        opt = fluid.optimizer.Adam(learning_rate=0.01)
+        losses = []
+        for step in range(60):
+            lab = rng.randint(0, 4, (32, 1))
+            xv = protos[lab[:, 0]] + 0.5 * rng.randn(32, 16).astype("f4")
+            x = dygraph.to_variable(xv)
+            label = dygraph.to_variable(lab)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(model(x), label)
+            )
+            loss.backward()
+            opt.minimize(loss, parameter_list=model.parameters())
+            model.clear_gradients()
+            losses.append(float(loss.numpy()[0]))
+        assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+
+def test_dygraph_matches_static_graph():
+    """Same init + same data => dygraph loss == static-graph loss
+    (the reference's test_imperative_mnist pattern)."""
+    rng = np.random.RandomState(3)
+    w1 = rng.randn(8, 8).astype("f4") * 0.3
+    b1 = np.zeros(8, "f4")
+    w2 = rng.randn(8, 1).astype("f4") * 0.3
+    xv = rng.rand(4, 8).astype("f4")
+    yv = xv.sum(1, keepdims=True).astype("f4")
+
+    # static
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h = fluid.layers.fc(
+            x, 8, act="relu",
+            param_attr=fluid.ParamAttr(initializer=fluid.initializer.NumpyArrayInitializer(w1)),
+        )
+        pred = fluid.layers.fc(
+            h, 1, bias_attr=False,
+            param_attr=fluid.ParamAttr(initializer=fluid.initializer.NumpyArrayInitializer(w2)),
+        )
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    (static_loss,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss], scope=scope)
+
+    # dygraph with identical weights
+    with dygraph.guard():
+        lin1 = dygraph.Linear(8, 8, act="relu")
+        lin1.weight.set_value(w1)
+        lin1.bias.set_value(b1)
+        lin2 = dygraph.Linear(8, 1)
+        lin2.weight.set_value(w2)
+        lin2.bias.set_value(np.zeros(1, "f4"))
+        out = lin2(lin1(dygraph.to_variable(xv)))
+        dloss = fluid.layers.mean(
+            fluid.layers.square_error_cost(out, dygraph.to_variable(yv))
+        )
+        np.testing.assert_allclose(dloss.numpy(), static_loss, rtol=1e-5)
+
+
+def test_dygraph_conv_bn_and_state_dict(tmp_path):
+    with dygraph.guard():
+        conv = dygraph.Conv2D(1, 4, 3)
+        bn = dygraph.BatchNorm(4)
+        x = dygraph.to_variable(np.random.rand(2, 1, 8, 8).astype("f4"))
+        y = bn(conv(x))
+        s = fluid.layers.mean(y)
+        s.backward()
+        assert conv.weight.gradient() is not None
+
+        class Net(dygraph.Layer):
+            def __init__(self, c, b):
+                super().__init__("net")
+                self.c = c
+                self.b = b
+
+        net = Net(conv, bn)
+        state = net.state_dict()
+        # conv w/b + bn scale/bias + bn running mean/variance
+        assert len(state) == 6
+        d = str(tmp_path / "dyckpt")
+        dygraph.save_persistables(net, d)
+        loaded = dygraph.load_persistables(d)
+        for k, v in net.state_dict().items():
+            np.testing.assert_allclose(loaded[k], v)
+
+
+def test_embedding_and_dropout_layers():
+    with dygraph.guard():
+        emb = dygraph.Embedding([50, 8])
+        ids = dygraph.to_variable(np.array([[1], [2], [3]]))
+        e = emb(ids)
+        assert e.shape == (3, 8)
+        drop = dygraph.Dropout(0.5)
+        y = drop(e)
+        loss = fluid.layers.mean(y)
+        loss.backward()
+        assert emb.weight.gradient() is not None
+        drop.eval()
+        y2 = drop(e.detach())
+        np.testing.assert_allclose(y2.numpy(), e.numpy())
