@@ -28,6 +28,7 @@ from .parallel import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa:
 from . import parallel as compiler  # reference exposes fluid.compiler.CompiledProgram  # noqa: F401
 from . import clip  # noqa: F401
 from . import io  # noqa: F401
+from .lod import LoDTensor, create_lod_tensor  # noqa: F401
 from . import models  # noqa: F401
 from . import reader  # noqa: F401
 from .reader import DataFeeder, DataLoader, PyReader  # noqa: F401

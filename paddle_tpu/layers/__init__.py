@@ -26,6 +26,24 @@ from .learning_rate_scheduler import (  # noqa: F401
 )
 from .metric import accuracy  # noqa: F401
 from .nn import *  # noqa: F401,F403
+from .sequence import (  # noqa: F401
+    DynamicRNN,
+    sequence_concat,
+    sequence_conv,
+    sequence_enumerate,
+    sequence_erase,
+    sequence_expand,
+    sequence_expand_as,
+    sequence_first_step,
+    sequence_last_step,
+    sequence_mask,
+    sequence_pad,
+    sequence_pool,
+    sequence_reverse,
+    sequence_slice,
+    sequence_softmax,
+    sequence_unpad,
+)
 from .tensor import (  # noqa: F401
     argmax,
     argmin,

@@ -8,9 +8,20 @@ from ..core.program import default_main_program
 def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True, stop_gradient=True):
     """Declare an input variable.  append_batch_size=True prefixes -1, like
     the reference; the concrete batch size binds at feed time and is part of
-    the executor's compile-cache key."""
+    the executor's compile-cache key.
+
+    lod_level >= 1 declares a ragged input: the padded carrier gets shape
+    [-1(batch), -1(time), *shape] plus an int32 lengths companion
+    `<name>@LOD` (paddle_tpu/lod.py); feeding a `fluid.LoDTensor` (or a
+    list of per-sequence arrays) fills both."""
+    from ..lod import lod_var_name
+
     shape = list(shape)
-    if append_batch_size:
+    if lod_level >= 1:
+        if append_batch_size:
+            shape = [-1, -1] + shape  # batch, bucketed time, *feature
+        # append_batch_size=False: caller already included batch+time dims
+    elif append_batch_size:
         shape = [-1] + shape
     block = default_main_program().current_block()
     var = block.create_var(
@@ -21,4 +32,13 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True, stop
         is_data=True,
         stop_gradient=stop_gradient,
     )
+    if lod_level >= 1:
+        lod = block.create_var(
+            lod_var_name(name),
+            shape=[-1],
+            dtype="int32",
+            is_data=True,
+            stop_gradient=True,
+        )
+        var._lod_ref = lod
     return var

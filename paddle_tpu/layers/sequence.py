@@ -1,0 +1,412 @@
+"""Sequence (ragged/LoD) layers.
+
+Reference surface: `python/paddle/fluid/layers/nn.py` sequence_* functions
+(sequence_pool, sequence_softmax, sequence_expand:4995, sequence_conv:2173,
+sequence_pad/unpad, sequence_reverse, ...) and `layers/control_flow.py:1692
+DynamicRNN`.  Here a ragged variable is padded dense [batch, time, *feature]
+with an int32 lengths companion (`<name>@LOD`); see paddle_tpu/lod.py.
+
+Every layer threads the lengths companion for the caller: derived ragged
+outputs carry `._lod_ref` pointing at their lengths Variable.
+"""
+from __future__ import annotations
+
+from ..core import unique_name
+from ..core.layer_helper import LayerHelper
+from ..core.program import default_main_program
+from ..lod import lod_var_name
+
+
+def _lod_of(x):
+    ref = getattr(x, "_lod_ref", None)
+    if ref is None:
+        raise ValueError(
+            f"{x.name!r} is not a ragged variable: declare it with "
+            "layers.data(..., lod_level=1) or produce it with a sequence layer"
+        )
+    return ref
+
+
+def _set_lod(var, lod_var):
+    var._lod_ref = lod_var
+    var.lod_level = 1
+    return var
+
+
+def _new_lod_var(helper, hint):
+    return helper.create_variable_for_type_inference("int32", shape=(-1,))
+
+
+def sequence_pool(input, pool_type="average"):
+    helper = LayerHelper("sequence_pool")
+    lod = _lod_of(input)
+    out_shape = None
+    if input.shape is not None:
+        out_shape = (input.shape[0],) + tuple(input.shape[2:])
+    out = helper.create_variable_for_type_inference(input.dtype, shape=out_shape)
+    max_index = helper.create_variable_for_type_inference("int32", shape=out_shape)
+    helper.append_op(
+        "sequence_pool",
+        inputs={"X": [input.name], "XLod": [lod.name]},
+        outputs={"Out": [out.name], "MaxIndex": [max_index.name]},
+        attrs={"pooltype": pool_type.upper()},
+    )
+    return out
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "last")
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    lod = _lod_of(input)
+    out = helper.create_variable_for_type_inference(input.dtype, shape=input.shape)
+    helper.append_op(
+        "sequence_softmax",
+        inputs={"X": [input.name], "XLod": [lod.name]},
+        outputs={"Out": [out.name]},
+    )
+    return _set_lod(out, lod)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Broadcast one row of x per batch item across y's time axis, masked to
+    y's lengths (reference sequence_expand with lod-level-0 x)."""
+    helper = LayerHelper("sequence_expand", name=name)
+    ylod = _lod_of(y)
+    out_shape = None
+    if x.shape is not None and y.shape is not None:
+        feat = tuple(x.shape[1:]) if len(x.shape) == 2 or x.shape[1] != 1 else tuple(x.shape[2:])
+        out_shape = (x.shape[0], y.shape[1]) + feat
+    out = helper.create_variable_for_type_inference(x.dtype, shape=out_shape)
+    helper.append_op(
+        "sequence_expand",
+        inputs={"X": [x.name], "Y": [y.name], "YLod": [ylod.name]},
+        outputs={"Out": [out.name]},
+        attrs={"ref_level": ref_level},
+    )
+    return _set_lod(out, ylod)
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y, name=name)
+
+
+def sequence_reverse(x, name=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    lod = _lod_of(x)
+    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    helper.append_op(
+        "sequence_reverse",
+        inputs={"X": [x.name], "XLod": [lod.name]},
+        outputs={"Out": [out.name]},
+    )
+    return _set_lod(out, lod)
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """Returns (padded dense tensor, lengths) like the reference (Out, Length)."""
+    helper = LayerHelper("sequence_pad", name=name)
+    lod = _lod_of(x)
+    T = maxlen if maxlen is not None else (x.shape[1] if x.shape is not None else None)
+    out_shape = None
+    if x.shape is not None and T is not None and T > 0:
+        out_shape = (x.shape[0], T) + tuple(x.shape[2:])
+    out = helper.create_variable_for_type_inference(x.dtype, shape=out_shape)
+    length = helper.create_variable_for_type_inference("int64", shape=(-1,))
+    helper.append_op(
+        "sequence_pad",
+        inputs={"X": [x.name], "XLod": [lod.name], "PadValue": [pad_value.name]},
+        outputs={"Out": [out.name], "Length": [length.name]},
+        attrs={"padded_length": -1 if maxlen is None else int(maxlen)},
+    )
+    return out, length
+
+
+def sequence_unpad(x, length, name=None):
+    """Dense [b, T, *f] + lengths -> ragged variable."""
+    helper = LayerHelper("sequence_unpad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    out_lod = _new_lod_var(helper, out.name)
+    helper.append_op(
+        "sequence_unpad",
+        inputs={"X": [x.name], "Length": [length.name]},
+        outputs={"Out": [out.name], "OutLod": [out_lod.name]},
+    )
+    return _set_lod(out, out_lod)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1, padding=True,
+                  padding_start=None, bias_attr=None, param_attr=None, act=None, name=None):
+    helper = LayerHelper("sequence_conv", name=name, act=act)
+    lod = _lod_of(input)
+    d = int(input.shape[-1])
+    w = helper.create_parameter(param_attr, [filter_size * d, num_filters], input.dtype)
+    out_shape = None
+    if input.shape is not None:
+        out_shape = tuple(input.shape[:2]) + (num_filters,)
+    out = helper.create_variable_for_type_inference(input.dtype, shape=out_shape)
+    helper.append_op(
+        "sequence_conv",
+        inputs={"X": [input.name], "XLod": [lod.name], "Filter": [w.name]},
+        outputs={"Out": [out.name]},
+        attrs={
+            "contextStart": padding_start,
+            "contextLength": filter_size,
+            "contextStride": filter_stride,
+        },
+    )
+    pre_act = helper.append_bias_op(out, bias_attr, [num_filters], dim_start=2)
+    return _set_lod(helper.append_activation(pre_act), lod)
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper("sequence_concat", name=name)
+    xs = list(input)
+    lods = [_lod_of(x) for x in xs]
+    T_out = None
+    if all(x.shape is not None and x.shape[1] and x.shape[1] > 0 for x in xs):
+        T_out = sum(int(x.shape[1]) for x in xs)
+    out_shape = None
+    if xs[0].shape is not None and T_out is not None:
+        out_shape = (xs[0].shape[0], T_out) + tuple(xs[0].shape[2:])
+    out = helper.create_variable_for_type_inference(xs[0].dtype, shape=out_shape)
+    out_lod = _new_lod_var(helper, out.name)
+    helper.append_op(
+        "sequence_concat",
+        inputs={"X": [x.name for x in xs], "XLod": [l.name for l in lods]},
+        outputs={"Out": [out.name], "OutLod": [out_lod.name]},
+    )
+    return _set_lod(out, out_lod)
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", name=name)
+    lod = _lod_of(input)
+    out = helper.create_variable_for_type_inference(input.dtype, shape=input.shape)
+    out_lod = _new_lod_var(helper, out.name)
+    helper.append_op(
+        "sequence_slice",
+        inputs={"X": [input.name], "XLod": [lod.name],
+                "Offset": [offset.name], "Length": [length.name]},
+        outputs={"Out": [out.name], "OutLod": [out_lod.name]},
+    )
+    return _set_lod(out, out_lod)
+
+
+def sequence_erase(input, tokens, name=None):
+    helper = LayerHelper("sequence_erase", name=name)
+    lod = _lod_of(input)
+    out = helper.create_variable_for_type_inference(input.dtype, shape=input.shape)
+    out_lod = _new_lod_var(helper, out.name)
+    helper.append_op(
+        "sequence_erase",
+        inputs={"X": [input.name], "XLod": [lod.name]},
+        outputs={"Out": [out.name], "OutLod": [out_lod.name]},
+        attrs={"tokens": list(tokens)},
+    )
+    return _set_lod(out, out_lod)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    helper = LayerHelper("sequence_enumerate", name=name)
+    lod = _lod_of(input)
+    out_shape = None
+    if input.shape is not None:
+        out_shape = tuple(input.shape[:2]) + (win_size,)
+    out = helper.create_variable_for_type_inference(input.dtype, shape=out_shape)
+    out_lod = _new_lod_var(helper, out.name)
+    helper.append_op(
+        "sequence_enumerate",
+        inputs={"X": [input.name], "XLod": [lod.name]},
+        outputs={"Out": [out.name], "OutLod": [out_lod.name]},
+        attrs={"win_size": win_size, "pad_value": pad_value},
+    )
+    return _set_lod(out, out_lod)
+
+
+def sequence_mask(x, maxlen, dtype="int64", name=None):
+    """x holds lengths; out[i, t] = t < x[i] (reference sequence_mask op).
+    maxlen must be a build-time int (static shapes under jit)."""
+    helper = LayerHelper("sequence_mask", name=name)
+    if maxlen is None or int(maxlen) <= 0:
+        raise ValueError("sequence_mask needs a positive build-time maxlen on TPU")
+    out = helper.create_variable_for_type_inference(dtype, shape=(-1, int(maxlen)))
+    helper.append_op(
+        "sequence_mask",
+        inputs={"X": [x.name]},
+        outputs={"Y": [out.name]},
+        attrs={"maxlen": int(maxlen), "out_dtype": dtype},
+    )
+    return out
+
+
+class DynamicRNN:
+    """Reference `layers/control_flow.py:1692` — with-block RNN over ragged
+    input.  The reference interprets the sub-block per time step over
+    length-sorted shrinking batches; here the sub-block lowers to one
+    `lax.scan` over the padded time axis with per-step masking
+    (ops/sequence_ops.py `dynamic_rnn`), so the whole RNN is a single
+    compiled XLA While with static shapes.
+
+        drnn = layers.DynamicRNN()
+        with drnn.block():
+            word = drnn.step_input(emb)         # [b, f] per step
+            prev = drnn.memory(shape=[h])        # carried state
+            hidden = layers.fc([word, prev], h, act="tanh")
+            drnn.update_memory(prev, hidden)
+            drnn.output(hidden)
+        out = drnn()                             # ragged [b, T, h]
+    """
+
+    def __init__(self, name=None, is_reverse=False):
+        self.helper = LayerHelper("dynamic_rnn", name=name)
+        self.main = default_main_program()
+        self._steps = []      # (src Variable, sub Variable)
+        self._mems = []       # dict(sub, init, shape, dtype, update)
+        self._outputs = []    # sub Variables
+        self._out_vars = None
+        self._lod = None
+        self._sub_block = None
+        self.is_reverse = is_reverse
+
+    def block(self):
+        return _DRNNGuard(self)
+
+    def _require_in_block(self):
+        if self._sub_block is None or self.main.current_block() is not self._sub_block:
+            raise RuntimeError("call inside `with drnn.block():`")
+
+    def step_input(self, x):
+        self._require_in_block()
+        lod = _lod_of(x)
+        if self._lod is None:
+            self._lod = lod
+        shape = None
+        if x.shape is not None:
+            shape = (x.shape[0],) + tuple(x.shape[2:])
+        sub = self._sub_block.create_var(
+            unique_name.generate("drnn.step"), shape=shape, dtype=x.dtype
+        )
+        self._steps.append((x, sub))
+        return sub
+
+    def static_input(self, x):
+        # outer vars are visible inside the scan body via env capture
+        self._require_in_block()
+        return x
+
+    def memory(self, init=None, shape=None, value=0.0, dtype="float32"):
+        self._require_in_block()
+        if init is not None:
+            shape_full = init.shape
+            dtype = init.dtype
+        else:
+            if shape is None:
+                raise ValueError("memory() needs init= or shape=")
+            shape_full = (-1,) + tuple(int(s) for s in shape)
+        sub = self._sub_block.create_var(
+            unique_name.generate("drnn.mem"), shape=shape_full, dtype=dtype
+        )
+        self._mems.append(
+            {"sub": sub, "init": init, "shape": shape, "dtype": str(dtype), "update": None,
+             "value": value}
+        )
+        return sub
+
+    def update_memory(self, mem, new):
+        self._require_in_block()
+        for m in self._mems:
+            if m["sub"].name == mem.name:
+                m["update"] = new
+                return
+        raise ValueError(f"{mem.name!r} is not a drnn memory")
+
+    def output(self, *outputs):
+        self._require_in_block()
+        self._outputs.extend(outputs)
+
+    def _finalize(self, parent_block, sub_idx):
+        helper = self.helper
+        if not self._steps:
+            raise ValueError("DynamicRNN needs at least one step_input")
+        for m in self._mems:
+            if m["update"] is None:
+                raise ValueError(f"memory {m['sub'].name!r} never updated")
+        out_vars = []
+        for o in self._outputs:
+            shape = None
+            src = self._steps[0][0]
+            if o.shape is not None and src.shape is not None:
+                shape = (src.shape[0], src.shape[1]) + tuple(o.shape[1:])
+            ov = parent_block.create_var(
+                unique_name.generate("drnn.out"), shape=shape, dtype=o.dtype
+            )
+            out_vars.append(ov)
+        final_mems = []
+        for m in self._mems:
+            fv = parent_block.create_var(
+                unique_name.generate("drnn.final_mem"),
+                shape=m["sub"].shape,
+                dtype=m["sub"].dtype,
+            )
+            final_mems.append(fv)
+        inits = [m["init"] for m in self._mems if m["init"] is not None]
+        parent_block.append_op(
+            "dynamic_rnn",
+            inputs={
+                "X": [src.name for src, _ in self._steps],
+                "XLod": [self._lod.name],
+                "MemInit": [v.name for v in inits],
+            },
+            outputs={
+                "Out": [v.name for v in out_vars],
+                "FinalMem": [v.name for v in final_mems],
+            },
+            attrs={
+                "sub_block": sub_idx,
+                "step_vars": [sub.name for _, sub in self._steps],
+                "mem_vars": [m["sub"].name for m in self._mems],
+                "mem_updates": [m["update"].name for m in self._mems],
+                "out_vars": [o.name for o in self._outputs],
+                "mem_has_init": [m["init"] is not None for m in self._mems],
+                "mem_shapes": [list(m["shape"] or []) for m in self._mems],
+                "mem_dtypes": [m["dtype"] for m in self._mems],
+                "mem_values": [float(m["value"]) for m in self._mems],
+                "is_reverse": self.is_reverse,
+            },
+        )
+        for ov in out_vars:
+            _set_lod(ov, self._lod)
+        self._out_vars = out_vars
+        self._final_mems = final_mems
+
+    def __call__(self):
+        if self._out_vars is None:
+            raise RuntimeError("DynamicRNN block not finished")
+        return self._out_vars[0] if len(self._out_vars) == 1 else self._out_vars
+
+
+class _DRNNGuard:
+    def __init__(self, drnn: DynamicRNN):
+        self.drnn = drnn
+        self.main = drnn.main
+
+    def __enter__(self):
+        self.parent_block = self.main.current_block()
+        self.drnn._sub_block = self.main.create_block()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self.main.rollback()
+            return False
+        sub_idx = self.drnn._sub_block.idx
+        self.main.rollback()
+        self.drnn._finalize(self.parent_block, sub_idx)
+        return False

@@ -1,0 +1,141 @@
+"""LoD (ragged sequence) support — the TPU-native answer to LoDTensor.
+
+Reference: `framework/lod_tensor.h:58` (LoD = nested offset vectors) and
+`:110` (LoDTensor = tensor + LoD).  The reference keeps batches *flat*
+(shape [sum_len, ...] + offset table) and every sequence kernel walks the
+offsets.  A static-shape compiler wants the opposite: **padded dense
+[batch, max_len, ...] + a lengths vector**, with masks derived inside the
+compiled program (SURVEY.md §5.7/§7.8).  This module is that boundary:
+
+  * `LoDTensor` — host-side ragged container (list of per-sequence numpy
+    arrays).  `.padded(bucket=...)` produces (padded, lengths) with the
+    time axis bucketed (rounded up to a multiple / power of two) so feed
+    shape drift doesn't trigger a recompile per distinct max_len.
+  * `create_lod_tensor(data, recursive_seq_lens, place)` — reference API
+    (`lod_tensor.py:create_lod_tensor`) accepting the flat layout and
+    converting to ragged.
+
+Inside a Program, a ragged variable `x` (lod_level >= 1) is TWO arrays:
+`x` (padded) and `x@LOD` (int32 valid lengths, shape [batch]).  The
+executor feeds both when the user feeds a `LoDTensor`; sequence ops take
+the lengths as an explicit input slot and lower to masked dense compute.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+LOD_SUFFIX = "@LOD"
+
+# Default time-axis bucketing policy: round max_len up to a multiple of
+# _BUCKET_MULTIPLE, then to the next power of two once past _POW2_FROM.
+# Bounds distinct compiled shapes to O(log max_len) (SURVEY §7 hard part 6).
+_BUCKET_MULTIPLE = 8
+_POW2_FROM = 64
+
+
+def bucket_length(n: int) -> int:
+    """Smallest bucketed length >= n under the default policy."""
+    n = max(int(n), 1)
+    if n <= _POW2_FROM:
+        return -(-n // _BUCKET_MULTIPLE) * _BUCKET_MULTIPLE
+    b = _POW2_FROM
+    while b < n:
+        b *= 2
+    return b
+
+
+def lod_var_name(name: str) -> str:
+    return name + LOD_SUFFIX
+
+
+class LoDTensor:
+    """Host-side ragged batch: a list of per-sequence numpy arrays.
+
+    Each sequence has shape [len_i, *feature]; `padded()` stacks them into
+    [batch, bucket(max_len), *feature] plus an int32 lengths vector.
+    """
+
+    def __init__(self, sequences: Sequence[np.ndarray], dtype=None):
+        seqs = [np.asarray(s) for s in sequences]
+        if not seqs:
+            raise ValueError("LoDTensor needs at least one sequence")
+        feat = seqs[0].shape[1:]
+        for s in seqs:
+            if s.shape[1:] != feat:
+                raise ValueError(
+                    f"ragged sequences must share feature dims: {s.shape[1:]} vs {feat}"
+                )
+        if dtype is not None:
+            seqs = [s.astype(dtype) for s in seqs]
+        self.sequences = seqs
+
+    def __len__(self):
+        return len(self.sequences)
+
+    @property
+    def lengths(self) -> np.ndarray:
+        return np.array([len(s) for s in self.sequences], dtype=np.int32)
+
+    @property
+    def dtype(self):
+        return self.sequences[0].dtype
+
+    def padded(self, bucket: Union[bool, int] = True):
+        """Returns (padded [b, T, *f], lengths [b] int32).
+
+        bucket=True applies the default bucketing policy to max_len;
+        bucket=<int> pads the time axis to exactly that length;
+        bucket=False pads to the exact max_len.
+        """
+        lens = self.lengths
+        max_len = int(lens.max())
+        if bucket is True:
+            T = bucket_length(max_len)
+        elif bucket is False:
+            T = max_len
+        else:
+            T = int(bucket)
+            if T < max_len:
+                raise ValueError(f"bucket {T} < longest sequence {max_len}")
+        feat = self.sequences[0].shape[1:]
+        out = np.zeros((len(self.sequences), T) + tuple(feat), dtype=self.dtype)
+        for i, s in enumerate(self.sequences):
+            out[i, : len(s)] = s
+        return out, lens
+
+    @staticmethod
+    def from_padded(padded: np.ndarray, lengths: Sequence[int]) -> "LoDTensor":
+        return LoDTensor([padded[i, : int(l)] for i, l in enumerate(lengths)])
+
+    def recursive_sequence_lengths(self) -> List[List[int]]:
+        """Reference LoDTensor API (length-based LoD, one level)."""
+        return [[int(l) for l in self.lengths]]
+
+    def __repr__(self):
+        return f"LoDTensor(batch={len(self)}, lengths={self.lengths.tolist()})"
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None) -> LoDTensor:
+    """Reference `fluid.create_lod_tensor` (lod_tensor.py): build a ragged
+    batch from a flat array + length-based LoD (one level supported; deeper
+    nesting flattens outer levels, matching how sequence ops consume it)."""
+    if isinstance(data, LoDTensor):
+        return data
+    if isinstance(data, (list, tuple)) and not isinstance(data[0], (int, float)):
+        arrs = [np.asarray(s) for s in data]
+        if all(a.ndim >= 1 for a in arrs):
+            return LoDTensor(arrs)
+    flat = np.asarray(data)
+    lens = list(recursive_seq_lens[-1])
+    if sum(lens) != flat.shape[0]:
+        raise ValueError(
+            f"sum of seq lens {sum(lens)} != leading dim {flat.shape[0]}"
+        )
+    seqs = []
+    off = 0
+    for l in lens:
+        seqs.append(flat[off : off + l])
+        off += l
+    return LoDTensor(seqs)

@@ -1,2 +1,9 @@
 """Importing this package registers all op lowerings."""
-from . import control_flow_ops, math_ops, nn_ops, optimizer_ops, tensor_ops  # noqa: F401
+from . import (  # noqa: F401
+    control_flow_ops,
+    math_ops,
+    nn_ops,
+    optimizer_ops,
+    sequence_ops,
+    tensor_ops,
+)

@@ -1,0 +1,334 @@
+"""Sequence (ragged/LoD) op lowerings — masked dense compute on padded
+batches [batch, time, *feature] + int32 lengths [batch].
+
+Reference: `operators/sequence_ops/` (~30 ops over flat LoDTensors whose
+kernels walk offset tables, e.g. sequence_pool_op.cc, sequence_softmax_op.cc,
+sequence_expand_op.cc, sequence_conv_op.cc, sequence_pad_op.cc,
+sequence_reverse_op.h, sequence_erase_op.cc, sequence_enumerate_op.cc) and
+`recurrent_op.cc` / `DynamicRNN` (control_flow.py:1692).  The TPU lowering
+replaces offset walks with masks derived from the lengths vector, and the
+per-step interpreter RNN with one `lax.scan` (SURVEY.md §5.7: padded dense +
+segment-ids/masks is the prescribed design).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .common import first
+
+
+def _mask(lens, T, extra_dims=0):
+    """[b, T] + `extra_dims` trailing singleton axes; True where t < len."""
+    m = jnp.arange(T)[None, :] < lens[:, None]
+    return m.reshape(m.shape + (1,) * extra_dims)
+
+
+@register_op("sequence_pool")
+def _sequence_pool(ctx, op, ins):
+    x = first(ins, "X")  # [b, T, *f]
+    lens = first(ins, "XLod")
+    ptype = op.attr("pooltype", "AVERAGE").upper()
+    T = x.shape[1]
+    m = _mask(lens, T, x.ndim - 2)
+    lensf = jnp.maximum(lens, 1).astype(x.dtype).reshape((-1,) + (1,) * (x.ndim - 2))
+    out_idx = None
+    if ptype == "SUM":
+        out = jnp.sum(jnp.where(m, x, 0), axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(jnp.where(m, x, 0), axis=1) / lensf
+    elif ptype == "SQRT":
+        out = jnp.sum(jnp.where(m, x, 0), axis=1) / jnp.sqrt(lensf)
+    elif ptype == "MAX":
+        neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        masked = jnp.where(m, x, neg)
+        out = jnp.max(masked, axis=1)
+        out_idx = jnp.argmax(masked, axis=1).astype(jnp.int32)
+    elif ptype == "LAST":
+        idx = jnp.maximum(lens - 1, 0).reshape((-1,) + (1,) * (x.ndim - 1)).astype(jnp.int32)
+        out = jnp.take_along_axis(x, jnp.broadcast_to(idx, (x.shape[0], 1) + x.shape[2:]), axis=1)[:, 0]
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise NotImplementedError(f"sequence_pool pooltype {ptype}")
+    outs = {"Out": out}
+    if out_idx is not None:
+        outs["MaxIndex"] = out_idx
+    return outs
+
+
+@register_op("sequence_softmax")
+def _sequence_softmax(ctx, op, ins):
+    x = first(ins, "X")  # [b, T] or [b, T, 1]
+    lens = first(ins, "XLod")
+    T = x.shape[1]
+    m = _mask(lens, T, x.ndim - 2)
+    neg = jnp.finfo(x.dtype).min
+    z = jnp.where(m, x, neg)
+    p = jax.nn.softmax(z, axis=1)
+    return {"Out": jnp.where(m, p, 0)}
+
+
+@register_op("sequence_expand")
+def _sequence_expand(ctx, op, ins):
+    """X is one row per batch item ([b, *f] or [b, 1, *f]); each row is
+    broadcast along Y's time axis and masked to Y's lengths (the dominant
+    reference use: expanding an encoder vector over decoder steps).  The
+    rarely-used repeat-whole-sequence form is not supported."""
+    x = first(ins, "X")
+    ylens = first(ins, "YLod")
+    T = first(ins, "Y").shape[1]
+    if x.ndim >= 3 and x.shape[1] == 1:
+        x = x[:, 0]
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], T) + x.shape[1:])
+    m = _mask(ylens, T, out.ndim - 2)
+    return {"Out": jnp.where(m, out, 0)}
+
+
+register_op("sequence_expand_as")(_sequence_expand)
+
+
+@register_op("sequence_reverse")
+def _sequence_reverse(ctx, op, ins):
+    x = first(ins, "X")
+    lens = first(ins, "XLod")
+    T = x.shape[1]
+    t = jnp.arange(T)[None, :]
+    idx = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t).astype(jnp.int32)
+    idx = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    idx = jnp.broadcast_to(idx, x.shape)
+    return {"Out": jnp.take_along_axis(x, idx, axis=1)}
+
+
+@register_op("sequence_pad")
+def _sequence_pad(ctx, op, ins):
+    """Ragged -> dense: the carrier is already padded, so this re-pads the
+    time axis to `padded_length` and writes PadValue beyond each length
+    (reference sequence_pad_op.cc semantics)."""
+    x = first(ins, "X")
+    lens = first(ins, "XLod")
+    pad_value = first(ins, "PadValue")
+    T_out = op.attr("padded_length", -1)
+    T = x.shape[1]
+    if T_out is None or T_out < 0:
+        T_out = T
+    if T_out > T:
+        pad = [(0, 0), (0, T_out - T)] + [(0, 0)] * (x.ndim - 2)
+        x = jnp.pad(x, pad)
+    elif T_out < T:
+        x = x[:, :T_out]
+    m = _mask(lens, T_out, x.ndim - 2)
+    out = jnp.where(m, x, jnp.asarray(pad_value, dtype=x.dtype))
+    return {"Out": out, "Length": lens.astype(jnp.int64)}
+
+
+@register_op("sequence_unpad")
+def _sequence_unpad(ctx, op, ins):
+    """Dense + lengths -> ragged: identity on data, lengths become the
+    companion; padded tail is zeroed for determinism."""
+    x = first(ins, "X")
+    lens = first(ins, "Length")
+    lens = lens.reshape((-1,)).astype(jnp.int32)
+    m = _mask(lens, x.shape[1], x.ndim - 2)
+    return {"Out": jnp.where(m, x, 0), "OutLod": lens}
+
+
+@register_op("sequence_conv")
+def _sequence_conv(ctx, op, ins):
+    """Context-window projection (reference sequence_conv_op.cc): for each
+    step t, concat x[t+start : t+start+length] (zero past boundaries) and
+    multiply by filter [context_length * dim, num_filters]."""
+    x = first(ins, "X")  # [b, T, d]
+    lens = first(ins, "XLod")
+    w = first(ins, "Filter")
+    start = op.attr("contextStart", None)
+    length = op.attr("contextLength", 3)
+    if start is None:
+        start = -((length - 1) // 2)
+    b, T, d = x.shape
+    m = _mask(lens, T, 1)
+    xz = jnp.where(m, x, 0)
+    cols = []
+    t = jnp.arange(T)
+    for k in range(length):
+        idx = t + start + k  # [T]
+        idxc = jnp.clip(idx, 0, T - 1).astype(jnp.int32)
+        g = xz[:, idxc, :]  # [b, T, d]
+        valid = (idx[None, :] >= 0) & (idx[None, :] < lens[:, None])
+        g = jnp.where(valid[:, :, None], g, 0)
+        cols.append(g)
+    ctxmat = jnp.concatenate(cols, axis=-1)  # [b, T, length*d]
+    out = jnp.einsum("btc,cf->btf", ctxmat, w.astype(x.dtype))
+    return {"Out": jnp.where(m, out, 0)}
+
+
+@register_op("sequence_concat")
+def _sequence_concat(ctx, op, ins):
+    """Per-row concat along time with repacking: out[i] = x1[i,:l1] ++ x2[i,:l2]..."""
+    xs = ins["X"]
+    lens_list = ins["XLod"]
+    T_out = sum(x.shape[1] for x in xs)
+    b = xs[0].shape[0]
+    t = jnp.arange(T_out)[None, :]  # [1, T_out]
+    out = jnp.zeros((b, T_out) + xs[0].shape[2:], dtype=xs[0].dtype)
+    offset = jnp.zeros((b, 1), dtype=jnp.int32)
+    for x, lens in zip(xs, lens_list):
+        local = t - offset  # position within this segment
+        valid = (local >= 0) & (local < lens[:, None])
+        idx = jnp.clip(local, 0, x.shape[1] - 1).astype(jnp.int32)
+        idx = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+        g = jnp.take_along_axis(x, jnp.broadcast_to(idx, (b, T_out) + x.shape[2:]), axis=1)
+        vmask = valid.reshape(valid.shape + (1,) * (x.ndim - 2))
+        out = jnp.where(vmask, g, out)
+        offset = offset + lens[:, None]
+    total = sum(l for l in lens_list)
+    return {"Out": out, "OutLod": total.astype(jnp.int32)}
+
+
+@register_op("sequence_slice")
+def _sequence_slice(ctx, op, ins):
+    x = first(ins, "X")
+    lens = first(ins, "XLod")
+    offset = first(ins, "Offset").reshape((-1,)).astype(jnp.int32)
+    length = first(ins, "Length").reshape((-1,)).astype(jnp.int32)
+    b, T = x.shape[0], x.shape[1]
+    t = jnp.arange(T)[None, :]
+    idx = jnp.clip(t + offset[:, None], 0, T - 1).astype(jnp.int32)
+    idx = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    g = jnp.take_along_axis(x, jnp.broadcast_to(idx, x.shape), axis=1)
+    m = _mask(length, T, x.ndim - 2)
+    return {"Out": jnp.where(m, g, 0), "OutLod": length}
+
+
+@register_op("sequence_erase")
+def _sequence_erase(ctx, op, ins):
+    """Remove tokens in `tokens` and left-repack each row
+    (reference sequence_erase_op.cc)."""
+    x = first(ins, "X")  # [b, T] or [b, T, 1] int
+    lens = first(ins, "XLod")
+    tokens = jnp.asarray(op.attr("tokens", []), dtype=x.dtype)
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    xs = x[..., 0] if squeeze else x  # [b, T]
+    T = xs.shape[1]
+    valid = _mask(lens, T)
+    keep = valid & ~jnp.isin(xs, tokens)
+    # stable partition: sort by (!keep) keeps original order of kept items
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    packed = jnp.take_along_axis(xs, order, axis=1)
+    new_lens = jnp.sum(keep, axis=1).astype(jnp.int32)
+    packed = jnp.where(_mask(new_lens, T), packed, 0)
+    out = packed[..., None] if squeeze else packed
+    return {"Out": out, "OutLod": new_lens}
+
+
+@register_op("sequence_enumerate")
+def _sequence_enumerate(ctx, op, ins):
+    """Sliding windows of ids (reference sequence_enumerate_op.cc):
+    out[i, t, k] = ids[i, t+k] if t+k < len else pad_value."""
+    x = first(ins, "X")
+    lens = first(ins, "XLod")
+    win = op.attr("win_size", 2)
+    pad_value = op.attr("pad_value", 0)
+    squeeze = x.ndim == 3 and x.shape[-1] == 1
+    xs = x[..., 0] if squeeze else x
+    b, T = xs.shape
+    t = jnp.arange(T)
+    outs = []
+    for k in range(win):
+        idx = jnp.clip(t + k, 0, T - 1).astype(jnp.int32)
+        g = xs[:, idx]
+        ok = (t[None, :] + k) < lens[:, None]
+        outs.append(jnp.where(ok, g, pad_value))
+    out = jnp.stack(outs, axis=-1)  # [b, T, win]
+    out = jnp.where(_mask(lens, T, 1), out, pad_value)
+    return {"Out": out, "OutLod": lens}
+
+
+@register_op("sequence_mask")
+def _sequence_mask(ctx, op, ins):
+    lens = first(ins, "X").reshape((-1,))
+    maxlen = int(op.attr("maxlen"))
+    out_dtype = op.attr("out_dtype", "int64")
+    from ..core.dtypes import as_np_dtype
+
+    m = jnp.arange(maxlen)[None, :] < lens[:, None]
+    return {"Y": m.astype(as_np_dtype(out_dtype))}
+
+
+@register_op("dynamic_rnn")
+def _dynamic_rnn(ctx, op, ins):
+    """One lax.scan over the padded time axis replaces the reference's
+    per-step interpreter RNN (recurrent_op.cc creates a scope per step and
+    re-runs the sub-block; DynamicRNN additionally sorts/shrinks batches).
+    Memories freeze once t >= length; outputs are zero-masked."""
+    from ..core.lowering import LoweringContext, run_ops
+
+    sub_block = op.block.program.blocks[op.attr("sub_block")]
+    xs = ins.get("X", [])
+    lens = first(ins, "XLod")
+    inits = list(ins.get("MemInit", []))
+    step_names = op.attr("step_vars")
+    mem_names = op.attr("mem_vars")
+    update_names = op.attr("mem_updates")
+    out_names = op.attr("out_vars")
+    mem_has_init = op.attr("mem_has_init")
+    mem_shapes = op.attr("mem_shapes")
+    mem_dtypes = op.attr("mem_dtypes")
+    mem_values = op.attr("mem_values", [0.0] * len(mem_names))
+    is_reverse = op.attr("is_reverse", False)
+
+    b, T = xs[0].shape[0], xs[0].shape[1]
+    from ..core.dtypes import as_np_dtype
+
+    carries = []
+    it = iter(inits)
+    for j in range(len(mem_names)):
+        if mem_has_init[j]:
+            carries.append(next(it))
+        else:
+            carries.append(
+                jnp.full((b,) + tuple(mem_shapes[j]), mem_values[j],
+                         dtype=as_np_dtype(mem_dtypes[j]))
+            )
+
+    outer = dict(ctx.env)
+    sub_ops = list(sub_block.ops)
+    xs_t = tuple(jnp.moveaxis(x, 1, 0) for x in xs)  # each [T, b, *f]
+    tvec = jnp.arange(T)
+    if is_reverse:
+        xs_t = tuple(jnp.flip(x, axis=0) for x in xs_t)
+        tvec = jnp.flip(tvec)
+
+    def step_fn(carry, scanned):
+        mems, key = carry
+        t, xrows = scanned
+        env = dict(outer)
+        sctx = LoweringContext(key, is_test=ctx.is_test, mesh=ctx.mesh)
+        for name, val in zip(step_names, xrows):
+            env[name] = val
+        for name, val in zip(mem_names, mems):
+            env[name] = val
+        env = run_ops(sctx, sub_ops, env)
+        active = t < lens  # [b]
+        new_mems = []
+        for un, old in zip(update_names, mems):
+            new = env[un]
+            am = active.reshape((b,) + (1,) * (new.ndim - 1))
+            new_mems.append(jnp.where(am, new, old))
+        step_outs = []
+        for n in out_names:
+            o = env[n]
+            am = active.reshape((b,) + (1,) * (o.ndim - 1))
+            step_outs.append(jnp.where(am, o, jnp.zeros_like(o)))
+        return (new_mems, sctx.key), step_outs
+
+    (final_mems, final_key), ys = jax.lax.scan(
+        step_fn, (carries, ctx.next_key()), (tvec, xs_t)
+    )
+    ctx.key = final_key
+    if is_reverse:
+        ys = [jnp.flip(y, axis=0) for y in ys]
+    outs = [jnp.moveaxis(y, 0, 1) for y in ys]  # [b, T, *f]
+    return {"Out": outs, "FinalMem": final_mems}

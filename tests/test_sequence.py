@@ -1,0 +1,290 @@
+"""LoD/sequence subsystem: masking correctness vs numpy references.
+
+Reference test pattern: per-op numpy golden (unittests/test_sequence_*.py
+compute expected outputs by walking LoD offsets on flat tensors; here the
+goldens walk the ragged lists directly)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.lod import LoDTensor, bucket_length
+
+
+def run_seq(build, seqs, extra_feed=None, fetch=None):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feat = seqs[0].shape[1:]
+        x = layers.data("x", list(feat), dtype=str(seqs[0].dtype), lod_level=1)
+        outs = build(x)
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup)
+    feed = {"x": LoDTensor(seqs)}
+    feed.update(extra_feed or {})
+    fetch = fetch or outs
+    fetch = fetch if isinstance(fetch, (list, tuple)) else [fetch]
+    return exe.run(main, feed=feed, fetch_list=list(fetch))
+
+
+def ragged(lengths, feat=(3,), seed=0, dtype="float32"):
+    rng = np.random.RandomState(seed)
+    return [rng.randn(l, *feat).astype(dtype) for l in lengths]
+
+
+class TestSequencePool:
+    @pytest.mark.parametrize("ptype", ["average", "sum", "sqrt", "max", "last", "first"])
+    def test_golden(self, ptype):
+        seqs = ragged([3, 5, 1, 4])
+        (out,) = run_seq(lambda x: layers.sequence_pool(x, ptype), seqs)
+        for i, s in enumerate(seqs):
+            if ptype == "average":
+                exp = s.mean(0)
+            elif ptype == "sum":
+                exp = s.sum(0)
+            elif ptype == "sqrt":
+                exp = s.sum(0) / np.sqrt(len(s))
+            elif ptype == "max":
+                exp = s.max(0)
+            elif ptype == "last":
+                exp = s[-1]
+            else:
+                exp = s[0]
+            np.testing.assert_allclose(out[i], exp, rtol=1e-5, atol=1e-5)
+
+
+class TestSequenceSoftmax:
+    def test_masked(self):
+        seqs = ragged([2, 6, 4], feat=(1,))
+        (out,) = run_seq(layers.sequence_softmax, seqs)
+        for i, s in enumerate(seqs):
+            e = np.exp(s - s.max())
+            np.testing.assert_allclose(out[i, : len(s)], e / e.sum(), rtol=1e-5, atol=1e-6)
+            assert np.all(out[i, len(s):] == 0)
+
+
+class TestSequenceExpand:
+    def test_broadcast_rows(self):
+        seqs = ragged([2, 5], feat=(4,))
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            y = layers.data("y", [4], dtype="float32", lod_level=1)
+            xv = layers.data("xv", [4], dtype="float32")
+            out = layers.sequence_expand(xv, y)
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(startup)
+        xrow = np.arange(8, dtype="float32").reshape(2, 4)
+        (o,) = exe.run(main, feed={"y": LoDTensor(seqs), "xv": xrow}, fetch_list=[out])
+        for i, s in enumerate(seqs):
+            assert np.all(o[i, : len(s)] == xrow[i])
+            assert np.all(o[i, len(s):] == 0)
+
+
+class TestSequenceReverse:
+    def test_golden(self):
+        seqs = ragged([3, 1, 5])
+        (out,) = run_seq(layers.sequence_reverse, seqs)
+        for i, s in enumerate(seqs):
+            np.testing.assert_allclose(out[i, : len(s)], s[::-1], rtol=1e-6)
+
+
+class TestSequencePadUnpad:
+    def test_pad(self):
+        seqs = ragged([2, 4], feat=(3,))
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [3], dtype="float32", lod_level=1)
+            pv = layers.fill_constant([1], "float32", -1.0)
+            out, length = layers.sequence_pad(x, pv)
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(startup)
+        o, l = exe.run(main, feed={"x": LoDTensor(seqs)}, fetch_list=[out, length])
+        assert list(l) == [2, 4]
+        np.testing.assert_allclose(o[0, :2], seqs[0], rtol=1e-6)
+        assert np.all(o[0, 2:] == -1.0)
+
+    def test_unpad_roundtrip(self):
+        seqs = ragged([2, 4], feat=(3,))
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            dense = layers.data("dense", [8, 3], dtype="float32", append_batch_size=True)
+            lens = layers.data("lens", [1], dtype="int32", append_batch_size=True)
+            rag = layers.sequence_unpad(dense, lens)
+            pooled = layers.sequence_pool(rag, "sum")
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(startup)
+        padded = np.zeros((2, 8, 3), dtype="float32")
+        padded[0, :2], padded[1, :4] = seqs[0], seqs[1]
+        # garbage beyond lengths must not leak into the pooled sum
+        padded[0, 5:] = 99.0
+        (o,) = exe.run(main, feed={"dense": padded, "lens": np.array([[2], [4]], dtype="int32")},
+                       fetch_list=[pooled])
+        np.testing.assert_allclose(o[0], seqs[0].sum(0), rtol=1e-5)
+        np.testing.assert_allclose(o[1], seqs[1].sum(0), rtol=1e-5)
+
+
+class TestSequenceConv:
+    def test_golden_window(self):
+        seqs = ragged([4, 6], feat=(5,), seed=3)
+        (out,) = run_seq(
+            lambda x: layers.sequence_conv(x, num_filters=7, filter_size=3, bias_attr=False),
+            seqs,
+        )
+        # recover the filter from the program-built parameter: rerun with
+        # identity check instead; simpler golden: compare vs numpy using the
+        # actual initialized weight fetched from the scope
+        scope = fluid.global_scope()
+        wname = [n for n in scope.var_names() if ".w" in n][0]
+        w = np.asarray(scope.find_var(wname))  # [3*5, 7]
+        for i, s in enumerate(seqs):
+            T = len(s)
+            ctx = np.zeros((T, 3 * 5), dtype="float32")
+            for t in range(T):
+                parts = []
+                for k in (-1, 0, 1):
+                    parts.append(s[t + k] if 0 <= t + k < T else np.zeros(5, "f4"))
+                ctx[t] = np.concatenate(parts)
+            exp = ctx @ w
+            np.testing.assert_allclose(out[i, :T], exp, rtol=1e-4, atol=1e-4)
+            assert np.all(out[i, T:] == 0)
+
+
+class TestSequenceEraseEnumerateConcat:
+    def test_erase(self):
+        seqs = [np.array([[2], [1], [2], [3]], dtype="int32"),
+                np.array([[2], [2]], dtype="int32")]
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [1], dtype="int32", lod_level=1)
+            out = layers.sequence_erase(x, [2])
+            lod = out._lod_ref
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(startup)
+        o, l = exe.run(main, feed={"x": LoDTensor(seqs)}, fetch_list=[out, lod])
+        assert list(l) == [2, 0]
+        assert o[0, :2, 0].tolist() == [1, 3]
+
+    def test_enumerate(self):
+        seqs = [np.array([[1], [2], [3]], dtype="int32")]
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [1], dtype="int32", lod_level=1)
+            out = layers.sequence_enumerate(x, win_size=2, pad_value=0)
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(startup)
+        (o,) = exe.run(main, feed={"x": LoDTensor(seqs)}, fetch_list=[out])
+        assert o[0, :3].tolist() == [[1, 2], [2, 3], [3, 0]]
+
+    def test_concat(self):
+        a = [np.ones((2, 3), "f4"), np.ones((1, 3), "f4") * 2]
+        b = [np.ones((1, 3), "f4") * 5, np.ones((3, 3), "f4") * 6]
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            xa = layers.data("xa", [3], dtype="float32", lod_level=1)
+            xb = layers.data("xb", [3], dtype="float32", lod_level=1)
+            out = layers.sequence_concat([xa, xb])
+            lod = out._lod_ref
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(startup)
+        o, l = exe.run(main, feed={"xa": LoDTensor(a), "xb": LoDTensor(b)},
+                       fetch_list=[out, lod])
+        assert list(l) == [3, 4]
+        np.testing.assert_allclose(o[0, :3], np.concatenate([a[0], b[0]]), rtol=1e-6)
+        np.testing.assert_allclose(o[1, :4], np.concatenate([a[1], b[1]]), rtol=1e-6)
+
+
+class TestSequenceMask:
+    def test_mask(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            lens = layers.data("lens", [], dtype="int32", append_batch_size=True)
+            m = layers.sequence_mask(lens, maxlen=5, dtype="float32")
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(startup)
+        (o,) = exe.run(main, feed={"lens": np.array([2, 5, 0], "int32")}, fetch_list=[m])
+        assert o.tolist() == [[1, 1, 0, 0, 0], [1, 1, 1, 1, 1], [0, 0, 0, 0, 0]]
+
+
+class TestDynamicRNN:
+    def test_simple_rnn_vs_numpy(self):
+        h = 4
+        seqs = ragged([3, 5, 2], feat=(6,), seed=7)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [6], dtype="float32", lod_level=1)
+            drnn = layers.DynamicRNN()
+            with drnn.block():
+                word = drnn.step_input(x)
+                prev = drnn.memory(shape=[h], value=0.0)
+                hid = layers.fc([word, prev], h, act="tanh", bias_attr=False)
+                drnn.update_memory(prev, hid)
+                drnn.output(hid)
+            out = drnn()
+            final = layers.sequence_last_step(out)
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(startup)
+        o, f = exe.run(main, feed={"x": LoDTensor(seqs)}, fetch_list=[out, final])
+
+        scope = fluid.global_scope()
+        # recover the two fc weights (word, prev order) from the sub-block muls
+        sub = main.blocks[
+            [o for o in main.global_block().ops if o.type == "dynamic_rnn"][0].attrs["sub_block"]
+        ]
+        wnames = [o.inputs["Y"][0] for o in sub.ops if o.type == "mul"]
+        w1 = np.asarray(scope.find_var(wnames[0]))
+        w2 = np.asarray(scope.find_var(wnames[1]))
+        for i, s in enumerate(seqs):
+            hprev = np.zeros(h, "f4")
+            for t in range(len(s)):
+                hprev = np.tanh(s[t] @ w1 + hprev @ w2)
+                np.testing.assert_allclose(o[i, t], hprev, rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(f[i], hprev, rtol=1e-4, atol=1e-5)
+            assert np.all(o[i, len(s):] == 0)
+
+    def test_trainable(self):
+        """Gradients flow through the scan: loss decreases."""
+        seqs = ragged([3, 5, 2, 4], feat=(6,), seed=1)
+        tgt = np.array([[0.5], [-0.3], [0.1], [0.9]], dtype="float32")
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [6], dtype="float32", lod_level=1)
+            y = layers.data("y", [1], dtype="float32")
+            drnn = layers.DynamicRNN()
+            with drnn.block():
+                word = drnn.step_input(x)
+                prev = drnn.memory(shape=[8], value=0.0)
+                hid = layers.fc([word, prev], 8, act="tanh")
+                drnn.update_memory(prev, hid)
+                drnn.output(hid)
+            last = layers.sequence_last_step(drnn())
+            pred = layers.fc(last, 1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(startup)
+        feed = {"x": LoDTensor(seqs), "y": tgt}
+        losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0][0]) for _ in range(30)]
+        assert losses[-1] < losses[0] * 0.5
+
+
+class TestBucketing:
+    def test_bucket_policy(self):
+        assert bucket_length(1) == 8
+        assert bucket_length(8) == 8
+        assert bucket_length(9) == 16
+        assert bucket_length(64) == 64
+        assert bucket_length(65) == 128
+        assert bucket_length(1000) == 1024
+
+    def test_bounded_recompiles(self):
+        """Feeds whose max_len drifts within one bucket reuse the executable."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [3], dtype="float32", lod_level=1)
+            out = layers.sequence_pool(x, "sum")
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(startup)
+        exe.run(main, feed={"x": LoDTensor(ragged([2, 3]))}, fetch_list=[out])
+        n_compiled = len(exe._cache)
+        for lens in ([4, 5], [5, 8]):  # all bucket to T=8
+            exe.run(main, feed={"x": LoDTensor(ragged(lens))}, fetch_list=[out])
+        assert len(exe._cache) == n_compiled
