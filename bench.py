@@ -2,10 +2,17 @@
 
 Flagship: ResNet-50 ImageNet training throughput on one TPU chip, bf16
 compute (reference harness: benchmark/fluid/fluid_benchmark.py, which
-printed `Throughput` per pass; BASELINE.md target is >=50% MFU).
-vs_baseline is vs the reference's published numbers — it published none
-(BASELINE.md), so 1.0 marks parity-by-default and the absolute value is
-the series to track across rounds.
+printed `Throughput` per pass; BASELINE.md target is >=50% MFU — see
+docs/perf_r02.md for the measured breakdown of the gap).
+
+vs_baseline: the reference published no numbers (BASELINE.md), so the
+absolute imgs/s series is what's tracked across rounds; vs_baseline is
+this round's value over the round-1 recorded value (2295 imgs/s) so
+regressions are visible, NOT parity vs the reference.
+
+MFU is computed from analytic FLOPs (3x 4.089 GFLOP/img) because the
+tunnel backend's compiled-program cost_analysis() is broken (returns
+4.2 GFLOP for a full train step).
 """
 from __future__ import annotations
 
@@ -15,9 +22,12 @@ import time
 
 import numpy as np
 
+ROUND1_IMGS_PER_SEC = 2295.0  # BENCH_r01.json
 
-def bench_resnet50(batch_size=64, warmup=3, iters=20):
+
+def bench_resnet50(batch_size=128, steps_per_dispatch=8, warmup=1, iters=4):
     import jax
+    import jax.numpy as jnp
 
     import paddle_tpu as fluid
     from paddle_tpu.models import resnet
@@ -29,14 +39,13 @@ def bench_resnet50(batch_size=64, warmup=3, iters=20):
     exe = fluid.Executor(fluid.TPUPlace(0))
     exe.run(startup, scope=scope)
 
+    K = steps_per_dispatch
     rng = np.random.RandomState(0)
-    img = rng.rand(batch_size, 3, 224, 224).astype("float32")
-    label = rng.randint(0, 1000, size=(batch_size, 1)).astype(np.int32)
+    img = rng.rand(K, batch_size, 3, 224, 224).astype("float32")
+    label = rng.randint(0, 1000, size=(K, batch_size, 1)).astype(np.int32)
     # device-resident synthetic batch (reference harness: --use_fake_data in
     # benchmark/fluid/fluid_benchmark.py) so the tunnel's H2D bandwidth
     # doesn't pollute the compute measurement
-    import jax.numpy as jnp
-
     dev = fluid.TPUPlace(0).jax_device()
     feed = {
         "img": jax.device_put(jnp.asarray(img), dev),
@@ -44,38 +53,56 @@ def bench_resnet50(batch_size=64, warmup=3, iters=20):
     }
     loss_name = fetches["loss"].name
 
+    def dispatch():
+        # steps=K scans K optimizer steps inside one compiled call,
+        # amortizing host/tunnel dispatch overhead (docs/perf_r02.md)
+        return exe.run(main, feed=feed, fetch_list=[loss_name], scope=scope,
+                       steps=K, return_numpy=False)
+
+    out = dispatch()
+    np.asarray(out[0])  # hard sync (block_until_ready is advisory on the tunnel)
     for _ in range(warmup):
-        out = exe.run(main, feed=feed, fetch_list=[loss_name], scope=scope, return_numpy=False)
-    loss0 = float(np.asarray(out[0])[0])  # hard sync (block_until_ready is
-    # advisory on the axon tunnel backend)
+        out = dispatch()
+    np.asarray(out[0])
 
     t0 = time.perf_counter()
     for _ in range(iters):
-        out = exe.run(main, feed=feed, fetch_list=[loss_name], scope=scope, return_numpy=False)
-    lossN = float(np.asarray(out[0])[0])  # hard sync: value read drains the chain
-    dt = (time.perf_counter() - t0) / iters
+        out = dispatch()
+    losses = np.asarray(out[0])  # hard sync: value read drains the chain
+    dt = (time.perf_counter() - t0) / (iters * K)
+    lossN = float(losses[-1])
+    if not np.isfinite(lossN):
+        raise RuntimeError(f"non-finite loss from bench step: {lossN}")
 
     imgs_per_sec = batch_size / dt
-    # ResNet-50 fwd ~4.09 GFLOP/img at 224^2; train ~3x fwd.
+    # ResNet-50 fwd ~4.09 GFLOP/img at 224^2; train ~3x fwd (analytic; see
+    # module docstring for why XLA cost analysis isn't used here).
     train_flops_per_img = 3 * 4.089e9
-    achieved = imgs_per_sec * train_flops_per_img
     peak = 197e12  # v5e bf16 peak FLOP/s
-    mfu = achieved / peak
+    mfu = imgs_per_sec * train_flops_per_img / peak
     print(f"step {dt*1e3:.1f} ms  loss {lossN:.3f}  mfu {mfu:.3f}", file=sys.stderr)
     return imgs_per_sec, mfu
 
 
 def main():
     batch = 128
-    imgs_per_sec, mfu = bench_resnet50(batch_size=batch)
+    steps_per_dispatch = 8
+    imgs_per_sec, mfu = bench_resnet50(
+        batch_size=batch, steps_per_dispatch=steps_per_dispatch
+    )
     print(
         json.dumps(
             {
                 "metric": "resnet50_train_imgs_per_sec_per_chip",
                 "value": round(imgs_per_sec, 2),
                 "unit": "imgs/sec",
-                "vs_baseline": 1.0,
-                "extra": {"mfu_bf16": round(mfu, 4), "batch_size": batch},
+                "vs_baseline": round(imgs_per_sec / ROUND1_IMGS_PER_SEC, 4),
+                "extra": {
+                    "mfu_bf16_analytic": round(mfu, 4),
+                    "batch_size": batch,
+                    "steps_per_dispatch": steps_per_dispatch,
+                    "vs_baseline_is": "this_round_imgs_per_sec / round1_imgs_per_sec",
+                },
             }
         )
     )

@@ -1,0 +1,107 @@
+"""MFU experiment: ResNet-50 train-step timing on the real TPU.
+
+Variants:
+  * batch size sweep (NCHW logical layout, current lowering)
+  * NHWC internal conv/pool/BN lowering (transpose at op edges; XLA folds
+    back-to-back transposes between consecutive layers)
+Reports XLA cost-analysis FLOPs per step so MFU is measured, not estimated.
+
+Usage: python experiments/mfu_sweep.py [--variant nchw|nhwc] [--batches 64,128,256]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def time_step(batch_size, warmup=2, iters=10):
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+
+    main, startup, feeds, fetches = resnet.build(
+        dtype="bfloat16", class_dim=1000, learning_rate=0.1, with_optimizer=True
+    )
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup, scope=scope)
+
+    rng = np.random.RandomState(0)
+    img = rng.rand(batch_size, 3, 224, 224).astype("float32")
+    label = rng.randint(0, 1000, size=(batch_size, 1)).astype(np.int32)
+    dev = fluid.TPUPlace(0).jax_device()
+    feed = {
+        "img": jax.device_put(jnp.asarray(img), dev),
+        "label": jax.device_put(jnp.asarray(label), dev),
+    }
+    loss_name = fetches["loss"].name
+
+    t_c0 = time.perf_counter()
+    out = exe.run(main, feed=feed, fetch_list=[loss_name], scope=scope, return_numpy=False)
+    float(np.asarray(out[0])[0])
+    compile_s = time.perf_counter() - t_c0
+    for _ in range(warmup):
+        out = exe.run(main, feed=feed, fetch_list=[loss_name], scope=scope, return_numpy=False)
+    float(np.asarray(out[0])[0])
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = exe.run(main, feed=feed, fetch_list=[loss_name], scope=scope, return_numpy=False)
+    loss = float(np.asarray(out[0])[0])
+    dt = (time.perf_counter() - t0) / iters
+
+    # XLA-measured FLOPs of the compiled step executable.
+    flops = None
+    try:
+        compiled = next(iter(exe._cache.values()))
+        from paddle_tpu.core.scope import RNG_STATE_VAR
+
+        state_rw = {n: scope.find_var(n) for n in compiled.rw_names}
+        state_ro = {n: scope.find_var(n) for n in compiled.ro_names}
+        key = scope.find_var(RNG_STATE_VAR)
+        if key is None:
+            key = jax.random.PRNGKey(0)
+        lowered = compiled.jfn.lower(state_rw, state_ro, feed, key)
+        ca = lowered.compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops = ca.get("flops")
+    except Exception as e:
+        print("cost_analysis failed:", e, file=sys.stderr)
+
+    return dt, loss, compile_s, flops
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--variant", default="nchw", choices=["nchw", "nhwc"])
+    ap.add_argument("--batches", default="128")
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+
+    if args.variant == "nhwc":
+        import paddle_tpu.ops.nn_ops as nn_ops
+        nn_ops.enable_nhwc_lowering()
+
+    peak = 197e12
+    for bs in [int(b) for b in args.batches.split(",")]:
+        dt, loss, compile_s, flops = time_step(bs, iters=args.iters)
+        imgs = bs / dt
+        mfu_est = imgs * 3 * 4.089e9 / peak
+        mfu_xla = (flops / dt / peak) if flops else float("nan")
+        gflops = f"{flops/1e9:.1f}G" if flops else "n/a"
+        print(
+            f"variant={args.variant} bs={bs} step={dt*1e3:.1f}ms imgs/s={imgs:.0f} "
+            f"loss={loss:.3f} compile={compile_s:.0f}s "
+            f"xla_flops={gflops} mfu_xla={mfu_xla:.3f} mfu_est={mfu_est:.3f}",
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()

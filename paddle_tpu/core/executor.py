@@ -65,13 +65,24 @@ def _runnable_ops(block):
     return [op for op in block.ops if op.type not in ("feed", "fetch")]
 
 
+def _lowering_flags():
+    """Process-global lowering options that change generated code; they must
+    participate in the compile-cache key or toggling them would silently
+    reuse stale executables."""
+    from ..ops import nn_ops
+
+    return ("nhwc", nn_ops._NHWC_LOWERING)
+
+
 class _CompiledStep:
     """One jitted executable for (program, feed sig, fetch names, state sig)."""
 
     def __init__(self, program: Program, feed_names: Sequence[str], fetch_names: Sequence[str], scope: Scope,
-                 mesh=None, batch_axis: str = "dp", feed_shapes: Optional[Dict[str, tuple]] = None):
+                 mesh=None, batch_axis: str = "dp", feed_shapes: Optional[Dict[str, tuple]] = None,
+                 n_steps: int = 1):
         self.mesh = mesh
         self.batch_axis = batch_axis
+        self.n_steps = n_steps
         feed_shapes = feed_shapes or {}
         block = program.global_block()
         ops = _runnable_ops(block)
@@ -84,10 +95,13 @@ class _CompiledStep:
         written = []
         written_set = set()
         for op in ops:
-            read_names.update(op.input_arg_names)
+            # _effective_io folds in sub-block reads/writes (while / cond /
+            # dynamic_rnn bodies read parameters the top-level op doesn't list)
+            reads, outs = self._effective_io(op)
+            read_names.update(reads)
             if op.type == "backward":
                 read_names.update(op.attrs.get("param_names", []))
-            for n in op.output_arg_names:
+            for n in outs:
                 if n in persistable and n not in written_set:
                     written_set.add(n)
                     written.append(n)
@@ -114,6 +128,31 @@ class _CompiledStep:
             fetches = [env[n] for n in self.fetch_names]
             return fetches, new_state, ctx.key
 
+        if n_steps > 1:
+            # Multi-step dispatch: lax.scan the whole train step over feeds
+            # stacked on a leading [n_steps] axis.  One host->device dispatch
+            # drives K optimizer steps — the TPU answer to the reference's
+            # dataset-driven trainer hot loop (`hogwild_worker.cc:137`:
+            # `for op in ops: op->Run()` per batch, no Python between steps).
+            # Requires every written persistable to round-trip through the
+            # carry, i.e. written ⊆ read state (true for params/accumulators).
+            missing = [n for n in written if n not in set(self.rw_names)]
+            if missing:
+                raise ValueError(
+                    f"steps>1 needs write-back state to be read by the program "
+                    f"too; write-only persistables: {missing}"
+                )
+            inner = step
+
+            def step(state_rw, state_ro, feeds, key):
+                def body(carry, feed_t):
+                    srw, k = carry
+                    fetches_t, new_state, k2 = inner(srw, state_ro, feed_t, k)
+                    return (new_state, k2), fetches_t
+
+                (srw, key2), stacked = jax.lax.scan(body, (state_rw, key), feeds)
+                return stacked, srw, key2
+
         if mesh is None:
             self.jfn = jax.jit(step, donate_argnums=(0,))
             self.feed_specs = None
@@ -129,13 +168,13 @@ class _CompiledStep:
                 return NamedSharding(mesh, P(*hints[n]) if n in hints else P())
 
             repl = NamedSharding(mesh, P())
-            batch_sharded = NamedSharding(mesh, P(batch_axis))
             n_dp = mesh.shape[batch_axis]
 
             def feed_spec(n):
                 shape = feed_shapes.get(n, ())
-                if len(shape) >= 1 and shape[0] % n_dp == 0:
-                    return batch_sharded
+                bdim = 1 if n_steps > 1 else 0  # steps>1: axis 0 is the scan axis
+                if len(shape) > bdim and shape[bdim] % n_dp == 0:
+                    return NamedSharding(mesh, P(*([None] * bdim + [batch_axis])))
                 return repl  # scalars / indivisible feeds replicate
 
             rw_specs = {n: state_spec(n) for n in self.rw_names}
@@ -161,7 +200,7 @@ class _CompiledStep:
         """(reads, writes) including sub-block effects for control flow."""
         reads = list(op.input_arg_names)
         writes = list(op.output_arg_names)
-        if op.type in ("while", "conditional_block"):
+        if op.type in ("while", "conditional_block", "dynamic_rnn"):
             idx = op.attrs.get("sub_block")
             if idx is not None:
                 sub = op.block.program.blocks[idx]
@@ -230,7 +269,12 @@ class Executor:
         scope: Optional[Scope] = None,
         return_numpy: bool = True,
         use_program_cache: bool = True,  # parity arg; caching is always on
+        steps: int = 1,
     ):
+        """steps > 1 runs K optimizer steps in ONE device dispatch: every
+        feed must carry a leading [steps] axis and fetches come back stacked
+        [steps, ...].  Amortizes host/tunnel dispatch overhead the way the
+        reference's dataset trainers amortize the Python boundary."""
         program = program if program is not None else default_main_program()
         mesh = None
         batch_axis = "dp"
@@ -246,6 +290,27 @@ class Executor:
         block = program.global_block()
 
         # Convert feeds to host arrays with the declared var dtype.
+        # Ragged feeds (LoDTensor / list of per-sequence arrays) expand into
+        # the padded carrier + `<name>@LOD` lengths pair (paddle_tpu/lod.py).
+        from ..lod import LoDTensor, lod_var_name
+
+        expanded = {}
+        for name, value in feed.items():
+            declared_ragged = block.has_var(name) and block.var(name).lod_level >= 1
+            if isinstance(value, LoDTensor) or (
+                declared_ragged
+                and isinstance(value, (list, tuple))
+                and len(value) > 0
+                and all(isinstance(s, np.ndarray) for s in value)
+            ):
+                lt = value if isinstance(value, LoDTensor) else LoDTensor(value)
+                padded, lens = lt.padded(bucket=True)
+                expanded[name] = padded
+                expanded[lod_var_name(name)] = lens
+            else:
+                expanded[name] = value
+        feed = expanded
+
         jfeeds = {}
         for name, value in feed.items():
             if isinstance(value, jax.Array):
@@ -288,6 +353,8 @@ class Executor:
             tuple(fetch_names),
             scope._uuid,
             (tuple(mesh.shape.items()), batch_axis) if mesh is not None else None,
+            steps,
+            _lowering_flags(),
         )
         compiled = self._cache.get(cache_key)
         if compiled is None:
@@ -295,6 +362,7 @@ class Executor:
                 program, list(jfeeds), fetch_names, scope,
                 mesh=mesh, batch_axis=batch_axis,
                 feed_shapes={n: v.shape for n, v in jfeeds.items()},
+                n_steps=steps,
             )
             self._cache[cache_key] = compiled
             if len(self._cache) > 128:  # drop oldest executable (LRU-ish)

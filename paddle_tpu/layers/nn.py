@@ -184,16 +184,20 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5, param
     helper = LayerHelper("batch_norm", name=name, act=act)
     ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
     dtype = input.dtype
+    # norm params and running stats stay fp32 even for bf16/fp16 activations
+    # (reference batch_norm_op.cc keeps fp32 scale/bias for fp16 kernels);
+    # the lowering normalizes in fp32 and casts Y back to the input dtype
+    param_dtype = "float32" if str(dtype) in ("bfloat16", "float16") else dtype
     from ..core.initializer import ConstantInitializer
     from ..core.param_attr import ParamAttr
 
-    scale = helper.create_parameter(param_attr, [ch], dtype, default_initializer=ConstantInitializer(1.0))
-    bias = helper.create_parameter(bias_attr, [ch], dtype, is_bias=True)
+    scale = helper.create_parameter(param_attr, [ch], param_dtype, default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(bias_attr, [ch], param_dtype, is_bias=True)
     # moving stats: persistable, not trainable
     mean_attr = ParamAttr(name=moving_mean_name, initializer=ConstantInitializer(0.0), trainable=False)
     var_attr = ParamAttr(name=moving_variance_name, initializer=ConstantInitializer(1.0), trainable=False)
-    mean = helper.create_parameter(mean_attr, [ch], dtype)
-    variance = helper.create_parameter(var_attr, [ch], dtype)
+    mean = helper.create_parameter(mean_attr, [ch], param_dtype)
+    variance = helper.create_parameter(var_attr, [ch], param_dtype)
     mean.stop_gradient = True
     variance.stop_gradient = True
 

@@ -17,6 +17,18 @@ import numpy as np
 from ..core.registry import register_op
 from .common import first, match_dtype
 
+# When True, conv/pool/batch_norm lower with an internal NHWC layout
+# (transpose at op edges): the public program stays NCHW (fluid layout)
+# but on TPU the MXU-native layout is channels-last, and XLA folds the
+# back-to-back transposes between consecutive layers so the whole conv
+# stack runs NHWC with one transpose at each end of the network.
+_NHWC_LOWERING = False
+
+
+def enable_nhwc_lowering(on: bool = True):
+    global _NHWC_LOWERING
+    _NHWC_LOWERING = on
+
 
 @register_op("conv2d")
 def _conv2d(ctx, op, ins):
@@ -27,6 +39,17 @@ def _conv2d(ctx, op, ins):
     dilations = tuple(op.attr("dilations", [1, 1]))
     groups = op.attr("groups", 1) or 1
     padding = [(pads[0], pads[0]), (pads[1], pads[1])]
+    if _NHWC_LOWERING:
+        out = jax.lax.conv_general_dilated(
+            jnp.transpose(x, (0, 2, 3, 1)),
+            jnp.transpose(w, (2, 3, 1, 0)),  # OIHW -> HWIO
+            window_strides=strides,
+            padding=padding,
+            rhs_dilation=dilations,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=groups,
+        )
+        return {"Output": jnp.transpose(out, (0, 3, 1, 2))}
     out = jax.lax.conv_general_dilated(
         x,
         w,
@@ -90,28 +113,43 @@ def _pool2d(ctx, op, ins):
         ksize = [x.shape[2], x.shape[3]]
         strides = [1, 1]
         pads = [0, 0]
-    window = (1, 1, ksize[0], ksize[1])
-    strides4 = (1, 1, strides[0], strides[1])
+    nhwc = _NHWC_LOWERING
+    if nhwc:
+        x = jnp.transpose(x, (0, 2, 3, 1))
+        window = (1, ksize[0], ksize[1], 1)
+        strides4 = (1, strides[0], strides[1], 1)
+    else:
+        window = (1, 1, ksize[0], ksize[1])
+        strides4 = (1, 1, strides[0], strides[1])
     pad_hi = [pads[0], pads[1]]
     if op.attr("ceil_mode", False):
-        # extra low-side... high-side padding so the window count rounds up
+        # extra high-side padding so the window count rounds up
         for d in (0, 1):
-            in_sz = x.shape[2 + d]
+            in_sz = x.shape[1 + d] if nhwc else x.shape[2 + d]
             out_floor = (in_sz + 2 * pads[d] - ksize[d]) // strides[d] + 1
             out_ceil = -(-(in_sz + 2 * pads[d] - ksize[d]) // strides[d]) + 1
             pad_hi[d] += (out_ceil - out_floor) * strides[d]
-    padding = ((0, 0), (0, 0), (pads[0], pad_hi[0]), (pads[1], pad_hi[1]))
+    spatial_pad = ((pads[0], pad_hi[0]), (pads[1], pad_hi[1]))
+    if nhwc:
+        padding = ((0, 0),) + spatial_pad + ((0, 0),)
+    else:
+        padding = ((0, 0), (0, 0)) + spatial_pad
+    # exclusive avg pool must divide by the valid-element count whenever any
+    # effective padding exists (explicit pads OR ceil-mode high padding)
+    any_pad = bool(pads[0] or pads[1] or pad_hi[0] or pad_hi[1])
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides4, padding)
     else:
         summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides4, padding)
-        if op.attr("exclusive", True) and (pads[0] or pads[1]):
+        if op.attr("exclusive", True) and any_pad:
             ones = jnp.ones_like(x)
             counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides4, padding)
             out = summed / counts
         else:
             out = summed / float(ksize[0] * ksize[1])
+    if nhwc:
+        out = jnp.transpose(out, (0, 3, 1, 2))
     return {"Out": out}
 
 
@@ -131,7 +169,12 @@ def _batch_norm(ctx, op, ins):
     momentum = op.attr("momentum", 0.9)
     is_test = op.attr("is_test", False)
     layout = op.attr("data_layout", "NCHW")
-    ch_axis = 1 if layout == "NCHW" else x.ndim - 1
+    nhwc_internal = _NHWC_LOWERING and layout == "NCHW" and x.ndim == 4
+    if nhwc_internal:
+        x = jnp.transpose(x, (0, 2, 3, 1))
+        ch_axis = x.ndim - 1
+    else:
+        ch_axis = 1 if layout == "NCHW" else x.ndim - 1
     axes = tuple(i for i in range(x.ndim) if i != ch_axis)
     bshape = [1] * x.ndim
     bshape[ch_axis] = x.shape[ch_axis]
@@ -149,6 +192,8 @@ def _batch_norm(ctx, op, ins):
 
     inv = jax.lax.rsqrt(var.reshape(bshape) + eps)
     y = (x - mean.reshape(bshape)) * inv * scale.reshape(bshape) + bias.reshape(bshape)
+    if nhwc_internal:
+        y = jnp.transpose(y, (0, 3, 1, 2))
     return {
         "Y": y.astype(orig_dtype),
         "MeanOut": mean_out,

@@ -19,14 +19,37 @@ def _lr(ins):
     return lr.reshape(()) if lr.ndim else lr
 
 
-@register_op("sgd")
+def register_opt(type: str):
+    """register_op + dtype preservation: update math runs in the promoted
+    (fp32) type, but each `<Slot>Out` is cast back to `<Slot>`'s dtype so
+    bf16 params stay bf16 across steps (otherwise state dtype drifts and,
+    e.g., a multi-step lax.scan carry mismatches)."""
+
+    def deco(fn):
+        def wrapped(ctx, op, ins):
+            outs = fn(ctx, op, ins)
+            for k, v in list(outs.items()):
+                src = k[:-3] if k.endswith("Out") else None
+                if src and ins.get(src):
+                    ref = ins[src][0]
+                    if hasattr(v, "dtype") and v.dtype != ref.dtype:
+                        outs[k] = v.astype(ref.dtype)
+            return outs
+
+        register_op(type)(wrapped)
+        return wrapped
+
+    return deco
+
+
+@register_opt("sgd")
 def _sgd(ctx, op, ins):
     p = first(ins, "Param")
     g = first(ins, "Grad")
     return {"ParamOut": p - _lr(ins) * g}
 
 
-@register_op("momentum")
+@register_opt("momentum")
 def _momentum(ctx, op, ins):
     p = first(ins, "Param")
     g = first(ins, "Grad")
@@ -41,7 +64,7 @@ def _momentum(ctx, op, ins):
     return {"ParamOut": p_new, "VelocityOut": v_new}
 
 
-@register_op("adam")
+@register_opt("adam")
 def _adam(ctx, op, ins):
     p = first(ins, "Param")
     g = first(ins, "Grad")
@@ -66,7 +89,7 @@ def _adam(ctx, op, ins):
     }
 
 
-@register_op("adagrad")
+@register_opt("adagrad")
 def _adagrad(ctx, op, ins):
     p = first(ins, "Param")
     g = first(ins, "Grad")
@@ -78,7 +101,7 @@ def _adagrad(ctx, op, ins):
     return {"ParamOut": p_new, "MomentOut": m_new}
 
 
-@register_op("rmsprop")
+@register_opt("rmsprop")
 def _rmsprop(ctx, op, ins):
     p = first(ins, "Param")
     g = first(ins, "Grad")
@@ -106,7 +129,7 @@ def _rmsprop(ctx, op, ins):
     }
 
 
-@register_op("adamax")
+@register_opt("adamax")
 def _adamax(ctx, op, ins):
     p = first(ins, "Param")
     g = first(ins, "Grad")
@@ -124,7 +147,7 @@ def _adamax(ctx, op, ins):
     return {"ParamOut": p_new, "MomentOut": m_new, "InfNormOut": inf_new}
 
 
-@register_op("adadelta")
+@register_opt("adadelta")
 def _adadelta(ctx, op, ins):
     p = first(ins, "Param")
     g = first(ins, "Grad")
@@ -138,7 +161,7 @@ def _adadelta(ctx, op, ins):
     return {"ParamOut": p + update, "AvgSquaredGradOut": g2, "AvgSquaredUpdateOut": u2}
 
 
-@register_op("lamb")
+@register_opt("lamb")
 def _lamb(ctx, op, ins):
     p = first(ins, "Param")
     g = first(ins, "Grad")
@@ -168,7 +191,7 @@ def _lamb(ctx, op, ins):
     }
 
 
-@register_op("ftrl")
+@register_opt("ftrl")
 def _ftrl(ctx, op, ins):
     p = first(ins, "Param")
     g = first(ins, "Grad")
