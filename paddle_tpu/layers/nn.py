@@ -14,6 +14,17 @@ def _out(helper, dtype, shape=None):
     return helper.create_variable_for_type_inference(dtype, shape=shape)
 
 
+def _keep_lod(src, out):
+    """Propagate the ragged lengths companion through a layer whose output
+    keeps the time axis (dropout/scale/embedding/layer_norm/...), so model
+    code doesn't hand-thread `_lod_ref` (paddle_tpu/lod.py)."""
+    ref = getattr(src, "_lod_ref", None)
+    if ref is not None:
+        out._lod_ref = ref
+        out.lod_level = 1
+    return out
+
+
 def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None, act=None, name=None):
     helper = LayerHelper("fc", name=name, act=act)
     inputs = input if isinstance(input, (list, tuple)) else [input]
@@ -38,7 +49,9 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None, act=Non
             "sum", inputs={"X": [v.name for v in mul_results]}, outputs={"Out": [pre_bias.name]}
         )
     pre_act = helper.append_bias_op(pre_bias, bias_attr, [size], dim_start=num_flatten_dims)
-    return helper.append_activation(pre_act)
+    out = helper.append_activation(pre_act)
+    # time-axis-preserving projection keeps the ragged lengths companion
+    return _keep_lod(inputs[0], out) if num_flatten_dims >= 2 else out
 
 
 def embedding(input, size, is_sparse=False, is_distributed=False, padding_idx=None,
@@ -61,7 +74,7 @@ def embedding(input, size, is_sparse=False, is_distributed=False, padding_idx=No
             "padding_idx": padding_idx,
         },
     )
-    return out
+    return _keep_lod(input, out)
 
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1, groups=None,
@@ -254,7 +267,7 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,
         outputs={"Y": [out.name], "Mean": [mean.name], "Variance": [var.name]},
         attrs={"epsilon": epsilon, "begin_norm_axis": begin_norm_axis},
     )
-    return helper.append_activation(out)
+    return _keep_lod(input, helper.append_activation(out))
 
 
 def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
@@ -274,7 +287,7 @@ def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
             "dropout_implementation": dropout_implementation,
         },
     )
-    return out
+    return _keep_lod(x, out)
 
 
 def softmax(input, use_cudnn=False, name=None, axis=-1):
@@ -313,6 +326,7 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-10
         outputs={"Loss": [loss.name], "Softmax": [softmax_out.name]},
         attrs={"soft_label": soft_label, "ignore_index": ignore_index},
     )
+    _keep_lod(logits, loss)
     if return_softmax:
         return loss, softmax_out
     return loss
@@ -513,7 +527,7 @@ def _elementwise_layer(op_type):
             outputs={"Out": [out.name]},
             attrs={"axis": axis},
         )
-        return helper.append_activation(out)
+        return _keep_lod(x, helper.append_activation(out))
 
     f.__name__ = op_type
     return f
@@ -579,6 +593,7 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
         outputs={"Out": [out.name]},
         attrs={"scale": float(scale), "bias": float(bias), "bias_after_scale": bias_after_scale},
     )
+    _keep_lod(x, out)
     return helper.append_activation(out)
 
 

@@ -246,6 +246,32 @@ def sequence_mask(x, maxlen, dtype="int64", name=None):
     return out
 
 
+def attention_bias(q, k, causal=False, name=None):
+    """Additive [b, 1, Tq, Tk] bias masking padded keys of ragged `k`
+    (optionally causal); add it to pre-softmax attention scores."""
+    helper = LayerHelper("attention_bias", name=name)
+    klod = _lod_of(k)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "attention_bias",
+        inputs={"Q": [q.name], "K": [k.name], "KLod": [klod.name]},
+        outputs={"Out": [out.name]},
+        attrs={"causal": causal},
+    )
+    return out
+
+
+def position_encoding(x, name=None):
+    """x + sinusoid positions along the (padded) time axis; preserves lod."""
+    helper = LayerHelper("position_encoding", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    helper.append_op(
+        "position_encoding", inputs={"X": [x.name]}, outputs={"Out": [out.name]}
+    )
+    ref = getattr(x, "_lod_ref", None)
+    return _set_lod(out, ref) if ref is not None else out
+
+
 class DynamicRNN:
     """Reference `layers/control_flow.py:1692` — with-block RNN over ragged
     input.  The reference interprets the sub-block per time step over

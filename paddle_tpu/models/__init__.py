@@ -2,4 +2,4 @@
 (reference: benchmark/fluid/models/__init__.py:16-19 — machine_translation,
 resnet, vgg, mnist, stacked_dynamic_lstm, se_resnext + BERT/Transformer
 targets from BASELINE.md)."""
-from . import mnist, resnet, transformer  # noqa: F401
+from . import mnist, nmt, resnet, transformer  # noqa: F401

@@ -1,0 +1,140 @@
+"""Transformer-base NMT on the ragged/LoD path (BASELINE.md target).
+
+Reference: `tests/unittests/dist_transformer.py` + the LoD machine-translation
+benchmark (`benchmark/fluid/machine_translation.py`).  The reference feeds
+host-built attention-bias tensors computed from the LoD; here ragged src/tgt
+feed as `fluid.LoDTensor` and every mask/bias derives inside the compiled
+program from the lengths companions (layers.attention_bias), so bucketed
+padded batches recompile only per bucket, not per shape.
+
+Time dims are dynamic at build time (shape -1): head split/merge reshapes
+use fluid's `0` (copy-dim) semantics, so one build serves every bucket.
+"""
+from __future__ import annotations
+
+from .. import layers, optimizer
+from ..core.program import Program, program_guard
+from .transformer import _attr, multi_head_attention
+
+
+def _mha(q_in, kv_in, bias, d_model, n_heads, prefix, dropout=0.1, is_test=False):
+    """Cross/self attention with additive bias (shared transformer builder)."""
+    return multi_head_attention(q_in, None, d_model, n_heads, prefix,
+                                dropout_prob=dropout, is_test=is_test,
+                                kv=None if kv_in is q_in else kv_in, bias=bias)
+
+
+def _ffn(x, d_model, d_ff, prefix, dropout=0.1, is_test=False):
+    h = layers.fc(x, d_ff, num_flatten_dims=2, act="relu",
+                  param_attr=_attr(f"{prefix}.fc1.w"), bias_attr=_attr(f"{prefix}.fc1.b"))
+    if dropout and not is_test:
+        h = layers.dropout(h, dropout, is_test=is_test,
+                           dropout_implementation="upscale_in_train")
+    return layers.fc(h, d_model, num_flatten_dims=2,
+                     param_attr=_attr(f"{prefix}.fc2.w"), bias_attr=_attr(f"{prefix}.fc2.b"))
+
+
+def _add_norm(x, y, prefix, dropout=0.1, is_test=False):
+    if dropout and not is_test:
+        y = layers.dropout(y, dropout, is_test=is_test,
+                           dropout_implementation="upscale_in_train")
+    out = layers.elementwise_add(x, y)
+    return layers.layer_norm(out, begin_norm_axis=2,
+                             param_attr=_attr(f"{prefix}.ln.w"), bias_attr=_attr(f"{prefix}.ln.b"))
+
+
+def _embed(ids, vocab, d_model, prefix, dropout=0.1, is_test=False):
+    # lengths companion propagates through each of these (layers._keep_lod)
+    emb = layers.embedding(ids, size=[vocab, d_model], param_attr=_attr(f"{prefix}.emb"))
+    emb = layers.scale(emb, scale=float(d_model) ** 0.5)
+    emb = layers.position_encoding(emb)
+    if dropout and not is_test:
+        emb = layers.dropout(emb, dropout, is_test=is_test,
+                             dropout_implementation="upscale_in_train")
+    return emb
+
+
+def build_transformer_nmt(
+    src_vocab=1000,
+    tgt_vocab=1000,
+    d_model=256,
+    n_layers=2,
+    n_heads=4,
+    d_ff=1024,
+    dropout=0.1,
+    label_smooth_eps=0.1,
+    learning_rate=2.0,
+    warmup_steps=400,
+    with_optimizer=True,
+    is_test=False,
+):
+    """Returns (main, startup, feeds, fetches).
+
+    Feeds: src_word [b,Ts,1] int64 ragged; trg_word [b,Tt,1] int64 ragged
+    (decoder input, <bos>-shifted); lbl_word [b,Tt,1] int64 ragged (targets).
+    Loss is per-token cross entropy with label smoothing, masked to each
+    row's length and normalized by the total token count.
+    """
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        src = layers.data("src_word", [1], dtype="int64", lod_level=1)
+        tgt = layers.data("trg_word", [1], dtype="int64", lod_level=1)
+        lbl = layers.data("lbl_word", [1], dtype="int64", lod_level=1)
+
+        enc = _embed(src, src_vocab, d_model, "src", dropout, is_test)
+        enc_bias = layers.attention_bias(enc, enc, causal=False)
+        for i in range(n_layers):
+            p = f"enc{i}"
+            enc = _add_norm(enc, _mha(enc, enc, enc_bias, d_model, n_heads,
+                                      f"{p}.attn", dropout, is_test), f"{p}.a", dropout, is_test)
+            enc = _add_norm(enc, _ffn(enc, d_model, d_ff, f"{p}.ffn", dropout, is_test),
+                            f"{p}.f", dropout, is_test)
+
+        dec = _embed(tgt, tgt_vocab, d_model, "tgt", dropout, is_test)
+        self_bias = layers.attention_bias(dec, dec, causal=True)
+        cross_bias = layers.attention_bias(dec, enc, causal=False)
+        for i in range(n_layers):
+            p = f"dec{i}"
+            dec = _add_norm(dec, _mha(dec, dec, self_bias, d_model, n_heads,
+                                      f"{p}.self", dropout, is_test), f"{p}.s", dropout, is_test)
+            dec = _add_norm(dec, _mha(dec, enc, cross_bias, d_model, n_heads,
+                                      f"{p}.cross", dropout, is_test), f"{p}.c", dropout, is_test)
+            dec = _add_norm(dec, _ffn(dec, d_model, d_ff, f"{p}.ffn", dropout, is_test),
+                            f"{p}.f", dropout, is_test)
+
+        logits = layers.fc(dec, tgt_vocab, num_flatten_dims=2,
+                           param_attr=_attr("proj.w"), bias_attr=_attr("proj.b"))
+
+        if label_smooth_eps:
+            smooth = layers.label_smooth(layers.one_hot(lbl, tgt_vocab),
+                                         epsilon=label_smooth_eps)
+            ce = layers.softmax_with_cross_entropy(logits, smooth, soft_label=True)
+        else:
+            ce = layers.softmax_with_cross_entropy(logits, lbl)
+        # ce inherits the decoder side's raggedness (logits carry tgt's
+        # lengths companion); the sum pool masks beyond each row's length
+        per_sent = layers.sequence_pool(ce, "sum")  # [b, 1]
+        total = layers.reduce_sum(per_sent)
+        ntok = layers.reduce_sum(layers.cast(tgt._lod_ref, "float32"))
+        loss = layers.elementwise_div(total, ntok)
+
+        if with_optimizer:
+            lr = layers.noam_decay(d_model, warmup_steps, learning_rate)
+            optimizer.Adam(learning_rate=lr, beta1=0.9, beta2=0.997,
+                           epsilon=1e-9).minimize(loss)
+
+    feeds = {"src_word": src, "trg_word": tgt, "lbl_word": lbl}
+    return main, startup, feeds, {"loss": loss, "logits": logits}
+
+
+def make_fake_nmt_batch(lengths_src, lengths_tgt, src_vocab, tgt_vocab, seed=0):
+    """Ragged fake batch: returns the feed dict of LoDTensors."""
+    import numpy as np
+
+    from ..lod import LoDTensor
+
+    rng = np.random.RandomState(seed)
+    src = [rng.randint(1, src_vocab, (l, 1)).astype("int64") for l in lengths_src]
+    tgt = [rng.randint(1, tgt_vocab, (l, 1)).astype("int64") for l in lengths_tgt]
+    lbl = [rng.randint(1, tgt_vocab, (l, 1)).astype("int64") for l in lengths_tgt]
+    return {"src_word": LoDTensor(src), "trg_word": LoDTensor(tgt), "lbl_word": LoDTensor(lbl)}

@@ -24,14 +24,20 @@ def _attr(name):
 
 
 def multi_head_attention(x, seq_len, d_model, n_heads, prefix, dropout_prob=0.1, is_test=False,
-                         use_ring_attention=False, causal=False):
+                         use_ring_attention=False, causal=False, kv=None, bias=None):
+    """Self- or cross-attention over [b, T, d] (T may be dynamic: head
+    split/merge uses fluid's 0-copy-dim reshape).  `kv` switches to
+    cross-attention (keys/values from another sequence); `bias` is an
+    additive [b, 1, Tq, Tk] pre-softmax mask (layers.attention_bias).
+    Serves both the fixed-length BERT builder and the ragged NMT model."""
     d_head = d_model // n_heads
+    kv_in = kv if kv is not None else x
     q = layers.fc(x, d_model, num_flatten_dims=2, param_attr=_attr(f"{prefix}.q.w"), bias_attr=_attr(f"{prefix}.q.b"))
-    k = layers.fc(x, d_model, num_flatten_dims=2, param_attr=_attr(f"{prefix}.k.w"), bias_attr=_attr(f"{prefix}.k.b"))
-    v = layers.fc(x, d_model, num_flatten_dims=2, param_attr=_attr(f"{prefix}.v.w"), bias_attr=_attr(f"{prefix}.v.b"))
+    k = layers.fc(kv_in, d_model, num_flatten_dims=2, param_attr=_attr(f"{prefix}.k.w"), bias_attr=_attr(f"{prefix}.k.b"))
+    v = layers.fc(kv_in, d_model, num_flatten_dims=2, param_attr=_attr(f"{prefix}.v.w"), bias_attr=_attr(f"{prefix}.v.b"))
 
     def split_heads(t):
-        t = layers.reshape(t, [-1, seq_len, n_heads, d_head])
+        t = layers.reshape(t, [0, 0, n_heads, d_head])
         return layers.transpose(t, [0, 2, 1, 3])  # (B, H, L, dh)
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
@@ -45,13 +51,15 @@ def multi_head_attention(x, seq_len, d_model, n_heads, prefix, dropout_prob=0.1,
                                  dropout_implementation="upscale_in_train")
     else:
         scores = layers.matmul(q, k, transpose_y=True, alpha=1.0 / np.sqrt(d_head))
+        if bias is not None:
+            scores = layers.elementwise_add(scores, bias)
         attn = layers.softmax(scores)
         if dropout_prob and not is_test:
             attn = layers.dropout(attn, dropout_prob, is_test=is_test,
                                   dropout_implementation="upscale_in_train")
         ctx = layers.matmul(attn, v)  # (B, H, L, dh)
     ctx = layers.transpose(ctx, [0, 2, 1, 3])
-    ctx = layers.reshape(ctx, [-1, seq_len, d_model])
+    ctx = layers.reshape(ctx, [0, 0, d_model])
     return layers.fc(ctx, d_model, num_flatten_dims=2,
                      param_attr=_attr(f"{prefix}.out.w"), bias_attr=_attr(f"{prefix}.out.b"))
 

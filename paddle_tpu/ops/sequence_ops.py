@@ -257,6 +257,43 @@ def _sequence_mask(ctx, op, ins):
     return {"Y": m.astype(as_np_dtype(out_dtype))}
 
 
+@register_op("attention_bias")
+def _attention_bias(ctx, op, ins):
+    """Additive attention bias [b, 1, Tq, Tk] from the key side's lengths
+    (+ optional causal triangle).  The reference expressed this as explicit
+    mask tensors fed per batch (dist_transformer.py builds
+    src_slf_attn_bias on the host from the LoD); here it derives inside the
+    compiled program from the lengths vector, so bucketing keeps it free."""
+    q = first(ins, "Q")  # [b, Tq, ...] ragged carrier (shape source only)
+    k = first(ins, "K")
+    klens = first(ins, "KLod")
+    b, Tq, Tk = q.shape[0], q.shape[1], k.shape[1]
+    neg = jnp.asarray(-1e9, jnp.float32)
+    m = jnp.arange(Tk)[None, :] < klens[:, None]  # [b, Tk]
+    bias = jnp.where(m, 0.0, neg)[:, None, None, :]  # [b,1,1,Tk]
+    bias = jnp.broadcast_to(bias, (b, 1, Tq, Tk))
+    if op.attr("causal", False):
+        tri = jnp.arange(Tq)[:, None] >= jnp.arange(Tk)[None, :]
+        bias = bias + jnp.where(tri, 0.0, neg)[None, None, :, :]
+    return {"Out": jnp.maximum(bias, neg)}
+
+
+@register_op("position_encoding")
+def _position_encoding(ctx, op, ins):
+    """Sinusoid position table [1, T, d] sized from X at trace time
+    (reference: transformer's position_encoding_init in
+    dist_transformer.py computes it host-side with numpy)."""
+    x = first(ins, "X")  # [b, T, d]
+    T, d = x.shape[1], x.shape[2]
+    pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+    i = jnp.arange(d // 2, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, 2.0 * i / d)
+    pe = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=-1)
+    if pe.shape[-1] < d:  # odd d
+        pe = jnp.pad(pe, ((0, 0), (0, d - pe.shape[-1])))
+    return {"Out": (x + pe[None].astype(x.dtype))}
+
+
 @register_op("dynamic_rnn")
 def _dynamic_rnn(ctx, op, ins):
     """One lax.scan over the padded time axis replaces the reference's
