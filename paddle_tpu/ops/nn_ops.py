@@ -284,7 +284,9 @@ def _softmax_with_cross_entropy(ctx, op, ins):
     if op.attr("soft_label", False):
         loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
     else:
-        idx = label if label.shape[-1] == 1 else label[..., None]
+        # expand unless the label is already rank-matched with trailing dim 1
+        # (shape test alone mis-handles a rank-1 label of batch size 1)
+        idx = label if label.ndim == logits.ndim and label.shape[-1] == 1 else label[..., None]
         picked = jnp.take_along_axis(logp, idx.astype(jnp.int32), axis=-1)
         loss = -picked
         ignore = op.attr("ignore_index", -100)

@@ -1,0 +1,292 @@
+"""Per-op golden corpus: elementwise binaries, activations, logical/compare.
+
+Reference pattern: unittests/test_elementwise_*_op.py, test_activation_op.py
+(each declares numpy inputs + numpy-computed expected outputs; OpTest builds
+a one-op program and compares; check_grad vs finite differences)."""
+import numpy as np
+import pytest
+from scipy import special
+
+from op_test import OpTest
+
+RNG = np.random.RandomState(42)
+
+
+def _x(shape=(3, 4), lo=-2.0, hi=2.0, dtype="float32"):
+    return (RNG.rand(*shape) * (hi - lo) + lo).astype(dtype)
+
+
+# --- elementwise binaries with fluid axis-broadcast semantics -------------
+
+ELEMENTWISE = {
+    "elementwise_add": np.add,
+    "elementwise_sub": np.subtract,
+    "elementwise_mul": np.multiply,
+    "elementwise_div": np.divide,
+    "elementwise_max": np.maximum,
+    "elementwise_min": np.minimum,
+    "elementwise_pow": np.power,
+    "elementwise_mod": np.mod,
+    "elementwise_floordiv": np.floor_divide,
+}
+
+
+@pytest.mark.parametrize("op_name", sorted(ELEMENTWISE))
+def test_elementwise_same_shape(op_name):
+    fn = ELEMENTWISE[op_name]
+    x = _x((3, 4), 1.0, 3.0)
+    y = _x((3, 4), 1.0, 3.0)
+
+    class T(OpTest):
+        def setUp(self):
+            self.op_type = op_name
+            self.inputs = {"X": x, "Y": y}
+            self.outputs = {"Out": fn(x, y)}
+            self.attrs = {}
+
+    T().check_output(rtol=1e-5)
+
+
+@pytest.mark.parametrize("op_name", ["elementwise_add", "elementwise_mul"])
+def test_elementwise_axis_broadcast(op_name):
+    """fluid semantics: Y's shape matches X's dims starting at `axis`
+    (reference elementwise_op_function.h)."""
+    fn = ELEMENTWISE[op_name]
+    x = _x((2, 3, 4, 5))
+    y = _x((3, 4))
+    expected = fn(x, y.reshape(1, 3, 4, 1))
+
+    class T(OpTest):
+        def setUp(self):
+            self.op_type = op_name
+            self.inputs = {"X": x, "Y": y}
+            self.outputs = {"Out": expected}
+            self.attrs = {"axis": 1}
+
+    T().check_output(rtol=1e-5)
+
+
+def test_elementwise_add_grad():
+    x = _x((3, 4))
+    y = _x((4,))
+
+    class T(OpTest):
+        def setUp(self):
+            self.op_type = "elementwise_add"
+            self.inputs = {"X": x, "Y": y}
+            self.outputs = {"Out": x + y}
+            self.attrs = {"axis": 1}
+
+    T().check_grad(["X", "Y"], "Out")
+
+
+def test_elementwise_div_grad():
+    x = _x((3, 4), 1.0, 2.0)
+    y = _x((3, 4), 1.0, 2.0)
+
+    class T(OpTest):
+        def setUp(self):
+            self.op_type = "elementwise_div"
+            self.inputs = {"X": x, "Y": y}
+            self.outputs = {"Out": x / y}
+            self.attrs = {}
+
+    T().check_grad(["X", "Y"], "Out", max_relative_error=0.02)
+
+
+# --- activations ----------------------------------------------------------
+
+ACTIVATIONS = {
+    "abs": (lambda x: np.abs(x), {}),
+    "ceil": (np.ceil, {}),
+    "cos": (np.cos, {}),
+    "erf": (special.erf, {}),
+    "exp": (np.exp, {}),
+    "floor": (np.floor, {}),
+    "log": (np.log, {"positive": True}),
+    "reciprocal": (lambda x: 1.0 / x, {"positive": True}),
+    "relu": (lambda x: np.maximum(x, 0), {}),
+    "relu6": (lambda x: np.clip(x, 0, 6), {}),
+    "round": (np.round, {}),
+    "rsqrt": (lambda x: x ** -0.5, {"positive": True}),
+    "sigmoid": (lambda x: 1 / (1 + np.exp(-x)), {}),
+    "sign": (np.sign, {}),
+    "sin": (np.sin, {}),
+    "sqrt": (np.sqrt, {"positive": True}),
+    "square": (np.square, {}),
+    "softsign": (lambda x: x / (1 + np.abs(x)), {}),
+    "tanh": (np.tanh, {}),
+    "logsigmoid": (lambda x: np.log(1 / (1 + np.exp(-x))), {}),
+    "softplus": (lambda x: np.log1p(np.exp(x)), {}),
+    "tanh_shrink": (lambda x: x - np.tanh(x), {}),
+    "gelu": (lambda x: 0.5 * x * (1 + special.erf(x / np.sqrt(2.0))), {}),
+}
+
+
+@pytest.mark.parametrize("op_name", sorted(ACTIVATIONS))
+def test_activation(op_name):
+    fn, opts = ACTIVATIONS[op_name]
+    x = _x((3, 5), 0.2, 3.0) if opts.get("positive") else _x((3, 5))
+
+    class T(OpTest):
+        def setUp(self):
+            self.op_type = op_name
+            self.inputs = {"X": x}
+            self.outputs = {"Out": fn(x)}
+            self.attrs = {}
+
+    T().check_output(rtol=2e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("op_name", ["tanh", "sigmoid", "exp", "square"])
+def test_activation_grad(op_name):
+    fn, _ = ACTIVATIONS[op_name]
+    x = _x((2, 3))
+
+    class T(OpTest):
+        def setUp(self):
+            self.op_type = op_name
+            self.inputs = {"X": x}
+            self.outputs = {"Out": fn(x)}
+            self.attrs = {}
+
+    T().check_grad(["X"], "Out", max_relative_error=0.01)
+
+
+def test_leaky_relu():
+    x = _x((3, 4))
+
+    class T(OpTest):
+        def setUp(self):
+            self.op_type = "leaky_relu"
+            self.inputs = {"X": x}
+            self.outputs = {"Out": np.where(x > 0, x, 0.1 * x)}
+            self.attrs = {"alpha": 0.1}
+
+    T().check_output()
+
+
+def test_elu():
+    x = _x((3, 4))
+
+    class T(OpTest):
+        def setUp(self):
+            self.op_type = "elu"
+            self.inputs = {"X": x}
+            self.outputs = {"Out": np.where(x > 0, x, 0.5 * (np.exp(x) - 1))}
+            self.attrs = {"alpha": 0.5}
+
+    T().check_output()
+
+
+def test_hard_sigmoid():
+    x = _x((3, 4))
+
+    class T(OpTest):
+        def setUp(self):
+            self.op_type = "hard_sigmoid"
+            self.inputs = {"X": x}
+            self.outputs = {"Out": np.clip(x * 0.2 + 0.5, 0.0, 1.0)}
+            self.attrs = {"slope": 0.2, "offset": 0.5}
+
+    T().check_output()
+
+
+def test_swish():
+    x = _x((3, 4))
+
+    class T(OpTest):
+        def setUp(self):
+            self.op_type = "swish"
+            self.inputs = {"X": x}
+            self.outputs = {"Out": x / (1 + np.exp(-2.0 * x))}
+            self.attrs = {"beta": 2.0}
+
+    T().check_output()
+
+
+def test_softshrink():
+    x = _x((3, 4))
+    lam = 0.5
+
+    class T(OpTest):
+        def setUp(self):
+            self.op_type = "softshrink"
+            self.inputs = {"X": x}
+            self.outputs = {"Out": np.where(x > lam, x - lam, np.where(x < -lam, x + lam, 0.0))}
+            self.attrs = {"lambda": lam}
+
+    T().check_output()
+
+
+def test_prelu_modes():
+    x = _x((2, 3, 4))
+    for mode, alpha in (("all", np.array([0.25], "f4")),
+                        ("channel", (RNG.rand(3) * 0.5).astype("f4")),
+                        ("element", (RNG.rand(3, 4) * 0.5).astype("f4"))):
+        if mode == "all":
+            a = alpha.reshape(())
+        elif mode == "channel":
+            a = alpha.reshape(1, 3, 1)
+        else:
+            a = alpha.reshape(1, 3, 4)
+        expected = np.where(x > 0, x, a * x)
+
+        class T(OpTest):
+            def setUp(self):
+                self.op_type = "prelu"
+                self.inputs = {"X": x, "Alpha": alpha}
+                self.outputs = {"Out": expected}
+                self.attrs = {"mode": mode}
+
+        T().check_output()
+
+
+# --- logical / compare ----------------------------------------------------
+
+def test_logical_ops():
+    a = RNG.rand(3, 4) > 0.5
+    b = RNG.rand(3, 4) > 0.5
+    for op_name, fn in (("logical_and", np.logical_and), ("logical_or", np.logical_or)):
+        class T(OpTest):
+            def setUp(self):
+                self.op_type = op_name
+                self.inputs = {"X": a, "Y": b}
+                self.outputs = {"Out": fn(a, b)}
+                self.attrs = {}
+
+        T().check_output()
+
+    class TN(OpTest):
+        def setUp(self):
+            self.op_type = "logical_not"
+            self.inputs = {"X": a}
+            self.outputs = {"Out": np.logical_not(a)}
+            self.attrs = {}
+
+    TN().check_output()
+
+
+COMPARES = {
+    "equal": np.equal,
+    "not_equal": np.not_equal,
+    "less_than": np.less,
+    "less_equal": np.less_equal,
+    "greater_than": np.greater,
+    "greater_equal": np.greater_equal,
+}
+
+
+@pytest.mark.parametrize("op_name", sorted(COMPARES))
+def test_compare(op_name):
+    x = RNG.randint(0, 3, (4, 5)).astype("float32")
+    y = RNG.randint(0, 3, (4, 5)).astype("float32")
+
+    class T(OpTest):
+        def setUp(self):
+            self.op_type = op_name
+            self.inputs = {"X": x, "Y": y}
+            self.outputs = {"Out": COMPARES[op_name](x, y)}
+            self.attrs = {}
+
+    T().check_output()
