@@ -297,12 +297,20 @@ class Executor:
         expanded = {}
         for name, value in feed.items():
             declared_ragged = block.has_var(name) and block.var(name).lod_level >= 1
-            if isinstance(value, LoDTensor) or (
+            is_ragged_feed = isinstance(value, LoDTensor) or (
                 declared_ragged
                 and isinstance(value, (list, tuple))
                 and len(value) > 0
                 and all(isinstance(s, np.ndarray) for s in value)
-            ):
+            )
+            if steps > 1 and is_ragged_feed:
+                raise ValueError(
+                    f"steps>1 does not support ragged/LoDTensor feeds (got one for "
+                    f"'{name}'): the padded expansion has no [steps] axis. Stack "
+                    f"pre-padded dense arrays [steps, b, T, ...] plus the lengths "
+                    f"companion instead, or run with steps=1."
+                )
+            if is_ragged_feed:
                 lt = value if isinstance(value, LoDTensor) else LoDTensor(value)
                 padded, lens = lt.padded(bucket=True)
                 expanded[name] = padded
@@ -310,6 +318,8 @@ class Executor:
             else:
                 expanded[name] = value
         feed = expanded
+
+        from ..ops.common import canon_dtype
 
         jfeeds = {}
         for name, value in feed.items():
@@ -326,14 +336,21 @@ class Executor:
                 arr = arr.astype(dtype)
             # x32 canonicalization at the feed boundary (silences jax's
             # per-call int64-truncation warning)
-            if not jax.config.jax_enable_x64:
-                if arr.dtype == np.int64:
-                    arr = arr.astype(np.int32)
-                elif arr.dtype == np.float64:
-                    arr = arr.astype(np.float32)
-                elif arr.dtype == np.uint64:
-                    arr = arr.astype(np.uint32)
+            canon = canon_dtype(arr.dtype)
+            if arr.dtype != canon:
+                arr = arr.astype(canon)
             jfeeds[name] = arr
+
+        if steps > 1:
+            for name, value in jfeeds.items():
+                shape = np.shape(value)
+                if len(shape) == 0 or shape[0] != steps:
+                    raise ValueError(
+                        f"steps={steps} requires every feed to carry a leading "
+                        f"[steps] axis; feed '{name}' has shape {shape}. Stack K "
+                        f"batches along axis 0 (fetches come back stacked the "
+                        f"same way)."
+                    )
 
         key = scope.find_var(RNG_STATE_VAR)
         if key is None:
@@ -356,8 +373,10 @@ class Executor:
             steps,
             _lowering_flags(),
         )
-        compiled = self._cache.get(cache_key)
-        if compiled is None:
+        compiled = self._cache.pop(cache_key, None)
+        if compiled is not None:
+            self._cache[cache_key] = compiled  # re-insert: true LRU order
+        else:
             compiled = _CompiledStep(
                 program, list(jfeeds), fetch_names, scope,
                 mesh=mesh, batch_axis=batch_axis,

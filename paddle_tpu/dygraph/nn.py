@@ -233,7 +233,7 @@ def _activation(x, act):
         "sigmoid": jax.nn.sigmoid,
         "tanh": jnp.tanh,
         "softmax": jax.nn.softmax,
-        "gelu": jax.nn.gelu,
+        "gelu": lambda x: jax.nn.gelu(x, approximate=False),
         "leaky_relu": functools.partial(jax.nn.leaky_relu, negative_slope=0.02),
     }
     return _apply(act, fns[act], x)

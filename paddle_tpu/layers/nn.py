@@ -593,8 +593,9 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
         outputs={"Out": [out.name]},
         attrs={"scale": float(scale), "bias": float(bias), "bias_after_scale": bias_after_scale},
     )
+    out = helper.append_activation(out)
     _keep_lod(x, out)
-    return helper.append_activation(out)
+    return out
 
 
 def pow(x, factor=1.0, name=None):

@@ -30,6 +30,26 @@ def np_dtype(attr_dtype):
     return as_np_dtype(attr_dtype)
 
 
+def canon_dtype(dtype):
+    """x32-canonicalized dtype for in-program casts: int64/float64 requests
+    become int32/float32 unless jax_enable_x64 is set (avoids the per-trace
+    jnp truncation warning while keeping declared var dtypes intact)."""
+    import jax
+
+    if isinstance(dtype, str):
+        d = np.dtype(as_np_dtype(dtype))
+    else:
+        d = np.dtype(dtype)  # accept any numpy dtype (incl. uint32/uint64)
+    if not jax.config.jax_enable_x64:
+        if d == np.int64:
+            return np.int32
+        if d == np.uint64:
+            return np.uint32
+        if d == np.float64:
+            return np.float32
+    return d
+
+
 def match_dtype(x, y):
     """Harmonize a parameter/second operand to the activation dtype for
     mixed precision: when both are floats of different width, y follows x

@@ -69,7 +69,7 @@ _UNARY = {
     "round": jnp.round,
     "sin": jnp.sin,
     "cos": jnp.cos,
-    "gelu": jax.nn.gelu,
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
     "softplus": jax.nn.softplus,
     "softsign": jax.nn.soft_sign,
     "softshrink": lambda x: jnp.where(x > 0.5, x - 0.5, jnp.where(x < -0.5, x + 0.5, 0.0)),

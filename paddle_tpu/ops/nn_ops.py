@@ -15,7 +15,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.registry import register_op
-from .common import first, match_dtype
+from .common import canon_dtype, first, match_dtype
 
 # When True, conv/pool/batch_norm lower with an internal NHWC layout
 # (transpose at op edges): the public program stays NCHW (fluid layout)
@@ -367,20 +367,20 @@ def _top_k(ctx, op, ins):
     x = first(ins, "X")
     k = op.attr("k", 1)
     vals, idx = jax.lax.top_k(x, k)
-    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+    return {"Out": vals, "Indices": idx.astype(canon_dtype("int64"))}
 
 
 @register_op("arg_max")
 def _arg_max(ctx, op, ins):
     x = first(ins, "X")
     axis = op.attr("axis", -1)
-    return {"Out": jnp.argmax(x, axis=axis).astype(jnp.int64)}
+    return {"Out": jnp.argmax(x, axis=axis).astype(canon_dtype("int64"))}
 
 
 @register_op("arg_min")
 def _arg_min(ctx, op, ins):
     x = first(ins, "X")
-    return {"Out": jnp.argmin(x, axis=op.attr("axis", -1)).astype(jnp.int64)}
+    return {"Out": jnp.argmin(x, axis=op.attr("axis", -1)).astype(canon_dtype("int64"))}
 
 
 @register_op("accuracy")

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.registry import register_op
-from .common import first
+from .common import canon_dtype, first
 
 
 def _mask(lens, T, extra_dims=0):
@@ -121,7 +121,7 @@ def _sequence_pad(ctx, op, ins):
         x = x[:, :T_out]
     m = _mask(lens, T_out, x.ndim - 2)
     out = jnp.where(m, x, jnp.asarray(pad_value, dtype=x.dtype))
-    return {"Out": out, "Length": lens.astype(jnp.int64)}
+    return {"Out": out, "Length": lens.astype(canon_dtype("int64"))}
 
 
 @register_op("sequence_unpad")
@@ -146,7 +146,9 @@ def _sequence_conv(ctx, op, ins):
     start = op.attr("contextStart", None)
     length = op.attr("contextLength", 3)
     if start is None:
-        start = -((length - 1) // 2)
+        # reference layer hard-codes contextStart = -int(filter_size // 2)
+        # (python/paddle/fluid/layers/nn.py:1870)
+        start = -(length // 2)
     b, T, d = x.shape
     m = _mask(lens, T, 1)
     xz = jnp.where(m, x, 0)
@@ -251,10 +253,8 @@ def _sequence_mask(ctx, op, ins):
     lens = first(ins, "X").reshape((-1,))
     maxlen = int(op.attr("maxlen"))
     out_dtype = op.attr("out_dtype", "int64")
-    from ..core.dtypes import as_np_dtype
-
     m = jnp.arange(maxlen)[None, :] < lens[:, None]
-    return {"Y": m.astype(as_np_dtype(out_dtype))}
+    return {"Y": m.astype(canon_dtype(out_dtype))}
 
 
 @register_op("attention_bias")
