@@ -1,0 +1,57 @@
+"""Measure NCHW vs whole-model-NHWC ResNet-50 train step on the real chip."""
+import sys
+import time
+
+import numpy as np
+
+
+def bench(data_format, batch_size=128, K=8, iters=3):
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+
+    main, startup, feeds, fetches = resnet.build(
+        dtype="bfloat16", class_dim=1000, learning_rate=0.1, with_optimizer=True,
+        data_format=data_format,
+    )
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup, scope=scope)
+
+    rng = np.random.RandomState(0)
+    shp = (K, batch_size, 3, 224, 224) if data_format == "NCHW" else (K, batch_size, 224, 224, 3)
+    img = rng.rand(*shp).astype("float32")
+    label = rng.randint(0, 1000, size=(K, batch_size, 1)).astype(np.int32)
+    dev = fluid.TPUPlace(0).jax_device()
+    feed = {
+        "img": jax.device_put(jnp.asarray(img), dev),
+        "label": jax.device_put(jnp.asarray(label), dev),
+    }
+    loss_name = fetches["loss"].name
+
+    def dispatch():
+        return exe.run(main, feed=feed, fetch_list=[loss_name], scope=scope,
+                       steps=K, return_numpy=False)
+
+    out = dispatch()
+    np.asarray(out[0])
+    out = dispatch()
+    np.asarray(out[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = dispatch()
+    losses = np.asarray(out[0])
+    dt = (time.perf_counter() - t0) / (iters * K)
+    imgs = batch_size / dt
+    mfu = imgs * 3 * 4.089e9 / 197e12
+    lossN = float(np.asarray(losses).reshape(-1)[-1])
+    print(f"{data_format}: {dt*1e3:.1f} ms/step  {imgs:.0f} imgs/s  mfu {mfu:.3f}  loss {lossN:.3f}",
+          file=sys.stderr)
+    return imgs
+
+
+if __name__ == "__main__":
+    fmt = sys.argv[1] if len(sys.argv) > 1 else "NHWC"
+    bench(fmt)

@@ -78,7 +78,8 @@ def embedding(input, size, is_sparse=False, is_distributed=False, padding_idx=No
 
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1, groups=None,
-           param_attr=None, bias_attr=None, use_cudnn=True, act=None, name=None):
+           param_attr=None, bias_attr=None, use_cudnn=True, act=None, name=None,
+           data_format="NCHW"):
     helper = LayerHelper("conv2d", name=name, act=act)
     groups = groups or 1
     if isinstance(filter_size, int):
@@ -89,7 +90,11 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1, gro
         padding = [padding, padding]
     if isinstance(dilation, int):
         dilation = [dilation, dilation]
-    num_channels = input.shape[1]
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"conv2d: data_format must be NCHW or NHWC, got {data_format!r}")
+    ch_axis = 1 if data_format == "NCHW" else 3
+    num_channels = input.shape[ch_axis]
+    # filter stays OIHW in both layouts so params are layout-independent
     filter_shape = [num_filters, num_channels // groups, filter_size[0], filter_size[1]]
     from ..core.initializer import NormalInitializer
 
@@ -97,17 +102,18 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1, gro
     default_init = NormalInitializer(0.0, float(np.sqrt(2.0 / fan_in)))
     w = helper.create_parameter(param_attr, filter_shape, input.dtype, default_initializer=default_init)
     out_shape = None
-    if input.shape is not None and input.shape[2] is not None:
+    h_axis, w_axis = (2, 3) if data_format == "NCHW" else (1, 2)
+    if input.shape is not None and input.shape[h_axis] is not None:
         def _osz(i, k, p, s, d):
             if i is None or i < 0:
                 return -1
             return (i + 2 * p - (d * (k - 1) + 1)) // s + 1
-        out_shape = (
-            input.shape[0],
-            num_filters,
-            _osz(input.shape[2], filter_size[0], padding[0], stride[0], dilation[0]),
-            _osz(input.shape[3], filter_size[1], padding[1], stride[1], dilation[1]),
-        )
+        oh = _osz(input.shape[h_axis], filter_size[0], padding[0], stride[0], dilation[0])
+        ow = _osz(input.shape[w_axis], filter_size[1], padding[1], stride[1], dilation[1])
+        if data_format == "NCHW":
+            out_shape = (input.shape[0], num_filters, oh, ow)
+        else:
+            out_shape = (input.shape[0], oh, ow, num_filters)
     pre_bias = _out(helper, input.dtype, shape=out_shape)
     helper.append_op(
         "conv2d",
@@ -118,9 +124,10 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1, gro
             "paddings": padding,
             "dilations": dilation,
             "groups": groups,
+            "data_format": data_format,
         },
     )
-    pre_act = helper.append_bias_op(pre_bias, bias_attr, [num_filters], dim_start=1)
+    pre_act = helper.append_bias_op(pre_bias, bias_attr, [num_filters], dim_start=ch_axis)
     return helper.append_activation(pre_act)
 
 
@@ -151,7 +158,8 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None, str
 
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
-           global_pooling=False, use_cudnn=True, ceil_mode=False, exclusive=True, name=None):
+           global_pooling=False, use_cudnn=True, ceil_mode=False, exclusive=True, name=None,
+           data_format="NCHW"):
     helper = LayerHelper("pool2d", name=name)
     if isinstance(pool_size, int):
         pool_size = [pool_size, pool_size]
@@ -159,20 +167,26 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
         pool_stride = [pool_stride, pool_stride]
     if isinstance(pool_padding, int):
         pool_padding = [pool_padding, pool_padding]
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"pool2d: data_format must be NCHW or NHWC, got {data_format!r}")
+    h_axis, w_axis = (2, 3) if data_format == "NCHW" else (1, 2)
     out_shape = None
     if input.shape is not None and not global_pooling:
         def _osz(i, k, p, s):
             if i is None or i < 0:
                 return -1
             return (i + 2 * p - k) // s + 1
-        out_shape = (
-            input.shape[0],
-            input.shape[1],
-            _osz(input.shape[2], pool_size[0], pool_padding[0], pool_stride[0]),
-            _osz(input.shape[3], pool_size[1], pool_padding[1], pool_stride[1]),
-        )
+        oh = _osz(input.shape[h_axis], pool_size[0], pool_padding[0], pool_stride[0])
+        ow = _osz(input.shape[w_axis], pool_size[1], pool_padding[1], pool_stride[1])
+        if data_format == "NCHW":
+            out_shape = (input.shape[0], input.shape[1], oh, ow)
+        else:
+            out_shape = (input.shape[0], oh, ow, input.shape[3])
     elif input.shape is not None:
-        out_shape = (input.shape[0], input.shape[1], 1, 1)
+        if data_format == "NCHW":
+            out_shape = (input.shape[0], input.shape[1], 1, 1)
+        else:
+            out_shape = (input.shape[0], 1, 1, input.shape[3])
     out = _out(helper, input.dtype, shape=out_shape)
     helper.append_op(
         "pool2d",
@@ -186,6 +200,7 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
             "global_pooling": global_pooling,
             "ceil_mode": ceil_mode,
             "exclusive": exclusive,
+            "data_format": data_format,
         },
     )
     return out

@@ -4,9 +4,11 @@ Reference kernels: conv_cudnn_op.cu.cc / conv_op.cc, pool_op.cc,
 batch_norm_op.cc, layer_norm_op.cc, dropout_op.cc, cross_entropy_op.cc,
 softmax_with_cross_entropy_op.cc, lookup_table_op.cc, top_k_op.cc.
 
-Convs use lax.conv_general_dilated with NCHW logical layout (the public
-fluid layout); XLA relayouts to what the MXU wants, so no manual NHWC
-shuffling is needed at this level.
+Conv/pool/batch_norm have three layout paths: NCHW (the public fluid
+default — XLA relayouts internally), whole-model channels-last via the
+`data_format`/`data_layout` attr (zero transposes in the program), and the
+legacy `_NHWC_LOWERING` transpose-at-op-edges toggle (measured regression;
+kept only for experiments).
 """
 from __future__ import annotations
 
@@ -39,6 +41,21 @@ def _conv2d(ctx, op, ins):
     dilations = tuple(op.attr("dilations", [1, 1]))
     groups = op.attr("groups", 1) or 1
     padding = [(pads[0], pads[0]), (pads[1], pads[1])]
+    if op.attr("data_format", "NCHW") == "NHWC":
+        # whole-model channels-last path: activations are NHWC end to end
+        # (zero transposes in the program); the filter stays OIHW so params
+        # are layout-independent — XLA's layout assignment picks the MXU
+        # layout for the filter itself.
+        out = jax.lax.conv_general_dilated(
+            x,
+            w,
+            window_strides=strides,
+            padding=padding,
+            rhs_dilation=dilations,
+            dimension_numbers=("NHWC", "OIHW", "NHWC"),
+            feature_group_count=groups,
+        )
+        return {"Output": out}
     if _NHWC_LOWERING:
         out = jax.lax.conv_general_dilated(
             jnp.transpose(x, (0, 2, 3, 1)),
@@ -109,13 +126,15 @@ def _pool2d(ctx, op, ins):
     ksize = list(op.attr("ksize", [2, 2]))
     strides = list(op.attr("strides", [1, 1]))
     pads = list(op.attr("paddings", [0, 0]))
+    channels_last = op.attr("data_format", "NCHW") == "NHWC"
     if op.attr("global_pooling", False):
-        ksize = [x.shape[2], x.shape[3]]
+        ksize = [x.shape[1], x.shape[2]] if channels_last else [x.shape[2], x.shape[3]]
         strides = [1, 1]
         pads = [0, 0]
-    nhwc = _NHWC_LOWERING
+    nhwc = _NHWC_LOWERING and not channels_last
     if nhwc:
         x = jnp.transpose(x, (0, 2, 3, 1))
+    if nhwc or channels_last:
         window = (1, ksize[0], ksize[1], 1)
         strides4 = (1, strides[0], strides[1], 1)
     else:
@@ -125,12 +144,12 @@ def _pool2d(ctx, op, ins):
     if op.attr("ceil_mode", False):
         # extra high-side padding so the window count rounds up
         for d in (0, 1):
-            in_sz = x.shape[1 + d] if nhwc else x.shape[2 + d]
+            in_sz = x.shape[1 + d] if (nhwc or channels_last) else x.shape[2 + d]
             out_floor = (in_sz + 2 * pads[d] - ksize[d]) // strides[d] + 1
             out_ceil = -(-(in_sz + 2 * pads[d] - ksize[d]) // strides[d]) + 1
             pad_hi[d] += (out_ceil - out_floor) * strides[d]
     spatial_pad = ((pads[0], pad_hi[0]), (pads[1], pad_hi[1]))
-    if nhwc:
+    if nhwc or channels_last:
         padding = ((0, 0),) + spatial_pad + ((0, 0),)
     else:
         padding = ((0, 0), (0, 0)) + spatial_pad

@@ -1,0 +1,89 @@
+"""Whole-model NHWC (channels-last) parity: the NHWC program must contain no
+transpose ops and match the NCHW program's forward + training numerics with
+the same parameters (reference data_format attr: conv_op.cc / pool_op.cc /
+batch_norm_op.cc support NHWC kernels)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.models import resnet
+
+
+def _build(data_format, depth=18, class_dim=7, hw=32, seed=1234):
+    main, startup = (
+        fluid.Program(),
+        fluid.Program(),
+    )
+    startup.random_seed = seed
+    shape = [3, hw, hw] if data_format == "NCHW" else [hw, hw, 3]
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape, dtype="float32")
+        label = fluid.layers.data("label", [1], dtype="int64")
+        logits = resnet.resnet_imagenet(img, class_dim=class_dim, depth=depth,
+                                        data_format=data_format)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Momentum(learning_rate=0.01, momentum=0.9).minimize(loss)
+    return main, startup, loss
+
+
+def test_nhwc_program_has_no_transposes():
+    main, _, _ = _build("NHWC")
+    types = [op.type for op in main.global_block().ops]
+    assert "transpose" not in types and "transpose2" not in types
+    assert types.count("conv2d") > 10
+
+
+def test_nhwc_matches_nchw_training():
+    rng = np.random.RandomState(0)
+    img = rng.rand(4, 3, 32, 32).astype("float32")
+    label = rng.randint(0, 7, size=(4, 1)).astype("int64")
+
+    losses = {}
+    for fmt in ("NCHW", "NHWC"):
+        main, startup, loss = _build(fmt, seed=1234)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.core.scope.Scope()
+        exe.run(startup, scope=scope)
+        feed_img = img if fmt == "NCHW" else np.transpose(img, (0, 2, 3, 1))
+        vals = []
+        for _ in range(3):
+            (lv,) = exe.run(main, feed={"img": feed_img, "label": label},
+                            fetch_list=[loss], scope=scope)
+            vals.append(float(np.asarray(lv).reshape(-1)[0]))
+        losses[fmt] = vals
+    # same params (seeded startup), same data => same losses in both layouts
+    np.testing.assert_allclose(losses["NCHW"], losses["NHWC"], rtol=2e-4, atol=2e-4)
+
+
+def test_nhwc_conv_pool_golden():
+    """conv2d+pool2d NHWC vs numpy-free NCHW cross-check on random data."""
+    rng = np.random.RandomState(3)
+    x = rng.rand(2, 5, 9, 9).astype("float32")
+
+    def run(fmt):
+        main, startup = fluid.Program(), fluid.Program()
+        startup.random_seed = 7
+        shape = [5, 9, 9] if fmt == "NCHW" else [9, 9, 5]
+        with fluid.program_guard(main, startup):
+            inp = fluid.layers.data("x", shape, dtype="float32")
+            c = fluid.layers.conv2d(inp, num_filters=6, filter_size=3, stride=2, padding=1,
+                                    data_format=fmt)
+            p = fluid.layers.pool2d(c, pool_size=2, pool_stride=2, pool_type="avg",
+                                    data_format=fmt)
+            g = fluid.layers.pool2d(p, global_pooling=True, pool_type="max", data_format=fmt)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.core.scope.Scope()
+        exe.run(startup, scope=scope)
+        feed = x if fmt == "NCHW" else np.transpose(x, (0, 2, 3, 1))
+        (pv, gv) = exe.run(main, feed={"x": feed}, fetch_list=[p, g], scope=scope)
+        pv = np.asarray(pv)
+        gv = np.asarray(gv)
+        if fmt == "NHWC":
+            pv = np.transpose(pv, (0, 3, 1, 2))
+            gv = np.transpose(gv, (0, 3, 1, 2))
+        return pv, gv
+
+    p_nchw, g_nchw = run("NCHW")
+    p_nhwc, g_nhwc = run("NHWC")
+    np.testing.assert_allclose(p_nchw, p_nhwc, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(g_nchw, g_nhwc, rtol=1e-5, atol=1e-5)
