@@ -62,9 +62,41 @@ def append_backward(
             "loss_name": loss.name,
             "param_names": param_names,
             "grad_names": grad_names,
+            "sparse_param_names": _find_sparse_params(block, param_names),
         },
     )
     return list(zip(params, grads))
+
+
+def _find_sparse_params(block, param_names) -> List[str]:
+    """Params eligible for SelectedRows gradients (reference: lookup_table
+    W grads are SelectedRows when is_sparse=True, lookup_table_op.cc).  A
+    param qualifies only if EVERY read of it is an is_sparse lookup_table —
+    any other consumer (weight tying, dense reuse) needs the dense vjp path."""
+    pset = set(param_names)
+    sparse_ok: dict = {}
+    program = block.program
+
+    def scan(blk):
+        for op in blk.ops:
+            for slot, names in op.inputs.items():
+                for n in names:
+                    if n not in pset:
+                        continue
+                    is_sparse_lookup = (
+                        op.type in ("lookup_table", "lookup_table_v2")
+                        and slot == "W"
+                        and bool(op.attrs.get("is_sparse", False))
+                    )
+                    sparse_ok[n] = sparse_ok.get(n, True) and is_sparse_lookup
+            # sub-block reads count too (a tied table consumed densely inside
+            # a While/cond body must stay on the dense vjp path)
+            sub = op.attrs.get("sub_block")
+            if sub is not None and program is not None:
+                scan(program.blocks[sub])
+
+    scan(block)
+    return sorted(n for n, ok in sparse_ok.items() if ok)
 
 
 def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):

@@ -39,6 +39,8 @@ class LoweringContext:
         # current var env, set by run_ops; control-flow lowerings read it to
         # capture outer values and compute loop-carried state
         self.env: Dict[str, Any] = {}
+        # set by run_block_with_backward while sparse-grad taps are active
+        self.sparse_taps = None
 
     def next_key(self):
         self.key, sub = jax.random.split(self.key)
@@ -100,6 +102,41 @@ def find_backward_split(ops: List[Operator]) -> Optional[int]:
     return None
 
 
+# Trace-time report of the last lowered backward (inspection/test surface;
+# static facts only — which params took the SelectedRows path).
+LAST_TRACE_REPORT: Dict[str, Any] = {}
+
+
+class SparseTapCollector:
+    """Collects is_sparse lookup_table 'taps' so embedding-table gradients
+    come out as SelectedRows instead of dense V×D arrays.
+
+    Phase "record": the forward is abstractly evaluated (jax.eval_shape) and
+    each sparse lookup registers (w_name, ids_name, out_shape/dtype).
+    Phase "inject": the real vjp'd forward adds a zero `delta` to each
+    tapped lookup output (before padding_idx masking); d(loss)/d(delta) is
+    exactly the per-row gradient slab, and the ids come out of the aux env
+    by var name — no dense table-shaped cotangent ever exists.
+    """
+
+    def __init__(self, params):
+        self.params = set(params)
+        self.taps: list = []  # (w_name, ids_name, shape, dtype)
+        self.mode = "record"
+        self.deltas: Optional[list] = None
+        self.i = 0
+
+    def tap(self, w_name: str, ids_name: str, out):
+        if w_name not in self.params:
+            return out
+        if self.mode == "record":
+            self.taps.append((w_name, ids_name, out.shape, out.dtype))
+            return out
+        d = self.deltas[self.i]
+        self.i += 1
+        return out + d
+
+
 def run_block_with_backward(ctx: LoweringContext, ops: List[Operator], env: Dict[str, Any]) -> Dict[str, Any]:
     """Interpret a block that may contain one `backward` op.
 
@@ -120,26 +157,91 @@ def run_block_with_backward(ctx: LoweringContext, ops: List[Operator], env: Dict
 
     base_env = dict(env)
 
-    def fwd(params: Dict[str, Any]):
+    for p in param_names:
+        if p not in env:
+            raise KeyError(f"backward: parameter {p!r} not initialized (run the startup program)")
+
+    sparse_names = [n for n in bw.attrs.get("sparse_param_names", []) if n in param_names]
+    dense_names = [p for p in param_names if p not in sparse_names]
+    LAST_TRACE_REPORT.clear()
+    LAST_TRACE_REPORT["sparse_grad_params"] = list(sparse_names)
+
+    coll = None
+    if sparse_names:
+        # Phase "record": abstract-eval the forward to enumerate sparse taps
+        # (cheap — no compute, no compile).  RNG key is saved/restored so the
+        # probe doesn't advance the real stream.
+        coll = SparseTapCollector(sparse_names)
+        ctx.sparse_taps = coll
+        saved_key = ctx.key
+
+        def probe(params):
+            e = dict(base_env)
+            e.update(params)
+            run_ops(ctx, fwd_ops, e)
+            return 0
+
+        jax.eval_shape(probe, {p: env[p] for p in param_names})
+        ctx.key = saved_key
+        coll.mode = "inject"
+
+    def fwd(params: Dict[str, Any], deltas: Dict[str, Any]):
+        if coll is not None:
+            coll.deltas = [deltas[f"__tap{i}"] for i in range(len(coll.taps))]
+            coll.i = 0
         e = dict(base_env)
         e.update(params)
         e = run_ops(ctx, fwd_ops, e)
         loss = e[loss_name]
         return loss, e
 
-    primal_params = {}
-    for p in param_names:
-        if p not in env:
-            raise KeyError(f"backward: parameter {p!r} not initialized (run the startup program)")
-        primal_params[p] = env[p]
+    primal_params = {p: env[p] for p in dense_names}
+    deltas0 = {}
+    if coll is not None:
+        for i, (_, _, shape, dtype) in enumerate(coll.taps):
+            deltas0[f"__tap{i}"] = jnp.zeros(shape, dtype)
 
-    loss, vjp_fn, env_after = jax.vjp(fwd, primal_params, has_aux=True)
-    (grads,) = vjp_fn(jnp.ones_like(loss))
+    loss, vjp_fn, env_after = jax.vjp(fwd, primal_params, deltas0, has_aux=True)
+    (grads, dtaps) = vjp_fn(jnp.ones_like(loss))
 
     env = env_after
+    ctx.sparse_taps = None
     for p, g in zip(param_names, grad_names):
+        if p in sparse_names:
+            env[g] = _gather_sparse_grad(p, coll, dtaps, env)
+            continue
         gval = grads[p]
         if gval is None:  # non-float param leaked in; treat as zero
             gval = jnp.zeros_like(env[p])
         env[g] = gval
     return run_ops(ctx, tail_ops, env)
+
+
+def _gather_sparse_grad(param: str, coll: "SparseTapCollector", dtaps: Dict[str, Any], env: Dict[str, Any]):
+    """Assemble a SelectedRows grad for `param` from its lookup taps: rows
+    are the (traced) ids read from the aux env, values the delta-cotangents.
+    Multiple lookups of one table concatenate (duplicates are legal and
+    merged by the optimizer's MergeAdd)."""
+    from ..ops.common import flatten_lookup_ids
+    from .selected_rows import SelectedRows
+
+    height = env[param].shape[0]
+    dim = env[param].shape[1] if len(env[param].shape) > 1 else 1
+    rows_parts = []
+    vals_parts = []
+    for i, (w_name, ids_name, _, _) in enumerate(coll.taps):
+        if w_name != param:
+            continue
+        flat = flatten_lookup_ids(env[ids_name])
+        rows_parts.append(flat.reshape(-1).astype(jnp.int32))
+        vals_parts.append(dtaps[f"__tap{i}"].reshape(-1, dim))
+    if not rows_parts:
+        # table never actually looked up in the pruned program: empty slab
+        return SelectedRows(
+            jnp.zeros((0,), jnp.int32),
+            jnp.zeros((0, dim), env[param].dtype),
+            height,
+        )
+    return SelectedRows(
+        jnp.concatenate(rows_parts), jnp.concatenate(vals_parts), height
+    )

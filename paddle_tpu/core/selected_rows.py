@@ -1,0 +1,69 @@
+"""SelectedRows: the row-slab sparse gradient (reference:
+framework/selected_rows.h:32, merge/add kernels in
+operators/math/selected_rows_functor.cc).
+
+TPU-first redesign: XLA wants static shapes, so a SelectedRows is a fixed
+(N,) `rows` index vector plus (N, D) `values` — duplicates allowed, and
+`merged()` (the reference's scatter::MergeAdd) dedups with a sort +
+in-batch segment-sum, writing the sentinel row id `height` into freed
+duplicate slots so downstream scatters drop them (`mode="drop"`).  A V×D
+embedding table under `is_sparse=True` therefore never materializes a
+dense V×D gradient: the backward taps the lookup outputs (core/lowering.py)
+and the optimizer sparse kernels (ops/optimizer_ops.py) gather/scatter only
+the touched rows.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.tree_util import register_pytree_node_class
+
+
+@register_pytree_node_class
+class SelectedRows:
+    """rows: (N,) int32 row ids (may repeat; entries == height are dropped);
+    values: (N, D) per-row gradient slabs; height: table row count V."""
+
+    def __init__(self, rows, values, height: int):
+        self.rows = rows
+        self.values = values
+        self.height = height
+
+    def tree_flatten(self):
+        return (self.rows, self.values), self.height
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], aux)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    def merged(self) -> "SelectedRows":
+        """Reference MergeAdd: sum duplicate rows.  Static-shape variant:
+        sort by row id, segment-sum runs inside the batch, park freed slots
+        at the sentinel id (height)."""
+        n = self.rows.shape[0]
+        if n == 0:
+            return self
+        order = jnp.argsort(self.rows)
+        r = jnp.take(self.rows, order)
+        v = jnp.take(self.values, order, axis=0)
+        first = jnp.concatenate([jnp.ones((1,), bool), r[1:] != r[:-1]])
+        seg = jnp.cumsum(first) - 1
+        summed = jax.ops.segment_sum(v, seg, num_segments=n)
+        rows_out = jnp.full((n,), self.height, dtype=r.dtype)
+        rows_out = rows_out.at[seg].set(r)
+        return SelectedRows(rows_out, summed, self.height)
+
+    def to_dense(self):
+        d = jnp.zeros(self.shape, self.values.dtype)
+        return d.at[self.rows].add(self.values, mode="drop")
+
+    def __repr__(self):
+        return f"SelectedRows(height={self.height}, nnz={self.rows.shape[0]}, d={self.values.shape[1:]})"

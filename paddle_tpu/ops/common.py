@@ -70,3 +70,10 @@ def normalize_axes(dim, ndim):
     if isinstance(dim, (int, np.integer)):
         dim = [dim]
     return tuple(sorted(d % ndim for d in dim))
+
+
+def flatten_lookup_ids(ids):
+    """Fluid lookup_table ids carry a trailing dim of 1 (lookup_table_op.cc);
+    strip it when present.  Shared by the lookup lowering and the sparse-grad
+    assembler (core/lowering.py) so SelectedRows rows/values stay aligned."""
+    return ids.reshape(ids.shape[:-1]) if ids.shape and ids.shape[-1] == 1 else ids

@@ -347,11 +347,19 @@ def _huber_loss(ctx, op, ins):
 
 @register_op("lookup_table")
 def _lookup_table(ctx, op, ins):
-    """reference lookup_table_op.cc; ids have trailing dim 1."""
+    """reference lookup_table_op.cc; ids have trailing dim 1.  Under
+    is_sparse=True with an active backward, the tap makes the table's
+    gradient a SelectedRows slab (core/lowering.py SparseTapCollector)."""
+    from .common import flatten_lookup_ids
+
     w = first(ins, "W")
     ids = first(ins, "Ids")
-    flat = ids.reshape(ids.shape[:-1]) if ids.shape and ids.shape[-1] == 1 else ids
+    flat = flatten_lookup_ids(ids)
     out = jnp.take(w, flat.astype(jnp.int32), axis=0)
+    coll = getattr(ctx, "sparse_taps", None)
+    if coll is not None and op.attr("is_sparse", False):
+        # tap BEFORE padding_idx masking so padded positions get zero grad
+        out = coll.tap(op.inputs["W"][0], op.inputs["Ids"][0], out)
     pad = op.attr("padding_idx", None)
     if pad is not None:
         real_pad = pad if pad >= 0 else w.shape[0] + pad

@@ -5,13 +5,24 @@ adamax,adadelta,ftrl,lamb}_op.cc.  Each is a pure function from
 (param, grad, accumulators, lr) to updated values; the executor fuses all
 per-param updates into the same XLA program as the backward pass, which is
 what the reference's fuse_sgd/fuse_adam build passes approximated.
-"""
+
+Sparse (SelectedRows) gradients: sgd/momentum/adagrad/adam carry row-wise
+update kernels matching the reference's SelectedRows functors (each op's
+`.cc` sparse kernel + math/selected_rows_functor.cc MergeAdd): duplicates
+merge first, then only touched table rows are gathered/updated/scattered —
+accumulator state for untouched rows is left alone (same deliberate
+semantic difference from the dense kernels the reference documents for
+momentum/adam)."""
 from __future__ import annotations
 
 import jax.numpy as jnp
 
 from ..core.registry import register_op
+from ..core.selected_rows import SelectedRows
 from .common import first
+
+
+_SPARSE_CAPABLE = {"sgd", "momentum", "adam", "adagrad"}
 
 
 def _lr(ins):
@@ -27,6 +38,13 @@ def register_opt(type: str):
 
     def deco(fn):
         def wrapped(ctx, op, ins):
+            gslot = ins.get("Grad")
+            if gslot and isinstance(gslot[0], SelectedRows) and type not in _SPARSE_CAPABLE:
+                raise NotImplementedError(
+                    f"{type}: no SelectedRows (sparse) update kernel; use "
+                    f"sgd/momentum/adagrad/adam for is_sparse embeddings, or "
+                    f"set is_sparse=False"
+                )
             outs = fn(ctx, op, ins)
             for k, v in list(outs.items()):
                 src = k[:-3] if k.endswith("Out") else None
@@ -42,11 +60,21 @@ def register_opt(type: str):
     return deco
 
 
+def _rows_gather(state, rows):
+    """Gather state rows for a merged SelectedRows (sentinel rows read
+    garbage that the paired drop-scatter discards)."""
+    return state.at[rows].get(mode="fill", fill_value=0)
+
+
 @register_opt("sgd")
 def _sgd(ctx, op, ins):
     p = first(ins, "Param")
     g = first(ins, "Grad")
-    return {"ParamOut": p - _lr(ins) * g}
+    lr = _lr(ins)
+    if isinstance(g, SelectedRows):
+        # no MergeAdd needed: scatter-add already sums duplicate rows
+        return {"ParamOut": p.at[g.rows].add((-lr * g.values).astype(p.dtype), mode="drop")}
+    return {"ParamOut": p - lr * g}
 
 
 @register_opt("momentum")
@@ -56,6 +84,15 @@ def _momentum(ctx, op, ins):
     v = first(ins, "Velocity")
     mu = op.attr("mu", 0.9)
     lr = _lr(ins)
+    if isinstance(g, SelectedRows):
+        m = g.merged()
+        vr = _rows_gather(v, m.rows)
+        v_new_r = mu * vr + m.values
+        upd = m.values + mu * v_new_r if op.attr("use_nesterov", False) else v_new_r
+        return {
+            "ParamOut": p.at[m.rows].add((-lr * upd).astype(p.dtype), mode="drop"),
+            "VelocityOut": v.at[m.rows].set(v_new_r.astype(v.dtype), mode="drop"),
+        }
     v_new = mu * v + g
     if op.attr("use_nesterov", False):
         p_new = p - lr * (g + mu * v_new)
@@ -76,6 +113,21 @@ def _adam(ctx, op, ins):
     beta2 = op.attr("beta2", 0.999)
     eps = op.attr("epsilon", 1e-8)
     lr = _lr(ins)
+    if isinstance(g, SelectedRows):
+        # reference SparseAdamFunctor (adam_op.h): row-wise moment updates,
+        # beta powers advance globally
+        m = g.merged()
+        lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+        m1r = beta1 * _rows_gather(m1, m.rows) + (1.0 - beta1) * m.values
+        m2r = beta2 * _rows_gather(m2, m.rows) + (1.0 - beta2) * jnp.square(m.values)
+        upd = lr_t * m1r / (jnp.sqrt(m2r) + eps)
+        return {
+            "ParamOut": p.at[m.rows].add(-upd.astype(p.dtype), mode="drop"),
+            "Moment1Out": m1.at[m.rows].set(m1r.astype(m1.dtype), mode="drop"),
+            "Moment2Out": m2.at[m.rows].set(m2r.astype(m2.dtype), mode="drop"),
+            "Beta1PowOut": (b1p * beta1).reshape((1,)),
+            "Beta2PowOut": (b2p * beta2).reshape((1,)),
+        }
     m1n = beta1 * m1 + (1.0 - beta1) * g
     m2n = beta2 * m2 + (1.0 - beta2) * jnp.square(g)
     lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
@@ -96,6 +148,14 @@ def _adagrad(ctx, op, ins):
     moment = first(ins, "Moment")
     eps = op.attr("epsilon", 1e-6)
     lr = _lr(ins)
+    if isinstance(g, SelectedRows):
+        m = g.merged()
+        mr = _rows_gather(moment, m.rows) + jnp.square(m.values)
+        upd = lr * m.values / (jnp.sqrt(mr) + eps)
+        return {
+            "ParamOut": p.at[m.rows].add(-upd.astype(p.dtype), mode="drop"),
+            "MomentOut": moment.at[m.rows].set(mr.astype(moment.dtype), mode="drop"),
+        }
     m_new = moment + jnp.square(g)
     p_new = p - lr * g / (jnp.sqrt(m_new) + eps)
     return {"ParamOut": p_new, "MomentOut": m_new}

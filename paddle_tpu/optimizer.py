@@ -88,8 +88,19 @@ class Optimizer:
 
         block = default_main_program().global_block()
         self._create_global_learning_rate()
-        params_grads = append_gradient_clip_ops(params_grads)
-        params_grads = append_regularization_ops(params_grads, self.regularization)
+        # SelectedRows grads (is_sparse embeddings) bypass clip/regularization
+        # op rewrites — those append dense-tensor ops onto the grad var
+        # (reference pserver mode likewise routes sparse grads around the
+        # dense grad pipeline, distribute_transpiler.py:1428)
+        sparse_set = set()
+        for op in block.ops:
+            if op.type == "backward":
+                sparse_set.update(op.attrs.get("sparse_param_names", []))
+        sparse_pg = [(p, g) for p, g in params_grads if p.name in sparse_set]
+        dense_pg = [(p, g) for p, g in params_grads if p.name not in sparse_set]
+        dense_pg = append_gradient_clip_ops(dense_pg)
+        dense_pg = append_regularization_ops(dense_pg, self.regularization)
+        params_grads = dense_pg + sparse_pg
         self._create_accumulators(block, [p for p, _ in params_grads])
         ops = []
         for pg in params_grads:
