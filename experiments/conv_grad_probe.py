@@ -1,8 +1,8 @@
-"""Per-conv-shape cost probe for ResNet-50 bs=128, tunnel-safe methodology:
-chain each op K times inside ONE jit (serialized through a scalar carry) so
-dispatch overhead and D2H transfer don't pollute the per-op number; fetch
-only a scalar. Compares XLA's native grad-filter vjp against a manual
-shift+dot_general formulation for 3x3, and reports achieved TFLOP/s."""
+"""Grad-filter conv probe for the hot ResNet-50 3x3 layers (bs=128):
+compares XLA's native conv vjp against a manual shift+dot_general
+formulation, chained K times inside one jit (arrays passed as ARGUMENTS —
+closure capture would embed them as HLO constants and break the tunnel's
+remote-compile size limit)."""
 import sys
 import time
 
@@ -11,59 +11,55 @@ import jax.numpy as jnp
 import numpy as np
 
 SHAPES = [
-    # (cin, hw, cout, k, stride, count) distinct convs of ResNet-50 @224, bottleneck
+    # (cin, hw, cout, k, stride, count): the 3x3 convs + the stem
     (3, 224, 64, 7, 2, 1),
-    (64, 56, 64, 1, 1, 3),
     (64, 56, 64, 3, 1, 3),
-    (64, 56, 256, 1, 1, 4),   # 3 expand + 1 shortcut
-    (256, 56, 64, 1, 1, 2),
-    (256, 56, 512, 1, 2, 1),  # stage2 shortcut
-    (256, 56, 128, 1, 1, 1),  # stage2 first reduce (s1; spatial drop in 3x3)
     (128, 56, 128, 3, 2, 1),
     (128, 28, 128, 3, 1, 3),
-    (128, 28, 512, 1, 1, 4),
-    (512, 28, 128, 1, 1, 3),
-    (512, 28, 1024, 1, 2, 1),
-    (512, 28, 256, 1, 1, 1),
     (256, 28, 256, 3, 2, 1),
     (256, 14, 256, 3, 1, 5),
-    (256, 14, 1024, 1, 1, 6),
-    (1024, 14, 256, 1, 1, 5),
-    (1024, 14, 2048, 1, 2, 1),
-    (1024, 14, 512, 1, 1, 1),
     (512, 14, 512, 3, 2, 1),
     (512, 7, 512, 3, 1, 2),
-    (512, 7, 2048, 1, 1, 3),
-    (2048, 7, 512, 1, 1, 2),
 ]
 
 BS = 128
-K = 30
 
 
-def chain_time(make_step, x0):
-    """make_step(carry_scalar) -> new scalar; times K serialized steps in one jit."""
+def chain_time_k(make_step, arrs, k, reps=2):
     @jax.jit
-    def run(s):
-        def body(i, s):
-            return make_step(s)
-        return jax.lax.fori_loop(0, K, body, s)
+    def run(s, n, *a):
+        def body(i, ss):
+            return make_step(ss, *a)
+        return jax.lax.fori_loop(0, n, body, s)
 
-    s = jnp.float32(x0)
-    float(run(s))  # compile+warm
-    t0 = time.perf_counter()
-    r = float(run(s))
-    t1 = time.perf_counter()
-    assert np.isfinite(r)
-    return (t1 - t0) / K
+    s = jnp.float32(0.0)
+    n = jnp.int32(k)
+    float(run(s, n, *arrs))  # compile+warm
+    best = 1e9
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        r = float(run(s, n, *arrs))
+        best = min(best, time.perf_counter() - t0)
+        assert np.isfinite(r)
+    return best
+
+
+def chain_time(make_step, arrs):
+    """Adaptive K: pilot at K=200, then size K so device work ~2s (the
+    tunnel dispatch jitter is ~±50ms; bury it)."""
+    pilot_k = 200
+    t = chain_time_k(make_step, arrs, pilot_k, reps=1)
+    per = max(t / pilot_k, 2e-6)
+    k = int(min(max(2.0 / per, 200), 50000))
+    return chain_time_k(make_step, arrs, k) / k
 
 
 def main():
     rng = np.random.RandomState(0)
-    dispatch = chain_time(lambda s: s * 1.0000001, 1.0) * K  # whole-call overhead
-    print(f"dispatch+loop overhead per call: {dispatch*1e3:.2f} ms", file=sys.stderr, flush=True)
+    base = chain_time(lambda s: s * 1.0000001, ())
+    print(f"baseline per-iter overhead: {base*1e6:.1f} us", file=sys.stderr, flush=True)
 
-    tot = {"fwd": 0.0, "gx": 0.0, "gw": 0.0, "gw_man": 0.0}
+    tot_gw = tot_man = 0.0
     for cin, hw, cout, k, stride, count in SHAPES:
         pad = (k - 1) // 2
         ohw = (hw + 2 * pad - k) // stride + 1
@@ -77,27 +73,15 @@ def main():
                 xx, ww, window_strides=(stride, stride), padding=[(pad, pad), (pad, pad)],
                 dimension_numbers=("NCHW", "OIHW", "NCHW"))
 
-        def loss(xx, ww):
-            return jnp.sum(conv(xx, ww).astype(jnp.float32))
-
-        _, vjp = jax.vjp(loss, x, w)
-
-        def step_fwd(s):
-            y = conv(x * (1 + s * 1e-12).astype(x.dtype), w)
-            return s + jnp.mean(y) * 1e-12
-
-        def step_gx(s):
-            gx, = jax.vjp(lambda xx: loss(xx, w), x * (1 + s * 1e-12).astype(x.dtype))[1](jnp.float32(1))
-            return s + jnp.mean(gx.astype(jnp.float32)) * 1e-12
-
-        def step_gw(s):
-            gw, = jax.vjp(lambda ww: loss(x * (1 + s * 1e-12).astype(x.dtype), ww), w)[1](jnp.float32(1))
+        def step_gw(s, xx, ww, dyy):
+            def loss(wv):
+                return jnp.sum(conv(xx * (1 + s * 1e-12).astype(xx.dtype), wv).astype(jnp.float32) * dyy.astype(jnp.float32))
+            gw, = jax.vjp(loss, ww)[1](jnp.float32(1))
             return s + jnp.mean(gw.astype(jnp.float32)) * 1e-12
 
-        def manual_gw(xx, dyy):
-            # grad-filter as k*k shifted matmuls: dW[o,i,kh,kw] =
-            #   sum_n,oh,ow dY[n,o,oh,ow] * X[n,i,oh*s+kh-p,ow*s+kw-p]
-            xp = jnp.pad(xx, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        def manual_gw(s, xx, dyy):
+            xp = jnp.pad(xx * (1 + s * 1e-12).astype(xx.dtype),
+                         ((0, 0), (0, 0), (pad, pad), (pad, pad)))
             outs = []
             for kh in range(k):
                 for kw in range(k):
@@ -105,31 +89,28 @@ def main():
                         xp, (0, 0, kh, kw),
                         (BS, cin, kh + (ohw - 1) * stride + 1, kw + (ohw - 1) * stride + 1),
                         (1, 1, stride, stride))
-                    # [n,i,oh,ow] x [n,o,oh,ow] -> [o,i] contracting n,oh,ow
                     g = jax.lax.dot_general(
                         dyy, xs,
                         (((0, 2, 3), (0, 2, 3)), ((), ())),
                         preferred_element_type=jnp.float32)
                     outs.append(g)
-            return jnp.stack(outs, axis=-1).reshape(cout, cin, k, k)
+            return jnp.stack(outs, -1).reshape(cout, cin, k, k)
 
-        def step_gw_man(s):
-            g = manual_gw(x * (1 + s * 1e-12).astype(x.dtype), dy)
+        def step_man(s, xx, ww, dyy):
+            g = manual_gw(s, xx, dyy)
             return s + jnp.mean(g) * 1e-12
 
-        row = {}
-        for name, fn in (("fwd", step_fwd), ("gx", step_gx), ("gw", step_gw),
-                         ("gw_man", step_gw_man)):
-            t = chain_time(fn, 0.0) - dispatch / K
-            row[name] = t
-            tot[name] += t * count
-        print(f"c{cin:4d} hw{hw:3d} c{cout:4d} k{k} s{stride} x{count}: " +
-              " ".join(f"{n} {flops/row[n]/1e12:6.1f}TF {row[n]*1e3:6.2f}ms"
-                       for n in ("fwd", "gx", "gw", "gw_man")),
+        t_gw = chain_time(step_gw, (x, w, dy)) - base
+        t_man = chain_time(step_man, (x, w, dy)) - base
+        tot_gw += t_gw * count
+        tot_man += t_man * count
+        print(f"c{cin:4d} hw{hw:3d} c{cout:4d} k{k} s{stride} x{count}: "
+              f"gw {flops/t_gw/1e12:6.1f}TF {t_gw*1e3:6.2f}ms | "
+              f"man {flops/t_man/1e12:6.1f}TF {t_man*1e3:6.2f}ms",
               file=sys.stderr, flush=True)
 
-    print(f"TOTAL weighted: fwd {tot['fwd']*1e3:.1f} gx {tot['gx']*1e3:.1f} "
-          f"gw {tot['gw']*1e3:.1f} gw_man {tot['gw_man']*1e3:.1f} ms", file=sys.stderr)
+    print(f"TOTAL weighted gw {tot_gw*1e3:.1f} ms vs manual {tot_man*1e3:.1f} ms",
+          file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,180 @@
+"""Decompose the ResNet-50 bs=128 bf16 train step: where do the 54ms go?
+Raw-JAX mirror of the framework lowering (conv NCHW + BN fp32 stats + relu,
+Momentum), timed as scan-of-K like bench.py. Variants isolate forward,
+backward, BN batch-stats, optimizer, layout."""
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BS = 128
+DTYPE = jnp.bfloat16
+
+
+def conv(x, w, stride=1, pad=0):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding=[(pad, pad), (pad, pad)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+def bn(x, p, training=True, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    if training:
+        m = jnp.mean(xf, axis=(0, 2, 3))
+        v = jnp.var(xf, axis=(0, 2, 3))
+    else:
+        m, v = p["rm"], p["rv"]
+    inv = jax.lax.rsqrt(v.reshape(1, -1, 1, 1) + eps)
+    y = (xf - m.reshape(1, -1, 1, 1)) * inv * p["s"].reshape(1, -1, 1, 1) + p["b"].reshape(1, -1, 1, 1)
+    return y.astype(x.dtype)
+
+
+def init_bn(c, key):
+    return {"s": jnp.ones((c,), jnp.float32), "b": jnp.zeros((c,), jnp.float32),
+            "rm": jnp.zeros((c,), jnp.float32), "rv": jnp.ones((c,), jnp.float32)}
+
+
+def make_resnet50(bn_mode="train", act=True):
+    stages = [3, 4, 6, 3]
+    chans = [64, 128, 256, 512]
+    STRIDES = []
+
+    def init(key):
+        ks = iter(jax.random.split(key, 200))
+        params = {"stem_w": jax.random.normal(next(ks), (64, 3, 7, 7), DTYPE) * 0.05,
+                  "stem_bn": init_bn(64, None), "blocks": []}
+        cin = 64
+        for si, (n, c) in enumerate(zip(stages, chans)):
+            for bi in range(n):
+                stride = 2 if (bi == 0 and si > 0) else 1
+                blk = {
+                    "w1": jax.random.normal(next(ks), (c, cin, 1, 1), DTYPE) * 0.05,
+                    "bn1": init_bn(c, None),
+                    "w2": jax.random.normal(next(ks), (c, c, 3, 3), DTYPE) * 0.05,
+                    "bn2": init_bn(c, None),
+                    "w3": jax.random.normal(next(ks), (c * 4, c, 1, 1), DTYPE) * 0.05,
+                    "bn3": init_bn(c * 4, None),
+                }
+                if bi == 0:
+                    blk["ws"] = jax.random.normal(next(ks), (c * 4, cin, 1, 1), DTYPE) * 0.05
+                    blk["bns"] = init_bn(c * 4, None)
+                params["blocks"].append(blk)
+                STRIDES.append(stride)
+                cin = c * 4
+        params["fc_w"] = jax.random.normal(next(ks), (2048, 1000), DTYPE) * 0.01
+        return params
+
+    training = bn_mode == "train"
+    use_bn = bn_mode != "none"
+
+    def apply(params, x):
+        h = conv(x, params["stem_w"], 2, 3)
+        if use_bn:
+            h = bn(h, params["stem_bn"], training)
+        if act:
+            h = jax.nn.relu(h)
+        h = jax.lax.reduce_window(h, -jnp.inf, jax.lax.max, (1, 1, 3, 3), (1, 1, 2, 2),
+                                  ((0, 0), (0, 0), (1, 1), (1, 1)))
+        for blk, s in zip(params["blocks"], STRIDES):
+            short = h
+            if "ws" in blk:
+                short = conv(h, blk["ws"], s, 0)
+                if use_bn:
+                    short = bn(short, blk["bns"], training)
+            h1 = conv(h, blk["w1"], 1, 0)
+            if use_bn:
+                h1 = bn(h1, blk["bn1"], training)
+            if act:
+                h1 = jax.nn.relu(h1)
+            h2 = conv(h1, blk["w2"], s, 1)
+            if use_bn:
+                h2 = bn(h2, blk["bn2"], training)
+            if act:
+                h2 = jax.nn.relu(h2)
+            h3 = conv(h2, blk["w3"], 1, 0)
+            if use_bn:
+                h3 = bn(h3, blk["bn3"], training)
+            h = h3 + short
+            if act:
+                h = jax.nn.relu(h)
+        h = jnp.mean(h.astype(jnp.float32), axis=(2, 3))
+        logits = h @ params["fc_w"].astype(jnp.float32)
+        return logits
+
+    return init, apply
+
+
+def timeit_scan(step_fn, state, feeds, K=8, iters=3):
+    @partial(jax.jit, donate_argnums=(0,))
+    def run(st, fd):
+        def body(c, _):
+            return step_fn(c, fd), 0.0
+        st2, _ = jax.lax.scan(body, st, None, length=K)
+        return st2
+
+    state = run(state, feeds)
+    state = run(state, feeds)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = run(state, feeds)
+    jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+    dt = (time.perf_counter() - t0) / (iters * K)
+    return dt, state
+
+
+def main():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(BS, 3, 224, 224), DTYPE)
+    y = jnp.asarray(rng.randint(0, 1000, (BS,)), jnp.int32)
+
+    def loss_of(apply):
+        def loss(params, fd):
+            logits = apply(params, fd["x"])
+            lo = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(lo, fd["y"][:, None], 1))
+        return loss
+
+    variants = [
+        ("fwd_only", "train", "fwd"),
+        ("full_train_bnTrain", "train", "train"),
+        ("full_train_bnFrozen", "frozen", "train"),
+        ("full_train_noBN", "none", "train"),
+        ("grad_only_bnTrain", "train", "grad"),
+    ]
+    for name, bn_mode, mode in variants:
+        init, apply = make_resnet50(bn_mode)
+        params = init(jax.random.PRNGKey(0))
+        loss = loss_of(apply)
+        if mode == "fwd":
+            def step(carry, fd):
+                p, s = carry
+                l = loss(p, fd)
+                return (p, s + l * 1e-9)
+            st = (params, jnp.float32(0))
+        elif mode == "grad":
+            def step(carry, fd):
+                p, s = carry
+                g = jax.grad(loss)(p, fd)
+                leaf = jax.tree_util.tree_leaves(g)[0]
+                return (p, s + jnp.sum(leaf.astype(jnp.float32)) * 1e-12)
+            st = (params, jnp.float32(0))
+        else:
+            vel = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+            def step(carry, fd):
+                p, v = carry
+                g = jax.grad(loss)(p, fd)
+                v2 = jax.tree_util.tree_map(lambda vv, gg: 0.9 * vv + gg.astype(jnp.float32), v, g)
+                p2 = jax.tree_util.tree_map(lambda pp, vv: (pp.astype(jnp.float32) - 0.1 * vv).astype(pp.dtype), p, v2)
+                return (p2, v2)
+            st = (params, vel)
+
+        dt, _ = timeit_scan(step, st, {"x": x, "y": y})
+        imgs = BS / dt
+        print(f"{name:24s}: {dt*1e3:6.1f} ms  {imgs:7.0f} imgs/s", file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -20,6 +20,7 @@ from .core.program import (  # noqa: F401
     Variable,
     default_main_program,
     default_startup_program,
+    device_guard,
     program_guard,
 )
 from .core.scope import Scope, global_scope  # noqa: F401
@@ -32,5 +33,9 @@ from .lod import LoDTensor, create_lod_tensor  # noqa: F401
 from . import models  # noqa: F401
 from . import reader  # noqa: F401
 from .reader import DataFeeder, DataLoader, PyReader  # noqa: F401
+from . import contrib  # mixed_precision decorator etc.  # noqa: F401
+from . import dygraph  # noqa: F401
+from . import metrics  # noqa: F401
+from . import profiler  # noqa: F401
 
 __version__ = "0.1.0"

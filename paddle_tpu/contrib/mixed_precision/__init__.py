@@ -1,0 +1,1 @@
+from .decorator import OptimizerWithMixedPrecision, decorate  # noqa: F401

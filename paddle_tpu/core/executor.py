@@ -168,12 +168,12 @@ class _CompiledStep:
                 return NamedSharding(mesh, P(*hints[n]) if n in hints else P())
 
             repl = NamedSharding(mesh, P())
-            n_dp = mesh.shape[batch_axis]
+            n_dp = dict(mesh.shape).get(batch_axis, 0)  # 0: no data axis (e.g. pure pp mesh)
 
             def feed_spec(n):
                 shape = feed_shapes.get(n, ())
                 bdim = 1 if n_steps > 1 else 0  # steps>1: axis 0 is the scan axis
-                if len(shape) > bdim and shape[bdim] % n_dp == 0:
+                if n_dp and len(shape) > bdim and shape[bdim] % n_dp == 0:
                     return NamedSharding(mesh, P(*([None] * bdim + [batch_axis])))
                 return repl  # scalars / indivisible feeds replicate
 

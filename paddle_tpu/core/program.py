@@ -279,6 +279,8 @@ class Block:
         from .registry import infer_and_check  # late import: registry needs Block
 
         op = Operator(self, type, _normalize_io(inputs), _normalize_io(outputs), attrs)
+        if _device_guard_stage is not None and "pipeline_stage" not in op.attrs:
+            op.attrs["pipeline_stage"] = _device_guard_stage
         self.ops.append(op)
         infer_and_check(op, self)
         self.program._bump()
@@ -441,6 +443,29 @@ class Program:
 
 _main_program = Program()
 _startup_program = Program()
+_device_guard_stage: Optional[int] = None
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Reference: framework.device_guard("gpu:0") — tags appended ops with a
+    pipeline stage for PipelineOptimizer to cut on.  Accepts an int stage or
+    a "gpu:N"/"tpu:N" string (device kind is irrelevant on a mesh; only the
+    stage index survives)."""
+    global _device_guard_stage
+    prev = _device_guard_stage
+    if device is None:
+        _device_guard_stage = None
+    elif isinstance(device, int):
+        _device_guard_stage = device
+    else:
+        tail = str(device).rsplit(":", 1)[-1]
+        # "cpu" / "gpu" with no index (reference accepts these): no stage tag
+        _device_guard_stage = int(tail) if tail.isdigit() else None
+    try:
+        yield
+    finally:
+        _device_guard_stage = prev
 
 
 def default_main_program() -> Program:

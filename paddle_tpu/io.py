@@ -94,6 +94,190 @@ def load_params(executor, dirname: str, main_program: Optional[Program] = None,
     return load_vars(dirname, [p.name for p in program.all_parameters()], scope)
 
 
+# --- sharded (per-device-slice) checkpointing -------------------------------
+
+SHARDED_MANIFEST = "__sharded_manifest__.json"
+
+
+def _norm_index(index, shape):
+    """Shard index (tuple of slices) -> [[start, stop], ...] per dim."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start, stop, step = sl.indices(dim)
+        assert step == 1, "strided shard layouts are not supported"
+        out.append([int(start), int(stop)])
+    return out
+
+
+def _save_array(path, arr):
+    """bfloat16 (and other ml_dtypes) don't round-trip through np.load's
+    mmap; store them as a same-width uint view and reinterpret on load."""
+    arr = np.asarray(arr)
+    if arr.dtype.kind == "V" or str(arr.dtype) == "bfloat16":
+        np.save(path, arr.view(np.uint16))
+        return "bfloat16_as_uint16"
+    np.save(path, arr)
+    return None
+
+
+def _loaded_view(mm, stored_as):
+    if stored_as == "bfloat16_as_uint16":
+        import ml_dtypes
+
+        return mm.view(ml_dtypes.bfloat16)
+    return mm
+
+
+def save_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
+                 scope: Optional[Scope] = None, program: Optional[Program] = None):
+    """Sharded checkpoint (SURVEY §5.4: TensorStore-style per-shard save;
+    reference precedent: sliced pserver save, io.py:292
+    _save_distributed_persistables).  Each variable writes only its unique
+    device shards — one .npy per distinct slice, never a host gather of the
+    global array — plus layout metadata (global shape, dtype, PartitionSpec)
+    so load can re-place shards without resharding.  Multi-host ready: each
+    process writes only its addressable shards, tagged by process index."""
+    import jax
+
+    scope = scope or global_scope()
+    if var_names is None:
+        program = program or default_main_program()
+        var_names = [v.name for v in _persistables(program)]
+    os.makedirs(dirname, exist_ok=True)
+    proc = jax.process_index()
+    entries = []
+    for name in var_names:
+        v = scope.find_var(name)
+        if v is None:
+            raise KeyError(f"save_sharded: {name!r} not found in scope")
+        safe = name.replace("/", "%2F")
+        shards_meta = []
+        spec = None
+        if isinstance(v, jax.Array):
+            sh = v.sharding
+            from jax.sharding import NamedSharding
+
+            if isinstance(sh, NamedSharding):
+                spec = [list(p) if isinstance(p, (list, tuple)) else p for p in sh.spec]
+            seen = set()
+            for i, shard in enumerate(v.addressable_shards):
+                idx = _norm_index(shard.index, v.shape)
+                key = tuple(tuple(p) for p in idx)
+                if key in seen:
+                    continue  # replicated copy — save once
+                seen.add(key)
+                fname = f"{safe}.p{proc}s{i}.npy"
+                stored_as = _save_array(os.path.join(dirname, fname), np.asarray(shard.data))
+                shards_meta.append({"file": fname, "index": idx, "stored_as": stored_as})
+            gshape = list(v.shape)
+            dtype = str(v.dtype)
+        else:
+            arr = np.asarray(v)
+            fname = f"{safe}.p{proc}s0.npy"
+            stored_as = _save_array(os.path.join(dirname, fname), arr)
+            shards_meta.append({"file": fname, "index": _norm_index(
+                tuple(slice(0, d) for d in arr.shape), arr.shape), "stored_as": stored_as})
+            gshape = list(arr.shape)
+            dtype = str(arr.dtype)
+        entries.append({"name": name, "global_shape": gshape, "dtype": dtype,
+                        "spec": spec, "shards": shards_meta})
+    # one manifest per process; process 0's carries the authoritative copy
+    mname = SHARDED_MANIFEST if proc == 0 else f"__sharded_manifest__.p{proc}.json"
+    with open(os.path.join(dirname, mname), "w") as f:
+        json.dump({"vars": entries, "process": proc}, f, indent=1)
+    return [e["name"] for e in entries]
+
+
+def load_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
+                 scope: Optional[Scope] = None, mesh=None):
+    """Restore a sharded checkpoint.  With `mesh`, every var that recorded a
+    PartitionSpec is rebuilt via jax.make_array_from_callback — each device
+    reads exactly its slice from the shard files (memmapped, no full-array
+    host materialization when the layouts match).  Without a mesh, shards
+    are assembled on host."""
+    import jax
+
+    import glob as _glob
+
+    scope = scope or global_scope()
+    with open(os.path.join(dirname, SHARDED_MANIFEST)) as f:
+        manifest = json.load(f)
+    # multi-host save: merge every process's shard lists into proc-0's view
+    by_name = {e["name"]: e for e in manifest["vars"]}
+    for extra in sorted(_glob.glob(os.path.join(dirname, "__sharded_manifest__.p*.json"))):
+        with open(extra) as f:
+            m2 = json.load(f)
+        for e in m2["vars"]:
+            tgt = by_name.get(e["name"])
+            if tgt is None:
+                manifest["vars"].append(e)
+                by_name[e["name"]] = e
+                continue
+            have = {tuple(tuple(p) for p in sh["index"]) for sh in tgt["shards"]}
+            for sh in e["shards"]:
+                if tuple(tuple(p) for p in sh["index"]) not in have:
+                    tgt["shards"].append(sh)
+    want = set(var_names) if var_names is not None else None
+    loaded = []
+    for entry in manifest["vars"]:
+        name = entry["name"]
+        if want is not None and name not in want:
+            continue
+        shape = tuple(entry["global_shape"])
+        mms = [(sh["index"], _loaded_view(
+                    np.load(os.path.join(dirname, sh["file"]), mmap_mode="r"),
+                    sh.get("stored_as")))
+               for sh in entry["shards"]]
+
+        def read_region(index, _mms=mms, _shape=shape, _name=name):
+            """Assemble an arbitrary sub-slice from the stored shards,
+            verifying full coverage (a partially-covered region means a
+            missing/corrupt shard and must never return silent garbage)."""
+            tgt = [sl.indices(d) for sl, d in zip(index, _shape)]
+            out = None
+            covered = None
+            for idx, mm in _mms:
+                # overlap of shard block and target region, per dim
+                src_sel, dst_sel = [], []
+                ok = True
+                for (t0, t1, _), (s0, s1) in zip(tgt, idx):
+                    lo, hi = max(t0, s0), min(t1, s1)
+                    if lo >= hi:
+                        ok = False
+                        break
+                    src_sel.append(slice(lo - s0, hi - s0))
+                    dst_sel.append(slice(lo - t0, hi - t0))
+                if not ok:
+                    continue
+                if out is None:
+                    out = np.empty([t1 - t0 for t0, t1, _ in tgt], mm.dtype)
+                    covered = np.zeros(out.shape, bool)
+                out[tuple(dst_sel)] = mm[tuple(src_sel)]
+                covered[tuple(dst_sel)] = True
+            if out is None or not covered.all():
+                raise ValueError(
+                    f"checkpoint shards do not fully cover {index} of {_name} "
+                    f"(missing shard files? partial multi-host save?)")
+            return out
+
+        if mesh is not None and entry["spec"] is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            spec = [tuple(p) if isinstance(p, list) else p for p in entry["spec"]]
+            sharding = NamedSharding(mesh, P(*spec))
+            arr = jax.make_array_from_callback(shape, sharding, read_region)
+        else:
+            full = read_region(tuple(slice(0, d) for d in shape))
+            arr = full
+        scope.set_var(name, arr)
+        loaded.append(name)
+    if want is not None:
+        missing = want - set(loaded)
+        if missing:
+            raise KeyError(f"load_sharded: checkpoint lacks {sorted(missing)}")
+    return loaded
+
+
 def save_inference_model(
     dirname: str,
     feeded_var_names: Sequence[str],

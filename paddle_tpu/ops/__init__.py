@@ -4,6 +4,7 @@ from . import (  # noqa: F401
     math_ops,
     nn_ops,
     optimizer_ops,
+    pipeline_ops,
     sequence_ops,
     tensor_ops,
 )

@@ -46,12 +46,19 @@ def register_opt(type: str):
                     f"set is_sparse=False"
                 )
             outs = fn(ctx, op, ins)
+            found_inf = ins.get("FoundInf")  # AMP decorator predication
+            skip = found_inf[0].reshape(()) if found_inf else None
             for k, v in list(outs.items()):
                 src = k[:-3] if k.endswith("Out") else None
                 if src and ins.get(src):
                     ref = ins[src][0]
                     if hasattr(v, "dtype") and v.dtype != ref.dtype:
-                        outs[k] = v.astype(ref.dtype)
+                        v = v.astype(ref.dtype)
+                    if skip is not None:
+                        # overflow step: every state buffer keeps its old
+                        # value exactly (contrib/mixed_precision/decorator.py)
+                        v = jnp.where(skip, ref, v)
+                    outs[k] = v
             return outs
 
         register_op(type)(wrapped)
@@ -268,3 +275,35 @@ def _ftrl(ctx, op, ins):
     pre = jnp.clip(new_lin, -l1, l1) - new_lin
     p_new = jnp.where(jnp.abs(new_lin) > l1, pre / quad, jnp.zeros_like(p))
     return {"ParamOut": p_new, "SquaredAccumOut": new_sq, "LinearAccumOut": new_lin}
+
+
+@register_op("update_loss_scaling")
+def _update_loss_scaling(ctx, op, ins):
+    """Dynamic loss-scaling state machine (reference:
+    contrib/mixed_precision/decorator.py _increment/_decrement logic):
+    N consecutive finite steps multiply the scale by incr_ratio; M overflow
+    steps within a window multiply by decr_ratio (floored at 1.0)."""
+    fi = first(ins, "FoundInf").reshape(())
+    s = first(ins, "LossScaling").reshape(())
+    good = first(ins, "GoodSteps").reshape(())
+    bad = first(ins, "BadSteps").reshape(())
+    incr_n = op.attr("incr_every_n_steps", 1000)
+    decr_n = op.attr("decr_every_n_nan_or_inf", 2)
+    incr_ratio = op.attr("incr_ratio", 2.0)
+    decr_ratio = op.attr("decr_ratio", 0.5)
+    good_new = jnp.where(fi, 0, good + 1)
+    bad_new = jnp.where(fi, bad + 1, 0)
+    do_incr = good_new >= incr_n
+    do_decr = bad_new >= decr_n
+    # keep the old scale if growth would overflow (reference
+    # update_loss_scaling_op.h keeps pre-update scale when non-finite)
+    grown = s * incr_ratio
+    s_new = jnp.where(do_incr & jnp.isfinite(grown), grown, s)
+    s_new = jnp.where(do_decr, jnp.maximum(s * decr_ratio, 1.0), s_new)
+    good_new = jnp.where(do_incr, 0, good_new)
+    bad_new = jnp.where(do_decr, 0, bad_new)
+    return {
+        "LossScalingOut": s_new.reshape((1,)),
+        "GoodStepsOut": good_new.reshape((1,)).astype(good.dtype),
+        "BadStepsOut": bad_new.reshape((1,)).astype(bad.dtype),
+    }

@@ -1,0 +1,69 @@
+"""`pipeline` op: program-level GPipe (reference: PipelineOptimizer
+optimizer.py:2661 + PipelineTrainer/SectionWorker trainer_desc.proto:57-79).
+
+The PipelineOptimizer (optimizer.py) cuts device_guard-tagged stage segments
+out of the main block into ONE canonical sub-block (stages must be
+structurally identical — the TPU-idiomatic pipeline case of repeated
+blocks), stacks per-stage parameters on a leading S axis, and emits this op.
+
+Lowering: with a `pp` mesh axis, microbatches stream through
+parallel/pipeline.py's collective_permute schedule (params sharded over pp,
+one stage per device); without one, stages run sequentially — identical
+math, so CPU tests validate the cut itself.  Backward is jax.vjp through
+either path (vjp of ppermute is the reverse permute)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .common import first
+
+
+@register_op("pipeline")
+def _pipeline(ctx, op, ins):
+    from ..core.lowering import run_ops
+
+    x = first(ins, "X")
+    plist = ins["Params"]
+    S = op.attr("num_stages")
+    M = op.attr("num_microbatches", 4)
+    axis = op.attr("axis_name", "pp")
+    canon = list(op.attr("canonical_params"))
+    cin = op.attr("carry_in")
+    cout = op.attr("carry_out")
+    n_per = len(canon)
+    sub = op.block.program.blocks[op.attr("sub_block")]
+
+    def stage_fn(stage_params, xx):
+        e = dict(stage_params)
+        e[cin] = xx
+        run_ops(ctx, sub.ops, e)
+        return e[cout]
+
+    if ctx.mesh is not None and axis in ctx.mesh.shape:
+        from ..parallel.pipeline import gpipe
+
+        n_pp = ctx.mesh.shape[axis]
+        if n_pp != S:
+            raise ValueError(
+                f"pipeline: program has {S} stages but mesh axis {axis!r} has "
+                f"{n_pp} devices; they must match (or run without a pp axis "
+                f"for the sequential fallback)")
+        if x.shape[0] % M:
+            raise ValueError(
+                f"pipeline: batch {x.shape[0]} not divisible by "
+                f"num_microbatches={M}")
+        stacked = {
+            n: jnp.stack([plist[s * n_per + j] for s in range(S)])
+            for j, n in enumerate(canon)
+        }
+        mbs = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+        ys = gpipe(stage_fn, stacked, mbs, ctx.mesh, axis)
+        return {"Out": ys.reshape((x.shape[0],) + ys.shape[2:])}
+
+    # no pp axis: run the stages back to back (same math; exercises the cut)
+    h = x
+    for s in range(S):
+        sp = {n: plist[s * n_per + j] for j, n in enumerate(canon)}
+        h = stage_fn(sp, h)
+    return {"Out": h}

@@ -459,6 +459,192 @@ class LambOptimizer(AdamOptimizer):
         )
 
 
+class PipelineOptimizer:
+    """Program-level pipeline parallelism (reference: optimizer.py:2661
+    PipelineOptimizer + SectionWorker).
+
+    Usage: tag the repeated middle blocks of the network with
+    `with fluid.device_guard(s):` for s = 0..S-1, then
+    `PipelineOptimizer(inner_opt, num_microbatches=M).minimize(loss)`.
+    The tagged segments are cut out of the main block into one canonical
+    sub-block, per-stage parameters are stacked, and a single `pipeline` op
+    (ops/pipeline_ops.py) replaces them — GPipe over a `pp` mesh axis, or
+    sequential execution without one.
+
+    TPU-first constraint: stages must be structurally identical (same op
+    sequence, same param shapes) — the repeated-transformer-block case that
+    pipelining on an SPMD machine actually wants.  Head and tail (embedding,
+    loss, optimizer) run outside the pipelined region on every device."""
+
+    def __init__(self, optimizer, num_microbatches: int = 4, axis_name: str = "pp"):
+        self._optimizer = optimizer
+        self._num_microbatches = num_microbatches
+        self._axis_name = axis_name
+
+    # delegate the non-minimize surface
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        self._cut(loss.block.program)
+        return self._optimizer.minimize(loss, startup_program, parameter_list, no_grad_set)
+
+    # -- the program cutter ------------------------------------------------
+    def _cut(self, program):
+        block = program.global_block()
+        ops = block.ops
+        tags = [op.attrs.get("pipeline_stage") for op in ops]
+        stage_ids = sorted({t for t in tags if t is not None})
+        if not stage_ids:
+            raise ValueError(
+                "PipelineOptimizer: no ops tagged with fluid.device_guard(stage)")
+        S = len(stage_ids)
+        if stage_ids != list(range(S)):
+            raise ValueError(f"PipelineOptimizer: stages must be 0..{S-1}, got {stage_ids}")
+
+        # contiguous, ordered segments
+        seg_range = {}
+        for i, t in enumerate(tags):
+            if t is None:
+                continue
+            lo, hi = seg_range.get(t, (i, i))
+            seg_range[t] = (min(lo, i), max(hi, i))
+        bounds = [seg_range[s] for s in range(S)]
+        for s, (lo, hi) in enumerate(bounds):
+            if any(tags[i] != s for i in range(lo, hi + 1)):
+                raise ValueError(
+                    f"PipelineOptimizer: stage {s} ops are not contiguous "
+                    f"(found a different tag inside [{lo},{hi}])")
+            if s and bounds[s - 1][1] >= lo:
+                raise ValueError("PipelineOptimizer: stage segments out of order")
+            if s and bounds[s - 1][1] + 1 != lo:
+                gap = [ops[i].type for i in range(bounds[s - 1][1] + 1, lo)]
+                raise ValueError(
+                    f"PipelineOptimizer: untagged ops {gap} sit between stage "
+                    f"{s-1} and stage {s}; everything between the first and "
+                    f"last device_guard region must belong to a stage")
+
+        segs = [ops[lo:hi + 1] for lo, hi in bounds]
+
+        def is_param(name):
+            v = block._find_var_recursive(name)
+            from .core.program import Parameter
+
+            return isinstance(v, Parameter)
+
+        # isomorphism + per-stage params (positional correspondence)
+        sig0 = [(o.type, sorted(o.inputs), sorted(o.outputs)) for o in segs[0]]
+        stage_params = []
+        for s, seg in enumerate(segs):
+            sig = [(o.type, sorted(o.inputs), sorted(o.outputs)) for o in seg]
+            if sig != sig0:
+                raise ValueError(
+                    f"PipelineOptimizer: stage {s} is not structurally identical "
+                    f"to stage 0 (op sequence {sig} vs {sig0}); pipeline stages "
+                    f"must be repeated blocks")
+            pnames, seen = [], set()
+            for o in seg:
+                for n in o.input_arg_names:
+                    if n not in seen and is_param(n):
+                        seen.add(n)
+                        pnames.append(n)
+            stage_params.append(pnames)
+            if len(pnames) != len(stage_params[0]):
+                raise ValueError("PipelineOptimizer: stages read different param counts")
+            for a, b in zip(pnames, stage_params[0]):
+                if tuple(block.var(a).shape or ()) != tuple(block.var(b).shape or ()):
+                    raise ValueError(
+                        f"PipelineOptimizer: param shape mismatch {a} vs {b}")
+            # persistable writes (BN running stats) can't cross the stage cut
+            for o in seg:
+                for n in o.output_arg_names:
+                    v = block._find_var_recursive(n)
+                    if v is not None and v.persistable:
+                        raise ValueError(
+                            f"PipelineOptimizer: stage {s} op {o.type!r} writes "
+                            f"persistable {n!r}; pipelined stages must be "
+                            f"stateless (use is_test norms or stat-free blocks)")
+
+        # boundary carries: exactly one non-param tensor in and out per stage
+        def carries(seg, prev_outputs):
+            produced = {n for o in seg for n in o.output_arg_names}
+            reads = []
+            for o in seg:
+                for n in o.input_arg_names:
+                    if n in produced or is_param(n) or n in reads:
+                        continue
+                    reads.append(n)
+            ext = [n for n in reads if prev_outputs is None or n in prev_outputs]
+            return ext, produced
+
+        prev_prod = None
+        cins = []
+        for s, seg in enumerate(segs):
+            ext, produced = carries(seg, prev_prod)
+            if len(ext) != 1:
+                raise ValueError(
+                    f"PipelineOptimizer: stage {s} must consume exactly one "
+                    f"boundary tensor, found {ext}")
+            cins.append(ext[0])
+            prev_prod = produced
+        # canonical carry-out: stage1's carry-in IS a stage0 product, and the
+        # canonical block is stage0's ops verbatim — so its name is the carry
+        cout0 = cins[1] if S > 1 else None
+        # final output: the unique last-stage product read by the tail
+        lo_last, hi_last = bounds[-1]
+        tail_ops = ops[hi_last + 1:]
+        last_prod = {n for o in segs[-1] for n in o.output_arg_names}
+        tail_reads = [n for o in tail_ops for n in o.input_arg_names if n in last_prod]
+        final_outs = list(dict.fromkeys(tail_reads))
+        if len(final_outs) != 1:
+            raise ValueError(
+                f"PipelineOptimizer: the tail must read exactly one pipeline "
+                f"output, found {final_outs}")
+        final_out = final_outs[0]
+        if S > 1:
+            # positional analogue in stage0 must be cout0 (same slot chain)
+            pos = None
+            for oi, o in enumerate(segs[-1]):
+                for slot, names in o.outputs.items():
+                    if final_out in names:
+                        pos = (oi, slot, names.index(final_out))
+            canon_final = segs[0][pos[0]].outputs[pos[1]][pos[2]]
+            if canon_final != cout0:
+                raise ValueError(
+                    "PipelineOptimizer: inter-stage carry and final output sit "
+                    "at different positions in the stage body — stages must "
+                    "chain through one tensor")
+        else:
+            pos = None
+            for oi, o in enumerate(segs[0]):
+                for slot, names in o.outputs.items():
+                    if final_out in names:
+                        pos = (oi, slot, names.index(final_out))
+            cout0 = final_out
+
+        # canonical sub-block = stage0's ops
+        sub = program.create_block(parent_idx=0)
+        program.rollback()
+        for o in segs[0]:
+            o.attrs.pop("pipeline_stage", None)
+            o.block = sub
+        sub.ops = list(segs[0])
+
+        flat_params = [n for s in range(S) for n in stage_params[s]]
+        head = ops[:bounds[0][0]]
+        pipe_op_inputs = {"X": [cins[0]], "Params": flat_params}
+        from .core.program import Operator
+
+        pipe = Operator(block, "pipeline", pipe_op_inputs, {"Out": [final_out]},
+                        {"sub_block": sub.idx, "num_stages": S,
+                         "num_microbatches": self._num_microbatches,
+                         "axis_name": self._axis_name,
+                         "canonical_params": list(stage_params[0]),
+                         "carry_in": cins[0], "carry_out": cout0})
+        block.ops = head + [pipe] + tail_ops
+        program._bump()
+
+
 # reference exports both Xxx and XxxOptimizer names
 SGD = SGDOptimizer
 Momentum = MomentumOptimizer

@@ -1,0 +1,100 @@
+"""Mixed-precision decorator: dynamic loss scaling, overflow skip, state
+machine (reference contrib/mixed_precision/decorator.py:26)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib.mixed_precision import decorate
+
+
+def _build(dtype="float16", incr_every=4, init_scale=8.0, lr=0.05):
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        net_in = fluid.layers.cast(x, dtype) if dtype != "float32" else x
+        h = fluid.layers.fc(net_in, 16, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        pred32 = fluid.layers.cast(pred, "float32") if dtype != "float32" else pred
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred32, y))
+        opt = decorate(fluid.optimizer.Momentum(lr, 0.9),
+                       init_loss_scaling=init_scale,
+                       incr_every_n_steps=incr_every,
+                       decr_every_n_nan_or_inf=1)
+        opt.minimize(loss)
+    return main, startup, loss, opt
+
+
+def test_amp_fp16_converges():
+    main, startup, loss, opt = _build("float16")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    w = rng.rand(6, 1).astype("f4")
+    losses = []
+    for _ in range(60):
+        xv = rng.rand(16, 6).astype("f4")
+        yv = xv @ w
+        (lv,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.3, f"{losses[0]} -> {losses[-1]}"
+
+
+def test_amp_scaling_grows_on_finite_steps():
+    main, startup, loss, opt = _build("float32", incr_every=3, init_scale=4.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(1)
+    for _ in range(6):
+        xv = rng.rand(8, 6).astype("f4")
+        yv = rng.rand(8, 1).astype("f4")
+        exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss], scope=scope)
+    # 6 finite steps with incr_every=3 => scale doubled twice: 4 -> 16
+    s = float(np.asarray(scope.find_var("loss_scaling_0"))[0])
+    assert s == 16.0, s
+
+
+def test_amp_overflow_skips_update_and_halves_scale():
+    main, startup, loss, opt = _build("float32", incr_every=100, init_scale=8.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    # params before
+    pnames = [v.name for v in main.all_parameters()]
+    rng = np.random.RandomState(2)
+    xv = rng.rand(8, 6).astype("f4")
+    yv = rng.rand(8, 1).astype("f4")
+    exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss], scope=scope)
+    before = {n: np.asarray(scope.find_var(n)).copy() for n in pnames}
+    # poison one feed -> non-finite loss/grads -> step must be a no-op
+    bad = xv.copy()
+    bad[0, 0] = np.inf
+    exe.run(main, feed={"x": bad, "y": yv}, fetch_list=[loss], scope=scope)
+    after = {n: np.asarray(scope.find_var(n)) for n in pnames}
+    for n in pnames:
+        np.testing.assert_array_equal(before[n], after[n], err_msg=f"param {n} changed on overflow")
+    s = float(np.asarray(scope.find_var("loss_scaling_0"))[0])
+    assert s == 4.0, s  # decr_every_n_nan_or_inf=1 => halved immediately
+    # and a following clean step trains again
+    exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss], scope=scope)
+    moved = any(np.abs(np.asarray(scope.find_var(n)) - after[n]).max() > 0 for n in pnames)
+    assert moved
+
+
+def test_amp_loss_scale_floor():
+    main, startup, loss, opt = _build("float32", incr_every=100, init_scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(3)
+    xv = rng.rand(4, 6).astype("f4")
+    bad = xv.copy()
+    bad[0, 0] = np.nan
+    yv = rng.rand(4, 1).astype("f4")
+    for _ in range(5):
+        exe.run(main, feed={"x": bad, "y": yv}, fetch_list=[loss], scope=scope)
+    s = float(np.asarray(scope.find_var("loss_scaling_0"))[0])
+    assert s == 1.0, s  # floored, never reaches 0

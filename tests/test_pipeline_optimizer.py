@@ -1,0 +1,109 @@
+"""Program-level PipelineOptimizer: device_guard-tagged repeated blocks cut
+into a `pipeline` op; pp-mesh GPipe run matches the unpiped single-device
+program (reference: optimizer.py:2661 PipelineOptimizer)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.parallel import make_mesh
+
+
+def _build(piped: bool, S=4, M=4, d=16, seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [d], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h = fluid.layers.fc(x, d, act="tanh")  # head (untagged)
+        for s in range(S):
+            ctx = fluid.device_guard(s) if piped else fluid.device_guard(None)
+            with ctx:
+                h = fluid.layers.fc(h, d, act="tanh",
+                                    param_attr=fluid.ParamAttr(name=f"stage{s}_w"),
+                                    bias_attr=fluid.ParamAttr(name=f"stage{s}_b"))
+        pred = fluid.layers.fc(h, 1)  # tail
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        inner = fluid.optimizer.SGD(0.1)
+        if piped:
+            fluid.optimizer.PipelineOptimizer(inner, num_microbatches=M).minimize(loss)
+        else:
+            inner.minimize(loss)
+    return main, startup, loss
+
+
+def test_cut_structure():
+    main, _, _ = _build(True)
+    types = [op.type for op in main.global_block().ops]
+    assert "pipeline" in types
+    pipe = next(op for op in main.global_block().ops if op.type == "pipeline")
+    assert pipe.attrs["num_stages"] == 4
+    assert len(pipe.inputs["Params"]) == 8  # 4 stages x (w, b)
+    assert len(main.blocks) >= 2
+    # stage ops moved out of the main block
+    assert types.count("mul") == 2  # head + tail fc only
+
+
+def _train(main, startup, loss, mesh=None, steps=6, seed=0):
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    prog = fluid.CompiledProgram(main).with_mesh(mesh, batch_axis="dp") if mesh is not None else main
+    rng = np.random.RandomState(seed)
+    losses = []
+    for _ in range(steps):
+        xv = rng.rand(16, 16).astype("f4")
+        yv = np.tanh(xv.sum(1, keepdims=True)).astype("f4")
+        (lv,) = exe.run(prog, feed={"x": xv, "y": yv}, fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    return losses
+
+
+def test_pipeline_sequential_matches_unpiped():
+    ref = _train(*_build(False))
+    got = _train(*_build(True))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_pp4_matches_unpiped():
+    ref = _train(*_build(False))
+    mesh = make_mesh((4,), ("pp",))
+    got = _train(*_build(True), mesh=mesh)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_pp2_dp2_trains():
+    mesh = make_mesh((2, 2, 2), ("dp", "pp", "mp"))
+    losses = _train(*_build(True, S=2), mesh=mesh, steps=8)
+    assert losses[-1] < losses[0]
+
+
+def test_cut_rejects_heterogeneous_stages():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        with fluid.device_guard(0):
+            h = fluid.layers.fc(x, 8, act="tanh")
+        with fluid.device_guard(1):
+            h = fluid.layers.fc(h, 8, act="relu")  # different act op
+            h = fluid.layers.fc(h, 8)
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        with pytest.raises(ValueError, match="structurally identical"):
+            fluid.optimizer.PipelineOptimizer(fluid.optimizer.SGD(0.1)).minimize(loss)
+
+
+def test_cut_rejects_stateful_stage():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8, 4, 4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        with fluid.device_guard(0):
+            h = fluid.layers.batch_norm(fluid.layers.conv2d(x, 8, 3, padding=1))
+        with fluid.device_guard(1):
+            h = fluid.layers.batch_norm(fluid.layers.conv2d(h, 8, 3, padding=1))
+        pool = fluid.layers.pool2d(h, global_pooling=True, pool_type="avg")
+        pred = fluid.layers.fc(fluid.layers.reshape(pool, [-1, 8]), 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        with pytest.raises(ValueError, match="persistable"):
+            fluid.optimizer.PipelineOptimizer(fluid.optimizer.SGD(0.1)).minimize(loss)
