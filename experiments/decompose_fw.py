@@ -1,0 +1,56 @@
+"""Decompose the real framework ResNet-50 step (bench.py methodology):
+variants isolate forward, BN batch-stats, and the optimizer."""
+import sys
+import time
+
+import numpy as np
+
+
+def run_variant(name, with_optimizer, is_test, batch_size=128, K=8, iters=3):
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+
+    main, startup, feeds, fetches = resnet.build(
+        dtype="bfloat16", class_dim=1000, learning_rate=0.1,
+        with_optimizer=with_optimizer, is_test=is_test,
+    )
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    img = rng.rand(K, batch_size, 3, 224, 224).astype("float32")
+    label = rng.randint(0, 1000, size=(K, batch_size, 1)).astype(np.int32)
+    dev = fluid.TPUPlace(0).jax_device()
+    feed = {"img": jax.device_put(jnp.asarray(img), dev),
+            "label": jax.device_put(jnp.asarray(label), dev)}
+    loss_name = fetches["loss"].name
+
+    def dispatch():
+        return exe.run(main, feed=feed, fetch_list=[loss_name], scope=scope,
+                       steps=K, return_numpy=False)
+
+    out = dispatch(); np.asarray(out[0])
+    out = dispatch(); np.asarray(out[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = dispatch()
+    np.asarray(out[0])
+    dt = (time.perf_counter() - t0) / (iters * K)
+    print(f"{name:28s}: {dt*1e3:6.1f} ms  {batch_size/dt:7.0f} imgs/s",
+          file=sys.stderr, flush=True)
+    return dt
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "train"):
+        run_variant("train_bnTrain", True, False)
+    if which in ("all", "train_frozen"):
+        run_variant("train_bnFrozen", True, True)
+    if which in ("all", "fwd"):
+        run_variant("fwd_only", False, True)
+    if which in ("all", "fwd_bntrain"):
+        run_variant("fwd_only_bnTrain", False, False)

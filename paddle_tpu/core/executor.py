@@ -39,7 +39,9 @@ class TPUPlace(Place):
         return f"TPUPlace({self.device_id})"
 
     def jax_device(self):
-        devs = [d for d in jax.devices() if d.platform != "cpu"] or jax.devices()
+        # local_devices: under multi-process, jax.devices() lists the global
+        # topology but only local ones can receive single-device work
+        devs = [d for d in jax.local_devices() if d.platform != "cpu"] or jax.local_devices()
         return devs[self.device_id % len(devs)]
 
 
@@ -52,9 +54,9 @@ class CPUPlace(Place):
 
     def jax_device(self):
         try:
-            return jax.devices("cpu")[0]
+            return jax.local_devices(backend="cpu")[0]
         except RuntimeError:
-            return jax.devices()[0]
+            return jax.local_devices()[0]
 
 
 # CUDAPlace alias keeps reference-era scripts importable; it is a TPU device.
@@ -83,6 +85,9 @@ class _CompiledStep:
         self.mesh = mesh
         self.batch_axis = batch_axis
         self.n_steps = n_steps
+        self.multiprocess = mesh is not None and any(
+            d.process_index != jax.process_index() for d in mesh.devices.flat
+        )
         feed_shapes = feed_shapes or {}
         block = program.global_block()
         ops = _runnable_ops(block)
@@ -169,12 +174,22 @@ class _CompiledStep:
 
             repl = NamedSharding(mesh, P())
             n_dp = dict(mesh.shape).get(batch_axis, 0)  # 0: no data axis (e.g. pure pp mesh)
+            # multiprocess: feed arrays are PROCESS-LOCAL slices, so the
+            # divisibility check runs against this process's share of dp
+            n_dp_local = max(n_dp // jax.process_count(), 1) if self.multiprocess else n_dp
 
             def feed_spec(n):
                 shape = feed_shapes.get(n, ())
                 bdim = 1 if n_steps > 1 else 0  # steps>1: axis 0 is the scan axis
-                if n_dp and len(shape) > bdim and shape[bdim] % n_dp == 0:
+                if n_dp and len(shape) > bdim and shape[bdim] % n_dp_local == 0:
                     return NamedSharding(mesh, P(*([None] * bdim + [batch_axis])))
+                if self.multiprocess and len(shape) > bdim and shape[bdim] > 1:
+                    # replicating per-process data that differs across
+                    # processes silently breaks sync-SGD; refuse instead
+                    raise ValueError(
+                        f"multiprocess feed {n!r}: local batch {shape[bdim]} is "
+                        f"not divisible by this process's dp share "
+                        f"({n_dp_local}); pad the local batch or adjust the mesh")
                 return repl  # scalars / indivisible feeds replicate
 
             rw_specs = {n: state_spec(n) for n in self.rw_names}
@@ -232,6 +247,16 @@ class _CompiledStep:
         kept.reverse()
         return kept
 
+    def _place(self, v, spec):
+        """Host/local array -> mesh placement.  Multi-process meshes can't
+        jax.device_put a local array onto non-addressable devices; each
+        process instead materializes its own shards from the (replicated)
+        host value via make_array_from_callback."""
+        if self.multiprocess:
+            host = np.asarray(v)
+            return jax.make_array_from_callback(host.shape, spec, lambda idx: host[idx])
+        return jax.device_put(v, spec)
+
     def __call__(self, scope: Scope, feeds: Dict[str, jnp.ndarray], key):
         if self.mesh is not None:
             # Reshard state committed elsewhere (e.g. by a single-device
@@ -239,9 +264,9 @@ class _CompiledStep:
             for n, spec in self.state_specs.items():
                 v = scope.find_var(n)
                 if getattr(v, "sharding", None) != spec:
-                    scope.set_var(n, jax.device_put(v, spec))
+                    scope.set_var(n, self._place(v, spec))
             if getattr(key, "sharding", None) != self.key_spec:
-                key = jax.device_put(key, self.key_spec)
+                key = self._place(key, self.key_spec)
         state_rw = {n: scope.find_var(n) for n in self.rw_names}
         state_ro = {n: scope.find_var(n) for n in self.ro_names}
         fetches, new_state, new_key = self.jfn(state_rw, state_ro, feeds, key)
@@ -397,6 +422,16 @@ class Executor:
                 v = scope.find_var(n)
                 if not isinstance(v, jax.Array):
                     scope.set_var(n, jax.device_put(jnp.asarray(v), device))
+        elif compiled.multiprocess:
+            # Cross-process mesh: every process contributes its LOCAL slice
+            # of batch-sharded feeds (reference: per-trainer data shards in
+            # NCCL2 mode); replicated feeds pass the full array everywhere.
+            jfeeds = {
+                n: v if isinstance(v, jax.Array)
+                else jax.make_array_from_process_local_data(
+                    compiled.feed_specs[n], np.asarray(v))
+                for n, v in jfeeds.items()
+            }
         else:
             # SPMD: shard feeds up front; jit's in_shardings places state.
             jfeeds = {

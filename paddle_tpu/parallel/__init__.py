@@ -9,4 +9,6 @@ reference emitted as c_allreduce ops.  `ring_id` -> named mesh axis.
 """
 from .compiled_program import CompiledProgram, ExecutionStrategy, BuildStrategy  # noqa: F401
 from .mesh import make_mesh  # noqa: F401
+from . import distributed  # noqa: F401
+from .distributed import init_distributed  # noqa: F401
 from .sharding import shard_parameters  # noqa: F401

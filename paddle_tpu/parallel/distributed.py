@@ -1,0 +1,104 @@
+"""Multi-process / multi-host bootstrap.
+
+Reference: the NCCL2 transpile mode — `gen_nccl_id_op.cc:31` RPC-broadcasts
+an ncclUniqueId keyed by trainer_id/endpoints set via
+PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS / PADDLE_CURRENT_ENDPOINT
+(`distribute_transpiler.py:261`).
+
+TPU-first: the bootstrap maps to JAX's coordination service
+(`jax.distributed.initialize`) — endpoint 0 is the coordinator, the rest
+dial in — after which `jax.devices()` is the GLOBAL device list and every
+in-program collective (GSPMD or shard_map) spans processes over ICI/DCN
+exactly where the reference spanned nodes with NCCL rings."""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+
+def trainer_env():
+    """Read the reference's trainer env-var contract."""
+    tid = os.environ.get("PADDLE_TRAINER_ID")
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS")
+    cur = os.environ.get("PADDLE_CURRENT_ENDPOINT")
+    return (
+        int(tid) if tid is not None else None,
+        eps.split(",") if eps else None,
+        cur,
+    )
+
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init_distributed(trainer_id: Optional[int] = None,
+                     trainer_endpoints: Optional[Sequence[str]] = None,
+                     current_endpoint: Optional[str] = None):
+    """Bring up the cross-process runtime.  Arguments default to the
+    PADDLE_* env vars (same contract the transpiler's NCCL2 mode used).
+    Endpoint 0's host:port doubles as the coordinator address (the
+    gen_nccl_id role)."""
+    global _initialized
+    import jax
+
+    if _initialized:
+        return  # idempotent: the runtime is already bootstrapped
+
+    env_tid, env_eps, env_cur = trainer_env()
+    trainer_id = trainer_id if trainer_id is not None else env_tid
+    trainer_endpoints = list(trainer_endpoints or env_eps or [])
+    current_endpoint = current_endpoint or env_cur
+    if trainer_id is None or not trainer_endpoints:
+        raise ValueError(
+            "init_distributed: need trainer_id + trainer_endpoints (args or "
+            "PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS)")
+    if current_endpoint and current_endpoint != trainer_endpoints[trainer_id]:
+        raise ValueError(
+            f"init_distributed: current_endpoint {current_endpoint!r} does not "
+            f"match trainer_endpoints[{trainer_id}] = "
+            f"{trainer_endpoints[trainer_id]!r}")
+    if len(trainer_endpoints) == 1:
+        _initialized = True
+        return  # single process: nothing to bootstrap
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        # cross-process collectives on the CPU backend need gloo
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
+    jax.distributed.initialize(
+        coordinator_address=trainer_endpoints[0],
+        num_processes=len(trainer_endpoints),
+        process_id=trainer_id,
+    )
+    _initialized = True
+
+
+def global_mesh(axes=None):
+    """Mesh over the GLOBAL device list (all processes).  axes defaults to
+    one data-parallel axis spanning everything."""
+    import jax
+    from .mesh import make_mesh
+
+    devs = jax.devices()
+    if axes is None:
+        return make_mesh((len(devs),), ("dp",), devices=devs)
+    shape = tuple(n for n, _ in axes)
+    names = tuple(a for _, a in axes)
+    return make_mesh(shape, names, devices=devs)
+
+
+def trainer_id() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def num_trainers() -> int:
+    import jax
+
+    return jax.process_count()

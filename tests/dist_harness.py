@@ -1,0 +1,77 @@
+"""Shared multiprocess-on-localhost harness (reference: test_dist_base.py
+_run_cluster) used by tests/test_dist_multiprocess.py and
+__graft_entry__.dryrun_multiprocess — one copy of the port allocation,
+PADDLE_* env contract, axon-shim scrubbing, and LOSSES parsing."""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+WORKER = os.path.join(HERE, "dist_worker.py")
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def worker_env(extra=None, devices_per_proc=2):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    # the axon tunnel shim (.axon_site) monkeypatches jax.distributed for
+    # its loopback relay; workers must run with a clean PYTHONPATH
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devices_per_proc}"
+    env.update(extra or {})
+    return env
+
+
+def spawn_workers(n_procs: int, devices_per_proc: int = 2, extra_env=None):
+    """Start n_procs dist_worker.py processes wired through one coordinator."""
+    port = free_port()
+    eps = ",".join(f"127.0.0.1:{port + i}" for i in range(n_procs))
+    procs = []
+    for tid in range(n_procs):
+        env = worker_env(extra_env, devices_per_proc)
+        env["PADDLE_TRAINER_ID"] = str(tid)
+        env["PADDLE_TRAINER_ENDPOINTS"] = eps
+        env["PADDLE_CURRENT_ENDPOINT"] = eps.split(",")[tid]
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env, text=True))
+    return procs
+
+
+def parse_losses(out: str, err: str, tag: str) -> dict:
+    for line in out.splitlines():
+        if line.startswith("LOSSES "):
+            return json.loads(line[len("LOSSES "):])
+    raise AssertionError(
+        f"{tag}: worker produced no LOSSES line.\nstdout:\n{out}\nstderr:\n{err[-3000:]}")
+
+
+def collect(procs, timeout=600):
+    """communicate() every worker; on any failure kill the stragglers so no
+    orphan sits blocked in jax.distributed.initialize."""
+    results = []
+    try:
+        for tid, p in enumerate(procs):
+            out, err = p.communicate(timeout=timeout)
+            if p.returncode != 0:
+                raise RuntimeError(f"worker {tid} failed:\n{err[-4000:]}")
+            results.append(parse_losses(out, err, f"worker{tid}"))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    return results

@@ -1,0 +1,72 @@
+"""Worker process for test_dist_multiprocess (reference:
+test_dist_base.py:47 TestDistRunnerBase — trains RUN_STEP steps and
+pickles per-step losses for the parent to compare)."""
+import json
+import os
+import sys
+
+# must be set before jax import
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=2").strip()
+
+import numpy as np  # noqa: E402
+
+
+def build_model():
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 90
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [32], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="int64")
+        h = fluid.layers.fc(x, 64, act="relu")
+        logits = fluid.layers.fc(h, 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import distributed as dist
+
+    run_local = os.environ.get("RUN_LOCAL") == "1"
+    if not run_local:
+        dist.init_distributed()  # PADDLE_TRAINER_* env contract
+        tid = dist.trainer_id()
+        nproc = dist.num_trainers()
+    else:
+        tid, nproc = 0, 1
+
+    mesh = dist.global_mesh()
+    n_dev = len(jax.devices())
+
+    prog, startup, loss = build_model()
+    compiled = fluid.CompiledProgram(prog).with_mesh(mesh)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+
+    rng = np.random.RandomState(1234)  # same global stream in every worker
+    per = 32 // nproc
+    losses = []
+    for step in range(5):
+        xg = rng.rand(32, 32).astype("f4")
+        yg = rng.randint(0, 10, size=(32, 1)).astype("int64")
+        xl = xg[tid * per:(tid + 1) * per]
+        yl = yg[tid * per:(tid + 1) * per]
+        (lv,) = exe.run(compiled, feed={"x": xl, "y": yl},
+                        fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    print("LOSSES " + json.dumps({"trainer": tid, "n_dev": n_dev,
+                                  "losses": losses}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Multi-process distributed training on localhost (reference:
+test_dist_base.py:366 check_with_place — fork trainer subprocesses, compare
+per-step losses against the single-process run)."""
+import subprocess
+import sys
+
+import numpy as np
+
+from dist_harness import REPO, WORKER, collect, parse_losses, spawn_workers, worker_env
+
+
+def test_two_process_loss_parity_with_single_process():
+    """2 procs x 2 virtual devices == 1 proc x 4 virtual devices, same data
+    stream => identical per-step losses (sync-SGD parity, the
+    test_dist_base contract)."""
+    outs = collect(spawn_workers(2, devices_per_proc=2))
+
+    # both workers must observe the same (global) losses and 4 global devices
+    assert outs[0]["n_dev"] == 4 and outs[1]["n_dev"] == 4
+    np.testing.assert_allclose(outs[0]["losses"], outs[1]["losses"], rtol=1e-6)
+
+    # single-process reference on the same 4-device topology
+    env = worker_env({"RUN_LOCAL": "1"}, devices_per_proc=4)
+    local = subprocess.Popen([sys.executable, WORKER], stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE, env=env, text=True)
+    out, err = local.communicate(timeout=600)
+    assert local.returncode == 0, f"local run failed:\n{err[-4000:]}"
+    ref = parse_losses(out, err, "local")
+    assert ref["n_dev"] == 4
+    np.testing.assert_allclose(outs[0]["losses"], ref["losses"], rtol=2e-5, atol=1e-6)

@@ -795,3 +795,25 @@ def test_lamb_golden():
              "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2},
             {"beta1": b1, "beta2": b2, "epsilon": eps, "weight_decay": wd},
             atol=1e-4, rtol=1e-4)
+
+
+def test_batch_norm_extreme_mean_stability():
+    """Single-sweep BN stats must not cancel catastrophically: activations
+    with |mean|/std ~ 3e4 (the classic E[x^2]-E[x]^2 failure mode) must
+    still produce accurate SavedVariance."""
+    x = (300.0 + 0.01 * RNG.randn(8, 3, 4, 4)).astype("float32")
+    scale = np.ones(3, "float32")
+    bias = np.zeros(3, "float32")
+    mean_in = np.zeros(3, "float32")
+    var_in = np.ones(3, "float32")
+    m = x.mean(axis=(0, 2, 3))
+    v = x.var(axis=(0, 2, 3))
+    eps = 1e-5
+    y = (x - m.reshape(1, 3, 1, 1)) / np.sqrt(v.reshape(1, 3, 1, 1) + eps)
+    _golden("batch_norm",
+            {"X": x, "Scale": scale, "Bias": bias, "Mean": mean_in, "Variance": var_in},
+            {"Y": y, "MeanOut": 0.9 * mean_in + 0.1 * m, "VarianceOut": 0.9 * var_in + 0.1 * v,
+             "SavedMean": m, "SavedVariance": v},
+            {"epsilon": eps, "momentum": 0.9, "is_test": False, "data_layout": "NCHW",
+             "use_global_stats": False},
+            atol=6e-3, rtol=5e-2)
