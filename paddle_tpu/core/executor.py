@@ -174,16 +174,28 @@ class _CompiledStep:
 
             repl = NamedSharding(mesh, P())
             n_dp = dict(mesh.shape).get(batch_axis, 0)  # 0: no data axis (e.g. pure pp mesh)
-            # multiprocess: feed arrays are PROCESS-LOCAL slices, so the
-            # divisibility check runs against this process's share of dp
-            n_dp_local = max(n_dp // jax.process_count(), 1) if self.multiprocess else n_dp
+            # Does the batch axis cross process boundaries?  Only then are
+            # feeds process-local slices; otherwise (tp-only global mesh,
+            # single process) every process passes identical full arrays.
+            dp_spans = False
+            dp_procs = 1
+            if self.multiprocess and n_dp:
+                ax = list(mesh.axis_names).index(batch_axis)
+                line = np.moveaxis(mesh.devices, ax, 0).reshape(n_dp, -1)[:, 0]
+                procs = {d.process_index for d in line}
+                dp_spans = len(procs) > 1
+                dp_procs = max(len(procs), 1)
+            n_dp_local = max(n_dp // dp_procs, 1) if dp_spans else n_dp
 
             def feed_spec(n):
+                # CONTRACT (cross-process dp): every feed with a batch dim is
+                # this process's slice of the global batch; replicated
+                # non-scalar data must be passed as a pre-placed jax.Array.
                 shape = feed_shapes.get(n, ())
                 bdim = 1 if n_steps > 1 else 0  # steps>1: axis 0 is the scan axis
                 if n_dp and len(shape) > bdim and shape[bdim] % n_dp_local == 0:
                     return NamedSharding(mesh, P(*([None] * bdim + [batch_axis])))
-                if self.multiprocess and len(shape) > bdim and shape[bdim] > 1:
+                if dp_spans and len(shape) > bdim and shape[bdim] > 1:
                     # replicating per-process data that differs across
                     # processes silently breaks sync-SGD; refuse instead
                     raise ValueError(

@@ -28,6 +28,8 @@ from .metric import accuracy  # noqa: F401
 from .nn import *  # noqa: F401,F403
 from .sequence import (  # noqa: F401
     DynamicRNN,
+    dynamic_gru,
+    dynamic_lstm,
     attention_bias,
     position_encoding,
     sequence_concat,

@@ -436,3 +436,66 @@ class _DRNNGuard:
         self.main.rollback()
         self.drnn._finalize(self.parent_block, sub_idx)
         return False
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None, bias_attr=None,
+                 use_peepholes=True, is_reverse=False, gate_activation="sigmoid",
+                 cell_activation="tanh", candidate_activation="tanh",
+                 dtype="float32", name=None):
+    """Reference layers/nn.py:420 — `input` is the ragged pre-projected
+    sequence [*, 4D]; returns (hidden, cell), both ragged [*, D].  Weight is
+    the (D, 4D) hidden-hidden matrix {W_ch, W_ih, W_fh, W_oh}; bias is
+    (1, 4D) or with peepholes (1, 7D) = {b, W_ic, W_fc, W_oc}."""
+    if gate_activation != "sigmoid" or cell_activation != "tanh" or \
+            candidate_activation != "tanh":
+        raise NotImplementedError("dynamic_lstm: only the default activations")
+    helper = LayerHelper("dynamic_lstm", name=name)
+    hidden = size // 4
+    lod = _lod_of(input)
+    weight = helper.create_parameter(param_attr, [hidden, 4 * hidden], dtype)
+    bias_size = [1, 7 * hidden] if use_peepholes else [1, 4 * hidden]
+    bias = helper.create_parameter(bias_attr, bias_size, dtype, is_bias=True)
+    shape = None
+    if input.shape is not None:
+        shape = (input.shape[0], input.shape[1], hidden)
+    hidden_out = helper.create_variable_for_type_inference(dtype, shape=shape)
+    cell_out = helper.create_variable_for_type_inference(dtype, shape=shape)
+    inputs = {"Input": [input.name], "XLod": [lod.name], "Weight": [weight.name],
+              "Bias": [bias.name]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0.name]
+    if c_0 is not None:
+        inputs["C0"] = [c_0.name]
+    helper.append_op(
+        "dynamic_lstm", inputs=inputs,
+        outputs={"Hidden": [hidden_out.name], "Cell": [cell_out.name]},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse},
+    )
+    return _set_lod(hidden_out, lod), _set_lod(cell_out, lod)
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None, is_reverse=False,
+                gate_activation="sigmoid", candidate_activation="tanh",
+                h_0=None, origin_mode=False, name=None):
+    """Reference layers/nn.py dynamic_gru — `input` is ragged [*, 3D];
+    returns ragged hidden [*, D]."""
+    if gate_activation != "sigmoid" or candidate_activation != "tanh":
+        raise NotImplementedError("dynamic_gru: only the default activations")
+    helper = LayerHelper("dynamic_gru", name=name)
+    lod = _lod_of(input)
+    dtype = input.dtype
+    weight = helper.create_parameter(param_attr, [size, 3 * size], dtype)
+    bias = helper.create_parameter(bias_attr, [1, 3 * size], dtype, is_bias=True)
+    shape = None
+    if input.shape is not None:
+        shape = (input.shape[0], input.shape[1], size)
+    out = helper.create_variable_for_type_inference(dtype, shape=shape)
+    inputs = {"Input": [input.name], "XLod": [lod.name], "Weight": [weight.name],
+              "Bias": [bias.name]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0.name]
+    helper.append_op(
+        "dynamic_gru", inputs=inputs, outputs={"Hidden": [out.name]},
+        attrs={"is_reverse": is_reverse, "origin_mode": origin_mode},
+    )
+    return _set_lod(out, lod)

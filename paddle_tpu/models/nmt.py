@@ -138,3 +138,77 @@ def make_fake_nmt_batch(lengths_src, lengths_tgt, src_vocab, tgt_vocab, seed=0):
     tgt = [rng.randint(1, tgt_vocab, (l, 1)).astype("int64") for l in lengths_tgt]
     lbl = [rng.randint(1, tgt_vocab, (l, 1)).astype("int64") for l in lengths_tgt]
     return {"src_word": LoDTensor(src), "trg_word": LoDTensor(tgt), "lbl_word": LoDTensor(lbl)}
+
+
+def build_nmt_infer(**kw):
+    """Inference-mode NMT program (no optimizer, no dropout, no label loss);
+    fetches logits [b, Tt, V].  Used by beam_search_decode."""
+    kw.update(with_optimizer=False, is_test=True, dropout=0.0, label_smooth_eps=0.0)
+    return build_transformer_nmt(**kw)
+
+
+def beam_search_decode(exe, infer_program, logits_var, scope, src_rows,
+                       bos=1, eos=2, beam_size=4, max_len=12, length_penalty=0.0):
+    """Static-shape beam search (reference capability:
+    operators/math/beam_search.cu + layers/nn.py beam_search, which walked a
+    LoDTensorArray; here every device step is the SAME padded-shape decoder
+    program — one compile, max_len dispatches — and the beam bookkeeping is
+    trivial host math).
+
+    src_rows: list of np [Ls,1] int64 source sentences (one per batch row).
+    Returns (sequences [b, max_len] int64, scores [b]) — best beam per row.
+    beam_size=1 is exact greedy decode.
+    """
+    import numpy as np
+
+    from ..lod import LoDTensor
+
+    b = len(src_rows)
+    k = beam_size
+    # source repeats per beam: row-major [b*k]
+    src_beam = [src_rows[i // k] for i in range(b * k)]
+
+    seqs = np.full((b, k, max_len), eos, dtype="int64")
+    seqs[:, :, 0] = bos
+    scores = np.full((b, k), -1e9, dtype="float64")
+    scores[:, 0] = 0.0  # only beam 0 alive at t=0 (all beams identical)
+    finished = np.zeros((b, k), dtype=bool)
+
+    for t in range(1, max_len):
+        prefix = seqs.reshape(b * k, max_len)[:, :t]  # [bk, t]
+        trg = LoDTensor([row.reshape(-1, 1) for row in prefix])
+        lbl = trg  # unused by the pruned fetch, but the program declares it
+        feed = {"src_word": LoDTensor(src_beam), "trg_word": trg, "lbl_word": lbl}
+        (logits,) = exe.run(infer_program, feed=feed, fetch_list=[logits_var],
+                            scope=scope)
+        logits = np.asarray(logits)  # [bk, T>=t, V]
+        step_logits = logits[:, t - 1, :].reshape(b, k, -1)
+        m = step_logits.max(-1, keepdims=True)  # stable log softmax
+        logp = step_logits - m - np.log(np.exp(step_logits - m).sum(-1, keepdims=True))
+        V = logp.shape[-1]
+        # finished beams only extend with EOS at no cost
+        logp_f = np.full_like(logp, -1e9)
+        logp_f[:, :, eos] = 0.0
+        logp = np.where(finished[:, :, None], logp_f, logp)
+        cand = scores[:, :, None] + logp  # [b, k, V]
+        flat = cand.reshape(b, k * V)
+        top = np.argsort(-flat, axis=1)[:, :k]  # [b, k]
+        new_scores = np.take_along_axis(flat, top, axis=1)
+        parent = top // V
+        token = top % V
+        new_seqs = np.empty_like(seqs)
+        for i in range(b):
+            new_seqs[i] = seqs[i, parent[i]]
+            new_seqs[i, :, t] = token[i]
+        seqs = new_seqs
+        finished = np.take_along_axis(finished, parent, axis=1) | (token == eos)
+        scores = new_scores
+        if finished.all():
+            break
+
+    if length_penalty:
+        lengths = (seqs != eos).sum(-1)
+        scores = scores / (lengths ** length_penalty)
+    best = np.argmax(scores, axis=1)
+    return (np.stack([seqs[i, best[i]] for i in range(b)]),
+            np.asarray([scores[i, best[i]] for i in range(b)]))

@@ -169,7 +169,18 @@ def _array_read(ctx, op, ins):
     i = first(ins, "I")
     idx = _static_index(i)
     if idx is None:
-        raise NotImplementedError("array_read with traced index: use stacked buffers")
+        # traced index (beam-search-style decode loops): homogeneous entries
+        # stack into one buffer and a dynamic slice picks the row — the
+        # static-shape answer to the reference's LoDTensorArray indexing
+        shapes = {tuple(a.shape) for a in arr}
+        dtypes = {a.dtype for a in arr}
+        if len(shapes) != 1 or len(dtypes) != 1:
+            raise NotImplementedError(
+                f"array_read with traced index needs homogeneous entries, "
+                f"got shapes {shapes} dtypes {dtypes}")
+        stacked = jnp.stack(list(arr))
+        ii = jnp.asarray(i).reshape(()).astype(jnp.int32)
+        return {"Out": jax.lax.dynamic_index_in_dim(stacked, ii, 0, keepdims=False)}
     return {"Out": arr[idx]}
 
 

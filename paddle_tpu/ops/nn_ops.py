@@ -214,9 +214,7 @@ def _batch_norm(ctx, op, ins):
             slice(None) if i in (0, ch_axis) else slice(0, 1) for i in range(x.ndim)
         )
         c = jnp.mean(x[pilot_idx], axis=tuple(i for i in range(x.ndim) if i != ch_axis))
-        cshape = [1] * x.ndim
-        cshape[ch_axis] = x.shape[ch_axis]
-        xc = x - c.reshape(cshape)
+        xc = x - c.reshape(bshape)
         d = jnp.mean(xc, axis=axes)
         m2 = jnp.mean(jnp.square(xc), axis=axes)
         mean = c + d

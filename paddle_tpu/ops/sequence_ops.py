@@ -369,3 +369,113 @@ def _dynamic_rnn(ctx, op, ins):
         ys = [jnp.flip(y, axis=0) for y in ys]
     outs = [jnp.moveaxis(y, 0, 1) for y in ys]  # [b, T, *f]
     return {"Out": outs, "FinalMem": final_mems}
+
+
+@register_op("dynamic_lstm")
+def _dynamic_lstm(ctx, op, ins):
+    """Fused LSTM over the padded time axis (reference lstm_op.cc +
+    layers/nn.py:420 dynamic_lstm).  Gate blocks ordered {c, i, f, o} in
+    both the projected input and the hidden-hidden weight (the reference's
+    W_{ch},W_{ih},W_{fh},W_{oh} layout); peephole weights live in the bias
+    tail {W_ic, W_fc, W_oc}.  One lax.scan -> one XLA While; memories
+    freeze and outputs zero once t >= length."""
+    x = first(ins, "Input")          # [b, T, 4D] padded
+    lens = first(ins, "XLod")        # [b]
+    w = first(ins, "Weight")         # [D, 4D]
+    bias = first(ins, "Bias")        # [1, 4D] or [1, 7D]
+    h0 = first(ins, "H0")
+    c0 = first(ins, "C0")
+    use_peepholes = op.attr("use_peepholes", True)
+    is_reverse = op.attr("is_reverse", False)
+    D = w.shape[0]
+    b_, T = x.shape[0], x.shape[1]
+    bias = bias.reshape(-1)
+    gate_bias = bias[: 4 * D]
+    w_ic = bias[4 * D: 5 * D] if use_peepholes else None
+    w_fc = bias[5 * D: 6 * D] if use_peepholes else None
+    w_oc = bias[6 * D: 7 * D] if use_peepholes else None
+
+    h_init = h0 if h0 is not None else jnp.zeros((b_, D), x.dtype)
+    c_init = c0 if c0 is not None else jnp.zeros((b_, D), x.dtype)
+
+    xs = jnp.moveaxis(x, 1, 0)  # [T, b, 4D]
+    tvec = jnp.arange(T)
+    if is_reverse:
+        xs = jnp.flip(xs, axis=0)
+        tvec = jnp.flip(tvec)
+
+    def step(carry, scanned):
+        h_prev, c_prev = carry
+        t, xt = scanned
+        gates = xt + h_prev @ w + gate_bias  # [b, 4D]
+        gc = gates[:, 0 * D:1 * D]
+        gi = gates[:, 1 * D:2 * D]
+        gf = gates[:, 2 * D:3 * D]
+        go = gates[:, 3 * D:4 * D]
+        if use_peepholes:
+            gi = gi + w_ic * c_prev
+            gf = gf + w_fc * c_prev
+        i = jax.nn.sigmoid(gi)
+        f = jax.nn.sigmoid(gf)
+        cand = jnp.tanh(gc)
+        c = f * c_prev + i * cand
+        if use_peepholes:
+            go = go + w_oc * c
+        o = jax.nn.sigmoid(go)
+        h = o * jnp.tanh(c)
+        active = (t < lens).reshape(b_, 1)
+        h = jnp.where(active, h, h_prev)
+        c = jnp.where(active, c, c_prev)
+        return (h, c), (jnp.where(active, h, 0.0), jnp.where(active, c, 0.0))
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h_init, c_init), (tvec, xs))
+    if is_reverse:
+        hs = jnp.flip(hs, axis=0)
+        cs = jnp.flip(cs, axis=0)
+    return {"Hidden": jnp.moveaxis(hs, 0, 1), "Cell": jnp.moveaxis(cs, 0, 1)}
+
+
+@register_op("dynamic_gru")
+def _dynamic_gru(ctx, op, ins):
+    """Fused GRU (reference gru_op.cc + layers/nn.py dynamic_gru): gate
+    blocks {u, r} in weight[:, :2D], candidate in weight[:, 2D:];
+    h_t = (1-u)h_prev + u*cand (origin_mode flips the convex combination)."""
+    x = first(ins, "Input")          # [b, T, 3D]
+    lens = first(ins, "XLod")
+    w = first(ins, "Weight")         # [D, 3D]
+    bias = first(ins, "Bias")        # [1, 3D]
+    h0 = first(ins, "H0")
+    is_reverse = op.attr("is_reverse", False)
+    origin_mode = op.attr("origin_mode", False)
+    D = w.shape[0]
+    b_, T = x.shape[0], x.shape[1]
+    bias = bias.reshape(-1)
+    w_ur = w[:, : 2 * D]
+    w_c = w[:, 2 * D:]
+
+    h_init = h0 if h0 is not None else jnp.zeros((b_, D), x.dtype)
+    xs = jnp.moveaxis(x, 1, 0)
+    tvec = jnp.arange(T)
+    if is_reverse:
+        xs = jnp.flip(xs, axis=0)
+        tvec = jnp.flip(tvec)
+
+    def step(carry, scanned):
+        h_prev = carry
+        t, xt = scanned
+        ur = jax.nn.sigmoid(xt[:, : 2 * D] + h_prev @ w_ur + bias[: 2 * D])
+        u = ur[:, :D]
+        r = ur[:, D:]
+        cand = jnp.tanh(xt[:, 2 * D:] + (r * h_prev) @ w_c + bias[2 * D:])
+        if origin_mode:
+            h = u * h_prev + (1.0 - u) * cand
+        else:
+            h = (1.0 - u) * h_prev + u * cand
+        active = (t < lens).reshape(b_, 1)
+        h = jnp.where(active, h, h_prev)
+        return h, jnp.where(active, h, 0.0)
+
+    _, hs = jax.lax.scan(step, h_init, (tvec, xs))
+    if is_reverse:
+        hs = jnp.flip(hs, axis=0)
+    return {"Hidden": jnp.moveaxis(hs, 0, 1)}

@@ -1,0 +1,160 @@
+"""dynamic_lstm / dynamic_gru fused recurrent layers + beam-search decode
+(reference: layers/nn.py:420 dynamic_lstm, dynamic_gru; math/beam_search.cu)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import LoDTensor
+
+
+def _np_lstm(x_rows, w, bias, use_peepholes, D):
+    """Row-by-row numpy LSTM matching the {c,i,f,o} fluid layout."""
+    bias = bias.reshape(-1)
+    gb = bias[:4 * D]
+    w_ic = bias[4 * D:5 * D] if use_peepholes else 0
+    w_fc = bias[5 * D:6 * D] if use_peepholes else 0
+    w_oc = bias[6 * D:7 * D] if use_peepholes else 0
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    outs = []
+    for row in x_rows:
+        h = np.zeros(D)
+        c = np.zeros(D)
+        hs = []
+        for xt in row:
+            g = xt + h @ w + gb
+            gc, gi, gf, go = g[:D], g[D:2 * D], g[2 * D:3 * D], g[3 * D:]
+            i = sig(gi + w_ic * c)
+            f = sig(gf + w_fc * c)
+            cand = np.tanh(gc)
+            c = f * c + i * cand
+            o = sig(go + w_oc * c)
+            h = o * np.tanh(c)
+            hs.append(h.copy())
+        outs.append(np.stack(hs))
+    return outs
+
+
+@pytest.mark.parametrize("use_peepholes", [False, True])
+def test_dynamic_lstm_golden(use_peepholes):
+    D = 5
+    rng = np.random.RandomState(0)
+    lengths = [4, 2, 6]
+    rows = [rng.randn(l, 4 * D).astype("f4") * 0.3 for l in lengths]
+
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4 * D], dtype="float32", lod_level=1)
+        hidden, cell = fluid.layers.dynamic_lstm(
+            x, size=4 * D, use_peepholes=use_peepholes,
+            param_attr=fluid.ParamAttr(name=f"lstm_w_{use_peepholes}"),
+            bias_attr=fluid.ParamAttr(name=f"lstm_b_{use_peepholes}"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    w = np.asarray(scope.find_var(f"lstm_w_{use_peepholes}"))
+    b = np.asarray(scope.find_var(f"lstm_b_{use_peepholes}"))
+    (hv,) = exe.run(main, feed={"x": LoDTensor(rows)}, fetch_list=[hidden], scope=scope)
+    hv = np.asarray(hv)  # [b, T, D] padded
+    ref = _np_lstm(rows, w, b, use_peepholes, D)
+    for i, l in enumerate(lengths):
+        np.testing.assert_allclose(hv[i, :l], ref[i], atol=1e-5, rtol=1e-4)
+        np.testing.assert_allclose(hv[i, l:], 0.0, atol=1e-7)  # masked tail
+
+
+def test_dynamic_lstm_reverse_runs():
+    D = 3
+    rng = np.random.RandomState(1)
+    rows = [rng.randn(l, 4 * D).astype("f4") * 0.3 for l in (3, 5)]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4 * D], dtype="float32", lod_level=1)
+        hidden, _ = fluid.layers.dynamic_lstm(x, size=4 * D, is_reverse=True,
+                                              use_peepholes=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    (hv,) = exe.run(main, feed={"x": LoDTensor(rows)}, fetch_list=[hidden], scope=scope)
+    assert np.isfinite(np.asarray(hv)).all()
+
+
+def test_dynamic_gru_golden():
+    D = 4
+    rng = np.random.RandomState(2)
+    lengths = [3, 5]
+    rows = [rng.randn(l, 3 * D).astype("f4") * 0.4 for l in lengths]
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [3 * D], dtype="float32", lod_level=1)
+        h = fluid.layers.dynamic_gru(x, size=D,
+                                     param_attr=fluid.ParamAttr(name="gru_w"),
+                                     bias_attr=fluid.ParamAttr(name="gru_b"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    w = np.asarray(scope.find_var("gru_w"))
+    b = np.asarray(scope.find_var("gru_b")).reshape(-1)
+    (hv,) = exe.run(main, feed={"x": LoDTensor(rows)}, fetch_list=[h], scope=scope)
+    hv = np.asarray(hv)
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    for i, row in enumerate(rows):
+        hprev = np.zeros(D)
+        for t, xt in enumerate(row):
+            ur = sig(xt[:2 * D] + hprev @ w[:, :2 * D] + b[:2 * D])
+            u, r = ur[:D], ur[D:]
+            cand = np.tanh(xt[2 * D:] + (r * hprev) @ w[:, 2 * D:] + b[2 * D:])
+            hprev = (1 - u) * hprev + u * cand
+            np.testing.assert_allclose(hv[i, t], hprev, atol=1e-5, rtol=1e-4)
+
+
+def test_dynamic_lstm_trains():
+    """stacked_dynamic_lstm-style classifier converges (reference
+    benchmark/fluid/models/stacked_dynamic_lstm.py shape)."""
+    D = 8
+    rng = np.random.RandomState(4)
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6], dtype="float32", lod_level=1)
+        label = fluid.layers.data("label", [1], dtype="float32")
+        proj = fluid.layers.fc(x, 4 * D, num_flatten_dims=2)
+        hidden, _ = fluid.layers.dynamic_lstm(proj, size=4 * D, use_peepholes=False)
+        last = fluid.layers.sequence_last_step(hidden)
+        pred = fluid.layers.fc(last, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, label))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    losses = []
+    for _ in range(30):
+        lengths = rng.randint(2, 6, size=8)
+        rows = [rng.randn(l, 6).astype("f4") for l in lengths]
+        y = np.asarray([[r.sum() > 0] for r in rows], dtype="f4")
+        (lv,) = exe.run(main, feed={"x": LoDTensor(rows), "label": y},
+                        fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0]
+
+
+def test_beam_search_beam1_equals_greedy():
+    from paddle_tpu.models import nmt
+
+    main, startup, feeds, fetches = nmt.build_nmt_infer(
+        src_vocab=30, tgt_vocab=30, d_model=16, n_layers=1, n_heads=2, d_ff=32)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    startup.random_seed = 11
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    src = [rng.randint(3, 30, (4, 1)).astype("int64"),
+           rng.randint(3, 30, (6, 1)).astype("int64")]
+    seq1, sc1 = nmt.beam_search_decode(exe, main, fetches["logits"], scope, src,
+                                       beam_size=1, max_len=6)
+    seq4, sc4 = nmt.beam_search_decode(exe, main, fetches["logits"], scope, src,
+                                       beam_size=4, max_len=6)
+    assert seq1.shape == (2, 6) and seq4.shape == (2, 6)
+    # beam search can only match or beat greedy on total log-prob
+    assert (sc4 >= sc1 - 1e-6).all()
+    assert (seq1[:, 0] == 1).all()  # bos
