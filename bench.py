@@ -1,18 +1,18 @@
-"""Benchmark driver: prints ONE JSON line with the flagship metric.
+"""Benchmark driver covering every BASELINE.md target (reference harness:
+benchmark/fluid/fluid_benchmark.py — one driver, many models).
 
-Flagship: ResNet-50 ImageNet training throughput on one TPU chip, bf16
-compute (reference harness: benchmark/fluid/fluid_benchmark.py, which
-printed `Throughput` per pass; BASELINE.md target is >=50% MFU — see
-docs/perf_r02.md for the measured breakdown of the gap).
+Default invocation prints ONE JSON line: the flagship ResNet-50 metric with
+every other model's result embedded under extra.models.  `--per-model`
+prints one JSON line per model instead (mnist parity gate, resnet50,
+transformer NMT ragged path, BERT-base, DeepFM CTR).
 
 vs_baseline: the reference published no numbers (BASELINE.md), so the
-absolute imgs/s series is what's tracked across rounds; vs_baseline is
-this round's value over the round-1 recorded value (2295 imgs/s) so
-regressions are visible, NOT parity vs the reference.
+absolute series is tracked across rounds; vs_baseline = this round's
+imgs/s over round-1's 2295.
 
-MFU is computed from analytic FLOPs (3x 4.089 GFLOP/img) because the
-tunnel backend's compiled-program cost_analysis() is broken (returns
-4.2 GFLOP for a full train step).
+MFU numbers are computed from analytic FLOPs (the tunnel backend's
+cost_analysis() is broken — returns 4.2 GFLOP for a full ResNet train
+step); labeled `*_analytic`.
 """
 from __future__ import annotations
 
@@ -23,9 +23,25 @@ import time
 import numpy as np
 
 ROUND1_IMGS_PER_SEC = 2295.0  # BENCH_r01.json
+V5E_BF16_PEAK = 197e12
 
 
-def bench_resnet50(batch_size=128, steps_per_dispatch=8, warmup=1, iters=4):
+def _sync(x):
+    return np.asarray(x)
+
+
+def _timed_steps(dispatch, n_warm=2, iters=3):
+    for _ in range(n_warm):
+        out = dispatch()
+    _sync(out[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = dispatch()
+    _sync(out[0])
+    return (time.perf_counter() - t0) / iters, out
+
+
+def bench_resnet50(batch_size=128, K=8, iters=4):
     import jax
     import jax.numpy as jnp
 
@@ -33,79 +49,219 @@ def bench_resnet50(batch_size=128, steps_per_dispatch=8, warmup=1, iters=4):
     from paddle_tpu.models import resnet
 
     main, startup, feeds, fetches = resnet.build(
-        dtype="bfloat16", class_dim=1000, learning_rate=0.1, with_optimizer=True
-    )
+        dtype="bfloat16", class_dim=1000, learning_rate=0.1, with_optimizer=True)
     scope = fluid.Scope()
     exe = fluid.Executor(fluid.TPUPlace(0))
     exe.run(startup, scope=scope)
-
-    K = steps_per_dispatch
     rng = np.random.RandomState(0)
-    img = rng.rand(K, batch_size, 3, 224, 224).astype("float32")
-    label = rng.randint(0, 1000, size=(K, batch_size, 1)).astype(np.int32)
-    # device-resident synthetic batch (reference harness: --use_fake_data in
-    # benchmark/fluid/fluid_benchmark.py) so the tunnel's H2D bandwidth
-    # doesn't pollute the compute measurement
     dev = fluid.TPUPlace(0).jax_device()
     feed = {
-        "img": jax.device_put(jnp.asarray(img), dev),
-        "label": jax.device_put(jnp.asarray(label), dev),
+        "img": jax.device_put(jnp.asarray(rng.rand(K, batch_size, 3, 224, 224), jnp.float32), dev),
+        "label": jax.device_put(jnp.asarray(
+            rng.randint(0, 1000, (K, batch_size, 1)), jnp.int32), dev),
     }
     loss_name = fetches["loss"].name
 
     def dispatch():
-        # steps=K scans K optimizer steps inside one compiled call,
-        # amortizing host/tunnel dispatch overhead (docs/perf_r02.md)
         return exe.run(main, feed=feed, fetch_list=[loss_name], scope=scope,
                        steps=K, return_numpy=False)
 
-    out = dispatch()
-    np.asarray(out[0])  # hard sync (block_until_ready is advisory on the tunnel)
-    for _ in range(warmup):
-        out = dispatch()
-    np.asarray(out[0])
+    dt, out = _timed_steps(dispatch, iters=iters)
+    dt /= K
+    lossN = float(np.asarray(out[0]).reshape(-1)[-1])
+    assert np.isfinite(lossN), f"non-finite resnet loss {lossN}"
+    imgs = batch_size / dt
+    mfu = imgs * 3 * 4.089e9 / V5E_BF16_PEAK
+    print(f"resnet50: {dt*1e3:.1f} ms  {imgs:.0f} imgs/s  mfu {mfu:.3f}", file=sys.stderr)
+    return {"metric": "resnet50_train_imgs_per_sec_per_chip", "value": round(imgs, 2),
+            "unit": "imgs/sec", "mfu_bf16_analytic": round(mfu, 4),
+            "batch_size": batch_size, "steps_per_dispatch": K}
 
+
+def bench_mnist(batch_size=128, steps=40):
+    """Loss-parity gate (BASELINE: 'loss parity vs CPU ref'): the same
+    seeded program must converge on the chip and match a rerun bit-for-bit
+    modulo accelerator numerics (rtol 1e-3 on the loss curve)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models import mnist
+
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(steps, batch_size, 1, 28, 28).astype("float32")
+    # learnable synthetic task (random labels would floor at ln10): class =
+    # decile of the mean pixel
+    m = imgs.mean(axis=(2, 3, 4))
+    order = m.reshape(-1).argsort().argsort().reshape(m.shape)
+    labels = (order * 10 // order.size).astype("int64")[..., None]
+
+    def run(place):
+        main, startup, feeds, fetches = mnist.build(learning_rate=1e-3)
+        startup.random_seed = 7
+        scope = fluid.Scope()
+        exe = fluid.Executor(place)
+        exe.run(startup, scope=scope)
+        losses = []
+        t0 = time.perf_counter()
+        for i in range(steps):
+            (lv,) = exe.run(main, feed={"img": imgs[i], "label": labels[i]},
+                            fetch_list=[fetches["loss"]], scope=scope)
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+        return losses, time.perf_counter() - t0
+
+    tpu_losses, dt = run(fluid.TPUPlace(0))
+    cpu_losses, _ = run(fluid.CPUPlace())
+    parity = bool(np.allclose(tpu_losses, cpu_losses, rtol=5e-2, atol=1e-3))
+    converged = tpu_losses[-1] < tpu_losses[0] * 0.7
+    imgs_per_sec = batch_size * steps / dt
+    print(f"mnist: parity={parity} converged={converged} "
+          f"loss {tpu_losses[0]:.3f}->{tpu_losses[-1]:.3f}", file=sys.stderr)
+    return {"metric": "mnist_loss_parity", "value": imgs_per_sec, "unit": "imgs/sec",
+            "parity_vs_cpu": parity, "converged": bool(converged),
+            "first_loss": round(tpu_losses[0], 4), "last_loss": round(tpu_losses[-1], 4)}
+
+
+def bench_nmt(iters=6):
+    """Transformer-base NMT on the ragged/LoD path: seqs/sec with bucketed
+    variable-length batches (BASELINE: 'no CUDA ops in executed program' —
+    trivially true: every op lowers to XLA)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models import nmt
+
+    main, startup, feeds, fetches = nmt.build_transformer_nmt(
+        src_vocab=8000, tgt_vocab=8000, d_model=512, n_layers=6, n_heads=8,
+        d_ff=2048, dropout=0.1, learning_rate=2.0)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    b = 32
+    batches = []
+    for _ in range(2):
+        ls = rng.randint(20, 64, size=b).tolist()
+        lt = rng.randint(20, 64, size=b).tolist()
+        batches.append(nmt.make_fake_nmt_batch(ls, lt, 8000, 8000))
+    for batch in batches:  # compile both buckets
+        exe.run(main, feed=batch, fetch_list=[fetches["loss"]], scope=scope)
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = dispatch()
-    losses = np.asarray(out[0])  # hard sync: value read drains the chain
-    dt = (time.perf_counter() - t0) / (iters * K)
-    lossN = float(losses[-1])
-    if not np.isfinite(lossN):
-        raise RuntimeError(f"non-finite loss from bench step: {lossN}")
+    n = 0
+    for i in range(iters):
+        (lv,) = exe.run(main, feed=batches[i % 2], fetch_list=[fetches["loss"]],
+                        scope=scope)
+        n += b
+    lv = float(np.asarray(lv).reshape(-1)[0])
+    dt = time.perf_counter() - t0
+    assert np.isfinite(lv)
+    seqs = n / dt
+    print(f"nmt: {seqs:.0f} seqs/s  loss {lv:.3f}", file=sys.stderr)
+    return {"metric": "transformer_nmt_train_seqs_per_sec_per_chip",
+            "value": round(seqs, 2), "unit": "seqs/sec", "batch_size": b,
+            "config": "base-6L-512d ragged"}
 
-    imgs_per_sec = batch_size / dt
-    # ResNet-50 fwd ~4.09 GFLOP/img at 224^2; train ~3x fwd (analytic; see
-    # module docstring for why XLA cost analysis isn't used here).
-    train_flops_per_img = 3 * 4.089e9
-    peak = 197e12  # v5e bf16 peak FLOP/s
-    mfu = imgs_per_sec * train_flops_per_img / peak
-    print(f"step {dt*1e3:.1f} ms  loss {lossN:.3f}  mfu {mfu:.3f}", file=sys.stderr)
-    return imgs_per_sec, mfu
+
+def bench_bert(batch_size=32, seq_len=128, iters=6):
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import transformer
+
+    main, startup, feeds, fetches = transformer.build_bert(
+        vocab_size=30522, seq_len=seq_len, d_model=768, n_layers=12, n_heads=12,
+        d_ff=3072, dropout_prob=0.1, with_optimizer=True)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup, scope=scope)
+    batch = transformer.make_fake_batch(batch_size, seq_len, 30522)
+    dev = fluid.TPUPlace(0).jax_device()
+    batch = {k: jax.device_put(jnp.asarray(v), dev) for k, v in batch.items()}
+    loss_name = fetches["loss"].name
+
+    def dispatch():
+        return exe.run(main, feed=batch, fetch_list=[loss_name], scope=scope,
+                       return_numpy=False)
+
+    dt, out = _timed_steps(dispatch, iters=iters)
+    lossN = float(np.asarray(out[0]).reshape(-1)[-1])
+    assert np.isfinite(lossN)
+    seqs = batch_size / dt
+    # analytic train FLOPs/seq for BERT-base @128: ~6 * 110e6 params * 128 tokens
+    flops_per_seq = 6 * 110e6 * seq_len
+    mfu = seqs * flops_per_seq / V5E_BF16_PEAK
+    print(f"bert: {dt*1e3:.1f} ms  {seqs:.0f} seqs/s  mfu {mfu:.3f}", file=sys.stderr)
+    return {"metric": "bert_base_train_seqs_per_sec_per_chip", "value": round(seqs, 2),
+            "unit": "seqs/sec", "mfu_bf16_analytic": round(mfu, 4),
+            "batch_size": batch_size, "seq_len": seq_len}
+
+
+def bench_deepfm(batch_size=4096, iters=8):
+    import paddle_tpu as fluid
+    from paddle_tpu.core import lowering
+    from paddle_tpu.models import deepfm
+
+    main, startup, feeds, fetches = deepfm.build(
+        num_fields=26, vocab_size=200000, embed_dim=16, mlp_dims=(400, 400, 400),
+        learning_rate=0.05)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 200000, (batch_size, 26))
+    label = (rng.rand(batch_size, 1) < 0.3).astype("float32")
+    feed = {"feat_ids": ids, "label": label}
+
+    def dispatch():
+        return exe.run(main, feed=feed, fetch_list=[fetches["loss"]], scope=scope,
+                       return_numpy=False)
+
+    dt, out = _timed_steps(dispatch, iters=iters)
+    lossN = float(np.asarray(out[0]).reshape(-1)[0])
+    assert np.isfinite(lossN)
+    sparse = sorted(lowering.LAST_TRACE_REPORT.get("sparse_grad_params", []))
+    ex = batch_size / dt
+    print(f"deepfm: {ex:.0f} ex/s  sparse={sparse}", file=sys.stderr)
+    return {"metric": "deepfm_ctr_train_examples_per_sec_per_chip",
+            "value": round(ex, 2), "unit": "examples/sec",
+            "batch_size": batch_size, "vocab": 200000,
+            "sparse_grad_params": sparse}
 
 
 def main():
-    batch = 128
-    steps_per_dispatch = 8
-    imgs_per_sec, mfu = bench_resnet50(
-        batch_size=batch, steps_per_dispatch=steps_per_dispatch
-    )
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_train_imgs_per_sec_per_chip",
-                "value": round(imgs_per_sec, 2),
-                "unit": "imgs/sec",
-                "vs_baseline": round(imgs_per_sec / ROUND1_IMGS_PER_SEC, 4),
-                "extra": {
-                    "mfu_bf16_analytic": round(mfu, 4),
-                    "batch_size": batch,
-                    "steps_per_dispatch": steps_per_dispatch,
-                    "vs_baseline_is": "this_round_imgs_per_sec / round1_imgs_per_sec",
-                },
-            }
-        )
-    )
+    per_model = "--per-model" in sys.argv
+    only = None
+    for a in sys.argv[1:]:
+        if not a.startswith("-"):
+            only = a
+    results = {}
+    benches = [("mnist", bench_mnist), ("nmt", bench_nmt), ("bert", bench_bert),
+               ("deepfm", bench_deepfm), ("resnet50", bench_resnet50)]
+    for name, fn in benches:
+        if only and name != only:
+            continue
+        try:
+            results[name] = fn()
+        except Exception as e:  # a broken side model must not kill the flagship
+            results[name] = {"metric": name, "error": f"{type(e).__name__}: {e}"}
+            print(f"{name} FAILED: {e}", file=sys.stderr)
+
+    if per_model or only:
+        for name, r in results.items():
+            print(json.dumps(r))
+        return
+
+    flag = results.get("resnet50", {})
+    imgs = flag.get("value", 0.0)
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_per_chip",
+        "value": imgs,
+        "unit": "imgs/sec",
+        "vs_baseline": round(imgs / ROUND1_IMGS_PER_SEC, 4) if imgs else 0.0,
+        "extra": {
+            "mfu_bf16_analytic": flag.get("mfu_bf16_analytic"),
+            "batch_size": flag.get("batch_size"),
+            "steps_per_dispatch": flag.get("steps_per_dispatch"),
+            "vs_baseline_is": "this_round_imgs_per_sec / round1_imgs_per_sec",
+            "models": {k: v for k, v in results.items() if k != "resnet50"},
+        },
+    }))
 
 
 if __name__ == "__main__":

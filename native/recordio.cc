@@ -1,0 +1,188 @@
+// RecordIO: chunked, CRC-checked record file format.
+//
+// Reference: paddle/fluid/recordio/{header.h:39,chunk.h:27,scanner.h:26,
+// writer.h:22} — magic-numbered chunk headers, per-chunk CRC32, sequential
+// scanner.  This is the TPU build's native (C++) implementation, exposed to
+// Python through a plain C ABI (ctypes — no pybind11 in the image).
+//
+// On-disk layout (little-endian):
+//   per chunk: u32 MAGIC | u32 num_records | u64 payload_len | u32 crc32
+//              payload = { u32 len | bytes } * num_records
+//
+// The scanner validates magic + CRC per chunk and streams records; a
+// corrupt chunk fails loudly (rio_error) instead of yielding garbage.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x01020304;  // reference header.h magic
+
+// CRC-32 (IEEE 802.3), small table implementation.  The table is built
+// eagerly at load time (static initializer) — scanners run from multiple
+// Python threads and a lazy non-atomic init would race.
+struct CrcTable {
+  uint32_t t[256];
+  CrcTable() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+  }
+};
+const CrcTable crc_table;
+
+uint32_t crc32(const uint8_t* buf, size_t len) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++)
+    c = crc_table.t[(c ^ buf[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+thread_local std::string g_error;
+
+struct Writer {
+  FILE* f = nullptr;
+  std::vector<uint8_t> buf;
+  uint32_t n_records = 0;
+  uint32_t max_records = 0;
+
+  bool flush_chunk() {
+    if (n_records == 0) return true;
+    uint32_t magic = kMagic;
+    uint64_t len = buf.size();
+    uint32_t crc = crc32(buf.data(), buf.size());
+    if (fwrite(&magic, 4, 1, f) != 1 || fwrite(&n_records, 4, 1, f) != 1 ||
+        fwrite(&len, 8, 1, f) != 1 || fwrite(&crc, 4, 1, f) != 1 ||
+        (len && fwrite(buf.data(), 1, len, f) != len)) {
+      g_error = "recordio: short write";
+      return false;
+    }
+    buf.clear();
+    n_records = 0;
+    return true;
+  }
+};
+
+struct Scanner {
+  FILE* f = nullptr;
+  std::vector<uint8_t> chunk;
+  size_t pos = 0;
+  uint32_t remaining = 0;
+
+  bool load_chunk() {
+    uint32_t magic, n, crc;
+    uint64_t len;
+    if (fread(&magic, 4, 1, f) != 1) return false;  // clean EOF
+    if (magic != kMagic) {
+      g_error = "recordio: bad chunk magic";
+      return false;
+    }
+    if (fread(&n, 4, 1, f) != 1 || fread(&len, 8, 1, f) != 1 ||
+        fread(&crc, 4, 1, f) != 1) {
+      g_error = "recordio: truncated chunk header";
+      return false;
+    }
+    chunk.resize(len);
+    if (len && fread(chunk.data(), 1, len, f) != len) {
+      g_error = "recordio: truncated chunk payload";
+      return false;
+    }
+    if (crc32(chunk.data(), chunk.size()) != crc) {
+      g_error = "recordio: chunk CRC mismatch";
+      return false;
+    }
+    pos = 0;
+    remaining = n;
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* rio_error() { return g_error.c_str(); }
+
+void* rio_writer_open(const char* path, uint32_t max_chunk_records) {
+  g_error.clear();
+  FILE* f = fopen(path, "wb");
+  if (!f) {
+    g_error = std::string("recordio: cannot open for write: ") + path;
+    return nullptr;
+  }
+  Writer* w = new Writer();
+  w->f = f;
+  w->max_records = max_chunk_records ? max_chunk_records : 1024;
+  return w;
+}
+
+int rio_write(void* handle, const uint8_t* data, uint32_t len) {
+  Writer* w = static_cast<Writer*>(handle);
+  uint32_t l = len;
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(&l);
+  w->buf.insert(w->buf.end(), p, p + 4);
+  w->buf.insert(w->buf.end(), data, data + len);
+  w->n_records++;
+  if (w->n_records >= w->max_records) return w->flush_chunk() ? 0 : -1;
+  return 0;
+}
+
+int rio_writer_close(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  bool ok = w->flush_chunk();
+  fclose(w->f);
+  delete w;
+  return ok ? 0 : -1;
+}
+
+void* rio_scanner_open(const char* path) {
+  g_error.clear();
+  FILE* f = fopen(path, "rb");
+  if (!f) {
+    g_error = std::string("recordio: cannot open for read: ") + path;
+    return nullptr;
+  }
+  Scanner* s = new Scanner();
+  s->f = f;
+  return s;
+}
+
+// Returns pointer to the next record (valid until the next call) and sets
+// *len; returns nullptr at EOF (rio_error() empty) or on error (non-empty).
+const uint8_t* rio_next(void* handle, uint32_t* len) {
+  Scanner* s = static_cast<Scanner*>(handle);
+  g_error.clear();
+  if (s->remaining == 0) {
+    if (!s->load_chunk()) return nullptr;  // EOF or error (g_error set)
+  }
+  if (s->pos + 4 > s->chunk.size()) {
+    g_error = "recordio: record header past chunk end";
+    return nullptr;
+  }
+  uint32_t l;
+  memcpy(&l, s->chunk.data() + s->pos, 4);
+  s->pos += 4;
+  if (s->pos + l > s->chunk.size()) {
+    g_error = "recordio: record payload past chunk end";
+    return nullptr;
+  }
+  const uint8_t* out = s->chunk.data() + s->pos;
+  s->pos += l;
+  s->remaining--;
+  *len = l;
+  return out;
+}
+
+void rio_scanner_close(void* handle) {
+  Scanner* s = static_cast<Scanner*>(handle);
+  fclose(s->f);
+  delete s;
+}
+
+}  // extern "C"

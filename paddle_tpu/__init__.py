@@ -34,6 +34,12 @@ from . import models  # noqa: F401
 from . import reader  # noqa: F401
 from .reader import DataFeeder, DataLoader, PyReader  # noqa: F401
 from . import contrib  # mixed_precision decorator etc.  # noqa: F401
+from . import flags  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
+from . import dataset  # noqa: F401
+from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
+from . import inference  # noqa: F401
+from . import recordio  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import metrics  # noqa: F401
 from . import profiler  # noqa: F401

@@ -421,7 +421,9 @@ class Executor:
                 n_steps=steps,
             )
             self._cache[cache_key] = compiled
-            if len(self._cache) > 128:  # drop oldest executable (LRU-ish)
+            from ..flags import flag as _flagv
+
+            if len(self._cache) > _flagv("FLAGS_executor_cache_capacity"):  # LRU evict
                 self._cache.pop(next(iter(self._cache)))
 
         if mesh is None:
@@ -465,6 +467,30 @@ class Executor:
             fetches, new_key = compiled(scope, jfeeds, key)
         scope.set_var(RNG_STATE_VAR, new_key)
 
+        from ..flags import flag as _flag
+
+        if _flag("FLAGS_check_nan_inf"):
+            for name, val in zip(fetch_names, fetches):
+                arr = np.asarray(val)
+                if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
+                    raise RuntimeError(
+                        f"FLAGS_check_nan_inf: fetch {name!r} contains "
+                        f"NaN/Inf (reference CheckTensorNANOrInf)")
+
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
+
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """reference executor.py:892 train_from_dataset — file-list-driven
+        training loop over a Dataset (paddle_tpu/dataset.py)."""
+        from ..dataset import train_from_dataset as _tfd
+
+        return _tfd(self, program if program is not None else default_main_program(),
+                    dataset, scope=scope, fetch_list=fetch_list,
+                    fetch_info=fetch_info, print_period=print_period)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None, **kw):
+        return self.train_from_dataset(program, dataset, scope, **kw)

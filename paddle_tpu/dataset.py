@@ -1,0 +1,151 @@
+"""File-driven datasets + train_from_dataset.
+
+Reference: framework/data_feed.h:62 + data_set.h:40 + python dataset.py
+(InMemoryDataset/QueueDataset) and Executor::RunFromDataset
+(executor.cc:120) — multithreaded file parsing feeding worker threads
+without per-step Python feeds.
+
+TPU-first: files are native RecordIO (native/recordio.cc); a thread pool
+parses chunks into sample tuples; batches assemble into dense feed dicts
+and drive the normal compiled executor (one XLA program, steps>1 capable) —
+the Hogwild thread-per-core model is replaced by the compiled step itself.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from . import recordio
+
+
+class DatasetBase:
+    def __init__(self):
+        self._batch_size = 1
+        self._filelist: List[str] = []
+        self._use_vars: List[str] = []
+        self._thread_num = 1
+        self._drop_last = True
+
+    # -- reference dataset.py config surface --
+    def set_batch_size(self, batch_size: int):
+        self._batch_size = batch_size
+
+    def set_thread(self, thread_num: int):
+        self._thread_num = max(1, thread_num)
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self._filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        self._use_vars = [v if isinstance(v, str) else v.name for v in var_list]
+
+    @property
+    def use_var_names(self):
+        return list(self._use_vars)
+
+    def _iter_samples(self) -> Iterator[List[np.ndarray]]:
+        raise NotImplementedError
+
+    def batches(self) -> Iterator[Dict[str, np.ndarray]]:
+        """Assemble sample tuples into stacked dense feed dicts."""
+        if not self._use_vars:
+            raise ValueError("dataset: call set_use_var first")
+        buf: List[List[np.ndarray]] = []
+        for sample in self._iter_samples():
+            if len(sample) != len(self._use_vars):
+                raise ValueError(
+                    f"dataset: record has {len(sample)} slots, expected "
+                    f"{len(self._use_vars)} ({self._use_vars})")
+            buf.append(sample)
+            if len(buf) == self._batch_size:
+                yield {n: np.stack([s[i] for s in buf])
+                       for i, n in enumerate(self._use_vars)}
+                buf = []
+        if buf and not self._drop_last:
+            yield {n: np.stack([s[i] for s in buf])
+                   for i, n in enumerate(self._use_vars)}
+
+
+class QueueDataset(DatasetBase):
+    """Streaming mode (reference MultiSlotDataFeed): files are parsed by a
+    thread pool and samples stream through a bounded queue — nothing is
+    materialized."""
+
+    def _iter_samples(self):
+        import queue
+
+        q: "queue.Queue" = queue.Queue(maxsize=4096)
+        DONE = object()
+        failure: list = []
+
+        def parse(path):
+            for sample in recordio.read_arrays(path):
+                q.put(sample)
+
+        def producer():
+            try:
+                with ThreadPoolExecutor(self._thread_num) as pool:
+                    list(pool.map(parse, self._filelist))
+            except BaseException as e:  # surface parse errors to the consumer
+                failure.append(e)
+            finally:
+                q.put(DONE)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is DONE:
+                if failure:
+                    raise failure[0]
+                return
+            yield item
+
+
+class InMemoryDataset(DatasetBase):
+    """reference InMemoryDataset: load all files (thread pool), optional
+    local_shuffle, then iterate repeatedly."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples: Optional[List[List[np.ndarray]]] = None
+
+    def load_into_memory(self):
+        with ThreadPoolExecutor(self._thread_num) as pool:
+            per_file = list(pool.map(lambda p: list(recordio.read_arrays(p)),
+                                     self._filelist))
+        self._samples = [s for rows in per_file for s in rows]
+
+    def local_shuffle(self, seed: Optional[int] = None):
+        if self._samples is None:
+            raise RuntimeError("load_into_memory() first")
+        random.Random(seed).shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, seed: Optional[int] = None):
+        # single-trainer fallback: same as local (the reference shuffles
+        # across trainers through fleet; multi-process hook point)
+        self.local_shuffle(seed)
+
+    def _iter_samples(self):
+        if self._samples is None:
+            raise RuntimeError("load_into_memory() first")
+        yield from self._samples
+
+
+def train_from_dataset(executor, program, dataset, scope=None, fetch_list=None,
+                       fetch_info=None, print_period=100):
+    """Executor::RunFromDataset equivalent: drive the program from a
+    Dataset's batches; returns the list of fetched values per print period.
+    (Bound onto Executor as a method in core/executor.py.)"""
+    fetch_list = fetch_list or []
+    logs = []
+    for i, feed in enumerate(dataset.batches()):
+        out = executor.run(program, feed=feed, fetch_list=fetch_list, scope=scope)
+        if fetch_list and (i % print_period) == 0:
+            names = fetch_info or [getattr(f, "name", str(f)) for f in fetch_list]
+            logs.append((i, dict(zip(names, [np.asarray(o).reshape(-1)[:4] for o in out]))))
+    return logs

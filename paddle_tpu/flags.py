@@ -1,0 +1,115 @@
+"""Typed flag registry with FLAGS_* env passthrough.
+
+Reference: gflags end-to-end — C++ DEFINE_* at point of use, Python collects
+a whitelist and seeds it from the environment
+(`python/paddle/fluid/__init__.py:154-216`), so the public config surface is
+`FLAGS_xxx` env vars plus `fluid.set_flags`/`fluid.get_flags`.
+
+TPU build: one registry.  Flags either drive real behavior here (NaN
+checking, HLO dumps, compile-cache size) or are accepted no-ops kept for
+source compatibility (allocator/cudnn knobs that PJRT/XLA own now — each
+says so in its help string)."""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List
+
+_REGISTRY: Dict[str, dict] = {}
+
+
+def _define(name: str, typ, default, help: str):
+    _REGISTRY[name] = {"type": typ, "value": default, "default": default, "help": help}
+
+
+def DEFINE_bool(name, default, help=""):
+    _define(name, bool, default, help)
+
+
+def DEFINE_int(name, default, help=""):
+    _define(name, int, default, help)
+
+
+def DEFINE_float(name, default, help=""):
+    _define(name, float, default, help)
+
+
+def DEFINE_string(name, default, help=""):
+    _define(name, str, default, help)
+
+
+def _coerce(typ, v):
+    if typ is bool:
+        if isinstance(v, str):
+            return v.lower() in ("1", "true", "yes", "on")
+        return bool(v)
+    return typ(v)
+
+
+def set_flags(flags: Dict[str, Any]):
+    """fluid.set_flags({"FLAGS_check_nan_inf": True})"""
+    for k, v in flags.items():
+        if k not in _REGISTRY:
+            raise KeyError(f"unknown flag {k!r}; known: {sorted(_REGISTRY)}")
+        ent = _REGISTRY[k]
+        ent["value"] = _coerce(ent["type"], v)
+        if k == "FLAGS_xla_dump_to":
+            apply_xla_dump()
+
+
+def get_flags(names) -> Dict[str, Any]:
+    if isinstance(names, str):
+        names = [names]
+    return {n: _REGISTRY[n]["value"] for n in names}
+
+
+def flag(name: str):
+    return _REGISTRY[name]["value"]
+
+
+def all_flags() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def init_from_env():
+    """Seed every registered flag from its FLAGS_* env var (the reference's
+    `core.init_gflags(["--tryfromenv=..."])` role)."""
+    for name, ent in _REGISTRY.items():
+        if name in os.environ:
+            ent["value"] = _coerce(ent["type"], os.environ[name])
+
+
+# --- the registry -----------------------------------------------------------
+
+DEFINE_bool("FLAGS_check_nan_inf", False,
+            "after each run, scan fetched values for NaN/Inf and raise "
+            "(reference operator.cc:950 CheckTensorNANOrInf; here a per-fetch "
+            "host guard)")
+DEFINE_string("FLAGS_xla_dump_to", "",
+              "directory for XLA HLO dumps of every compiled program "
+              "(reference graphviz/debug dumps); set before first compile")
+DEFINE_int("FLAGS_executor_cache_capacity", 128,
+           "LRU capacity of the executor's compiled-program cache "
+           "(reference use_program_cache)")
+DEFINE_bool("FLAGS_cudnn_deterministic", True,
+            "accepted no-op: XLA TPU lowerings are deterministic by default")
+DEFINE_float("FLAGS_fraction_of_gpu_memory_to_use", 1.0,
+             "accepted no-op: PJRT owns device memory")
+DEFINE_string("FLAGS_allocator_strategy", "auto_growth",
+              "accepted no-op: PJRT owns allocation")
+DEFINE_int("FLAGS_paddle_num_threads", 1,
+           "accepted no-op: XLA:CPU threading is runtime-managed")
+
+def apply_xla_dump():
+    """Wire FLAGS_xla_dump_to into XLA.  Effective for programs compiled
+    after the flag is set (XLA reads XLA_FLAGS at backend init; when the
+    backend is already up, per-compile env is still consulted by the
+    compiler for dump options)."""
+    d = flag("FLAGS_xla_dump_to")
+    if d and f"--xla_dump_to={d}" not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + f" --xla_dump_to={d}"
+        ).strip()
+
+
+init_from_env()
+apply_xla_dump()

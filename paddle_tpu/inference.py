@@ -1,0 +1,63 @@
+"""Inference predictor: compile-and-serve of saved inference models.
+
+Reference: AnalysisPredictor (inference/api/analysis_predictor.h:46) —
+load a saved __model__ + params, run analysis passes, serve Run() calls,
+clone() per serving thread.
+
+TPU-first: the "analysis passes" are XLA (whole-program fusion happens at
+compile, so the reference's fuse pass pipeline has no residue to apply);
+the predictor is a pruned Program + Scope + Executor with the compiled
+executable cached after the first call.  clone() shares the weights
+(read-only Scope) but gets its own Executor — the reference's
+clone-per-thread contract."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .core.executor import CPUPlace, Executor, Place, TPUPlace
+from .core.program import Program
+from .core.scope import Scope
+from . import io as _io
+
+
+class PredictConfig:
+    """reference AnalysisConfig (trimmed to what matters on TPU)."""
+
+    def __init__(self, model_dir: str, place: Optional[Place] = None):
+        self.model_dir = model_dir
+        self.place = place or TPUPlace(0)
+
+
+class Predictor:
+    def __init__(self, config: PredictConfig, _shared=None):
+        self.config = config
+        if _shared is not None:  # clone path: share program + weights
+            self.program, self.feed_names, self.fetch_names, self.scope = _shared
+        else:
+            self.scope = Scope()
+            exe = Executor(config.place)
+            self.program, self.feed_names, self.fetch_names = _io.load_inference_model(
+                config.model_dir, exe, scope=self.scope)
+        self.exe = Executor(config.place)
+
+    def run(self, feeds: Dict[str, np.ndarray],
+            fetch_names: Optional[Sequence[str]] = None) -> List[np.ndarray]:
+        missing = set(self.feed_names) - set(feeds)
+        if missing:
+            raise KeyError(f"Predictor.run: missing feeds {sorted(missing)}")
+        return self.exe.run(
+            self.program, feed=dict(feeds),
+            fetch_list=list(fetch_names or self.fetch_names), scope=self.scope)
+
+    def clone(self) -> "Predictor":
+        """Serve from another thread: shared weights, private executor
+        (compile cache is per-executor; XLA executables are thread-safe)."""
+        return Predictor(self.config, _shared=(
+            self.program, self.feed_names, self.fetch_names, self.scope))
+
+
+def create_predictor(config: PredictConfig) -> Predictor:
+    """reference CreatePaddlePredictor."""
+    return Predictor(config)

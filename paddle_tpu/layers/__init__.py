@@ -24,7 +24,7 @@ from .learning_rate_scheduler import (  # noqa: F401
     piecewise_decay,
     polynomial_decay,
 )
-from .metric import accuracy  # noqa: F401
+from .metric import accuracy, auc, mean_iou  # noqa: F401
 from .nn import *  # noqa: F401,F403
 from .sequence import (  # noqa: F401
     DynamicRNN,

@@ -476,3 +476,71 @@ def _prelu(ctx, op, ins):
     else:
         a = alpha.reshape(())
     return {"Out": jnp.where(x > 0, x, a * x)}
+
+
+@register_op("mean_iou")
+def _mean_iou(ctx, op, ins):
+    """reference operators/metrics/mean_iou_op.h: per-class intersection /
+    union over the batch; classes absent from both pred and label are
+    excluded from the mean."""
+    pred = first(ins, "Predictions").reshape(-1).astype(jnp.int32)
+    label = first(ins, "Labels").reshape(-1).astype(jnp.int32)
+    C = op.attr("num_classes")
+    match = pred == label
+    correct = jax.ops.segment_sum(match.astype(jnp.int32), label, num_segments=C)
+    pred_cnt = jax.ops.segment_sum(jnp.ones_like(pred), pred, num_segments=C)
+    label_cnt = jax.ops.segment_sum(jnp.ones_like(label), label, num_segments=C)
+    union = pred_cnt + label_cnt - correct
+    valid = union > 0
+    iou = jnp.where(valid, correct / jnp.maximum(union, 1), 0.0)
+    mean = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
+    return {
+        "OutMeanIou": mean.astype(jnp.float32).reshape((1,)),
+        # all mismatches touching class c (false neg + false pos), so the
+        # streaming invariant iou = correct/(correct+wrong) holds
+        # (reference mean_iou_op.h)
+        "OutWrong": (pred_cnt + label_cnt - 2 * correct).astype(jnp.int32),
+        "OutCorrect": correct.astype(jnp.int32),
+    }
+
+
+@register_op("auc")
+def _auc(ctx, op, ins):
+    """reference operators/metrics/auc_op.h: bucket predicted positive
+    probability into num_thresholds+1 histogram bins per class polarity,
+    accumulate across steps (StatPos/StatNeg are persistable state), and
+    integrate the ROC curve by trapezoid."""
+    predict = first(ins, "Predict")
+    label = first(ins, "Label").reshape(-1)
+    stat_pos = first(ins, "StatPos")
+    stat_neg = first(ins, "StatNeg")
+    T = op.attr("num_thresholds", 4095)
+    # positive-class probability: column 1 of [b,2], or the flat input
+    p = predict[:, 1] if predict.ndim == 2 and predict.shape[1] == 2 else predict.reshape(-1)
+    bucket = jnp.clip((p * T).astype(jnp.int32), 0, T)
+    is_pos = (label > 0).astype(stat_pos.dtype)
+    pos_new = stat_pos.at[bucket].add(is_pos)
+    neg_new = stat_neg.at[bucket].add(1 - is_pos)
+    # walk thresholds high->low: cumulative TP/FP above each bucket.
+    # Integer math throughout (x32 would silently round float64 to float32
+    # past 2^24 examples); only the final ratio goes to float, where error
+    # is relative, not absolute.
+    tp = jnp.cumsum(pos_new[::-1])[::-1]
+    fp = jnp.cumsum(neg_new[::-1])[::-1]
+    tot_pos = tp[0]
+    tot_neg = fp[0]
+    # 2x trapezoid area over consecutive (fp, tp) points incl. the (0,0) end
+    tp_ext = jnp.concatenate([tp, jnp.zeros((1,), tp.dtype)])
+    fp_ext = jnp.concatenate([fp, jnp.zeros((1,), fp.dtype)])
+    area2 = jnp.sum((fp_ext[:-1] - fp_ext[1:]) * (tp_ext[:-1] + tp_ext[1:]))
+    denom2 = 2 * tot_pos * tot_neg
+    auc_v = jnp.where(
+        denom2 > 0,
+        area2.astype(jnp.float32) / jnp.maximum(denom2, 1).astype(jnp.float32),
+        0.0,
+    )
+    return {
+        "AUC": auc_v.astype(jnp.float32).reshape((1,)),
+        "StatPosOut": pos_new,
+        "StatNegOut": neg_new,
+    }

@@ -307,3 +307,52 @@ def _update_loss_scaling(ctx, op, ins):
         "GoodStepsOut": good_new.reshape((1,)).astype(good.dtype),
         "BadStepsOut": bad_new.reshape((1,)).astype(bad.dtype),
     }
+
+
+@register_opt("lars_momentum")
+def _lars_momentum(ctx, op, ins):
+    """reference optimizers/lars_momentum_op.cc: layer-adaptive rate
+    scaling — local_lr = lr * lars_coeff * ||p|| / (||g|| + wd * ||p||),
+    then plain momentum with weight decay folded into the gradient."""
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    v = first(ins, "Velocity")
+    mu = op.attr("mu", 0.9)
+    lars_coeff = op.attr("lars_coeff", 0.001)
+    wd = op.attr("lars_weight_decay", 0.0005)
+    eps = op.attr("epsilon", 0.0)
+    lr = _lr(ins)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p.astype(jnp.float32))))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * lars_coeff * p_norm / (g_norm + wd * p_norm + eps),
+        lr,
+    )
+    v_new = mu * v + local_lr * (g + wd * p)
+    return {"ParamOut": p - v_new, "VelocityOut": v_new}
+
+
+@register_op("model_average_accum")
+def _model_average_accum(ctx, op, ins):
+    """Bounded-window parameter accumulation for ModelAverage (reference
+    optimizer.py:2241 rotates sum_1/sum_2/sum_3 windows; here one
+    sum+count pair halves when the count reaches max_average_window, which
+    bounds the effective window to ~2x max while staying O(1) state).
+    Count is read pre-step (the paired model_average_count op, appended
+    after every accum, owns the increment) so all params halve together."""
+    s = first(ins, "Sum")
+    cnt = first(ins, "Count").reshape(())
+    p = first(ins, "Param")
+    max_w = op.attr("max_average_window", 10000)
+    s2 = s + p.astype(s.dtype)
+    over = (cnt + 1.0) >= max_w
+    return {"SumOut": jnp.where(over, s2 * 0.5, s2)}
+
+
+@register_op("model_average_count")
+def _model_average_count(ctx, op, ins):
+    cnt = first(ins, "Count").reshape(())
+    max_w = op.attr("max_average_window", 10000)
+    c2 = cnt + 1.0
+    return {"CountOut": jnp.where(c2 >= max_w, c2 * 0.5, c2).reshape((1,))}

@@ -459,6 +459,209 @@ class LambOptimizer(AdamOptimizer):
         )
 
 
+class LarsMomentumOptimizer(MomentumOptimizer):
+    """Layer-adaptive rate scaling (reference optimizer.py:1044
+    LarsMomentumOptimizer over lars_momentum_op.cc)."""
+
+    def __init__(self, learning_rate, momentum, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, **kw):
+        super().__init__(learning_rate, momentum, **kw)
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _eager_update(self, p, g, lr, state):
+        import jax.numpy as jnp
+
+        wd = self._lars_weight_decay
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            lr * self._lars_coeff * p_norm / (g_norm + wd * p_norm),
+            lr,
+        )
+        v = state.get("velocity")
+        v = jnp.zeros_like(p) if v is None else v
+        v_new = self._momentum * v + local_lr * (g + wd * p)
+        state["velocity"] = v_new
+        return p - v_new
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._get_accumulator("velocity", p)
+        return block.append_op(
+            "lars_momentum",
+            inputs={"Param": [p.name], "Grad": [g.name], "Velocity": [v.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name], "VelocityOut": [v.name]},
+            attrs={"mu": self._momentum, "lars_coeff": self._lars_coeff,
+                   "lars_weight_decay": self._lars_weight_decay},
+        )
+
+
+class ExponentialMovingAverage:
+    """EMA shadow parameters (reference optimizer.py:2431):
+    `update()` appends shadow := decay*shadow + (1-decay)*param ops into the
+    main program (run them every step); `apply(exe, scope)` context swaps
+    bias-corrected shadows into the params for eval and restores after."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._name = name or "ema"
+        self._pairs = []  # (param Variable, shadow name)
+        self._step_var = None
+
+    def update(self):
+        from .core.initializer import ConstantInitializer
+        from .core.param_attr import ParamAttr
+        from .layers import tensor as tensor_layers
+
+        program = default_main_program()
+        block = program.global_block()
+        helper_block = block
+        self._step_var = tensor_layers.create_global_var(
+            [1], 0, "float32", persistable=True, name=f"{self._name}_step")
+        # step += 1
+        helper_block.append_op("increment", inputs={"X": [self._step_var.name]},
+                               outputs={"Out": [self._step_var.name]},
+                               attrs={"step": 1.0})
+        for p in program.all_parameters():
+            if not p.trainable:
+                continue
+            shadow_name = f"{self._name}@{p.name}"
+            from .core.program import default_startup_program
+
+            sblock = default_startup_program().global_block()
+            sblock.create_var(shadow_name, shape=p.shape, dtype=p.dtype, persistable=True)
+            block.create_var(shadow_name, shape=p.shape, dtype=p.dtype, persistable=True)
+            # startup: shadow = 0
+            sblock.append_op(
+                "fill_constant", outputs={"Out": [shadow_name]},
+                attrs={"shape": list(p.shape or []), "dtype": str(p.dtype), "value": 0.0})
+            # main: shadow = decay*shadow + (1-decay)*param
+            scaled_s = block.create_var(shape=p.shape, dtype=p.dtype)
+            block.append_op("scale", inputs={"X": [shadow_name]},
+                            outputs={"Out": [scaled_s.name]},
+                            attrs={"scale": self._decay})
+            scaled_p = block.create_var(shape=p.shape, dtype=p.dtype)
+            block.append_op("scale", inputs={"X": [p.name]},
+                            outputs={"Out": [scaled_p.name]},
+                            attrs={"scale": 1.0 - self._decay})
+            block.append_op("sum", inputs={"X": [scaled_s.name, scaled_p.name]},
+                            outputs={"Out": [shadow_name]})
+            self._pairs.append((p, shadow_name))
+
+    def apply(self, executor=None, scope=None, need_restore=True):
+        """Context manager: swap bias-corrected EMA values into the params."""
+        import contextlib
+
+        import numpy as np
+
+        from .core.scope import global_scope
+
+        scope = scope or global_scope()
+        ema = self
+
+        @contextlib.contextmanager
+        def guard():
+            saved = {}
+            step = float(np.asarray(scope.find_var(ema._step_var.name)).reshape(-1)[0])
+            corr = 1.0 - ema._decay ** max(step, 1.0)
+            for p, shadow in ema._pairs:
+                saved[p.name] = scope.find_var(p.name)
+                sh = np.asarray(scope.find_var(shadow))
+                scope.set_var(p.name, (sh / corr).astype(sh.dtype))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for n, v in saved.items():
+                        scope.set_var(n, v)
+
+        return guard()
+
+    def restore(self, executor=None):
+        pass  # the apply() context restores; kept for API parity
+
+
+class ModelAverage:
+    """Bounded-window parameter averaging (reference optimizer.py:2241,
+    which rotates sum_1/sum_2/sum_3 windows of max_average_window steps;
+    here a single sum+count pair halves on reaching max_average_window —
+    effective window ~2x max, O(1) state): `update()` appends the
+    accumulation ops, `apply()` swaps the window average in, restoring on
+    context exit."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000, name=None):
+        self._max_window = max_average_window
+        self._name = name or "model_avg"
+        self._pairs = []
+        self._count_var = None
+
+    def update(self):
+        from .layers import tensor as tensor_layers
+
+        program = default_main_program()
+        block = program.global_block()
+        self._count_var = tensor_layers.create_global_var(
+            [1], 0, "float32", persistable=True, name=f"{self._name}_n")
+        from .core.program import default_startup_program
+
+        sblock = default_startup_program().global_block()
+        for p in program.all_parameters():
+            if not p.trainable:
+                continue
+            acc = f"{self._name}@{p.name}"
+            block.create_var(acc, shape=p.shape, dtype=p.dtype, persistable=True)
+            sblock.create_var(acc, shape=p.shape, dtype=p.dtype, persistable=True)
+            sblock.append_op(
+                "fill_constant", outputs={"Out": [acc]},
+                attrs={"shape": list(p.shape or []), "dtype": str(p.dtype), "value": 0.0})
+            block.append_op(
+                "model_average_accum",
+                inputs={"Sum": [acc], "Count": [self._count_var.name], "Param": [p.name]},
+                outputs={"SumOut": [acc]},
+                attrs={"max_average_window": self._max_window})
+            self._pairs.append((p, acc))
+        block.append_op(
+            "model_average_count",
+            inputs={"Count": [self._count_var.name]},
+            outputs={"CountOut": [self._count_var.name]},
+            attrs={"max_average_window": self._max_window})
+
+    def apply(self, executor=None, scope=None, need_restore=True):
+        import contextlib
+
+        import numpy as np
+
+        from .core.scope import global_scope
+
+        scope = scope or global_scope()
+        avg = self
+
+        @contextlib.contextmanager
+        def guard():
+            saved = {}
+            n = float(np.asarray(scope.find_var(avg._count_var.name)).reshape(-1)[0])
+            n = max(n, 1.0)
+            for p, acc in avg._pairs:
+                saved[p.name] = scope.find_var(p.name)
+                s = np.asarray(scope.find_var(acc))
+                scope.set_var(p.name, (s / n).astype(s.dtype))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    for k, v in saved.items():
+                        scope.set_var(k, v)
+
+        return guard()
+
+    def restore(self, executor=None):
+        pass
+
+
 class PipelineOptimizer:
     """Program-level pipeline parallelism (reference: optimizer.py:2661
     PipelineOptimizer + SectionWorker).
@@ -655,3 +858,4 @@ Adamax = AdamaxOptimizer
 Adadelta = AdadeltaOptimizer
 Ftrl = FtrlOptimizer
 Lamb = LambOptimizer
+LarsMomentum = LarsMomentumOptimizer

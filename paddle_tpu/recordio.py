@@ -1,0 +1,181 @@
+"""RecordIO: native (C++) chunked CRC-checked record files.
+
+Reference: paddle/fluid/recordio/ (713 LoC C++) + recordio_writer.py.  The
+on-disk work — chunk framing, CRC validation, record splitting — runs in
+native/recordio.cc (built on first use with g++; plain C ABI via ctypes,
+since pybind11 isn't in the image).  Python adds the ndarray serde on top:
+`write_arrays` / `read_arrays` store dtype+shape headers per record so a
+reader pipeline can stream tensors straight out of a file the way the
+reference's create_recordio_file_reader op did.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+
+
+def _native_dir():
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+
+
+def _lib():
+    """Compile-on-first-use (cached .so next to the source)."""
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is not None:
+            return _LIB
+        src = os.path.join(_native_dir(), "recordio.cc")
+        so = os.path.join(_native_dir(), "librecordio.so")
+        if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", so, src],
+                check=True, capture_output=True, text=True)
+        lib = ctypes.CDLL(so)
+        lib.rio_error.restype = ctypes.c_char_p
+        lib.rio_writer_open.restype = ctypes.c_void_p
+        lib.rio_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_uint32]
+        lib.rio_write.restype = ctypes.c_int
+        lib.rio_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+        lib.rio_writer_close.restype = ctypes.c_int
+        lib.rio_writer_close.argtypes = [ctypes.c_void_p]
+        lib.rio_scanner_open.restype = ctypes.c_void_p
+        lib.rio_scanner_open.argtypes = [ctypes.c_char_p]
+        lib.rio_next.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.rio_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint32)]
+        lib.rio_scanner_close.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return lib
+
+
+def _check(cond, lib):
+    if not cond:
+        raise IOError(lib.rio_error().decode() or "recordio: unknown error")
+
+
+class Writer:
+    def __init__(self, path: str, max_chunk_records: int = 1024):
+        lib = _lib()
+        self._lib = lib
+        self._h = lib.rio_writer_open(path.encode(), max_chunk_records)
+        _check(self._h, lib)
+
+    def write(self, data: bytes):
+        rc = self._lib.rio_write(self._h, data, len(data))
+        _check(rc == 0, self._lib)
+
+    def close(self):
+        if self._h:
+            rc = self._lib.rio_writer_close(self._h)
+            self._h = None
+            _check(rc == 0, self._lib)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class Scanner:
+    def __init__(self, path: str):
+        lib = _lib()
+        self._lib = lib
+        self._h = lib.rio_scanner_open(path.encode())
+        _check(self._h, lib)
+
+    def __iter__(self) -> Iterator[bytes]:
+        ln = ctypes.c_uint32()
+        while True:
+            ptr = self._lib.rio_next(self._h, ctypes.byref(ln))
+            if not ptr:
+                err = self._lib.rio_error()
+                if err:
+                    raise IOError(err.decode())
+                return
+            yield ctypes.string_at(ptr, ln.value)
+
+    def close(self):
+        if self._h:
+            self._lib.rio_scanner_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+# --- ndarray serde on top ---------------------------------------------------
+
+def _pack_arrays(arrays: Sequence[np.ndarray]) -> bytes:
+    """One record = one sample = a tuple of ndarrays (slots)."""
+    parts = [struct.pack("<I", len(arrays))]
+    for a in arrays:
+        a = np.ascontiguousarray(a)
+        dt = a.dtype.str.encode()
+        parts.append(struct.pack("<I", len(dt)))
+        parts.append(dt)
+        parts.append(struct.pack("<I", a.ndim))
+        parts.append(struct.pack(f"<{a.ndim}q", *a.shape))
+        raw = a.tobytes()
+        parts.append(struct.pack("<Q", len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def _unpack_arrays(data: bytes) -> List[np.ndarray]:
+    off = 0
+    (n,) = struct.unpack_from("<I", data, off)
+    off += 4
+    out = []
+    for _ in range(n):
+        (dl,) = struct.unpack_from("<I", data, off)
+        off += 4
+        dt = np.dtype(data[off:off + dl].decode())
+        off += dl
+        (nd,) = struct.unpack_from("<I", data, off)
+        off += 4
+        shape = struct.unpack_from(f"<{nd}q", data, off)
+        off += 8 * nd
+        (raw_len,) = struct.unpack_from("<Q", data, off)
+        off += 8
+        out.append(np.frombuffer(data, dt, count=int(np.prod(shape)) if nd else 1,
+                                 offset=off).reshape(shape))
+        off += raw_len
+    return out
+
+
+def write_arrays(path: str, samples, max_chunk_records: int = 1024):
+    """samples: iterable of tuples/lists of ndarrays."""
+    n = 0
+    with Writer(path, max_chunk_records) as w:
+        for sample in samples:
+            if isinstance(sample, np.ndarray):
+                sample = (sample,)
+            w.write(_pack_arrays(sample))
+            n += 1
+    return n
+
+
+def read_arrays(path: str) -> Iterator[List[np.ndarray]]:
+    with Scanner(path) as s:
+        for rec in s:
+            yield _unpack_arrays(rec)
+
+
+def reader_creator(path: str):
+    """Decorator-style reader (reference recordio_writer.py contract)."""
+    def reader():
+        yield from read_arrays(path)
+
+    return reader

@@ -1,0 +1,271 @@
+"""Aux parity batch: flags registry + check_nan_inf, auc/mean_iou metric
+ops, LarsMomentum/EMA/ModelAverage, Predictor, Dataset/train_from_dataset."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import recordio
+
+
+def test_flags_registry_and_env():
+    assert fluid.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] is False
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    assert fluid.flags.flag("FLAGS_check_nan_inf") is True
+    fluid.set_flags({"FLAGS_check_nan_inf": False})
+    with pytest.raises(KeyError):
+        fluid.set_flags({"FLAGS_no_such_flag": 1})
+
+
+def test_check_nan_inf_guard():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [3], dtype="float32")
+        y = fluid.layers.log(x)  # log of a negative -> nan
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    bad = np.array([[1.0, -1.0, 2.0]], "float32")
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(RuntimeError, match="NaN"):
+            exe.run(main, feed={"x": bad}, fetch_list=[y], scope=scope)
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+    exe.run(main, feed={"x": bad}, fetch_list=[y], scope=scope)  # off: no raise
+
+
+def test_auc_layer_streaming():
+    from sklearn_free_auc import ref_auc  # noqa: F401 - defined below
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pred = fluid.layers.data("pred", [2], dtype="float32")
+        label = fluid.layers.data("label", [1], dtype="int64")
+        auc_out = fluid.layers.auc(pred, label, num_thresholds=1023)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    all_p, all_l = [], []
+    v = None
+    for _ in range(3):  # streaming accumulation across batches
+        lab = rng.randint(0, 2, (64, 1)).astype("int64")
+        p1 = np.clip(0.35 * lab[:, 0] + 0.4 * rng.rand(64), 0, 1).astype("float32")
+        pred_v = np.stack([1 - p1, p1], axis=1)
+        (v,) = exe.run(main, feed={"pred": pred_v, "label": lab},
+                       fetch_list=[auc_out], scope=scope)
+        all_p.append(p1)
+        all_l.append(lab[:, 0])
+    got = float(np.asarray(v).reshape(-1)[0])
+    expected = ref_auc(np.concatenate(all_l), np.concatenate(all_p))
+    assert abs(got - expected) < 0.02, (got, expected)
+
+
+def test_mean_iou_layer():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        p = fluid.layers.data("p", [6], dtype="int64")
+        l = fluid.layers.data("l", [6], dtype="int64")
+        iou, wrong, correct = fluid.layers.mean_iou(p, l, num_classes=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    pv = np.array([[0, 0, 1, 1, 2, 2]], "int64")
+    lv = np.array([[0, 1, 1, 1, 2, 0]], "int64")
+    (iv, wv, cv) = exe.run(main, feed={"p": pv, "l": lv},
+                           fetch_list=[iou, wrong, correct], scope=scope)
+    # class0: inter 1, union |pred0|+|lab0|-1 = 2+2-1=3 -> 1/3
+    # class1: inter 2, union 2+3-2=3 -> 2/3 ; class2: inter 1, union 2+1-1=2 -> 1/2
+    np.testing.assert_allclose(float(np.asarray(iv)[0]), (1/3 + 2/3 + 1/2) / 3, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(cv), [1, 2, 1])
+
+
+def test_lars_momentum_step_golden():
+    """Single-step golden for the lars_momentum op (LARS is a large-batch
+    method — convergence on a toy fc is not meaningful, the update rule is)."""
+    from op_test import OpTest
+
+    rng = np.random.RandomState(1)
+    p = rng.rand(6).astype("f4")
+    g = rng.rand(6).astype("f4")
+    v = rng.rand(6).astype("f4")
+    lr = np.array([0.5], "f4")
+    mu, coeff, wd = 0.9, 0.001, 0.0005
+    pn = np.sqrt((p ** 2).sum())
+    gn = np.sqrt((g ** 2).sum())
+    local_lr = 0.5 * coeff * pn / (gn + wd * pn)
+    v_new = mu * v + local_lr * (g + wd * p)
+    p_new = p - v_new
+
+    class T(OpTest):
+        def setUp(self):
+            self.op_type = "lars_momentum"
+            self.inputs = {"Param": p, "Grad": g, "Velocity": v, "LearningRate": lr}
+            self.outputs = {"ParamOut": p_new, "VelocityOut": v_new}
+            self.attrs = {"mu": mu, "lars_coeff": coeff, "lars_weight_decay": wd}
+
+    T().check_output(atol=1e-6)
+
+    # API surface: minimize() emits the op
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.LarsMomentum(20.0, 0.9).minimize(loss)
+    assert "lars_momentum" in [op.type for op in main.global_block().ops]
+
+
+def test_ema_apply_restore():
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 2
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        pred = fluid.layers.fc(x, 1, param_attr=fluid.ParamAttr(name="ema_w"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        ema = fluid.optimizer.ExponentialMovingAverage(0.9)
+        ema.update()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    for _ in range(10):
+        xv = rng.rand(8, 4).astype("f4")
+        exe.run(main, feed={"x": xv, "y": xv.sum(1, keepdims=True)},
+                fetch_list=[loss], scope=scope)
+    live = np.asarray(scope.find_var("ema_w")).copy()
+    with ema.apply(exe, scope):
+        inside = np.asarray(scope.find_var("ema_w")).copy()
+        assert not np.allclose(inside, live)  # shadow differs from live
+    after = np.asarray(scope.find_var("ema_w"))
+    np.testing.assert_array_equal(after, live)  # restored
+
+
+def test_model_average_apply_restore():
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        pred = fluid.layers.fc(x, 1, param_attr=fluid.ParamAttr(name="avg_w"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.2).minimize(loss)
+        ma = fluid.optimizer.ModelAverage()
+        ma.update()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    snaps = []
+    for _ in range(5):
+        xv = rng.rand(8, 4).astype("f4")
+        exe.run(main, feed={"x": xv, "y": xv.sum(1, keepdims=True)},
+                fetch_list=[loss], scope=scope)
+        snaps.append(np.asarray(scope.find_var("avg_w")).copy())
+    with ma.apply(exe, scope):
+        avg = np.asarray(scope.find_var("avg_w"))
+        np.testing.assert_allclose(avg, np.mean(snaps, axis=0), atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(scope.find_var("avg_w")), snaps[-1])
+
+
+def test_predictor_roundtrip(tmp_path):
+    from paddle_tpu.inference import PredictConfig, create_predictor
+
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 4
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6], dtype="float32")
+        h = fluid.layers.fc(x, 8, act="relu")
+        out = fluid.layers.fc(h, 3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    xv = np.random.RandomState(0).rand(4, 6).astype("f4")
+    (ref,) = exe.run(main, feed={"x": xv}, fetch_list=[out], scope=scope)
+
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [out], exe, main_program=main, scope=scope)
+    pred = create_predictor(PredictConfig(d, fluid.CPUPlace()))
+    (got,) = pred.run({"x": xv})
+    np.testing.assert_allclose(got, ref, atol=1e-6)
+    clone = pred.clone()
+    (got2,) = clone.run({"x": xv})
+    np.testing.assert_allclose(got2, ref, atol=1e-6)
+    with pytest.raises(KeyError):
+        pred.run({})
+
+
+def test_dataset_train_from_dataset(tmp_path):
+    # write two recordio shards with (feature, label) samples
+    rng = np.random.RandomState(0)
+    w_true = rng.rand(5, 1).astype("f4")
+    files = []
+    for shard in range(2):
+        p = str(tmp_path / f"part-{shard}.rio")
+        samples = []
+        for _ in range(40):
+            f = rng.rand(5).astype("f4")
+            samples.append((f, (f @ w_true).astype("f4")))
+        recordio.write_arrays(p, samples)
+        files.append(p)
+
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [5], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    ds = fluid.InMemoryDataset()
+    ds.set_batch_size(8)
+    ds.set_thread(2)
+    ds.set_filelist(files)
+    ds.set_use_var([x, y])
+    ds.load_into_memory()
+    ds.local_shuffle(seed=0)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    logs = exe.train_from_dataset(main, ds, scope=scope, fetch_list=[loss],
+                                  print_period=1)
+    first = float(list(logs[0][1].values())[0][0])
+    last = float(list(logs[-1][1].values())[0][0])
+    assert last < first, (first, last)
+
+    # queue mode streams the same sample count
+    qd = fluid.QueueDataset()
+    qd.set_batch_size(8)
+    qd.set_filelist(files)
+    qd.set_use_var([x, y])
+    n = sum(1 for _ in qd.batches())
+    assert n == 10  # 80 samples / 8
+
+
+# tiny dependency-free reference AUC
+import sys
+
+
+def _ref_auc(labels, scores):
+    order = np.argsort(-scores)
+    labels = labels[order]
+    tp = np.cumsum(labels)
+    fp = np.cumsum(1 - labels)
+    tp = np.concatenate([[0], tp])
+    fp = np.concatenate([[0], fp])
+    if tp[-1] == 0 or fp[-1] == 0:
+        return 0.0
+    return float(np.trapz(tp, fp) / (tp[-1] * fp[-1]))
+
+
+class _M:
+    ref_auc = staticmethod(_ref_auc)
+
+
+sys.modules["sklearn_free_auc"] = _M()
