@@ -71,6 +71,7 @@ struct Writer {
 
 struct Scanner {
   FILE* f = nullptr;
+  long file_size = 0;
   std::vector<uint8_t> chunk;
   size_t pos = 0;
   uint32_t remaining = 0;
@@ -86,6 +87,13 @@ struct Scanner {
     if (fread(&n, 4, 1, f) != 1 || fread(&len, 8, 1, f) != 1 ||
         fread(&crc, 4, 1, f) != 1) {
       g_error = "recordio: truncated chunk header";
+      return false;
+    }
+    // a corrupt len must fail via rio_error, not via a std::bad_alloc
+    // escaping the C ABI (CRC can't validate it — it's read before payload)
+    long here = ftell(f);
+    if (here < 0 || len > static_cast<uint64_t>(file_size - here)) {
+      g_error = "recordio: chunk length exceeds file size (corrupt header)";
       return false;
     }
     chunk.resize(len);
@@ -150,6 +158,9 @@ void* rio_scanner_open(const char* path) {
   }
   Scanner* s = new Scanner();
   s->f = f;
+  fseek(f, 0, SEEK_END);
+  s->file_size = ftell(f);
+  fseek(f, 0, SEEK_SET);
   return s;
 }
 

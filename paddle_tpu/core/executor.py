@@ -73,7 +73,8 @@ def _lowering_flags():
     reuse stale executables."""
     from ..ops import nn_ops
 
-    return ("nhwc", nn_ops._NHWC_LOWERING)
+    return ("nhwc", nn_ops._NHWC_LOWERING, "bn1p", nn_ops._BN_SINGLE_PASS,
+            "bnbf16", nn_ops._BN_BF16_COMPUTE)
 
 
 class _CompiledStep:

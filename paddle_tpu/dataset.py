@@ -81,10 +81,18 @@ class QueueDataset(DatasetBase):
         q: "queue.Queue" = queue.Queue(maxsize=4096)
         DONE = object()
         failure: list = []
+        stop = threading.Event()  # set when the consumer abandons the iterator
 
         def parse(path):
             for sample in recordio.read_arrays(path):
-                q.put(sample)
+                while not stop.is_set():
+                    try:
+                        q.put(sample, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
 
         def producer():
             try:
@@ -93,17 +101,26 @@ class QueueDataset(DatasetBase):
             except BaseException as e:  # surface parse errors to the consumer
                 failure.append(e)
             finally:
-                q.put(DONE)
+                # deliver DONE unless the consumer already walked away
+                while not stop.is_set():
+                    try:
+                        q.put(DONE, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is DONE:
-                if failure:
-                    raise failure[0]
-                return
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is DONE:
+                    if failure:
+                        raise failure[0]
+                    return
+                yield item
+        finally:
+            stop.set()  # early exit from batches(): release producer threads
 
 
 class InMemoryDataset(DatasetBase):

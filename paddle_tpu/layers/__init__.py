@@ -28,6 +28,7 @@ from .metric import accuracy, auc, mean_iou  # noqa: F401
 from .nn import *  # noqa: F401,F403
 from .sequence import (  # noqa: F401
     DynamicRNN,
+    StaticRNN,
     dynamic_gru,
     dynamic_lstm,
     attention_bias,
@@ -47,6 +48,7 @@ from .sequence import (  # noqa: F401
     sequence_slice,
     sequence_softmax,
     sequence_unpad,
+    warpctc,
 )
 from .tensor import (  # noqa: F401
     argmax,

@@ -688,3 +688,67 @@ def ring_attention(q, k, v, causal=False, sp_axis="sp", batch_axis="dp", name=No
 def dropout_prob_check(p):
     if not 0 <= p < 1:
         raise ValueError("dropout prob must be in [0,1)")
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None, align_corners=True):
+    """reference nn.py resize_bilinear over bilinear_interp_op."""
+    helper = LayerHelper("bilinear_interp", name=name)
+    attrs = {"align_corners": align_corners}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), int(out_shape[1])
+        oshape = None
+        if input.shape is not None:
+            oshape = (input.shape[0], input.shape[1], attrs["out_h"], attrs["out_w"])
+    else:
+        attrs["scale"] = float(scale)
+        oshape = None
+    out = _out(helper, input.dtype, shape=oshape)
+    helper.append_op("bilinear_interp", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]}, attrs=attrs)
+    return out
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None, align_corners=True):
+    helper = LayerHelper("nearest_interp", name=name)
+    attrs = {"align_corners": align_corners}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), int(out_shape[1])
+    else:
+        attrs["scale"] = float(scale)
+    out = _out(helper, input.dtype)
+    helper.append_op("nearest_interp", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]}, attrs=attrs)
+    return out
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0, name=None):
+    helper = LayerHelper("pad2d", name=name)
+    out = _out(helper, input.dtype)
+    helper.append_op("pad2d", inputs={"X": [input.name]}, outputs={"Out": [out.name]},
+                     attrs={"paddings": list(paddings), "mode": mode,
+                            "pad_value": pad_value})
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    if shape is None:
+        raise ValueError("crop: `shape` is required (static output extents)")
+    helper = LayerHelper("crop", name=name)
+    out = _out(helper, x.dtype, shape=tuple(shape) if shape else None)
+    helper.append_op("crop", inputs={"X": [x.name]}, outputs={"Out": [out.name]},
+                     attrs={"offsets": list(offsets or [0] * len(shape)),
+                            "shape": list(shape)})
+    return out
+
+
+def Print(input, first_n=-1, message=None, summarize=-1, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """reference layers.Print (print_op.cc): identity that prints at
+    execution (host callback through jax.debug.print)."""
+    helper = LayerHelper("print")
+    out = _out(helper, input.dtype, shape=input.shape)
+    msg = message or f"{input.name}: " if print_tensor_name else (message or "")
+    helper.append_op("print", inputs={"X": [input.name]}, outputs={"Out": [out.name]},
+                     attrs={"message": msg, "first_n": first_n})
+    return out

@@ -300,6 +300,7 @@ class DynamicRNN:
         self._lod = None
         self._sub_block = None
         self.is_reverse = is_reverse
+        self._allow_dense = False
 
     def block(self):
         return _DRNNGuard(self)
@@ -310,7 +311,7 @@ class DynamicRNN:
 
     def step_input(self, x):
         self._require_in_block()
-        lod = _lod_of(x)
+        lod = getattr(x, "_lod_ref", None) if self._allow_dense else _lod_of(x)
         if self._lod is None:
             self._lod = lod
         shape = None
@@ -383,13 +384,15 @@ class DynamicRNN:
             )
             final_mems.append(fv)
         inits = [m["init"] for m in self._mems if m["init"] is not None]
+        rnn_inputs = {
+            "X": [src.name for src, _ in self._steps],
+            "MemInit": [v.name for v in inits],
+        }
+        if self._lod is not None:
+            rnn_inputs["XLod"] = [self._lod.name]
         parent_block.append_op(
             "dynamic_rnn",
-            inputs={
-                "X": [src.name for src, _ in self._steps],
-                "XLod": [self._lod.name],
-                "MemInit": [v.name for v in inits],
-            },
+            inputs=rnn_inputs,
             outputs={
                 "Out": [v.name for v in out_vars],
                 "FinalMem": [v.name for v in final_mems],
@@ -407,8 +410,9 @@ class DynamicRNN:
                 "is_reverse": self.is_reverse,
             },
         )
-        for ov in out_vars:
-            _set_lod(ov, self._lod)
+        if self._lod is not None:
+            for ov in out_vars:
+                _set_lod(ov, self._lod)
         self._out_vars = out_vars
         self._final_mems = final_mems
 
@@ -499,3 +503,32 @@ def dynamic_gru(input, size, param_attr=None, bias_attr=None, is_reverse=False,
         attrs={"is_reverse": is_reverse, "origin_mode": origin_mode},
     )
     return _set_lod(out, lod)
+
+
+def warpctc(input, label, blank=0, norm_by_times=False):
+    """CTC loss over ragged logits/labels (reference layers/nn.py warpctc).
+    `input`: ragged [*, C] unnormalized logits; `label`: ragged [*, 1] int
+    targets.  Returns [b, 1] per-sequence loss."""
+    helper = LayerHelper("warpctc")
+    in_lod = _lod_of(input)
+    lbl_lod = _lod_of(label)
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "warpctc",
+        inputs={"Logits": [input.name], "XLod": [in_lod.name],
+                "Label": [label.name], "LabelLod": [lbl_lod.name]},
+        outputs={"Loss": [out.name]},
+        attrs={"blank": blank, "norm_by_times": norm_by_times},
+    )
+    return out
+
+
+class StaticRNN(DynamicRNN):
+    """Fixed-length RNN over dense [b, T, f] inputs (reference
+    layers/control_flow.py:278 StaticRNN — per-step sub-block, no length
+    sorting).  Same with-block API as DynamicRNN; every row runs the full
+    padded length (lengths companion optional)."""
+
+    def __init__(self, name=None):
+        super().__init__(name=name)
+        self._allow_dense = True

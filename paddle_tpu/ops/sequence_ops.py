@@ -304,7 +304,8 @@ def _dynamic_rnn(ctx, op, ins):
 
     sub_block = op.block.program.blocks[op.attr("sub_block")]
     xs = ins.get("X", [])
-    lens = first(ins, "XLod")
+    # StaticRNN path: no lengths companion means every row runs full length
+    lens = ins["XLod"][0] if ins.get("XLod") else None
     inits = list(ins.get("MemInit", []))
     step_names = op.attr("step_vars")
     mem_names = op.attr("mem_vars")
@@ -317,6 +318,8 @@ def _dynamic_rnn(ctx, op, ins):
     is_reverse = op.attr("is_reverse", False)
 
     b, T = xs[0].shape[0], xs[0].shape[1]
+    if lens is None:
+        lens = jnp.full((b,), T, dtype=jnp.int32)
     from ..core.dtypes import as_np_dtype
 
     carries = []
@@ -479,3 +482,71 @@ def _dynamic_gru(ctx, op, ins):
     if is_reverse:
         hs = jnp.flip(hs, axis=0)
     return {"Hidden": jnp.moveaxis(hs, 0, 1)}
+
+
+@register_op("warpctc")
+def _warpctc(ctx, op, ins):
+    """CTC loss (reference warpctc_op.cc wrapping the warp-ctc library).
+
+    TPU-first: the standard log-alpha forward recursion over the extended
+    label sequence (2L+1 states) as one lax.scan over time — static shapes
+    via padding + masks, gradients via jax autodiff through the scan (the
+    reference needed warp-ctc's hand-written backward).
+
+    Inputs: Logits [b, T, C] padded (+XLod lens), Label [b, L] padded
+    (+LabelLod lens).  blank index attr.  Loss: [b, 1] negative log-lik."""
+    logits = first(ins, "Logits")
+    logit_lens = first(ins, "XLod")
+    labels = first(ins, "Label").astype(jnp.int32)
+    if labels.ndim == 3 and labels.shape[-1] == 1:
+        labels = labels[..., 0]  # ragged [b, L, 1] feed -> [b, L]
+    label_lens = first(ins, "LabelLod")
+    blank = op.attr("blank", 0)
+    norm_by_times = op.attr("norm_by_times", False)
+
+    b, T, C = logits.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    # extended sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((b, S), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    # allowed skip transition s-2 -> s: only into a non-blank that differs
+    # from the previous non-blank
+    skip_ok = jnp.zeros((b, S), dtype=bool)
+    if L > 1:
+        diff = labels[:, 1:] != labels[:, :-1]
+        skip_ok = skip_ok.at[:, 3::2].set(diff)
+
+    NEG = jnp.float32(-1e30)
+    alpha0 = jnp.full((b, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+    if L > 0:  # S == 1 when every label is empty; index 1 would clip to 0
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.take_along_axis(logp[:, 0, :], ext[:, 1:2], axis=1)[:, 0])
+
+    def step(alpha, t):
+        from_self = alpha
+        from_prev = jnp.concatenate([jnp.full((b, 1), NEG), alpha[:, :-1]], axis=1)
+        from_skip = jnp.concatenate([jnp.full((b, 2), NEG), alpha[:, :-2]], axis=1)
+        from_skip = jnp.where(skip_ok, from_skip, NEG)
+        merged = jnp.logaddexp(jnp.logaddexp(from_self, from_prev), from_skip)
+        emit = jnp.take_along_axis(logp[:, t, :], ext, axis=1)  # [b, S]
+        new = merged + emit
+        # frozen past each row's logit length
+        active = (t < logit_lens).reshape(b, 1)
+        return jnp.where(active, new, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    # final states: ext positions 2*label_len (final blank) and 2*label_len-1
+    idx_last = (2 * label_lens).astype(jnp.int32)
+    a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha, jnp.maximum(idx_last - 1, 0)[:, None], axis=1)[:, 0]
+    # empty-label rows have only the all-blank state; logaddexp of the same
+    # state twice would inflate the likelihood by ln(2)
+    loglik = jnp.where(label_lens > 0, jnp.logaddexp(a_last, a_prev), a_last)
+    loss = -loglik
+    if norm_by_times:
+        loss = loss / jnp.maximum(logit_lens.astype(jnp.float32), 1.0)
+    return {"Loss": loss.reshape(b, 1)}

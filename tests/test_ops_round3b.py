@@ -1,0 +1,188 @@
+"""Round-3b op batch: interpolate, pad2d, crop, Print, StaticRNN, warpctc."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.lod import LoDTensor
+
+from op_test import OpTest
+
+
+def test_nearest_interp_golden():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    # exact 2x nearest upsample (align_corners=False): index floor(i/2)
+    expected = x.repeat(2, axis=2).repeat(2, axis=3)
+
+    class T(OpTest):
+        def setUp(self):
+            self.op_type = "nearest_interp"
+            self.inputs = {"X": x}
+            self.outputs = {"Out": expected}
+            self.attrs = {"out_h": 8, "out_w": 8, "align_corners": False}
+
+    T().check_output()
+
+
+def test_bilinear_interp_matches_numpy():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 5, 7).astype("float32")
+    out_h, out_w = 9, 11
+
+    def ref(x, oh, ow):  # align_corners=True bilinear
+        n, c, h, w = x.shape
+        ys = np.linspace(0, h - 1, oh)
+        xs = np.linspace(0, w - 1, ow)
+        y0 = np.clip(np.floor(ys).astype(int), 0, h - 1)
+        y1 = np.clip(y0 + 1, 0, h - 1)
+        x0 = np.clip(np.floor(xs).astype(int), 0, w - 1)
+        x1 = np.clip(x0 + 1, 0, w - 1)
+        wy = (ys - y0).reshape(1, 1, oh, 1)
+        wx = (xs - x0).reshape(1, 1, 1, ow)
+        g00 = x[:, :, y0][:, :, :, x0]
+        g01 = x[:, :, y0][:, :, :, x1]
+        g10 = x[:, :, y1][:, :, :, x0]
+        g11 = x[:, :, y1][:, :, :, x1]
+        return (g00 * (1 - wx) + g01 * wx) * (1 - wy) + (g10 * (1 - wx) + g11 * wx) * wy
+
+    class T(OpTest):
+        def setUp(self):
+            self.op_type = "bilinear_interp"
+            self.inputs = {"X": x}
+            self.outputs = {"Out": ref(x, out_h, out_w).astype("float32")}
+            self.attrs = {"out_h": out_h, "out_w": out_w, "align_corners": True}
+
+    T().check_output(atol=1e-5)
+
+
+def test_pad2d_modes():
+    x = np.arange(12, dtype="float32").reshape(1, 1, 3, 4)
+    for mode, np_mode in (("constant", "constant"), ("reflect", "reflect"), ("edge", "edge")):
+        kw = {"constant_values": 2.5} if mode == "constant" else {}
+        expected = np.pad(x, ((0, 0), (0, 0), (1, 2), (2, 1)), mode=np_mode, **kw)
+
+        class T(OpTest):
+            def setUp(self):
+                self.op_type = "pad2d"
+                self.inputs = {"X": x}
+                self.outputs = {"Out": expected}
+                self.attrs = {"paddings": [1, 2, 2, 1], "mode": mode, "pad_value": 2.5}
+
+        T().check_output()
+
+
+def test_crop_golden():
+    x = np.arange(60, dtype="float32").reshape(3, 4, 5)
+
+    class T(OpTest):
+        def setUp(self):
+            self.op_type = "crop"
+            self.inputs = {"X": x}
+            self.outputs = {"Out": x[1:3, 0:2, 2:5]}
+            self.attrs = {"offsets": [1, 0, 2], "shape": [2, 2, 3]}
+
+    T().check_output()
+
+
+def test_print_layer_passthrough(capfd):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [3], dtype="float32")
+        y = fluid.layers.Print(x, message="dbg: ")
+        z = y * 2.0
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    xv = np.ones((2, 3), "float32")
+    (zv,) = exe.run(main, feed={"x": xv}, fetch_list=[z], scope=scope)
+    np.testing.assert_allclose(zv, xv * 2)
+
+
+def test_static_rnn_cumsum():
+    """StaticRNN over a dense [b, T, f] input: running sum memory."""
+    rng = np.random.RandomState(0)
+    xv = rng.rand(3, 5, 4).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [5, 4], dtype="float32")
+        rnn = fluid.layers.StaticRNN()
+        with rnn.block():
+            step = rnn.step_input(x)
+            acc = rnn.memory(shape=[4], value=0.0)
+            new = acc + step
+            rnn.update_memory(acc, new)
+            rnn.output(new)
+        out = rnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    (ov,) = exe.run(main, feed={"x": xv}, fetch_list=[out], scope=scope)
+    np.testing.assert_allclose(np.asarray(ov), np.cumsum(xv, axis=1), atol=1e-5)
+
+
+def _np_ctc_loss(logits, labels, blank=0):
+    """Brute-force CTC by enumerating alignments (tiny T only)."""
+    import itertools
+
+    T, C = logits.shape
+    m = logits.max(-1, keepdims=True)
+    logp = logits - m - np.log(np.exp(logits - m).sum(-1, keepdims=True))
+    total = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        # collapse: remove repeats then blanks
+        collapsed = []
+        prev = None
+        for s in path:
+            if s != prev:
+                collapsed.append(s)
+            prev = s
+        collapsed = [s for s in collapsed if s != blank]
+        if collapsed == list(labels):
+            lp = sum(logp[t, path[t]] for t in range(T))
+            total = np.logaddexp(total, lp)
+    return -total
+
+
+def test_warpctc_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    T, C = 4, 3  # blank + 2 symbols; 3^4 = 81 paths to enumerate
+    rows = [rng.randn(T, C).astype("f4"), rng.randn(3, C).astype("f4")]
+    lbls = [np.array([[1], [2]], "int64"), np.array([[2]], "int64")]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        logits = fluid.layers.data("logits", [C], dtype="float32", lod_level=1)
+        label = fluid.layers.data("label", [1], dtype="int64", lod_level=1)
+        loss = fluid.layers.warpctc(logits, label)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    (lv,) = exe.run(main, feed={"logits": LoDTensor(rows), "label": LoDTensor(lbls)},
+                    fetch_list=[loss], scope=scope)
+    lv = np.asarray(lv).reshape(-1)
+    for i, (row, lab) in enumerate(zip(rows, lbls)):
+        ref = _np_ctc_loss(row, lab[:, 0].tolist())
+        np.testing.assert_allclose(lv[i], ref, rtol=1e-4, atol=1e-4)
+
+
+def test_warpctc_trainable():
+    """CTC loss decreases when training toward a fixed target."""
+    rng = np.random.RandomState(1)
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6], dtype="float32", lod_level=1)
+        label = fluid.layers.data("label", [1], dtype="int64", lod_level=1)
+        proj = fluid.layers.fc(x, 5, num_flatten_dims=2)
+        loss = fluid.layers.mean(fluid.layers.warpctc(proj, label))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rows = [rng.rand(6, 6).astype("f4") for _ in range(4)]
+    lbls = [np.array([[1], [3]], "int64") for _ in range(4)]
+    losses = []
+    for _ in range(30):
+        (lv,) = exe.run(main, feed={"x": LoDTensor(rows), "label": LoDTensor(lbls)},
+                        fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
