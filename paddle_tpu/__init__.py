@@ -40,6 +40,8 @@ from . import dataset  # noqa: F401
 from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
 from . import inference  # noqa: F401
 from . import recordio  # noqa: F401
+from . import datasets  # noqa: F401
+from . import nets  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import metrics  # noqa: F401
 from . import profiler  # noqa: F401

@@ -25,6 +25,13 @@ from .learning_rate_scheduler import (  # noqa: F401
     polynomial_decay,
 )
 from .metric import accuracy, auc, mean_iou  # noqa: F401
+from .detection import (  # noqa: F401
+    box_coder,
+    iou_similarity,
+    multiclass_nms,
+    prior_box,
+    yolo_box,
+)
 from .nn import *  # noqa: F401,F403
 from .sequence import (  # noqa: F401
     DynamicRNN,

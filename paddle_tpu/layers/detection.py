@@ -1,0 +1,90 @@
+"""Detection layers (reference: python/paddle/fluid/layers/detection.py)."""
+from __future__ import annotations
+
+from ..core.layer_helper import LayerHelper
+
+
+def _out(helper, dtype, shape=None):
+    return helper.create_variable_for_type_inference(dtype, shape=shape)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    helper = LayerHelper("prior_box", name=name)
+    boxes = _out(helper, "float32")
+    variances = _out(helper, "float32")
+    helper.append_op(
+        "prior_box",
+        inputs={"Input": [input.name], "Image": [image.name]},
+        outputs={"Boxes": [boxes.name], "Variances": [variances.name]},
+        attrs={"min_sizes": list(min_sizes),
+               "max_sizes": list(max_sizes or []),
+               "aspect_ratios": list(aspect_ratios),
+               "variances": list(variance), "flip": flip, "clip": clip,
+               "step_w": float(steps[0]), "step_h": float(steps[1]),
+               "offset": offset,
+               "min_max_aspect_ratios_order": min_max_aspect_ratios_order},
+    )
+    return boxes, variances
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    helper = LayerHelper("iou_similarity", name=name)
+    out = _out(helper, x.dtype)
+    helper.append_op("iou_similarity", inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"box_normalized": box_normalized})
+    return out
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, name=None,
+              axis=0):
+    helper = LayerHelper("box_coder", name=name)
+    out = _out(helper, target_box.dtype)
+    inputs = {"PriorBox": [prior_box.name], "TargetBox": [target_box.name]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var.name]
+    helper.append_op("box_coder", inputs=inputs,
+                     outputs={"OutputBox": [out.name]},
+                     attrs={"code_type": code_type,
+                            "box_normalized": box_normalized, "axis": axis})
+    return out
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, name=None):
+    helper = LayerHelper("yolo_box", name=name)
+    boxes = _out(helper, x.dtype)
+    scores = _out(helper, x.dtype)
+    helper.append_op(
+        "yolo_box",
+        inputs={"X": [x.name], "ImgSize": [img_size.name]},
+        outputs={"Boxes": [boxes.name], "Scores": [scores.name]},
+        attrs={"anchors": list(anchors), "class_num": class_num,
+               "conf_thresh": conf_thresh, "downsample_ratio": downsample_ratio},
+    )
+    return boxes, scores
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=64,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, name=None):
+    """Static-shape NMS: [N, keep_top_k, 6] with label -1 padding (the
+    reference's LoD-shaped variable output is incompatible with XLA)."""
+    if nms_eta != 1.0:
+        raise NotImplementedError("multiclass_nms: adaptive NMS (nms_eta != 1) "
+                                  "is not implemented")
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = _out(helper, bboxes.dtype)
+    helper.append_op(
+        "multiclass_nms",
+        inputs={"BBoxes": [bboxes.name], "Scores": [scores.name]},
+        outputs={"Out": [out.name]},
+        attrs={"score_threshold": score_threshold, "nms_top_k": nms_top_k,
+               "keep_top_k": keep_top_k, "nms_threshold": nms_threshold,
+               "background_label": background_label, "normalized": normalized},
+    )
+    return out

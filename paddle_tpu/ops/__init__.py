@@ -1,6 +1,7 @@
 """Importing this package registers all op lowerings."""
 from . import (  # noqa: F401
     control_flow_ops,
+    detection_ops,
     math_ops,
     nn_ops,
     optimizer_ops,

@@ -1,0 +1,262 @@
+"""Detection op family (reference: operators/detection/, 15.3k LoC CUDA/C++).
+
+TPU-first subset of the most-used ops: SSD anchors (prior_box), box
+encode/decode (box_coder), IoU (iou_similarity), YOLOv3 head decode
+(yolo_box), and a STATIC-SHAPE multiclass NMS — the reference emits
+LoD-shaped variable-length detections (multiclass_nms_op.cc); XLA wants
+fixed shapes, so nms returns a padded [keep_top_k, 6] block per image with
+label -1 in empty slots, the standard accelerator-native formulation.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .common import first
+
+
+@register_op("prior_box")
+def _prior_box(ctx, op, ins):
+    """reference detection/prior_box_op.h (loop at :100): SSD anchors per
+    feature-map cell.  Everything is static (shapes+attrs), so the boxes
+    are computed in numpy at trace time and constant-folded by XLA."""
+    feat = first(ins, "Input")    # [N, C, H, W]
+    image = first(ins, "Image")   # [N, C, IH, IW]
+    H, W = feat.shape[2], feat.shape[3]
+    IH, IW = image.shape[2], image.shape[3]
+    min_sizes = list(op.attr("min_sizes"))
+    max_sizes = list(op.attr("max_sizes", []) or [])
+    input_ars = list(op.attr("aspect_ratios", [1.0]))
+    variances = list(op.attr("variances", [0.1, 0.1, 0.2, 0.2]))
+    flip = op.attr("flip", False)
+    clip = op.attr("clip", False)
+    step_w = op.attr("step_w", 0.0) or IW / W
+    step_h = op.attr("step_h", 0.0) or IH / H
+    offset = op.attr("offset", 0.5)
+    mmar_order = op.attr("min_max_aspect_ratios_order", False)
+
+    ars = [1.0]
+    for ar in input_ars:
+        if any(abs(ar - a) < 1e-6 for a in ars):
+            continue
+        ars.append(ar)
+        if flip:
+            ars.append(1.0 / ar)
+
+    boxes = []
+    for h in range(H):
+        for w in range(W):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            cell = []
+
+            def emit(bw, bh):
+                cell.append([(cx - bw) / IW, (cy - bh) / IH,
+                             (cx + bw) / IW, (cy + bh) / IH])
+
+            for s, ms in enumerate(min_sizes):
+                if mmar_order:
+                    emit(ms / 2.0, ms / 2.0)
+                    if max_sizes:
+                        sq = math.sqrt(ms * max_sizes[s]) / 2.0
+                        emit(sq, sq)
+                    for ar in ars:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        emit(ms * math.sqrt(ar) / 2.0, ms / math.sqrt(ar) / 2.0)
+                else:
+                    for ar in ars:
+                        emit(ms * math.sqrt(ar) / 2.0, ms / math.sqrt(ar) / 2.0)
+                    if max_sizes:
+                        sq = math.sqrt(ms * max_sizes[s]) / 2.0
+                        emit(sq, sq)
+            boxes.append(cell)
+    num_priors = len(boxes[0])
+    out = np.asarray(boxes, dtype=np.float32).reshape(H, W, num_priors, 4)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.tile(np.asarray(variances, np.float32), (H, W, num_priors, 1))
+    return {"Boxes": jnp.asarray(out), "Variances": jnp.asarray(var)}
+
+
+@register_op("iou_similarity")
+def _iou_similarity(ctx, op, ins):
+    """reference detection/iou_similarity_op.h: pairwise IoU [N,4]x[M,4]."""
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    norm = op.attr("box_normalized", True)
+    one = 0.0 if norm else 1.0
+    ax = (x[:, 2] - x[:, 0] + one) * (x[:, 3] - x[:, 1] + one)
+    ay = (y[:, 2] - y[:, 0] + one) * (y[:, 3] - y[:, 1] + one)
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.maximum(rb - lt + one, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = ax[:, None] + ay[None, :] - inter
+    return {"Out": jnp.where(union > 0, inter / union, 0.0)}
+
+
+def _decode_center_size(prior, prior_var, target, norm, axis=0):
+    pw = prior[:, 2] - prior[:, 0] + (0.0 if norm else 1.0)
+    ph = prior[:, 3] - prior[:, 1] + (0.0 if norm else 1.0)
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    # target [N, M?, 4] broadcasting over priors on `axis`
+    tcx = target[..., 0] * prior_var[:, 0] * pw + pcx
+    tcy = target[..., 1] * prior_var[:, 1] * ph + pcy
+    tw = jnp.exp(prior_var[:, 2] * target[..., 2]) * pw
+    th = jnp.exp(prior_var[:, 3] * target[..., 3]) * ph
+    return jnp.stack([tcx - tw / 2, tcy - th / 2,
+                      tcx + tw / 2 - (0.0 if norm else 1.0),
+                      tcy + th / 2 - (0.0 if norm else 1.0)], axis=-1)
+
+
+@register_op("box_coder")
+def _box_coder(ctx, op, ins):
+    """reference detection/box_coder_op.h: encode/decode center-size."""
+    prior = first(ins, "PriorBox")       # [N, 4]
+    pvar = ins.get("PriorBoxVar")
+    target = first(ins, "TargetBox")
+    code_type = op.attr("code_type", "encode_center_size")
+    norm = op.attr("box_normalized", True)
+    if pvar:
+        prior_var = pvar[0]
+    else:
+        prior_var = jnp.ones((prior.shape[0], 4), prior.dtype)
+    one = 0.0 if norm else 1.0
+    pw = prior[:, 2] - prior[:, 0] + one
+    ph = prior[:, 3] - prior[:, 1] + one
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if code_type.startswith("encode"):
+        # target [M, 4] vs priors [N, 4] -> [M, N, 4]
+        tw = target[:, 2] - target[:, 0] + one
+        th = target[:, 3] - target[:, 1] + one
+        tcx = target[:, 0] + tw * 0.5
+        tcy = target[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :] / prior_var[None, :, 0]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / prior_var[None, :, 1]
+        dw = jnp.log(tw[:, None] / pw[None, :]) / prior_var[None, :, 2]
+        dh = jnp.log(th[:, None] / ph[None, :]) / prior_var[None, :, 3]
+        return {"OutputBox": jnp.stack([dx, dy, dw, dh], axis=-1)}
+    # decode: target [N, 4] deltas against priors [N, 4]
+    if target.ndim != 2 or op.attr("axis", 0) != 0:
+        raise NotImplementedError(
+            "box_coder decode: only 2-D targets with axis=0 are supported "
+            "(rank-3 score-ranked decode is not implemented)")
+    return {"OutputBox": _decode_center_size(prior, prior_var, target, norm)}
+
+
+@register_op("yolo_box")
+def _yolo_box(ctx, op, ins):
+    """reference detection/yolo_box_op.h: decode a YOLOv3 head."""
+    x = first(ins, "X")               # [N, A*(5+C), H, W]
+    img_size = first(ins, "ImgSize")  # [N, 2] (h, w)
+    anchors = list(op.attr("anchors"))
+    class_num = op.attr("class_num")
+    conf_thresh = op.attr("conf_thresh", 0.01)
+    downsample = op.attr("downsample_ratio", 32)
+    A = len(anchors) // 2
+    N, _, H, W = x.shape
+    x = x.reshape(N, A, 5 + class_num, H, W)
+    grid_x = jnp.arange(W).reshape(1, 1, 1, W)
+    grid_y = jnp.arange(H).reshape(1, 1, H, 1)
+    bx = (jax.nn.sigmoid(x[:, :, 0]) + grid_x) / W
+    by = (jax.nn.sigmoid(x[:, :, 1]) + grid_y) / H
+    aw = jnp.asarray(anchors[0::2], x.dtype).reshape(1, A, 1, 1)
+    ah = jnp.asarray(anchors[1::2], x.dtype).reshape(1, A, 1, 1)
+    input_w = downsample * W
+    input_h = downsample * H
+    bw = jnp.exp(x[:, :, 2]) * aw / input_w
+    bh = jnp.exp(x[:, :, 3]) * ah / input_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    # below-threshold detections are zeroed (reference sets score 0)
+    probs = jnp.where(conf[:, :, None] >= conf_thresh, probs, 0.0)
+    imgh = img_size[:, 0].reshape(N, 1, 1, 1).astype(x.dtype)
+    imgw = img_size[:, 1].reshape(N, 1, 1, 1).astype(x.dtype)
+    x0 = (bx - bw / 2) * imgw
+    y0 = (by - bh / 2) * imgh
+    x1 = (bx + bw / 2) * imgw
+    y1 = (by + bh / 2) * imgh
+    boxes = jnp.stack([x0, y0, x1, y1], axis=-1).reshape(N, A * H * W, 4)
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(N, A * H * W, class_num)
+    return {"Boxes": boxes, "Scores": scores}
+
+
+def _nms_single_class(boxes, scores, iou_threshold, top_k, normalized=True):
+    """Static-shape greedy NMS over the top_k candidates only (reference
+    multiclass_nms pre-selects nms_top_k before suppression — also keeps
+    the IoU matrix at O(top_k^2) instead of O(M^2))."""
+    n = min(top_k, boxes.shape[0])
+    k = n
+    order = jnp.argsort(-scores)[:n]
+    b = boxes[order]
+    s = scores[order]
+    one = 0.0 if normalized else 1.0
+    lt = jnp.maximum(b[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(b[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt + one, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area = jnp.maximum((b[:, 2] - b[:, 0] + one) * (b[:, 3] - b[:, 1] + one), 0.0)
+    union = area[:, None] + area[None, :] - inter
+    iou = jnp.where(union > 0, inter / union, 0.0)
+
+    def body(i, keep):
+        # suppressed if any higher-ranked KEPT box overlaps too much
+        mask = (jnp.arange(n) < i) & keep & (iou[i] > iou_threshold)
+        return keep.at[i].set(~jnp.any(mask))
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    kept_scores = jnp.where(keep, s, -1.0)
+    sel = jnp.argsort(-kept_scores)[:k]
+    valid = kept_scores[sel] > 0
+    return b[sel], jnp.where(valid, s[sel], -1.0)
+
+
+@register_op("multiclass_nms")
+def _multiclass_nms(ctx, op, ins):
+    """Static-shape multiclass NMS (reference multiclass_nms_op.cc emits a
+    variable-length LoD result; here each image yields a padded
+    [keep_top_k, 6] block (label, score, x0, y0, x1, y1) with label -1 in
+    empty slots — the accelerator-native fixed-size formulation)."""
+    bboxes = first(ins, "BBoxes")   # [N, M, 4]
+    scores = first(ins, "Scores")   # [N, C, M]
+    score_threshold = op.attr("score_threshold", 0.0)
+    nms_top_k = op.attr("nms_top_k", 64)
+    keep_top_k = op.attr("keep_top_k", 100)
+    nms_threshold = op.attr("nms_threshold", 0.3)
+    background_label = op.attr("background_label", 0)
+    normalized = op.attr("normalized", True)
+    N, C, M = scores.shape
+    if nms_top_k < 0:
+        nms_top_k = M
+    n_classes_kept = C - (1 if 0 <= background_label < C else 0)
+    if keep_top_k < 0:  # reference: -1 keeps everything
+        keep_top_k = n_classes_kept * min(nms_top_k, M)
+
+    def per_image(box, sc):
+        outs = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = jnp.where(sc[c] >= score_threshold, sc[c], -1.0)
+            bb, ss = _nms_single_class(box, s, nms_threshold, min(nms_top_k, M),
+                                       normalized=normalized)
+            lab = jnp.where(ss > 0, float(c), -1.0)
+            outs.append(jnp.concatenate([lab[:, None], ss[:, None], bb], axis=1))
+        allc = jnp.concatenate(outs, axis=0)
+        order = jnp.argsort(-allc[:, 1])[:keep_top_k]
+        picked = allc[order]
+        pad = keep_top_k - picked.shape[0]
+        if pad > 0:
+            picked = jnp.concatenate(
+                [picked, jnp.full((pad, 6), -1.0, picked.dtype)], axis=0)
+        return picked
+
+    out = jax.vmap(per_image)(bboxes, scores)
+    return {"Out": out}

@@ -1,0 +1,126 @@
+"""Detection op subset: prior_box, iou_similarity, box_coder, yolo_box,
+static-shape multiclass_nms (reference operators/detection/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _run(build):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feeds, fetches = build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    names = [f.name for f in fetches]
+    return exe.run(main, feed=feeds, fetch_list=names, scope=scope)
+
+
+def test_prior_box_shapes_and_geometry():
+    def build():
+        feat = fluid.layers.data("feat", [8, 4, 4], dtype="float32")
+        img = fluid.layers.data("img", [3, 32, 32], dtype="float32")
+        boxes, variances = fluid.layers.prior_box(
+            feat, img, min_sizes=[8.0], max_sizes=[16.0],
+            aspect_ratios=[2.0], flip=True, clip=True)
+        feeds = {"feat": np.zeros((1, 8, 4, 4), "f4"),
+                 "img": np.zeros((1, 3, 32, 32), "f4")}
+        return feeds, [boxes, variances]
+
+    boxes, variances = _run(build)
+    # priors per cell: ars {1, 2, 1/2} x 1 min_size + 1 max_size = 4
+    assert boxes.shape == (4, 4, 4, 4)
+    assert variances.shape == (4, 4, 4, 4)
+    np.testing.assert_allclose(variances[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+    # first prior of cell (0,0): center (4,4) of 32x32, min_size 8 => square
+    np.testing.assert_allclose(boxes[0, 0, 0],
+                               [0.0, 0.0, 8.0 / 32, 8.0 / 32], atol=1e-6)
+    assert boxes.min() >= 0.0 and boxes.max() <= 1.0  # clip
+
+
+def test_iou_similarity_golden():
+    def build():
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [4], dtype="float32")
+        out = fluid.layers.iou_similarity(x, y)
+        xv = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], "f4")
+        yv = np.array([[0, 0, 2, 2], [2, 2, 4, 4]], "f4")
+        return {"x": xv, "y": yv}, [out]
+
+    (iou,) = _run(build)
+    np.testing.assert_allclose(iou, [[1.0, 0.0], [1 / 7, 1 / 7]], atol=1e-6)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(0)
+    priors = np.sort(rng.rand(5, 2, 2), axis=1).reshape(5, 4).astype("f4")
+    targets = np.sort(rng.rand(5, 2, 2), axis=1).reshape(5, 4).astype("f4")
+    pvar = np.full((5, 4), 0.1, "f4")
+
+    def build_enc():
+        p = fluid.layers.data("p", [4], dtype="float32")
+        v = fluid.layers.data("v", [4], dtype="float32")
+        t = fluid.layers.data("t", [4], dtype="float32")
+        enc = fluid.layers.box_coder(p, v, t, code_type="encode_center_size")
+        return {"p": priors, "v": pvar, "t": targets}, [enc]
+
+    (enc,) = _run(build_enc)
+    assert enc.shape == (5, 5, 4)
+    deltas = enc[np.arange(5), np.arange(5)].astype("f4")  # diagonal: each target vs its prior
+
+    def build_dec():
+        p = fluid.layers.data("p", [4], dtype="float32")
+        v = fluid.layers.data("v", [4], dtype="float32")
+        t = fluid.layers.data("t", [4], dtype="float32")
+        dec = fluid.layers.box_coder(p, v, t, code_type="decode_center_size")
+        return {"p": priors, "v": pvar, "t": deltas}, [dec]
+
+    (dec,) = _run(build_dec)
+    np.testing.assert_allclose(dec, targets, atol=1e-5)
+
+
+def test_yolo_box_shapes_and_center():
+    A, C, H, W = 2, 3, 2, 2
+    anchors = [10, 14, 23, 27]
+
+    def build():
+        x = fluid.layers.data("x", [A * (5 + C), H, W], dtype="float32")
+        imgs = fluid.layers.data("imgs", [2], dtype="int64")
+        boxes, scores = fluid.layers.yolo_box(x, imgs, anchors, C,
+                                              conf_thresh=0.0,
+                                              downsample_ratio=32)
+        xv = np.zeros((1, A * (5 + C), H, W), "f4")
+        return {"x": xv, "imgs": np.array([[64, 64]], "int64")}, [boxes, scores]
+
+    boxes, scores = _run(build)
+    assert boxes.shape == (1, A * H * W, 4)
+    assert scores.shape == (1, A * H * W, C)
+    # zero logits: sigmoid=0.5 -> first cell center at ((0+0.5)/2)*64 = 16
+    cx = (boxes[0, 0, 0] + boxes[0, 0, 2]) / 2
+    np.testing.assert_allclose(cx, 16.0, atol=1e-4)
+
+
+def test_multiclass_nms_static_shape():
+    def build():
+        bb = fluid.layers.data("bb", [4, 4], dtype="float32")
+        sc = fluid.layers.data("sc", [3, 4], dtype="float32")
+        out = fluid.layers.multiclass_nms(bb, sc, score_threshold=0.1,
+                                          nms_threshold=0.5, keep_top_k=5,
+                                          background_label=0)
+        boxes = np.array([[[0, 0, 1, 1], [0, 0, 1.05, 1.05],
+                           [2, 2, 3, 3], [5, 5, 6, 6]]], "f4")
+        scores = np.zeros((1, 3, 4), "f4")
+        scores[0, 1] = [0.9, 0.8, 0.7, 0.05]   # class 1: two overlapping + one far
+        scores[0, 2] = [0.0, 0.0, 0.0, 0.95]   # class 2: only the far box
+        return {"bb": boxes, "sc": scores}, [out]
+
+    (out,) = _run(build)
+    assert out.shape == (1, 5, 6)
+    dets = out[0]
+    valid = dets[dets[:, 0] >= 0]
+    # expected survivors: class2@0.95, class1@0.9, class1@0.7 (0.8 suppressed
+    # by IoU with 0.9; 0.05 below threshold)
+    assert len(valid) == 3
+    np.testing.assert_allclose(valid[:, 1], [0.95, 0.9, 0.7], atol=1e-6)
+    assert valid[0, 0] == 2 and valid[1, 0] == 1 and valid[2, 0] == 1

@@ -1,0 +1,68 @@
+"""nets.py composites + synthetic dataset corpus loaders."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import datasets, nets
+from paddle_tpu.lod import LoDTensor
+
+
+def test_datasets_shapes_and_determinism():
+    a = list(datasets.uci_housing.train()())
+    b = list(datasets.uci_housing.train()())
+    assert len(a) == 404 and a[0][0].shape == (13,)
+    np.testing.assert_array_equal(a[0][0], b[0][0])  # deterministic
+    t = next(datasets.mnist.train()())
+    assert t[0].shape == (784,) and 0 <= int(t[1]) <= 9
+    s = next(datasets.imdb.train()())
+    assert s[0].dtype == np.int64
+    w = next(datasets.wmt14.train()())
+    assert w[1][0] == 0 and w[2][-1] == 1  # bos / eos framing
+
+
+def test_simple_img_conv_pool_and_glu():
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 1
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [1, 12, 12], dtype="float32")
+        cp = nets.simple_img_conv_pool(img, 4, 3, pool_size=2, pool_stride=2,
+                                       conv_padding=1, act="relu")
+        flat = fluid.layers.reshape(cp, [-1, 4 * 6 * 6])
+        g = nets.glu(fluid.layers.fc(flat, 16), dim=1)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    (gv,) = exe.run(main, feed={"img": np.ones((2, 1, 12, 12), "f4")},
+                    fetch_list=[g], scope=scope)
+    assert np.asarray(gv).shape == (2, 8)
+
+
+def test_sequence_conv_pool_trains_on_imdb_sample():
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 2
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", [1], dtype="int64", lod_level=1)
+        label = fluid.layers.data("label", [1], dtype="float32")
+        emb = fluid.layers.embedding(ids, size=[5000, 16])
+        feat = nets.sequence_conv_pool(emb, 16, 3, act="tanh")
+        pred = fluid.layers.fc(feat, 1)
+        loss = fluid.layers.mean(
+            fluid.layers.sigmoid_cross_entropy_with_logits(pred, label))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    reader = datasets.imdb.train()
+    batch, labels = [], []
+    losses = []
+    for i, (seq, lab) in enumerate(reader()):
+        batch.append(seq.reshape(-1, 1))
+        labels.append([float(lab)])
+        if len(batch) == 16:
+            (lv,) = exe.run(main, feed={"ids": LoDTensor(batch),
+                                        "label": np.asarray(labels, "f4")},
+                            fetch_list=[loss], scope=scope)
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+            batch, labels = [], []
+        if len(losses) >= 12:
+            break
+    assert losses[-1] < losses[0], losses

@@ -1,0 +1,63 @@
+"""Profiler: per-run aggregate, per-op attribution, Chrome-trace export
+(reference fluid.profiler + tools/timeline.py)."""
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import profiler
+
+
+def _model():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h = fluid.layers.fc(x, 16, act="relu")
+        pred = fluid.layers.fc(h, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_per_run_table_and_context_manager(capsys):
+    main, startup, loss = _model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    profiler.reset_profiler()
+    with profiler.profiler(sorted_key="total"):
+        for _ in range(3):
+            exe.run(main, feed={"x": np.ones((4, 8), "f4"), "y": np.ones((4, 1), "f4")},
+                    fetch_list=[loss], scope=scope)
+    out = capsys.readouterr().out
+    assert "executor.run" in out and "Calls" in out
+
+
+def test_per_op_attribution_and_chrome_trace(tmp_path):
+    main, startup, loss = _model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    profiler.reset_profiler()
+    profiler.start_profiler()
+    table = profiler.profile_program(
+        main, feed={"x": np.ones((4, 8), "f4"), "y": np.ones((4, 1), "f4")},
+        scope=scope, repeat=2)
+    profiler.stop_profiler(profile_path=str(tmp_path / "tbl.txt"))
+    assert "mul" in table and "Avg(ms)" in table
+
+    trace = str(tmp_path / "trace.json")
+    n = profiler.export_chrome_trace(trace)
+    assert n > 0
+    doc = json.load(open(trace))
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "mul" in names and "square_error_cost" in names
+
+    # multi-process merge gives distinct pid lanes
+    merged = str(tmp_path / "merged.json")
+    profiler.merge_chrome_traces({"trainer0": trace, "trainer1": trace}, merged)
+    doc = json.load(open(merged))
+    pids = {e["pid"] for e in doc["traceEvents"]}
+    assert pids == {0, 1}
