@@ -42,6 +42,7 @@ from . import inference  # noqa: F401
 from . import recordio  # noqa: F401
 from . import datasets  # noqa: F401
 from . import nets  # noqa: F401
+from . import debugger  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import metrics  # noqa: F401
 from . import profiler  # noqa: F401

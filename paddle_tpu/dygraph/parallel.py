@@ -1,17 +1,14 @@
 """Dygraph data parallelism (reference: dygraph/parallel.py:84 DataParallel —
 scale_loss:150 + apply_collective_grads:171 coalesced allreduce over NCCL).
 
-TPU-first: eager pmean of gradients over the device mesh.  On a single
-process this wraps `jax.pmap`-free semantics — gradients are averaged over
-the `dp` axis with an eager collective when a mesh is supplied; without
-one it is a transparent no-op wrapper (matching single-card behavior).
+TPU-first: the API is kept for parity, but both hooks are identity —
+place the batch sharded over a `dp` mesh axis (jax.device_put with a
+NamedSharding) and GSPMD computes the global loss/gradients directly;
+the cross-device reduction lives inside the backward math, so there is
+no separate collective step to apply.  tests/test_dygraph.py asserts
+sharded == unsharded loss trajectories.
 """
 from __future__ import annotations
-
-import numpy as np
-
-import jax
-import jax.numpy as jnp
 
 from .layers import Layer
 
@@ -35,30 +32,27 @@ class DataParallel(Layer):
     def __init__(self, layers: Layer, strategy=None, mesh=None):
         super().__init__("data_parallel")
         self._layers = layers
+        # mesh is accepted for source compatibility; placement of the
+        # sharded batch is the caller's device_put, not this wrapper's
         self._mesh = mesh
 
     def forward(self, *args, **kw):
         return self._layers(*args, **kw)
 
     def scale_loss(self, loss):
-        """Grads accumulate per-shard; with the eager tape the full batch is
-        already on one logical device, so scaling is identity unless a mesh
-        is attached."""
-        if self._mesh is None:
-            return loss
-        n = int(np.prod(list(self._mesh.shape.values())))
-        return loss * (1.0 / n)
+        """Identity.  The reference scaled by 1/nranks because every worker
+        held only its shard's loss; under GSPMD eager the loss is computed
+        over the GLOBAL (sharded) batch, already correctly normalized."""
+        return loss
 
     def apply_collective_grads(self):
-        """Average grads across the mesh (reference coalesced allreduce).
-        Single-process eager mode: grads are already global; with a mesh
-        they are psum-averaged."""
-        if self._mesh is None:
-            return
-        n = int(np.prod(list(self._mesh.shape.values())))
-        for p in self._layers.parameters():
-            if p.grad is not None:
-                p.grad = p.grad / n
+        """No-op by design (kept for API parity).  The reference ran a
+        coalesced NCCL allreduce here because each worker had shard-local
+        gradients; under GSPMD eager the tape's gradient of a
+        sharded-batch loss IS the global gradient — XLA inserted the
+        cross-device reduction inside the backward math.  The mesh-parity
+        test (tests/test_dygraph.py) asserts sharded == unsharded losses."""
+        return
 
     def parameters(self, include_sublayers: bool = True):
         return self._layers.parameters(include_sublayers)

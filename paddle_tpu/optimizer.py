@@ -260,6 +260,15 @@ class AdagradOptimizer(Optimizer):
         self._epsilon = epsilon
         self._initial = initial_accumulator_value
 
+    def _eager_update(self, p, g, lr, state):
+        import jax.numpy as jnp
+
+        m = state.get("moment")
+        m = jnp.full_like(p, self._initial) if m is None else m
+        m = m + jnp.square(g)
+        state["moment"] = m
+        return p - lr * g / (jnp.sqrt(m) + self._epsilon)
+
     def _create_accumulators(self, block, parameters):
         for p in parameters:
             self._add_accumulator("moment", p, fill_value=self._initial)
@@ -284,6 +293,23 @@ class RMSPropOptimizer(Optimizer):
     def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False, **kw):
         super().__init__(learning_rate, **kw)
         self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _eager_update(self, p, g, lr, state):
+        import jax.numpy as jnp
+
+        if not state:
+            state.update(ms=jnp.zeros_like(p), mg=jnp.zeros_like(p),
+                         mom=jnp.zeros_like(p))
+        ms, mg, mom = state["ms"], state["mg"], state["mom"]
+        ms = self._rho * ms + (1 - self._rho) * jnp.square(g)
+        if self._centered:
+            mg = self._rho * mg + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * mom + lr * g / denom
+        state.update(ms=ms, mg=mg, mom=mom)
+        return p - mom
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
@@ -326,6 +352,18 @@ class AdamaxOptimizer(Optimizer):
         super().__init__(learning_rate, **kw)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
 
+    def _eager_update(self, p, g, lr, state):
+        import jax.numpy as jnp
+
+        if not state:
+            state.update(m=jnp.zeros_like(p), inf=jnp.zeros_like(p), b1p=1.0)
+        m, inf = state["m"], state["inf"]
+        b1p = state["b1p"] * self._beta1
+        m = self._beta1 * m + (1 - self._beta1) * g
+        inf = jnp.maximum(self._beta2 * inf, jnp.abs(g))
+        state.update(m=m, inf=inf, b1p=b1p)
+        return p - (lr / (1 - b1p)) * m / (inf + self._epsilon)
+
     def _create_accumulators(self, block, parameters):
         for p in parameters:
             self._add_accumulator("moment", p)
@@ -364,6 +402,18 @@ class AdadeltaOptimizer(Optimizer):
     def __init__(self, learning_rate, epsilon=1e-6, rho=0.95, **kw):
         super().__init__(learning_rate, **kw)
         self._epsilon, self._rho = epsilon, rho
+
+    def _eager_update(self, p, g, lr, state):
+        import jax.numpy as jnp
+
+        if not state:
+            state.update(g2=jnp.zeros_like(p), u2=jnp.zeros_like(p))
+        g2, u2 = state["g2"], state["u2"]
+        g2 = self._rho * g2 + (1 - self._rho) * jnp.square(g)
+        upd = -jnp.sqrt((u2 + self._epsilon) / (g2 + self._epsilon)) * g
+        u2 = self._rho * u2 + (1 - self._rho) * jnp.square(upd)
+        state.update(g2=g2, u2=u2)
+        return p + upd
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:

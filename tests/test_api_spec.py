@@ -1,0 +1,27 @@
+"""API-freeze gate (reference: tools/diff_api.py over API.spec — a public
+signature change must come with an explicit API.spec update)."""
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def test_public_api_matches_spec():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import print_signatures
+
+    live = print_signatures.collect()
+    spec_path = os.path.join(REPO, "API.spec")
+    assert os.path.exists(spec_path), "API.spec missing; run tools/print_signatures.py --update"
+    recorded = open(spec_path).read().splitlines()
+    live_set, rec_set = set(live), set(recorded)
+    added = sorted(live_set - rec_set)
+    removed = sorted(rec_set - live_set)
+    assert not added and not removed, (
+        "public API drifted from API.spec.\n"
+        f"added ({len(added)}): {added[:10]}\n"
+        f"removed ({len(removed)}): {removed[:10]}\n"
+        "If intentional: python tools/print_signatures.py --update"
+    )

@@ -269,3 +269,21 @@ class _M:
 
 
 sys.modules["sklearn_free_auc"] = _M()
+
+
+def test_debugger_graphviz_dump(tmp_path):
+    from paddle_tpu import debugger
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(x, 1), y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    dots = debugger.draw_program(main, str(tmp_path / "prog"))
+    dot = dots[0]
+    assert "digraph" in dot and "backward" in dot and "sgd" in dot
+    assert (tmp_path / "prog.block0.dot").exists()
+    # persistable params render with the param fill color
+    assert "#ffe4b5" in dot

@@ -138,3 +138,73 @@ def test_embedding_and_dropout_layers():
         drop.eval()
         y2 = drop(e.detach())
         np.testing.assert_allclose(y2.numpy(), e.numpy())
+
+
+def test_dygraph_data_parallel_mesh_parity():
+    """weak-item regression: DataParallel + a real mesh — batch sharded over
+    dp, eager ops auto-partition (GSPMD), losses match the unsharded run."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.parallel import make_mesh
+
+    rng = np.random.RandomState(0)
+    xv = rng.rand(16, 8).astype("f4")
+    yv = xv.sum(1, keepdims=True).astype("f4")
+
+    def run(mesh):
+        with dygraph.guard():
+            layer = dygraph.Linear(8, 1)
+            params = layer.parameters()
+            params[0].value = jnp.full((8, 1), 0.1, jnp.float32)
+            params[1].value = jnp.zeros((1,), jnp.float32)
+            model = dygraph.parallel.DataParallel(layer, mesh=mesh)
+            opt = fluid.optimizer.SGD(0.1)
+            losses = []
+            for _ in range(4):
+                x, y = jnp.asarray(xv), jnp.asarray(yv)
+                if mesh is not None:
+                    x = jax.device_put(x, NamedSharding(mesh, P("dp")))
+                    y = jax.device_put(y, NamedSharding(mesh, P("dp")))
+                pred = model(dygraph.to_variable(x))
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, dygraph.to_variable(y)))
+                loss.backward()
+                model.apply_collective_grads()
+                opt.minimize(loss, parameter_list=model.parameters())
+                layer.clear_gradients()
+                losses.append(float(loss.numpy().reshape(-1)[0]))
+            return losses
+
+    base = run(None)
+    sharded = run(make_mesh((8,), ("dp",)))
+    np.testing.assert_allclose(sharded, base, rtol=1e-5, atol=1e-6)
+
+
+def test_dygraph_eager_optimizers_converge():
+    """every major optimizer family has an eager update rule now."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(1)
+    xv = rng.rand(32, 6).astype("f4")
+    w_true = rng.rand(6, 1).astype("f4")
+    yv = xv @ w_true
+
+    for make in (lambda: fluid.optimizer.Adagrad(0.3),
+                 lambda: fluid.optimizer.RMSProp(0.05),
+                 lambda: fluid.optimizer.Adamax(0.05),
+                 lambda: fluid.optimizer.Adadelta(1.0)):
+        with dygraph.guard():
+            layer = dygraph.Linear(6, 1)
+            opt = make()
+            losses = []
+            for _ in range(60):
+                pred = layer(dygraph.to_variable(xv))
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, dygraph.to_variable(yv)))
+                loss.backward()
+                opt.minimize(loss, parameter_list=layer.parameters())
+                layer.clear_gradients()
+                losses.append(float(loss.numpy().reshape(-1)[0]))
+            assert losses[-1] < losses[0] * 0.5, (type(opt).__name__, losses[0], losses[-1])

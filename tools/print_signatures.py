@@ -1,0 +1,83 @@
+"""API-freeze tooling (reference: tools/print_signatures.py + API.spec +
+tools/diff_api.py — CI fails when a public signature changes without the
+spec being updated).
+
+Usage:
+    python tools/print_signatures.py            # print current surface
+    python tools/print_signatures.py --update   # rewrite API.spec
+The pytest gate (tests/test_api_spec.py) diffs the live surface against
+API.spec.
+"""
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+
+MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.layers",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.io",
+    "paddle_tpu.nets",
+    "paddle_tpu.recordio",
+    "paddle_tpu.dataset",
+    "paddle_tpu.inference",
+    "paddle_tpu.parallel",
+    "paddle_tpu.contrib.mixed_precision",
+    "paddle_tpu.dygraph",
+    "paddle_tpu.metrics",
+    "paddle_tpu.profiler",
+    "paddle_tpu.flags",
+]
+
+
+def _sig(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (TypeError, ValueError):
+        return "(...)"
+
+
+def collect():
+    import importlib
+
+    lines = []
+    for modname in MODULES:
+        mod = importlib.import_module(modname)
+        for name in sorted(dir(mod)):
+            if name.startswith("_"):
+                continue
+            obj = getattr(mod, name)
+            if inspect.ismodule(obj):
+                continue
+            qual = f"{modname}.{name}"
+            if inspect.isclass(obj):
+                # classes: constructor + public methods
+                lines.append(f"{qual} (class) __init__{_sig(obj.__init__)}")
+                for m in sorted(vars(obj)):
+                    if m.startswith("_"):
+                        continue
+                    f = vars(obj)[m]
+                    if callable(f):
+                        lines.append(f"{qual}.{m} {_sig(f)}")
+            elif callable(obj):
+                lines.append(f"{qual} {_sig(obj)}")
+    return lines
+
+
+def main():
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, here)
+    lines = collect()
+    spec_path = os.path.join(here, "API.spec")
+    if "--update" in sys.argv:
+        with open(spec_path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        print(f"wrote {len(lines)} signatures to API.spec")
+    else:
+        print("\n".join(lines))
+
+
+if __name__ == "__main__":
+    main()
