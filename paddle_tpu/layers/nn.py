@@ -752,3 +752,103 @@ def Print(input, first_n=-1, message=None, summarize=-1, print_tensor_name=True,
     helper.append_op("print", inputs={"X": [input.name]}, outputs={"Out": [out.name]},
                      attrs={"message": msg, "first_n": first_n})
     return out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    helper = LayerHelper("group_norm", name=name, act=act)
+    if data_layout != "NCHW":
+        raise NotImplementedError("group_norm: only NCHW")
+    c = input.shape[1]
+    from ..core.initializer import ConstantInitializer
+
+    scale = helper.create_parameter(param_attr, [c], input.dtype,
+                                    default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(bias_attr, [c], input.dtype, is_bias=True)
+    out = _out(helper, input.dtype, shape=input.shape)
+    mean = _out(helper, "float32")
+    var = _out(helper, "float32")
+    helper.append_op(
+        "group_norm",
+        inputs={"X": [input.name], "Scale": [scale.name], "Bias": [bias.name]},
+        outputs={"Y": [out.name], "Mean": [mean.name], "Variance": [var.name]},
+        attrs={"epsilon": epsilon, "groups": groups},
+    )
+    return helper.append_activation(out)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None, name=None):
+    helper = LayerHelper("instance_norm", name=name)
+    c = input.shape[1]
+    from ..core.initializer import ConstantInitializer
+
+    scale = helper.create_parameter(param_attr, [c], input.dtype,
+                                    default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(bias_attr, [c], input.dtype, is_bias=True)
+    out = _out(helper, input.dtype, shape=input.shape)
+    smean = _out(helper, "float32")
+    svar = _out(helper, "float32")
+    helper.append_op(
+        "instance_norm",
+        inputs={"X": [input.name], "Scale": [scale.name], "Bias": [bias.name]},
+        outputs={"Y": [out.name], "SavedMean": [smean.name],
+                 "SavedVariance": [svar.name]},
+        attrs={"epsilon": epsilon},
+    )
+    return out
+
+
+def l2_normalize(x, axis, epsilon=1e-10, name=None):
+    helper = LayerHelper("l2_normalize", name=name)
+    out = _out(helper, x.dtype, shape=x.shape)
+    norm = _out(helper, x.dtype)
+    helper.append_op("norm", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name], "Norm": [norm.name]},
+                     attrs={"axis": axis, "epsilon": epsilon})
+    return out
+
+
+def gather_nd(input, index, name=None):
+    helper = LayerHelper("gather_nd", name=name)
+    out = _out(helper, input.dtype)
+    helper.append_op("gather_nd", inputs={"X": [input.name], "Index": [index.name]},
+                     outputs={"Out": [out.name]})
+    return out
+
+
+def scatter(input, index, updates, overwrite=True, name=None):
+    helper = LayerHelper("scatter", name=name)
+    out = _out(helper, input.dtype, shape=input.shape)
+    helper.append_op("scatter",
+                     inputs={"X": [input.name], "Ids": [index.name],
+                             "Updates": [updates.name]},
+                     outputs={"Out": [out.name]}, attrs={"overwrite": overwrite})
+    return out
+
+
+def cumsum(x, axis=-1, exclusive=False, reverse=False, name=None):
+    helper = LayerHelper("cumsum", name=name)
+    out = _out(helper, x.dtype, shape=x.shape)
+    helper.append_op("cumsum", inputs={"X": [x.name]}, outputs={"Out": [out.name]},
+                     attrs={"axis": axis, "exclusive": exclusive, "reverse": reverse})
+    return out
+
+
+def argsort(input, axis=-1, descending=False, name=None):
+    helper = LayerHelper("argsort", name=name)
+    out = _out(helper, input.dtype, shape=input.shape)
+    ids = _out(helper, "int64", shape=input.shape)
+    helper.append_op("argsort", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name], "Indices": [ids.name]},
+                     attrs={"axis": axis, "descending": descending})
+    return out, ids
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper("flatten", name=name)
+    out = _out(helper, x.dtype)
+    helper.append_op("flatten2", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name],
+                              "XShape": [_out(helper, x.dtype).name]},
+                     attrs={"axis": axis})
+    return out

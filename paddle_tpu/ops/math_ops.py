@@ -76,6 +76,16 @@ _UNARY = {
     "tanh_shrink": lambda x: x - jnp.tanh(x),
     "erf": jax.lax.erf,
     "sign": jnp.sign,
+    "tan": jnp.tan,
+    "asin": jnp.arcsin,
+    "acos": jnp.arccos,
+    "atan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
 }
 
 def _unary(fn):
@@ -87,6 +97,21 @@ def _unary(fn):
 
 for _name, _fn in _UNARY.items():
     register_op(_name)(_unary(_fn))
+
+
+@register_op("hard_shrink")
+def _hard_shrink(ctx, op, ins):
+    x = first(ins, "X")
+    t = op.attr("threshold", 0.5)
+    return {"Out": jnp.where(jnp.abs(x) > t, x, 0.0)}
+
+
+@register_op("stanh")
+def _stanh(ctx, op, ins):
+    x = first(ins, "X")
+    a = op.attr("scale_a", 0.67)  # reference activation_op.cc default
+    b = op.attr("scale_b", 1.7159)
+    return {"Out": b * jnp.tanh(a * x)}
 
 
 @register_op("leaky_relu")

@@ -682,3 +682,50 @@ def _print(ctx, op, ins):
 
     jax.debug.callback(_cb, x)
     return {"Out": x}
+
+
+@register_op("group_norm")
+def _group_norm(ctx, op, ins):
+    """reference group_norm_op: normalize within channel groups [N, C, *]."""
+    x = first(ins, "X")
+    scale = first(ins, "Scale")
+    bias = first(ins, "Bias")
+    eps = op.attr("epsilon", 1e-5)
+    groups = op.attr("groups", 1)
+    n, c = x.shape[0], x.shape[1]
+    xf = x.astype(jnp.float32).reshape((n, groups, c // groups) + x.shape[2:])
+    axes = tuple(range(2, xf.ndim))
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = ((xf - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+    cshape = (1, c) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(cshape)
+    if bias is not None:
+        y = y + bias.reshape(cshape)
+    return {"Y": y.astype(x.dtype),
+            "Mean": mean.reshape(n, groups),
+            "Variance": var.reshape(n, groups)}
+
+
+@register_op("instance_norm")
+def _instance_norm(ctx, op, ins):
+    """reference instance_norm_op: per-(sample, channel) normalization."""
+    x = first(ins, "X")
+    scale = first(ins, "Scale")
+    bias = first(ins, "Bias")
+    eps = op.attr("epsilon", 1e-5)
+    xf = x.astype(jnp.float32)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    cshape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(cshape)
+    if bias is not None:
+        y = y + bias.reshape(cshape)
+    n, c = x.shape[0], x.shape[1]
+    return {"Y": y.astype(x.dtype),
+            "SavedMean": mean.reshape(n, c),
+            "SavedVariance": var.reshape(n, c)}

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.registry import register_op
-from .common import first, np_dtype
+from .common import canon_dtype, first, np_dtype
 
 
 @register_op("fill_constant")
@@ -268,3 +268,126 @@ def _range(ctx, op, ins):
     if s is not None:
         return {"Out": jnp.arange(s, e, st, dtype=start.dtype if start is not None else jnp.int64)}
     return {"Out": jnp.arange(int(start), int(end), int(step))}
+
+
+@register_op("gather_nd")
+def _gather_nd(ctx, op, ins):
+    """reference gather_nd_op: index [..., K] selects into x's first K dims."""
+    x = first(ins, "X")
+    index = first(ins, "Index").astype(jnp.int32)
+    k = index.shape[-1]
+    flat_idx = index.reshape(-1, k)
+    out = x[tuple(flat_idx[:, i] for i in range(k))]
+    return {"Out": out.reshape(index.shape[:-1] + x.shape[k:])}
+
+
+@register_op("scatter")
+def _scatter(ctx, op, ins):
+    """reference scatter_op: write (or add) Updates rows into X at Ids."""
+    x = first(ins, "X")
+    ids = first(ins, "Ids").reshape(-1).astype(jnp.int32)
+    upd = first(ins, "Updates")
+    if op.attr("overwrite", True):
+        return {"Out": x.at[ids].set(upd)}
+    return {"Out": x.at[ids].add(upd)}
+
+
+@register_op("scatter_nd_add")
+def _scatter_nd_add(ctx, op, ins):
+    x = first(ins, "X")
+    index = first(ins, "Index").astype(jnp.int32)
+    upd = first(ins, "Updates")
+    k = index.shape[-1]
+    flat_idx = index.reshape(-1, k)
+    flat_upd = upd.reshape((flat_idx.shape[0],) + x.shape[k:])
+    return {"Out": x.at[tuple(flat_idx[:, i] for i in range(k))].add(flat_upd)}
+
+
+@register_op("cumsum")
+def _cumsum(ctx, op, ins):
+    x = first(ins, "X")
+    axis = op.attr("axis", -1)
+    rev = op.attr("reverse", False)
+    excl = op.attr("exclusive", False)
+    if rev:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if excl:
+        out = out - x
+    if rev:
+        out = jnp.flip(out, axis)
+    return {"Out": out}
+
+
+@register_op("argsort")
+def _argsort(ctx, op, ins):
+    x = first(ins, "X")
+    axis = op.attr("axis", -1)
+    idx = jnp.argsort(x, axis=axis, descending=op.attr("descending", False))
+    return {"Out": jnp.take_along_axis(x, idx, axis=axis),
+            "Indices": idx.astype(canon_dtype("int64"))}
+
+
+@register_op("expand_as")
+def _expand_as(ctx, op, ins):
+    x = first(ins, "X")
+    target = first(ins, "target_tensor")
+    if target is None:
+        target = first(ins, "Y")
+    times = tuple(t // s for t, s in zip(target.shape, x.shape))
+    return {"Out": jnp.tile(x, times)}
+
+
+@register_op("linspace")
+def _linspace(ctx, op, ins):
+    start = first(ins, "Start").reshape(())
+    stop = first(ins, "Stop").reshape(())
+    num = op.attr("num_v", None)
+    if num is None:
+        num_in = first(ins, "Num")
+        if hasattr(num_in, "aval") and not isinstance(num_in, np.ndarray):
+            # traced tensor Num: XLA needs a static length — tell the user
+            # how to supply it instead of failing in int() mid-trace
+            raise NotImplementedError(
+                "linspace: the output length must be static under XLA; pass "
+                "the point count via the num_v attr (layers.linspace does)")
+        num = int(np.asarray(num_in).reshape(()))
+    return {"Out": jnp.linspace(start, stop, num)}
+
+
+@register_op("norm")
+def _norm(ctx, op, ins):
+    """reference norm_op: l2-normalize along axis; Norm is the l2 norm."""
+    x = first(ins, "X")
+    axis = op.attr("axis", -1)
+    eps = op.attr("epsilon", 1e-10)
+    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": x / n, "Norm": n}
+
+
+@register_op("flatten2")
+def _flatten2(ctx, op, ins):
+    x = first(ins, "X")
+    ax = op.attr("axis", 1)
+    lead = int(np.prod(x.shape[:ax]))  # prod of empty tuple is 1
+    tail = int(np.prod(x.shape[ax:]))
+    out = jnp.reshape(x, (lead, tail))
+    return {"Out": out, "XShape": jnp.zeros((0,) + x.shape, dtype=x.dtype)}
+
+
+@register_op("flatten")
+def _flatten(ctx, op, ins):
+    return {"Out": _flatten2(ctx, op, ins)["Out"]}
+
+
+@register_op("shard_index")
+def _shard_index(ctx, op, ins):
+    """reference shard_index_op: map global ids to shard-local ids."""
+    x = first(ins, "X")
+    index_num = op.attr("index_num")
+    nshards = op.attr("nshards")
+    shard_id = op.attr("shard_id")
+    ignore_value = op.attr("ignore_value", -1)
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return {"Out": jnp.where(in_shard, x % shard_size, ignore_value)}

@@ -817,3 +817,122 @@ def test_batch_norm_extreme_mean_stability():
             {"epsilon": eps, "momentum": 0.9, "is_test": False, "data_layout": "NCHW",
              "use_global_stats": False},
             atol=6e-3, rtol=5e-2)
+
+
+# --- round-3c batch: scatter/gather_nd/cumsum/argsort/norm variants ----------
+
+def test_gather_nd_golden():
+    x = _x((3, 4, 5))
+    idx = np.array([[0, 1], [2, 3]], "int32")
+    _golden("gather_nd", {"X": x, "Index": idx}, {"Out": x[[0, 2], [1, 3]]}, {})
+
+
+def test_scatter_golden():
+    x = _x((5, 3))
+    ids = np.array([1, 3], "int32")
+    upd = _x((2, 3))
+    over = x.copy()
+    over[ids] = upd
+    _golden("scatter", {"X": x, "Ids": ids, "Updates": upd}, {"Out": over},
+            {"overwrite": True})
+    add = x.copy()
+    for i, r in zip(ids, upd):
+        add[i] += r
+    _golden("scatter", {"X": x, "Ids": ids, "Updates": upd}, {"Out": add},
+            {"overwrite": False})
+
+
+def test_scatter_nd_add_golden():
+    x = _x((4, 3))
+    idx = np.array([[1], [1], [3]], "int32")
+    upd = _x((3, 3))
+    ref = x.copy()
+    for i, r in zip(idx[:, 0], upd):
+        ref[i] += r
+    _golden("scatter_nd_add", {"X": x, "Index": idx, "Updates": upd}, {"Out": ref},
+            {}, atol=1e-5)
+
+
+def test_cumsum_variants():
+    x = _x((3, 4))
+    _golden("cumsum", {"X": x}, {"Out": np.cumsum(x, axis=1)}, {"axis": 1}, atol=1e-5)
+    ref = np.cumsum(x[:, ::-1], axis=1)[:, ::-1]
+    _golden("cumsum", {"X": x}, {"Out": ref}, {"axis": 1, "reverse": True}, atol=1e-5)
+    excl = np.cumsum(x, axis=1) - x
+    _golden("cumsum", {"X": x}, {"Out": excl}, {"axis": 1, "exclusive": True}, atol=1e-5)
+
+
+def test_argsort_golden():
+    x = _x((2, 5))
+    idx = np.argsort(-x, axis=1)
+    _golden("argsort", {"X": x},
+            {"Out": np.take_along_axis(x, idx, 1), "Indices": idx.astype("int64")},
+            {"axis": 1, "descending": True})
+
+
+def test_norm_l2_normalize_golden():
+    x = _x((3, 4), 0.5, 2.0)
+    n = np.sqrt((x ** 2).sum(axis=1, keepdims=True) + 1e-10)
+    _golden("norm", {"X": x}, {"Out": x / n, "Norm": n}, {"axis": 1, "epsilon": 1e-10},
+            atol=1e-5)
+
+
+def test_group_instance_norm_golden():
+    x = _x((2, 4, 3, 3))
+    scale = np.ones(4, "f4")
+    bias = np.zeros(4, "f4")
+    # group_norm, 2 groups
+    xr = x.reshape(2, 2, 2, 3, 3)
+    m = xr.mean(axis=(2, 3, 4), keepdims=True)
+    v = xr.var(axis=(2, 3, 4), keepdims=True)
+    y = ((xr - m) / np.sqrt(v + 1e-5)).reshape(x.shape)
+    _golden("group_norm", {"X": x, "Scale": scale, "Bias": bias},
+            {"Y": y, "Mean": m.reshape(2, 2), "Variance": v.reshape(2, 2)},
+            {"epsilon": 1e-5, "groups": 2}, atol=1e-4, rtol=1e-4)
+    # instance_norm
+    mi = x.mean(axis=(2, 3), keepdims=True)
+    vi = x.var(axis=(2, 3), keepdims=True)
+    yi = (x - mi) / np.sqrt(vi + 1e-5)
+    _golden("instance_norm", {"X": x, "Scale": scale, "Bias": bias},
+            {"Y": yi, "SavedMean": mi.reshape(2, 4), "SavedVariance": vi.reshape(2, 4)},
+            {"epsilon": 1e-5}, atol=1e-4, rtol=1e-4)
+
+
+def test_flatten_shard_index_linspace():
+    x = _x((2, 3, 4))
+    _golden("flatten2", {"X": x}, {"Out": x.reshape(2, 12)}, {"axis": 1},
+            no_check_set={"XShape"})
+    ids = np.array([0, 5, 9, 14], "int64")
+    # index_num 16, 4 shards -> shard size 4; shard 1 owns [4, 8)
+    exp = np.where((ids // 4) == 1, ids % 4, -1)
+    _golden("shard_index", {"X": ids}, {"Out": exp},
+            {"index_num": 16, "nshards": 4, "shard_id": 1})
+    _golden("linspace", {"Start": np.array([0.0], "f4"), "Stop": np.array([1.0], "f4"),
+                         "Num": np.array([5], "i4")},
+            {"Out": np.linspace(0, 1, 5, dtype="f4")}, {"num_v": 5}, atol=1e-6)
+
+
+@pytest.mark.parametrize("name,fn", [
+    ("tan", np.tan), ("asin", np.arcsin), ("acos", np.arccos),
+    ("atan", np.arctan), ("sinh", np.sinh), ("cosh", np.cosh),
+    ("log1p", np.log1p), ("expm1", np.expm1),
+])
+def test_unary_extras(name, fn):
+    x = _x((3, 4), -0.9, 0.9) if name in ("asin", "acos") else _x((3, 4), 0.1, 0.9)
+    _golden(name, {"X": x}, {"Out": fn(x)}, {}, atol=1e-5, rtol=1e-4)
+
+
+def test_hard_shrink_stanh_attrs():
+    x = _x((3, 4))
+    _golden("hard_shrink", {"X": x}, {"Out": np.where(np.abs(x) > 0.3, x, 0.0)},
+            {"threshold": 0.3})
+    _golden("stanh", {"X": x}, {"Out": 1.7159 * np.tanh(0.67 * x)}, {}, atol=1e-5)
+    _golden("stanh", {"X": x}, {"Out": 2.0 * np.tanh(0.5 * x)},
+            {"scale_a": 0.5, "scale_b": 2.0}, atol=1e-5)
+
+
+def test_expand_as_with_target_tensor():
+    x = _x((2, 3))
+    target = _x((4, 6))
+    _golden("expand_as", {"X": x, "target_tensor": target},
+            {"Out": np.tile(x, (2, 2))}, {})
