@@ -43,6 +43,8 @@ from . import recordio  # noqa: F401
 from . import datasets  # noqa: F401
 from . import nets  # noqa: F401
 from . import debugger  # noqa: F401
+from .checkpoint_manager import CheckpointManager  # noqa: F401
+from .core import passes  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import metrics  # noqa: F401
 from . import profiler  # noqa: F401

@@ -82,10 +82,11 @@ class _CompiledStep:
 
     def __init__(self, program: Program, feed_names: Sequence[str], fetch_names: Sequence[str], scope: Scope,
                  mesh=None, batch_axis: str = "dp", feed_shapes: Optional[Dict[str, tuple]] = None,
-                 n_steps: int = 1):
+                 n_steps: int = 1, remat: bool = False):
         self.mesh = mesh
         self.batch_axis = batch_axis
         self.n_steps = n_steps
+        self.remat = remat
         self.multiprocess = mesh is not None and any(
             d.process_index != jax.process_index() for d in mesh.devices.flat
         )
@@ -126,6 +127,7 @@ class _CompiledStep:
         def step(state_rw: Dict[str, jnp.ndarray], state_ro: Dict[str, jnp.ndarray],
                  feeds: Dict[str, jnp.ndarray], key):
             ctx = LoweringContext(key, mesh=mesh)
+            ctx.remat = self.remat
             env = dict(state_ro)
             env.update(state_rw)
             env.update(feeds)
@@ -316,9 +318,15 @@ class Executor:
         program = program if program is not None else default_main_program()
         mesh = None
         batch_axis = "dp"
+        remat = False
         if hasattr(program, "program") and hasattr(program, "mesh"):  # CompiledProgram
             mesh = program.mesh
             batch_axis = getattr(program, "batch_axis", "dp")
+            bs = getattr(program, "build_strategy", None)
+            # BuildStrategy.memory_optimize -> rematerialized backward
+            # (the XLA-native descendant of the reference's
+            # memory_optimize_pass: trade FLOPs for activation memory)
+            remat = bool(getattr(bs, "memory_optimize", False))
             program = program.program
         scope = scope if scope is not None else global_scope()
         feed = feed or {}
@@ -409,6 +417,7 @@ class Executor:
             scope._uuid,
             (tuple(mesh.shape.items()), batch_axis) if mesh is not None else None,
             steps,
+            remat,
             _lowering_flags(),
         )
         compiled = self._cache.pop(cache_key, None)
@@ -419,7 +428,7 @@ class Executor:
                 program, list(jfeeds), fetch_names, scope,
                 mesh=mesh, batch_axis=batch_axis,
                 feed_shapes={n: v.shape for n, v in jfeeds.items()},
-                n_steps=steps,
+                n_steps=steps, remat=remat,
             )
             self._cache[cache_key] = compiled
             from ..flags import flag as _flagv

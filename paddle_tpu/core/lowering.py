@@ -41,6 +41,9 @@ class LoweringContext:
         self.env: Dict[str, Any] = {}
         # set by run_block_with_backward while sparse-grad taps are active
         self.sparse_taps = None
+        # BuildStrategy.memory_optimize: rematerialize the forward during
+        # backward (jax.checkpoint) instead of keeping activations
+        self.remat = False
 
     def next_key(self):
         self.key, sub = jax.random.split(self.key)
@@ -201,7 +204,8 @@ def run_block_with_backward(ctx: LoweringContext, ops: List[Operator], env: Dict
         for i, (_, _, shape, dtype) in enumerate(coll.taps):
             deltas0[f"__tap{i}"] = jnp.zeros(shape, dtype)
 
-    loss, vjp_fn, env_after = jax.vjp(fwd, primal_params, deltas0, has_aux=True)
+    fwd_fn = jax.checkpoint(fwd) if ctx.remat else fwd
+    loss, vjp_fn, env_after = jax.vjp(fwd_fn, primal_params, deltas0, has_aux=True)
     (grads, dtaps) = vjp_fn(jnp.ones_like(loss))
 
     env = env_after

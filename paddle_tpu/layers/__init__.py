@@ -65,6 +65,7 @@ from .tensor import (  # noqa: F401
     concat,
     create_global_var,
     fill_constant,
+    linspace,
     ones,
     sums,
     zeros,

@@ -118,3 +118,21 @@ def create_global_var(shape, value, dtype, persistable=False, force_cpu=False, n
         attrs={"shape": list(shape), "dtype": canonical_dtype(dtype), "value": float(value)},
     )
     return var
+
+
+def linspace(start, stop, num, dtype="float32", name=None):
+    """num evenly spaced values in [start, stop] (reference layers.linspace).
+    `num` must be a python int — XLA needs a static output length."""
+    from ..core.layer_helper import LayerHelper
+
+    helper = LayerHelper("linspace", name=name)
+    out = helper.create_variable_for_type_inference(dtype, shape=(int(num),))
+    s = fill_constant([1], dtype, float(start))
+    e = fill_constant([1], dtype, float(stop))
+    helper.append_op(
+        "linspace",
+        inputs={"Start": [s.name], "Stop": [e.name]},
+        outputs={"Out": [out.name]},
+        attrs={"num_v": int(num)},
+    )
+    return out

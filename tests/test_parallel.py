@@ -91,3 +91,49 @@ def test_dp_batch_not_divisible_replicates():
     yv = np.random.randint(0, 4, size=(6, 1))
     (lv,) = exe.run(compiled, feed={"x": xv, "y": yv}, fetch_list=[loss], scope=scope)
     assert np.isfinite(lv[0])
+
+
+def test_memory_optimize_remat_matches_baseline():
+    """BuildStrategy.memory_optimize => jax.checkpoint over the forward:
+    same losses, rematerialized backward (reference memory_optimize_pass
+    capability, XLA-native form)."""
+    import numpy as np
+
+    def build():
+        main, startup = fluid.Program(), fluid.Program()
+        startup.random_seed = 21
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [16], dtype="float32")
+            y = fluid.layers.data("y", [1], dtype="float32")
+            h = fluid.layers.fc(x, 64, act="tanh")
+            h = fluid.layers.fc(h, 64, act="tanh")
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(fluid.layers.fc(h, 1), y))
+            fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+        return main, startup, loss
+
+    from paddle_tpu.parallel import make_mesh
+
+    rng = np.random.RandomState(0)
+    xv = rng.rand(8, 16).astype("f4")
+    yv = xv.sum(1, keepdims=True).astype("f4")
+
+    def run(memory_optimize):
+        main, startup, loss = build()
+        bs = fluid.BuildStrategy()
+        bs.memory_optimize = memory_optimize
+        mesh = make_mesh((8,), ("dp",))
+        prog = fluid.CompiledProgram(main, build_strategy=bs).with_mesh(mesh)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+        out = []
+        for _ in range(5):
+            (lv,) = exe.run(prog, feed={"x": xv, "y": yv}, fetch_list=[loss],
+                            scope=scope)
+            out.append(float(np.asarray(lv).reshape(-1)[0]))
+        return out
+
+    base = run(False)
+    remat = run(True)
+    np.testing.assert_allclose(remat, base, rtol=1e-6, atol=1e-7)

@@ -1,0 +1,120 @@
+"""Program-rewrite pass infrastructure (reference ir::Pass registry role)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core import passes
+
+
+def _run(main, startup, feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    return exe.run(main, feed=feed, fetch_list=[fetch], scope=scope)[0]
+
+
+def test_remove_identity_ops_preserves_semantics():
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 1
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        a = fluid.layers.assign(x)
+        b = fluid.layers.scale(a, scale=1.0, bias=0.0)   # identity
+        out = fluid.layers.scale(b, scale=2.0)
+    xv = np.random.RandomState(0).rand(3, 4).astype("f4")
+    ref = _run(main, startup, {"x": xv}, out)
+    n_before = len(main.global_block().ops)
+    passes.apply_pass(main, "remove_identity_ops")
+    n_after = len(main.global_block().ops)
+    assert n_after < n_before
+    got = _run(main, startup, {"x": xv}, out)
+    np.testing.assert_allclose(got, ref)
+    np.testing.assert_allclose(got, xv * 2.0)
+
+
+def test_fold_scale_chains():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.scale(x, scale=2.0, bias=1.0)
+        z = fluid.layers.scale(y, scale=3.0, bias=0.5)
+    xv = np.random.RandomState(1).rand(2, 4).astype("f4")
+    ref = _run(main, startup, {"x": xv}, z)
+    passes.apply_pass(main, "fold_scale_chains")
+    # the final scale now reads x directly with composed attrs; the bypassed
+    # intermediate stays (executor prune drops it when dead)
+    last = [op for op in main.global_block().ops if op.type == "scale"][-1]
+    assert last.input_arg_names == ["in_x" if False else "x"]
+    assert abs(last.attrs["scale"] - 6.0) < 1e-9 and abs(last.attrs["bias"] - 3.5) < 1e-9
+    got = _run(main, startup, {"x": xv}, z)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    np.testing.assert_allclose(got, xv * 6.0 + 3.5, rtol=1e-6)
+
+
+def test_pass_builder_pipeline():
+    pb = passes.PassBuilder()
+    pb.append_pass("remove_identity_ops").append_pass("fold_scale_chains")
+    assert pb.all_passes() == ["remove_identity_ops", "fold_scale_chains"]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        out = fluid.layers.scale(fluid.layers.scale(fluid.layers.assign(x), 2.0), 5.0)
+    pb.apply(main)
+    got = _run(main, startup, {"x": np.ones((1, 4), "f4")}, out)
+    np.testing.assert_allclose(got, np.full((1, 4), 10.0, "f4"))
+
+
+def test_unknown_pass_raises():
+    import pytest
+
+    with pytest.raises(KeyError, match="unknown pass"):
+        passes.apply_pass(fluid.Program(), "no_such_pass")
+
+
+
+def test_remove_identity_respects_keep_and_subblocks():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        a = fluid.layers.assign(x)   # fetched: must survive
+        b = fluid.layers.assign(x)   # unfetched: removable
+        out = fluid.layers.scale(b, scale=2.0)
+    passes.apply_pass(main, "remove_identity_ops", keep=[a.name])
+    types = [op.type for op in main.global_block().ops]
+    assert types.count("assign") == 1
+    xv = np.ones((1, 4), "f4")
+    got_a, got_out = None, None
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    got_a, got_out = exe.run(main, feed={"x": xv}, fetch_list=[a, out], scope=scope)
+    np.testing.assert_allclose(got_a, xv)
+    np.testing.assert_allclose(got_out, xv * 2)
+
+
+def test_fold_does_not_cross_inplace_writes():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.scale(x, scale=2.0)
+        # an intervening write to y's name (increment writes in place)
+        inc = fluid.layers.increment(y, value=10.0, in_place=True)
+        z = fluid.layers.scale(y, scale=3.0)
+    xv = np.ones((1, 4), "f4")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    (ref,) = exe.run(main, feed={"x": xv}, fetch_list=[z], scope=scope)
+    passes.apply_pass(main, "fold_scale_chains")
+    (got,) = exe.run(main, feed={"x": xv}, fetch_list=[z], scope=scope)
+    np.testing.assert_allclose(got, ref)  # (2*1 + 10) * 3 = 36, not 6
+
+
+def test_prune_requires_targets():
+    import pytest
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        fluid.layers.scale(x, scale=2.0)
+    with pytest.raises(ValueError, match="targets"):
+        passes.apply_pass(main, "prune_dead_ops")
