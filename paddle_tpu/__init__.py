@@ -44,6 +44,10 @@ from . import datasets  # noqa: F401
 from . import nets  # noqa: F401
 from . import debugger  # noqa: F401
 from .checkpoint_manager import CheckpointManager  # noqa: F401
+from . import fleet as _fleet_mod  # noqa: F401
+from .fleet import fleet  # the singleton (reference incubate.fleet)  # noqa: F401
+from . import transpiler  # noqa: F401
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .core import passes  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import metrics  # noqa: F401

@@ -1,0 +1,164 @@
+"""Fleet: the user-facing cluster training API.
+
+Reference: incubate/fleet/base/fleet_base.py:37 (Fleet) +
+role_maker.py (PaddleCloudRoleMaker reads PADDLE_* env) +
+transpiler/distribute_transpiler.py collective/NCCL2 modes.
+
+TPU-first: one implementation path — the coordination-service bootstrap
+(parallel/distributed.py) plus a global dp mesh; `distributed_optimizer`
+wraps any Optimizer so `minimize()` compiles the program for the global
+mesh.  The pserver mode has no TPU equivalent for dense params (allreduce
+won, SURVEY §2c); sparse tables ride the SelectedRows/ep path instead.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class UserDefinedRoleMaker:
+    """reference role_maker.UserDefinedRoleMaker (collective flavor)."""
+
+    def __init__(self, current_id: int = 0, worker_num: int = 1,
+                 worker_endpoints=None):
+        self._id = current_id
+        self._num = worker_num
+        self._endpoints = list(worker_endpoints or [])
+
+    def worker_index(self) -> int:
+        return self._id
+
+    def worker_num(self) -> int:
+        return self._num
+
+    def get_trainer_endpoints(self):
+        return list(self._endpoints)
+
+    def is_first_worker(self) -> bool:
+        return self._id == 0
+
+
+class PaddleCloudRoleMaker(UserDefinedRoleMaker):
+    """reference role_maker.PaddleCloudRoleMaker: everything from PADDLE_*
+    env vars (one parser: parallel.distributed.trainer_env)."""
+
+    def __init__(self, is_collective: bool = True):
+        from .parallel.distributed import trainer_env
+
+        tid, endpoints, _ = trainer_env()
+        endpoints = endpoints or []
+        super().__init__(
+            current_id=tid if tid is not None else 0,
+            worker_num=len(endpoints) or int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),
+            worker_endpoints=endpoints,
+        )
+
+
+class DistributedStrategy:
+    """reference DistributedStrategy carrier: the knobs that still mean
+    something map onto BuildStrategy/mesh choices."""
+
+    def __init__(self):
+        self.use_local_sgd = False
+        self.local_sgd_steps = 4
+        self.memory_optimize = False  # -> remat
+        self.nccl_comm_num = 1        # accepted no-op: ICI is one fabric
+
+
+class Fleet:
+    def __init__(self):
+        self._role = None
+        self._strategy = DistributedStrategy()
+        self._mesh = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def init(self, role_maker=None):
+        """Bootstrap the cross-process runtime when endpoints say so."""
+        self._role = role_maker or PaddleCloudRoleMaker()
+        eps = self._role.get_trainer_endpoints()
+        if len(eps) > 1:
+            from .parallel import distributed as dist
+
+            dist.init_distributed(
+                trainer_id=self._role.worker_index(),
+                trainer_endpoints=eps,
+            )
+        return self
+
+    def is_first_worker(self) -> bool:
+        return self._role is None or self._role.is_first_worker()
+
+    def worker_index(self) -> int:
+        return 0 if self._role is None else self._role.worker_index()
+
+    def worker_num(self) -> int:
+        return 1 if self._role is None else self._role.worker_num()
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            from .parallel.distributed import global_mesh
+
+            self._mesh = global_mesh()
+        return self._mesh
+
+    # -- the training surface ---------------------------------------------
+    def distributed_optimizer(self, optimizer, strategy: Optional[DistributedStrategy] = None):
+        if strategy is not None:
+            self._strategy = strategy
+        if self._strategy.use_local_sgd:
+            raise NotImplementedError(
+                "DistributedStrategy.use_local_sgd: program-integrated "
+                "LocalSGD is not wired into Fleet; use "
+                "paddle_tpu.parallel.local_sgd.local_sgd_train directly "
+                "(k local steps + one pmean per round)")
+        return _DistributedOptimizer(self, optimizer)
+
+    def main_program(self, program):
+        """Compile a program for the fleet's global mesh (what the
+        transpiler's NCCL2 mode produced as `trainer_program`)."""
+        from .parallel.compiled_program import BuildStrategy, CompiledProgram
+
+        bs = BuildStrategy()
+        bs.memory_optimize = self._strategy.memory_optimize
+        return CompiledProgram(program, build_strategy=bs).with_mesh(self.mesh)
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None, scope=None):
+        from . import io as _io
+
+        if self.is_first_worker():
+            return _io.save_inference_model(dirname, feeded_var_names,
+                                            target_vars, executor,
+                                            main_program=main_program, scope=scope)
+
+    def save_persistables(self, executor, dirname, main_program=None, scope=None):
+        from . import io as _io
+
+        if self.is_first_worker():
+            return _io.save_persistables(executor, dirname,
+                                         main_program=main_program, scope=scope)
+
+
+class _DistributedOptimizer:
+    """reference fleet_base.DistributedOptimizer: minimize() keeps the
+    reference's 2-tuple return; the mesh-compiled program is available as
+    `.compiled_program` afterwards (or via fleet.main_program)."""
+
+    def __init__(self, fleet: Fleet, inner):
+        self._fleet = fleet
+        self._inner = inner
+        self.compiled_program = None
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        ops, pg = self._inner.minimize(loss, startup_program, parameter_list,
+                                       no_grad_set)
+        self.compiled_program = self._fleet.main_program(loss.block.program)
+        return ops, pg
+
+
+fleet = Fleet()  # the module-level singleton the reference exposes

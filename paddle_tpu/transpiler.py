@@ -1,0 +1,88 @@
+"""DistributeTranspiler source-compatibility layer.
+
+Reference: transpiler/distribute_transpiler.py (DistributeTranspiler:183,
+transpile:377) with three modes — pserver (default), nccl2:261,
+collective:313 — rewriting programs into send/recv or c_allreduce graphs.
+
+TPU-first mapping (SURVEY §2c):
+  * collective / nccl2 modes -> NO program rewrite is needed: the executor
+    emits ONE SPMD program whose gradient all-reduces GSPMD inserts.
+    `transpile()` therefore only performs the bootstrap the `gen_nccl_id`
+    op did (coordination service via parallel/distributed.py) and
+    `get_trainer_program()` hands back the program compiled for the global
+    mesh.
+  * pserver mode for DENSE parameters is intentionally NOT implemented:
+    allreduce strictly wins on ICI (SURVEY §2c) — `get_pserver_program`
+    raises with that rationale.  The capability the pserver mode actually
+    carried (sparse embedding tables) lives in the SelectedRows/ep path
+    (core/selected_rows.py, parallel/embedding.py).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+
+class DistributeTranspilerConfig:
+    """reference transpiler config: carriers kept for source compat."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.min_block_size = 8192
+        self.mode = "collective"  # "nccl2" and "collective" behave the same
+        self.sync_mode = True
+        self.nccl_comm_num = 1
+        self.use_hierarchical_allreduce = False  # ICI handles hierarchy
+
+
+class DistributeTranspiler:
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._program = None
+
+    def transpile(self, trainer_id, program=None, pservers="", trainers=1,
+                  sync_mode=True, startup_program=None, current_endpoint=""):
+        """collective/nccl2 semantics: bootstrap the process group and
+        remember the program; no graph rewrite is required on TPU."""
+        from .core.program import default_main_program
+
+        # a non-empty `pservers` list IS the legacy pserver-mode request,
+        # whatever the config says — fail with the rationale instead of
+        # silently training unsynchronized replicas
+        if pservers or self.config.mode == "pserver":
+            raise NotImplementedError(
+                "DistributeTranspiler: dense parameter-server mode is "
+                "deliberately unimplemented on TPU — synchronous allreduce "
+                "over ICI strictly dominates (SURVEY §2c); use the default "
+                "collective mode. Sparse/giant tables: use is_sparse "
+                "embeddings (SelectedRows) with ep-axis sharding instead.")
+        self._program = program if program is not None else default_main_program()
+        if isinstance(trainers, str) and trainers:
+            endpoints = trainers.split(",")
+        else:
+            endpoints = None
+        if endpoints and len(endpoints) > 1:
+            from .parallel import distributed as dist
+
+            dist.init_distributed(trainer_id=trainer_id,
+                                  trainer_endpoints=endpoints,
+                                  current_endpoint=current_endpoint or None)
+        return self
+
+    def get_trainer_program(self, wait_port=True):
+        from .parallel.compiled_program import CompiledProgram
+        from .parallel.distributed import global_mesh
+
+        if self._program is None:
+            raise RuntimeError("call transpile() first")
+        return CompiledProgram(self._program).with_mesh(global_mesh())
+
+    def get_pserver_program(self, endpoint):
+        raise NotImplementedError(
+            "get_pserver_program: no pserver role exists in the TPU build — "
+            "every process is a trainer in one SPMD program (see transpile())")
+
+    def get_startup_program(self, endpoint=None, pserver_program=None,
+                            startup_program=None):
+        from .core.program import default_startup_program
+
+        return startup_program if startup_program is not None else default_startup_program()

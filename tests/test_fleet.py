@@ -1,0 +1,71 @@
+"""Fleet API + DistributeTranspiler compat (reference fleet_base.py:37,
+distribute_transpiler.py collective/nccl2 modes)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.fleet import Fleet, UserDefinedRoleMaker
+
+
+def _model():
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(x, 1), y))
+    return main, startup, loss
+
+
+def test_fleet_single_process_trains_on_global_mesh():
+    f = Fleet()
+    f.init(UserDefinedRoleMaker(current_id=0, worker_num=1))
+    main, startup, loss = _model()
+    with fluid.program_guard(main, startup):
+        opt = f.distributed_optimizer(fluid.optimizer.SGD(0.1))
+        ops, pg = opt.minimize(loss)  # reference 2-tuple contract
+        compiled = opt.compiled_program
+    assert compiled.mesh is not None and len(compiled.mesh.devices.flat) == 8
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(10):
+        xv = rng.rand(16, 8).astype("f4")
+        (lv,) = exe.run(compiled, feed={"x": xv, "y": xv.sum(1, keepdims=True)},
+                        fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.5
+    assert f.is_first_worker() and f.worker_num() == 1
+
+
+def test_transpiler_collective_mode_compiles_for_mesh():
+    main, startup, loss = _model()
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    t = fluid.DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, trainers=1)
+    prog = t.get_trainer_program()
+    assert prog.mesh is not None
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    xv = np.ones((8, 8), "f4")
+    (lv,) = exe.run(prog, feed={"x": xv, "y": xv.sum(1, keepdims=True)},
+                    fetch_list=[loss], scope=scope)
+    assert np.isfinite(np.asarray(lv)).all()
+
+
+def test_transpiler_pserver_mode_raises_with_rationale():
+    # a non-empty pservers list triggers the guard even with default config
+    t = fluid.DistributeTranspiler()
+    with pytest.raises(NotImplementedError, match="allreduce"):
+        t.transpile(trainer_id=0, pservers="127.0.0.1:6000", trainers=2)
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.mode = "pserver"
+    with pytest.raises(NotImplementedError, match="allreduce"):
+        fluid.DistributeTranspiler(cfg).transpile(trainer_id=0, trainers=2)
+    with pytest.raises(NotImplementedError, match="pserver"):
+        fluid.DistributeTranspiler().get_pserver_program("127.0.0.1:6000")
