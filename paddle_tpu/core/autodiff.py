@@ -109,9 +109,35 @@ def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
         targets = [targets]
     if isinstance(inputs, Variable):
         inputs = [inputs]
-    if len(targets) != 1:
-        raise NotImplementedError("calc_gradient currently supports a single target")
-    loss = targets[0]
+    if target_gradients is not None and len(target_gradients) != len(targets):
+        raise ValueError("calc_gradient: target_gradients must match targets")
+    if len(targets) == 1 and target_gradients is None:
+        loss = targets[0]
+    else:
+        # multiple targets / weighted cotangents: d/dx sum_i <t_i, tg_i>
+        # is exactly the requested vjp — build the combined scalar with
+        # program ops so one backward region covers it
+        block0 = targets[0].block
+        parts = []
+        for i, t in enumerate(targets):
+            v = t
+            tg = target_gradients[i] if target_gradients is not None else None
+            if tg is not None:  # None entry = all-ones cotangent (reference)
+                w = block0.create_var(shape=t.shape, dtype=t.dtype)
+                block0.append_op("elementwise_mul",
+                                 inputs={"X": [t.name], "Y": [tg.name]},
+                                 outputs={"Out": [w.name]}, attrs={"axis": -1})
+                v = w
+            r = block0.create_var(shape=(1,), dtype=t.dtype)
+            block0.append_op("reduce_sum", inputs={"X": [v.name]},
+                             outputs={"Out": [r.name]}, attrs={"reduce_all": True})
+            parts.append(r)
+        if len(parts) == 1:
+            loss = parts[0]
+        else:
+            loss = block0.create_var(shape=(1,), dtype=targets[0].dtype)
+            block0.append_op("sum", inputs={"X": [p.name for p in parts]},
+                             outputs={"Out": [loss.name]})
     block = loss.block
     param_names = [v.name for v in inputs]
     grad_names = [_grad_name(n) for n in param_names]

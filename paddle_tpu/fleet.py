@@ -47,6 +47,12 @@ class PaddleCloudRoleMaker(UserDefinedRoleMaker):
 
         tid, endpoints, _ = trainer_env()
         endpoints = endpoints or []
+        if len(endpoints) > 1 and tid is None:
+            # defaulting to rank 0 here would give every process the same id
+            # and corrupt the bootstrap — fail fast like the reference
+            raise ValueError(
+                "PaddleCloudRoleMaker: PADDLE_TRAINER_ENDPOINTS lists "
+                f"{len(endpoints)} workers but PADDLE_TRAINER_ID is unset")
         super().__init__(
             current_id=tid if tid is not None else 0,
             worker_num=len(endpoints) or int(os.environ.get("PADDLE_TRAINERS_NUM", "1")),

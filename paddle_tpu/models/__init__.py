@@ -3,3 +3,5 @@
 resnet, vgg, mnist, stacked_dynamic_lstm, se_resnext + BERT/Transformer
 targets from BASELINE.md)."""
 from . import mnist, nmt, resnet, transformer  # noqa: F401
+from . import vision  # noqa: F401
+from . import deepfm  # noqa: F401

@@ -87,3 +87,36 @@ def test_rng_advances_between_runs():
     (a,) = exe.run(feed={"x": xv}, fetch_list=[d])
     (b,) = exe.run(feed={"x": xv}, fetch_list=[d])
     assert not np.array_equal(a, b)
+
+
+def test_calc_gradient_multi_target():
+    """VERDICT weak-item regression: calc_gradient over several targets
+    (gradient of the summed targets, reference backward.py:672)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [3], dtype="float32")
+        t1 = fluid.layers.scale(x, scale=2.0)     # d sum(t1)/dx = 2
+        t2 = fluid.layers.scale(x, scale=5.0)     # d sum(t2)/dx = 5
+        grads = fluid.calc_gradient([t1, t2], [x])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    xv = np.ones((2, 3), "float32")
+    (g,) = exe.run(main, feed={"x": xv}, fetch_list=[grads[0]], scope=scope)
+    np.testing.assert_allclose(g, np.full((2, 3), 7.0), atol=1e-6)
+
+
+def test_calc_gradient_mixed_none_target_gradients():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [3], dtype="float32")
+        t1 = fluid.layers.scale(x, scale=2.0)
+        t2 = fluid.layers.scale(x, scale=5.0)
+        tg = fluid.layers.fill_constant([3], "float32", 3.0)
+        grads = fluid.calc_gradient([t1, t2], [x], target_gradients=[tg, None])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    (g,) = exe.run(main, feed={"x": np.ones((2, 3), "float32")},
+                   fetch_list=[grads[0]], scope=scope)
+    np.testing.assert_allclose(g, np.full((2, 3), 2 * 3 + 5.0), atol=1e-6)
