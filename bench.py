@@ -164,7 +164,7 @@ def bench_nmt(iters=6):
             "config": "base-6L-512d ragged"}
 
 
-def bench_bert(batch_size=64, seq_len=128, iters=6):
+def bench_bert(batch_size=256, seq_len=128, iters=4):
     import jax
     import jax.numpy as jnp
 

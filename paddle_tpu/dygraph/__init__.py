@@ -7,10 +7,13 @@ from .nn import (  # noqa: F401
     FC,
     BatchNorm,
     Conv2D,
+    Conv2DTranspose,
     Dropout,
     Embedding,
+    GRUUnit,
     LayerNorm,
     Linear,
     Pool2D,
+    PRelu,
 )
 from .parallel import DataParallel  # noqa: F401

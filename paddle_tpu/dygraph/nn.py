@@ -237,3 +237,95 @@ def _activation(x, act):
         "leaky_relu": functools.partial(jax.nn.leaky_relu, negative_slope=0.02),
     }
     return _apply(act, fns[act], x)
+
+
+class Conv2DTranspose(Layer):
+    """reference dygraph/nn.py Conv2DTranspose (fluid filter layout)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, param_attr=None, bias_attr=None, act=None,
+                 dtype="float32"):
+        super().__init__("conv2d_transpose", dtype)
+        fs = [filter_size] * 2 if isinstance(filter_size, int) else list(filter_size)
+        self._stride = [stride] * 2 if isinstance(stride, int) else list(stride)
+        self._padding = [padding] * 2 if isinstance(padding, int) else list(padding)
+        self.weight = self.create_parameter(
+            [num_channels, num_filters, fs[0], fs[1]], attr=param_attr)
+        self.bias = self.create_parameter([num_filters], attr=bias_attr, is_bias=True)
+        self.act = act
+
+    def forward(self, x):
+        s, p = self._stride, self._padding
+
+        def fn(xv, w, b):
+            kh, kw = w.shape[2], w.shape[3]
+            wt = jnp.swapaxes(jnp.flip(w, axis=(2, 3)), 0, 1)
+            out = jax.lax.conv_general_dilated(
+                xv, wt, window_strides=(1, 1),
+                padding=[(kh - 1 - p[0], kh - 1 - p[0]),
+                         (kw - 1 - p[1], kw - 1 - p[1])],
+                lhs_dilation=tuple(s),
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            return out + b.reshape(1, -1, 1, 1)
+
+        return _activation(_apply("conv2d_transpose", fn, x, self.weight, self.bias),
+                           self.act)
+
+
+class PRelu(Layer):
+    """reference dygraph/nn.py PRelu (mode 'all'|'channel'|'element')."""
+
+    def __init__(self, mode="all", channel=None, input_shape=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__("prelu", dtype)
+        self._mode = mode
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            shape = [channel]
+        else:
+            shape = list(input_shape)
+        self.weight = self.create_parameter(
+            shape, attr=param_attr, default_initializer=ConstantInitializer(0.25))
+
+    def forward(self, x):
+        mode = self._mode
+
+        def fn(xv, a):
+            if mode == "channel":
+                ar = a.reshape((1, -1) + (1,) * (xv.ndim - 2))
+            elif mode == "element":
+                ar = a.reshape((1,) + a.shape)
+            else:
+                ar = a.reshape(())
+            return jnp.where(xv > 0, xv, ar * xv)
+
+        return _apply("prelu", fn, x, self.weight)
+
+
+class GRUUnit(Layer):
+    """reference dygraph/nn.py GRUUnit: one GRU step (gate order u, r, c)."""
+
+    def __init__(self, size, param_attr=None, bias_attr=None, dtype="float32",
+                 origin_mode=False):
+        super().__init__("gru_unit", dtype)
+        d = size // 3
+        self._d = d
+        self._origin = origin_mode
+        self.weight = self.create_parameter([d, 3 * d], attr=param_attr)
+        self.bias = self.create_parameter([3 * d], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, hidden):
+        d = self._d
+        origin = self._origin
+
+        def fn(xv, h, w, b):
+            ur = jax.nn.sigmoid(xv[:, :2 * d] + h @ w[:, :2 * d] + b[:2 * d])
+            u, r = ur[:, :d], ur[:, d:]
+            c = jnp.tanh(xv[:, 2 * d:] + (r * h) @ w[:, 2 * d:] + b[2 * d:])
+            if origin:
+                return u * h + (1 - u) * c
+            return (1 - u) * h + u * c
+
+        out = _apply("gru_unit", fn, x, hidden, self.weight, self.bias)
+        return out, out, None  # (hidden, reset_hidden_prev, gate) API shape

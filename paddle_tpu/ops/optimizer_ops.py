@@ -15,6 +15,7 @@ semantic difference from the dense kernels the reference documents for
 momentum/adam)."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..core.registry import register_op
@@ -356,3 +357,21 @@ def _model_average_count(ctx, op, ins):
     max_w = op.attr("max_average_window", 10000)
     c2 = cnt + 1.0
     return {"CountOut": jnp.where(c2 >= max_w, c2 * 0.5, c2).reshape((1,))}
+
+
+@register_opt("dpsgd")
+def _dpsgd(ctx, op, ins):
+    """reference optimizers/dpsgd_op.cc: differentially-private SGD —
+    per-batch gradient L2-clipped to `clip`, Gaussian noise sigma*clip
+    added, then a plain SGD step."""
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    clip = op.attr("clip", 10.0)
+    sigma = op.attr("sigma", 1.0)
+    lr = _lr(ins)
+    gf = g.astype(jnp.float32)
+    norm = jnp.sqrt(jnp.sum(jnp.square(gf)))
+    scale = jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-12))
+    noise = sigma * clip * jax.random.normal(ctx.next_key(), g.shape, jnp.float32)
+    upd = gf * scale + noise
+    return {"ParamOut": p - lr * upd}

@@ -712,6 +712,25 @@ class ModelAverage:
         pass
 
 
+class DpsgdOptimizer(Optimizer):
+    """Differentially-private SGD (reference optimizer.py Dpsgd over
+    dpsgd_op.cc): clip the gradient's L2 norm, add Gaussian noise, step."""
+
+    def __init__(self, learning_rate, clip=10.0, batch_size=16.0, sigma=1.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._clip, self._sigma = clip, sigma
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "dpsgd",
+            inputs={"Param": [p.name], "Grad": [g.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name]},
+            attrs={"clip": self._clip, "sigma": self._sigma},
+        )
+
+
 class PipelineOptimizer:
     """Program-level pipeline parallelism (reference: optimizer.py:2661
     PipelineOptimizer + SectionWorker).
@@ -908,4 +927,5 @@ Adamax = AdamaxOptimizer
 Adadelta = AdadeltaOptimizer
 Ftrl = FtrlOptimizer
 Lamb = LambOptimizer
+Dpsgd = DpsgdOptimizer
 LarsMomentum = LarsMomentumOptimizer

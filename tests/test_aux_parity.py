@@ -287,3 +287,27 @@ def test_debugger_graphviz_dump(tmp_path):
     assert (tmp_path / "prog.block0.dot").exists()
     # persistable params render with the param fill color
     assert "#ffe4b5" in dot
+
+
+def test_dpsgd_trains_with_noise():
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 12
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [6], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.Dpsgd(0.05, clip=5.0, sigma=0.01).minimize(loss)
+    assert "dpsgd" in [op.type for op in main.global_block().ops]
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    w = rng.rand(6, 1).astype("f4")
+    losses = []
+    for _ in range(60):
+        xv = rng.rand(16, 6).astype("f4")
+        (lv,) = exe.run(main, feed={"x": xv, "y": xv @ w}, fetch_list=[loss],
+                        scope=scope)
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.5

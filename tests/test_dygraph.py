@@ -208,3 +208,29 @@ def test_dygraph_eager_optimizers_converge():
                 layer.clear_gradients()
                 losses.append(float(loss.numpy().reshape(-1)[0]))
             assert losses[-1] < losses[0] * 0.5, (type(opt).__name__, losses[0], losses[-1])
+
+
+def test_dygraph_new_layers_forward_backward():
+    """Conv2DTranspose / PRelu / GRUUnit eager layers run and backprop."""
+    rng = np.random.RandomState(5)
+    with dygraph.guard():
+        ct = dygraph.Conv2DTranspose(3, 5, 3, stride=2, padding=1)
+        x = dygraph.to_variable(rng.rand(2, 3, 4, 4).astype("f4"))
+        y = ct(x)
+        assert y.numpy().shape == (2, 5, 7, 7)
+
+        pr = dygraph.PRelu(mode="channel", channel=5)
+        z = pr(y)
+        loss = fluid.layers.mean(z)
+        loss.backward()
+        assert np.isfinite(ct.parameters()[0].gradient()).all()
+
+    with dygraph.guard():
+        gru = dygraph.GRUUnit(3 * 8)
+        x = dygraph.to_variable(rng.rand(4, 24).astype("f4"))
+        h0 = dygraph.to_variable(rng.rand(4, 8).astype("f4"))
+        h, _, _ = gru(x, h0)
+        assert h.numpy().shape == (4, 8)
+        loss = fluid.layers.mean(h)
+        loss.backward()
+        assert np.abs(gru.parameters()[0].gradient()).sum() > 0
