@@ -258,14 +258,9 @@ class Conv2DTranspose(Layer):
         s, p = self._stride, self._padding
 
         def fn(xv, w, b):
-            kh, kw = w.shape[2], w.shape[3]
-            wt = jnp.swapaxes(jnp.flip(w, axis=(2, 3)), 0, 1)
-            out = jax.lax.conv_general_dilated(
-                xv, wt, window_strides=(1, 1),
-                padding=[(kh - 1 - p[0], kh - 1 - p[0]),
-                         (kw - 1 - p[1], kw - 1 - p[1])],
-                lhs_dilation=tuple(s),
-                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            from ..ops.nn_ops import conv2d_transpose_math
+
+            out = conv2d_transpose_math(xv, w, strides=s, pads=p)
             return out + b.reshape(1, -1, 1, 1)
 
         return _activation(_apply("conv2d_transpose", fn, x, self.weight, self.bias),
@@ -284,7 +279,8 @@ class PRelu(Layer):
         elif mode == "channel":
             shape = [channel]
         else:
-            shape = list(input_shape)
+            # reference: alpha is per-element over the non-batch dims
+            shape = list(input_shape)[1:]
         self.weight = self.create_parameter(
             shape, attr=param_attr, default_initializer=ConstantInitializer(0.25))
 
@@ -295,7 +291,7 @@ class PRelu(Layer):
             if mode == "channel":
                 ar = a.reshape((1, -1) + (1,) * (xv.ndim - 2))
             elif mode == "element":
-                ar = a.reshape((1,) + a.shape)
+                ar = a.reshape((1,) + tuple(a.shape))
             else:
                 ar = a.reshape(())
             return jnp.where(xv > 0, xv, ar * xv)
@@ -324,8 +320,13 @@ class GRUUnit(Layer):
             u, r = ur[:, :d], ur[:, d:]
             c = jnp.tanh(xv[:, 2 * d:] + (r * h) @ w[:, 2 * d:] + b[2 * d:])
             if origin:
-                return u * h + (1 - u) * c
-            return (1 - u) * h + u * c
+                hn = u * h + (1 - u) * c
+            else:
+                hn = (1 - u) * h + u * c
+            # pack (hidden | r*h_prev) so both reference outputs come back
+            return jnp.concatenate([hn, r * h], axis=1)
 
-        out = _apply("gru_unit", fn, x, hidden, self.weight, self.bias)
-        return out, out, None  # (hidden, reset_hidden_prev, gate) API shape
+        packed = _apply("gru_unit", fn, x, hidden, self.weight, self.bias)
+        hn = _apply("gru_hidden", lambda pv: pv[:, :d], packed)
+        reset_h = _apply("gru_reset_h", lambda pv: pv[:, d:], packed)
+        return hn, reset_h, None  # gate tensor intentionally None

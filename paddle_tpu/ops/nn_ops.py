@@ -96,21 +96,16 @@ def _depthwise_conv2d(ctx, op, ins):
     return _conv2d(ctx, op, ins)
 
 
-@register_op("conv2d_transpose")
-def _conv2d_transpose(ctx, op, ins):
-    x = first(ins, "Input")
-    w = match_dtype(x, first(ins, "Filter"))  # fluid layout: (in, out, kh, kw)
-    strides = tuple(op.attr("strides", [1, 1]))
-    pads = op.attr("paddings", [0, 0])
-    dilations = tuple(op.attr("dilations", [1, 1]))
-    groups = op.attr("groups", 1) or 1
+def conv2d_transpose_math(x, w, strides=(1, 1), pads=(0, 0), dilations=(1, 1),
+                          groups=1):
+    """Transposed conv as an lhs-dilated conv with flipped kernel; fluid
+    filter layout (in, out/groups, kh, kw).  Shared by the graph lowering
+    and the dygraph Conv2DTranspose layer."""
     kh, kw = w.shape[2], w.shape[3]
-    # conv_transpose == lhs-dilated conv with flipped kernel
     pad_h = dilations[0] * (kh - 1) - pads[0]
     pad_w = dilations[1] * (kw - 1) - pads[1]
     wt = jnp.flip(w, axis=(2, 3))
     if groups > 1:
-        # fluid filter layout (in, out/groups, kh, kw) -> grouped OIHW:
         # per group swap (in/groups, out/groups) then stack groups on O
         cin, cog = w.shape[0], w.shape[1]
         wt = wt.reshape(groups, cin // groups, cog, kh, kw)
@@ -118,15 +113,28 @@ def _conv2d_transpose(ctx, op, ins):
         wt = wt.reshape(groups * cog, cin // groups, kh, kw)
     else:
         wt = jnp.swapaxes(wt, 0, 1)  # -> (out, in, kh, kw)
-    out = jax.lax.conv_general_dilated(
+    return jax.lax.conv_general_dilated(
         x,
         wt,
         window_strides=(1, 1),
         padding=[(pad_h, pad_h), (pad_w, pad_w)],
-        lhs_dilation=strides,
-        rhs_dilation=dilations,
+        lhs_dilation=tuple(strides),
+        rhs_dilation=tuple(dilations),
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=groups,
+    )
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ctx, op, ins):
+    x = first(ins, "Input")
+    w = match_dtype(x, first(ins, "Filter"))  # fluid layout: (in, out, kh, kw)
+    out = conv2d_transpose_math(
+        x, w,
+        strides=tuple(op.attr("strides", [1, 1])),
+        pads=op.attr("paddings", [0, 0]),
+        dilations=tuple(op.attr("dilations", [1, 1])),
+        groups=op.attr("groups", 1) or 1,
     )
     return {"Output": out}
 

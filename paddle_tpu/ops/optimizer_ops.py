@@ -368,10 +368,12 @@ def _dpsgd(ctx, op, ins):
     g = first(ins, "Grad")
     clip = op.attr("clip", 10.0)
     sigma = op.attr("sigma", 1.0)
+    batch_size = op.attr("batch_size", 16.0)
     lr = _lr(ins)
     gf = g.astype(jnp.float32)
     norm = jnp.sqrt(jnp.sum(jnp.square(gf)))
     scale = jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-12))
+    # reference dpsgd_op.h: update = (clipped_grad + sigma*clip*z) / batch
     noise = sigma * clip * jax.random.normal(ctx.next_key(), g.shape, jnp.float32)
-    upd = gf * scale + noise
+    upd = (gf * scale + noise) / batch_size
     return {"ParamOut": p - lr * upd}

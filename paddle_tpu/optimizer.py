@@ -718,7 +718,7 @@ class DpsgdOptimizer(Optimizer):
 
     def __init__(self, learning_rate, clip=10.0, batch_size=16.0, sigma=1.0, **kw):
         super().__init__(learning_rate, **kw)
-        self._clip, self._sigma = clip, sigma
+        self._clip, self._sigma, self._batch_size = clip, sigma, batch_size
 
     def _append_optimize_op(self, block, param_and_grad):
         p, g = param_and_grad
@@ -727,7 +727,8 @@ class DpsgdOptimizer(Optimizer):
             inputs={"Param": [p.name], "Grad": [g.name],
                     "LearningRate": [self._lr_var.name]},
             outputs={"ParamOut": [p.name]},
-            attrs={"clip": self._clip, "sigma": self._sigma},
+            attrs={"clip": self._clip, "sigma": self._sigma,
+                   "batch_size": self._batch_size},
         )
 
 
