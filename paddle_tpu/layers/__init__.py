@@ -30,6 +30,8 @@ from .detection import (  # noqa: F401
     iou_similarity,
     multiclass_nms,
     prior_box,
+    roi_align,
+    sigmoid_focal_loss,
     yolo_box,
 )
 from .nn import *  # noqa: F401,F403

@@ -88,3 +88,32 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=64,
                "background_label": background_label, "normalized": normalized},
     )
     return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
+              sampling_ratio=-1, rois_batch=None, name=None):
+    """Static-shape RoI Align: dense [R, 4] rois + optional [R] batch index
+    (replaces the reference's LoD rois)."""
+    helper = LayerHelper("roi_align", name=name)
+    out = _out(helper, input.dtype)
+    inputs = {"X": [input.name], "ROIs": [rois.name]}
+    if rois_batch is not None:
+        inputs["RoisBatch"] = [rois_batch.name]
+    helper.append_op(
+        "roi_align", inputs=inputs, outputs={"Out": [out.name]},
+        attrs={"pooled_height": pooled_height, "pooled_width": pooled_width,
+               "spatial_scale": spatial_scale, "sampling_ratio": sampling_ratio},
+    )
+    return out
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    helper = LayerHelper("sigmoid_focal_loss")
+    out = _out(helper, x.dtype, shape=x.shape)
+    helper.append_op(
+        "sigmoid_focal_loss",
+        inputs={"X": [x.name], "Label": [label.name], "FgNum": [fg_num.name]},
+        outputs={"Out": [out.name]},
+        attrs={"gamma": gamma, "alpha": alpha},
+    )
+    return out

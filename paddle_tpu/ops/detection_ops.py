@@ -260,3 +260,92 @@ def _multiclass_nms(ctx, op, ins):
 
     out = jax.vmap(per_image)(bboxes, scores)
     return {"Out": out}
+
+
+@register_op("roi_align")
+def _roi_align(ctx, op, ins):
+    """reference detection/roi_align_op: average of bilinear samples per
+    output bin.  ROIs are dense [R, 4] plus a batch-index vector RoisLod
+    replaces the reference's LoD (static-shape form)."""
+    x = first(ins, "X")                   # [N, C, H, W]
+    rois = first(ins, "ROIs")             # [R, 4] (x0, y0, x1, y1)
+    batch_idx = ins.get("RoisBatch")      # [R] batch indices (dense LoD stand-in)
+    batch_idx = (batch_idx[0].reshape(-1).astype(jnp.int32)
+                 if batch_idx else jnp.zeros((rois.shape[0],), jnp.int32))
+    ph = op.attr("pooled_height", 1)
+    pw = op.attr("pooled_width", 1)
+    scale = op.attr("spatial_scale", 1.0)
+    ratio = op.attr("sampling_ratio", -1)
+    # sampling_ratio <= 0: the reference uses an adaptive
+    # ceil(roi_size/pooled) grid, which is not jittable (data-dependent
+    # size); a fixed 2x2 grid per bin is the documented static stand-in —
+    # pass an explicit sampling_ratio for reference-exact sampling density.
+    n_samples = ratio if ratio > 0 else 2
+    H, W = x.shape[2], x.shape[3]
+
+    def bilinear(img, y, xq):
+        # reference roi_align_op.h: samples below -1 or beyond size are
+        # zero; [-1, 0] clamps to the border
+        valid = (y >= -1.0) & (y <= H) & (xq >= -1.0) & (xq <= W)
+        y = jnp.clip(y, 0.0, H - 1.0)
+        xq = jnp.clip(xq, 0.0, W - 1.0)
+        y0 = jnp.clip(jnp.floor(y).astype(jnp.int32), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xq).astype(jnp.int32), 0, W - 1)
+        y1 = jnp.clip(y0 + 1, 0, H - 1)
+        x1 = jnp.clip(x0 + 1, 0, W - 1)
+        wy = y - y0
+        wx = xq - x0
+        v00 = img[:, y0, x0]
+        v01 = img[:, y0, x1]
+        v10 = img[:, y1, x0]
+        v11 = img[:, y1, x1]
+        out = ((v00 * (1 - wx) + v01 * wx) * (1 - wy)
+               + (v10 * (1 - wx) + v11 * wx) * wy)
+        return jnp.where(valid[None, :], out, 0.0)
+
+    def one_roi(roi, bi):
+        img = x[bi]  # [C, H, W]
+        rx0, ry0, rx1, ry1 = roi[0] * scale, roi[1] * scale, roi[2] * scale, roi[3] * scale
+        rw = jnp.maximum(rx1 - rx0, 1.0)
+        rh = jnp.maximum(ry1 - ry0, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        # sample grid: n_samples x n_samples per bin
+        iy = (jnp.arange(ph)[:, None, None, None]
+              * bin_h + (jnp.arange(n_samples)[None, :, None, None] + 0.5)
+              * bin_h / n_samples + ry0)
+        ix = (jnp.arange(pw)[None, None, :, None]
+              * bin_w + (jnp.arange(n_samples)[None, None, None, :] + 0.5)
+              * bin_w / n_samples + rx0)
+        ys = jnp.broadcast_to(iy, (ph, n_samples, pw, n_samples)).reshape(-1)
+        xs = jnp.broadcast_to(ix, (ph, n_samples, pw, n_samples)).reshape(-1)
+        vals = bilinear(img, ys, xs)  # [C, ph*ns*pw*ns]
+        vals = vals.reshape(x.shape[1], ph, n_samples, pw, n_samples)
+        return jnp.mean(vals, axis=(2, 4))  # [C, ph, pw]
+
+    out = jax.vmap(one_roi)(rois, batch_idx)
+    return {"Out": out}
+
+
+@register_op("sigmoid_focal_loss")
+def _sigmoid_focal_loss(ctx, op, ins):
+    """reference detection/sigmoid_focal_loss_op: per-class focal loss over
+    logits [N, C], labels [N, 1] in 0..C (0 = background), FgNum
+    normalizer."""
+    x = first(ins, "X")
+    label = first(ins, "Label").reshape(-1)
+    fg = first(ins, "FgNum")
+    gamma = op.attr("gamma", 2.0)
+    alpha = op.attr("alpha", 0.25)
+    C = x.shape[1]
+    # one-hot target over classes 1..C mapped to columns 0..C-1;
+    # label -1 = ignore (reference kernel masks both loss terms)
+    t = (label[:, None] == (jnp.arange(C)[None, :] + 1)).astype(x.dtype)
+    p = jax.nn.sigmoid(x)
+    ce = jnp.maximum(x, 0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    p_t = p * t + (1 - p) * (1 - t)
+    a_t = alpha * t + (1 - alpha) * (1 - t)
+    loss = a_t * ((1 - p_t) ** gamma) * ce
+    loss = jnp.where((label >= 0)[:, None], loss, 0.0)
+    norm = jnp.maximum(fg.reshape(()).astype(x.dtype), 1.0)
+    return {"Out": loss / norm}

@@ -124,3 +124,88 @@ def test_multiclass_nms_static_shape():
     assert len(valid) == 3
     np.testing.assert_allclose(valid[:, 1], [0.95, 0.9, 0.7], atol=1e-6)
     assert valid[0, 0] == 2 and valid[1, 0] == 1 and valid[2, 0] == 1
+
+
+def test_roi_align_uniform_region():
+    """A constant feature map must pool to that constant for any roi."""
+    def build():
+        x = fluid.layers.data("x", [2, 8, 8], dtype="float32")
+        rois = fluid.layers.data("rois", [4], dtype="float32")
+        out = fluid.layers.roi_align(x, rois, pooled_height=2, pooled_width=2,
+                                     spatial_scale=1.0, sampling_ratio=2)
+        xv = np.full((1, 2, 8, 8), 3.5, "f4")
+        rv = np.array([[1.0, 1.0, 6.0, 6.0], [0.0, 0.0, 4.0, 4.0]], "f4")
+        return {"x": xv, "rois": rv}, [out]
+
+    (out,) = _run(build)
+    assert out.shape == (2, 2, 2, 2)
+    np.testing.assert_allclose(out, 3.5, atol=1e-5)
+
+
+def test_roi_align_gradient_region():
+    """A linear-in-x feature map pools to the bin centers' x coordinate."""
+    def build():
+        x = fluid.layers.data("x", [1, 8, 8], dtype="float32")
+        rois = fluid.layers.data("rois", [4], dtype="float32")
+        out = fluid.layers.roi_align(x, rois, pooled_height=1, pooled_width=2,
+                                     spatial_scale=1.0, sampling_ratio=2)
+        xv = np.tile(np.arange(8, dtype="f4")[None, None, None, :], (1, 1, 8, 1))
+        rv = np.array([[2.0, 2.0, 6.0, 6.0]], "f4")
+        return {"x": xv, "rois": rv}, [out]
+
+    (out,) = _run(build)
+    # roi x range [2, 6], two bins of width 2: centers at 3 and 5
+    np.testing.assert_allclose(out.reshape(-1), [3.0, 5.0], atol=0.1)
+
+
+def test_sigmoid_focal_loss_golden():
+    def build():
+        x = fluid.layers.data("x", [3], dtype="float32")
+        label = fluid.layers.data("label", [1], dtype="int64")
+        fg = fluid.layers.data("fg", [1], dtype="int32")
+        out = fluid.layers.sigmoid_focal_loss(x, label, fg, gamma=2.0, alpha=0.25)
+        xv = np.array([[0.5, -0.3, 1.2], [0.1, 0.8, -0.5]], "f4")
+        lv = np.array([[1], [3]], "int64")  # class 1 / class 3 (cols 0, 2)
+        return {"x": xv, "label": lv, "fg": np.array([[2]], "int32")}, [out]
+
+    (out,) = _run(build)
+    x = np.array([[0.5, -0.3, 1.2], [0.1, 0.8, -0.5]], "f4")
+    t = np.zeros((2, 3), "f4")
+    t[0, 0] = 1
+    t[1, 2] = 1
+    p = 1 / (1 + np.exp(-x))
+    ce = np.maximum(x, 0) - x * t + np.log1p(np.exp(-np.abs(x)))
+    pt = p * t + (1 - p) * (1 - t)
+    at = 0.25 * t + 0.75 * (1 - t)
+    ref = at * (1 - pt) ** 2 * ce / 2.0
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_roi_align_outside_image_is_zero():
+    """ROIs past the border: samples beyond [-1, size] contribute zeros
+    (reference roi_align_op.h), never extrapolated values."""
+    def build():
+        x = fluid.layers.data("x", [1, 4, 4], dtype="float32")
+        rois = fluid.layers.data("rois", [4], dtype="float32")
+        out = fluid.layers.roi_align(x, rois, pooled_height=1, pooled_width=1,
+                                     sampling_ratio=1)
+        xv = np.tile(np.arange(4, dtype="f4")[None, None, :, None], (1, 1, 1, 4))
+        rv = np.array([[0.0, -8.0, 4.0, -4.0]], "f4")  # fully above the image
+        return {"x": xv, "rois": rv}, [out]
+
+    (out,) = _run(build)
+    np.testing.assert_allclose(out, 0.0, atol=1e-6)
+
+
+def test_sigmoid_focal_loss_ignore_label():
+    def build():
+        x = fluid.layers.data("x", [3], dtype="float32")
+        label = fluid.layers.data("label", [1], dtype="int64")
+        fg = fluid.layers.data("fg", [1], dtype="int32")
+        out = fluid.layers.sigmoid_focal_loss(x, label, fg)
+        xv = np.array([[2.0, -1.0, 0.5]], "f4")
+        return {"x": xv, "label": np.array([[-1]], "int64"),
+                "fg": np.array([[1]], "int32")}, [out]
+
+    (out,) = _run(build)
+    np.testing.assert_allclose(out, 0.0, atol=1e-7)  # ignored row: zero loss
