@@ -102,8 +102,9 @@ def _find_sparse_params(block, param_names) -> List[str]:
 def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
     """Gradients of `targets` w.r.t. arbitrary `inputs` (backward.py:672).
 
-    Implemented with the same single-backward-op mechanism; restricted (like
-    the executor) to one backward region per program for now.
+    Emits its own backward region; a program may hold several (e.g.
+    calc_gradient + optimizer.minimize) — the lowering runs each region
+    over the shared op prefix with a pinned RNG stream.
     """
     if isinstance(targets, Variable):
         targets = [targets]

@@ -98,13 +98,6 @@ def lower_one(ctx: LoweringContext, op: Operator, env: Dict[str, Any]) -> None:
             env[n] = v
 
 
-def find_backward_split(ops: List[Operator]) -> Optional[int]:
-    for i, op in enumerate(ops):
-        if op.type == "backward":
-            return i
-    return None
-
-
 # Trace-time report of the last lowered backward (inspection/test surface;
 # static facts only — which params took the SelectedRows path).
 LAST_TRACE_REPORT: Dict[str, Any] = {}
@@ -141,22 +134,44 @@ class SparseTapCollector:
 
 
 def run_block_with_backward(ctx: LoweringContext, ops: List[Operator], env: Dict[str, Any]) -> Dict[str, Any]:
-    """Interpret a block that may contain one `backward` op.
+    """Interpret a block that may contain `backward` ops.
 
     Forward ops re-run inside jax.vjp so forward+backward fuse into one XLA
     program; the aux env carries every forward intermediate out of the vjp
     (XLA keeps only what is actually used downstream).
+
+    Multiple backward regions (calc_gradient + minimize in one program) are
+    supported: each region differentiates the full op prefix before it —
+    values produced by EARLIER regions (e.g. their grads) enter later
+    regions as constants (stop-gradient), matching the reference's
+    grad-of-grad-free semantics.  XLA CSEs the re-interpreted prefixes.
     """
-    split = find_backward_split(ops)
-    if split is None:
+    splits = [i for i, op in enumerate(ops) if op.type == "backward"]
+    if not splits:
         return run_ops(ctx, ops, env)
 
+    report_sparse: List[str] = []
+    # every region re-interprets the same op prefix: pin the RNG stream so
+    # dropout masks etc. are IDENTICAL across regions (the grads must all
+    # describe one forward pass); the final ctx.key reflects exactly one
+    # consumption of the longest prefix
+    key0 = ctx.key
+    for si in splits:
+        ctx.key = key0
+        env = _run_one_backward_region(ctx, ops, si, env, report_sparse)
+    LAST_TRACE_REPORT.clear()
+    LAST_TRACE_REPORT["sparse_grad_params"] = report_sparse
+    tail_ops = ops[splits[-1] + 1:]
+    return run_ops(ctx, tail_ops, env)
+
+
+def _run_one_backward_region(ctx: LoweringContext, ops: List[Operator], split: int,
+                             env: Dict[str, Any], report_sparse: List[str]) -> Dict[str, Any]:
     bw = ops[split]
     loss_name = bw.attrs["loss_name"]
     param_names: List[str] = list(bw.attrs["param_names"])
     grad_names: List[str] = list(bw.attrs["grad_names"])
-    fwd_ops = ops[:split]
-    tail_ops = ops[split + 1 :]
+    fwd_ops = [o for o in ops[:split] if o.type != "backward"]
 
     base_env = dict(env)
 
@@ -166,8 +181,7 @@ def run_block_with_backward(ctx: LoweringContext, ops: List[Operator], env: Dict
 
     sparse_names = [n for n in bw.attrs.get("sparse_param_names", []) if n in param_names]
     dense_names = [p for p in param_names if p not in sparse_names]
-    LAST_TRACE_REPORT.clear()
-    LAST_TRACE_REPORT["sparse_grad_params"] = list(sparse_names)
+    report_sparse.extend(n for n in sparse_names if n not in report_sparse)
 
     coll = None
     if sparse_names:
@@ -208,7 +222,10 @@ def run_block_with_backward(ctx: LoweringContext, ops: List[Operator], env: Dict
     loss, vjp_fn, env_after = jax.vjp(fwd_fn, primal_params, deltas0, has_aux=True)
     (grads, dtaps) = vjp_fn(jnp.ones_like(loss))
 
-    env = env_after
+    # merge the region's fresh intermediates over the incoming env so
+    # earlier regions' grads survive for downstream consumers
+    env = dict(env)
+    env.update(env_after)
     ctx.sparse_taps = None
     for p, g in zip(param_names, grad_names):
         if p in sparse_names:
@@ -218,7 +235,7 @@ def run_block_with_backward(ctx: LoweringContext, ops: List[Operator], env: Dict
         if gval is None:  # non-float param leaked in; treat as zero
             gval = jnp.zeros_like(env[p])
         env[g] = gval
-    return run_ops(ctx, tail_ops, env)
+    return env
 
 
 def _gather_sparse_grad(param: str, coll: "SparseTapCollector", dtaps: Dict[str, Any], env: Dict[str, Any]):

@@ -852,3 +852,12 @@ def flatten(x, axis=1, name=None):
                               "XShape": [_out(helper, x.dtype).name]},
                      attrs={"axis": axis})
     return out
+
+
+def gather(input, index, name=None):
+    """rows of input at index (reference layers.gather over gather_op)."""
+    helper = LayerHelper("gather", name=name)
+    out = _out(helper, input.dtype)
+    helper.append_op("gather", inputs={"X": [input.name], "Index": [index.name]},
+                     outputs={"Out": [out.name]})
+    return out

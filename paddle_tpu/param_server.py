@@ -1,0 +1,223 @@
+"""Host parameter service for out-of-HBM embedding tables.
+
+Reference: the pserver stack — listen_and_serv_op.cc (RunSyncLoop:109),
+RPCClient/RPCServer + VariableMessage wire form (operators/distributed/),
+parameter_prefetch.cc (sparse rows pulled on demand), and the transpiler's
+distributed lookup table (distribute_transpiler.py:1428-1583).
+
+TPU-first scope (SURVEY §2c): DENSE parameters never touch this — allreduce
+over ICI owns them.  What survives is the capability the pserver actually
+carried: embedding tables too big for HBM, sharded on HOSTS, with rows
+pulled before the step and sparse row gradients pushed after.  The wire is
+a length-prefixed binary protocol over TCP sockets (no gRPC in the image);
+the server applies the optimizer row-update itself (SGD/Adagrad), which is
+exactly the listen_and_serv optimize-block role.
+
+Use with the SelectedRows machinery: run the device program with the
+pulled rows as a feed, read the lookup's SelectedRows gradient, push it.
+`HostTableEmbedding` below packages that loop.
+"""
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+_MAGIC = b"PTPS"
+
+
+def _send_msg(sock, op: bytes, payload: bytes):
+    sock.sendall(_MAGIC + op + struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("parameter server connection closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock) -> Tuple[bytes, bytes]:
+    head = _recv_exact(sock, 13)
+    if head[:4] != _MAGIC:
+        raise ValueError("parameter server: bad magic")
+    op = head[4:5]
+    (n,) = struct.unpack("<Q", head[5:13])
+    return op, _recv_exact(sock, n)
+
+
+def _pack_arr(a: np.ndarray) -> bytes:
+    a = np.ascontiguousarray(a)
+    dt = a.dtype.str.encode()
+    return (struct.pack("<I", len(dt)) + dt + struct.pack("<I", a.ndim)
+            + struct.pack(f"<{a.ndim}q", *a.shape) + a.tobytes())
+
+
+def _unpack_arr(b: bytes, off: int = 0):
+    (dl,) = struct.unpack_from("<I", b, off)
+    off += 4
+    dt = np.dtype(b[off:off + dl].decode())
+    off += dl
+    (nd,) = struct.unpack_from("<I", b, off)
+    off += 4
+    shape = struct.unpack_from(f"<{nd}q", b, off)
+    off += 8 * nd
+    size = int(np.prod(shape)) if nd else 1
+    arr = np.frombuffer(b, dt, count=size, offset=off).reshape(shape)
+    return arr, off + arr.nbytes
+
+
+class ParameterServer:
+    """Row-sharded host table server (one shard per server process/port).
+
+    Protocol ops: b"P" pull(name, ids) -> rows; b"G" push(name, ids, grads)
+    applying the configured row update; b"C" create(name, array);
+    b"F" fetch full table (checkpointing); b"Q" shutdown."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 optimizer: str = "sgd", lr: float = 0.1):
+        self.tables: Dict[str, np.ndarray] = {}
+        self.accums: Dict[str, np.ndarray] = {}
+        self.optimizer = optimizer
+        self.lr = lr
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        op, payload = _recv_msg(self.request)
+                        if op == b"Q":
+                            _send_msg(self.request, b"q", b"")
+                            outer._srv.shutdown()
+                            return
+                        try:
+                            resp = outer._dispatch(op, payload)
+                        except Exception as e:  # error REPLY, not a dead socket
+                            _send_msg(self.request, b"e",
+                                      f"{type(e).__name__}: {e}".encode())
+                            continue
+                        _send_msg(self.request, op.lower(), resp)
+                except (ConnectionError, OSError):
+                    return
+
+        class Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = Srv((host, port), Handler)
+        self.endpoint = f"{self._srv.server_address[0]}:{self._srv.server_address[1]}"
+        self._thread: Optional[threading.Thread] = None
+
+    # -- server-side ops ---------------------------------------------------
+    def _dispatch(self, op: bytes, payload: bytes) -> bytes:
+        (nl,) = struct.unpack_from("<I", payload, 0)
+        name = payload[4:4 + nl].decode()
+        off = 4 + nl
+        if op == b"C":
+            arr, _ = _unpack_arr(payload, off)
+            with self._lock:
+                self.tables[name] = np.array(arr)
+                self.accums[name] = np.zeros_like(self.tables[name])
+            return b""
+        if op == b"P":
+            ids, _ = _unpack_arr(payload, off)
+            with self._lock:
+                rows = self.tables[name][ids.astype(np.int64)]
+            return _pack_arr(rows)
+        if op == b"G":
+            ids, off2 = _unpack_arr(payload, off)
+            grads, _ = _unpack_arr(payload, off2)
+            with self._lock:
+                t = self.tables[name]
+                # MergeAdd first (reference selected_rows_functor): duplicate
+                # rows sum BEFORE the accumulator update, or adagrad drifts
+                uniq, inv = np.unique(ids.astype(np.int64), return_inverse=True)
+                merged = np.zeros((uniq.size,) + grads.shape[1:], grads.dtype)
+                np.add.at(merged, inv, grads)
+                if self.optimizer == "adagrad":
+                    acc = self.accums[name]
+                    acc[uniq] += merged * merged
+                    t[uniq] += -self.lr * merged / (np.sqrt(acc[uniq]) + 1e-6)
+                else:  # sgd
+                    t[uniq] += -self.lr * merged
+            return b""
+        if op == b"F":
+            with self._lock:
+                return _pack_arr(self.tables[name])
+        raise ValueError(f"parameter server: unknown op {op!r}")
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class KVClient:
+    def __init__(self, endpoint: str):
+        host, port = endpoint.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)))
+        self._lock = threading.Lock()
+
+    def _call(self, op: bytes, name: str, *arrays) -> bytes:
+        payload = struct.pack("<I", len(name)) + name.encode()
+        for a in arrays:
+            payload += _pack_arr(np.asarray(a))
+        with self._lock:
+            _send_msg(self._sock, op, payload)
+            rop, resp = _recv_msg(self._sock)
+        if rop == b"e":
+            raise RuntimeError(f"parameter server error: {resp.decode()}")
+        return resp
+
+    def create(self, name: str, array: np.ndarray):
+        self._call(b"C", name, array)
+
+    def pull(self, name: str, ids: np.ndarray) -> np.ndarray:
+        resp = self._call(b"P", name, np.asarray(ids, np.int64))
+        return _unpack_arr(resp)[0]
+
+    def push(self, name: str, ids: np.ndarray, grads: np.ndarray):
+        self._call(b"G", name, np.asarray(ids, np.int64), grads)
+
+    def fetch_table(self, name: str) -> np.ndarray:
+        return _unpack_arr(self._call(b"F", name))[0]
+
+    def close(self):
+        self._sock.close()
+
+
+class HostTableEmbedding:
+    """Out-of-HBM embedding: the device program sees only the pulled rows
+    (a [B*, D] dense feed whose lookup ids are batch-local positions); the
+    V×D table lives on the parameter server (reference
+    parameter_prefetch.cc flow).
+
+    Per step: (unique_ids, local_ids) <- batch ids; rows <- pull;
+    run program with rows + local ids; push SelectedRows grad back."""
+
+    def __init__(self, client: KVClient, name: str, dim: int):
+        self.client = client
+        self.name = name
+        self.dim = dim
+
+    def prepare_batch(self, ids: np.ndarray):
+        uniq, local = np.unique(ids.reshape(-1), return_inverse=True)
+        rows = self.client.pull(self.name, uniq)
+        return uniq, local.reshape(ids.shape).astype(np.int64), rows
+
+    def push_grad(self, uniq: np.ndarray, grad_rows: np.ndarray):
+        self.client.push(self.name, uniq, np.asarray(grad_rows))
