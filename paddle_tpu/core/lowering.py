@@ -151,14 +151,18 @@ def run_block_with_backward(ctx: LoweringContext, ops: List[Operator], env: Dict
         return run_ops(ctx, ops, env)
 
     report_sparse: List[str] = []
-    # every region re-interprets the same op prefix: pin the RNG stream so
-    # dropout masks etc. are IDENTICAL across regions (the grads must all
-    # describe one forward pass); the final ctx.key reflects exactly one
-    # consumption of the longest prefix
+    # every region re-interprets its op prefix FROM THE BLOCK-START env
+    # (so stateful-name ops apply exactly once no matter how many regions
+    # re-trace them), with earlier regions' grads injected as constants;
+    # the RNG stream is pinned so dropout masks etc. are IDENTICAL across
+    # regions — all grads describe one forward pass
     key0 = ctx.key
+    start_env = dict(env)
+    grads_so_far: Dict[str, Any] = {}
     for si in splits:
         ctx.key = key0
-        env = _run_one_backward_region(ctx, ops, si, env, report_sparse)
+        env = _run_one_backward_region(ctx, ops, si, start_env, grads_so_far,
+                                       report_sparse)
     LAST_TRACE_REPORT.clear()
     LAST_TRACE_REPORT["sparse_grad_params"] = report_sparse
     tail_ops = ops[splits[-1] + 1:]
@@ -166,14 +170,17 @@ def run_block_with_backward(ctx: LoweringContext, ops: List[Operator], env: Dict
 
 
 def _run_one_backward_region(ctx: LoweringContext, ops: List[Operator], split: int,
-                             env: Dict[str, Any], report_sparse: List[str]) -> Dict[str, Any]:
+                             start_env: Dict[str, Any], grads_so_far: Dict[str, Any],
+                             report_sparse: List[str]) -> Dict[str, Any]:
     bw = ops[split]
     loss_name = bw.attrs["loss_name"]
     param_names: List[str] = list(bw.attrs["param_names"])
     grad_names: List[str] = list(bw.attrs["grad_names"])
     fwd_ops = [o for o in ops[:split] if o.type != "backward"]
 
-    base_env = dict(env)
+    base_env = dict(start_env)
+    base_env.update(grads_so_far)
+    env = base_env
 
     for p in param_names:
         if p not in env:
@@ -229,12 +236,13 @@ def _run_one_backward_region(ctx: LoweringContext, ops: List[Operator], split: i
     ctx.sparse_taps = None
     for p, g in zip(param_names, grad_names):
         if p in sparse_names:
-            env[g] = _gather_sparse_grad(p, coll, dtaps, env)
-            continue
-        gval = grads[p]
-        if gval is None:  # non-float param leaked in; treat as zero
-            gval = jnp.zeros_like(env[p])
+            gval = _gather_sparse_grad(p, coll, dtaps, env)
+        else:
+            gval = grads[p]
+            if gval is None:  # non-float param leaked in; treat as zero
+                gval = jnp.zeros_like(env[p])
         env[g] = gval
+        grads_so_far[g] = gval
     return env
 
 

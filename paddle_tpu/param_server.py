@@ -30,6 +30,15 @@ import numpy as np
 _MAGIC = b"PTPS"
 
 
+def _merge_rows(ids: np.ndarray, grads: np.ndarray):
+    """MergeAdd (reference selected_rows_functor): sum duplicate rows."""
+    uniq, inv = np.unique(np.asarray(ids, np.int64).reshape(-1),
+                          return_inverse=True)
+    merged = np.zeros((uniq.size,) + grads.shape[1:], grads.dtype)
+    np.add.at(merged, inv, grads)
+    return uniq, merged
+
+
 def _send_msg(sock, op: bytes, payload: bytes):
     sock.sendall(_MAGIC + op + struct.pack("<Q", len(payload)) + payload)
 
@@ -138,11 +147,9 @@ class ParameterServer:
             grads, _ = _unpack_arr(payload, off2)
             with self._lock:
                 t = self.tables[name]
-                # MergeAdd first (reference selected_rows_functor): duplicate
-                # rows sum BEFORE the accumulator update, or adagrad drifts
-                uniq, inv = np.unique(ids.astype(np.int64), return_inverse=True)
-                merged = np.zeros((uniq.size,) + grads.shape[1:], grads.dtype)
-                np.add.at(merged, inv, grads)
+                # MergeAdd first: duplicate rows sum BEFORE the accumulator
+                # update, or adagrad drifts
+                uniq, merged = _merge_rows(ids, grads)
                 if self.optimizer == "adagrad":
                     acc = self.accums[name]
                     acc[uniq] += merged * merged
@@ -221,3 +228,81 @@ class HostTableEmbedding:
 
     def push_grad(self, uniq: np.ndarray, grad_rows: np.ndarray):
         self.client.push(self.name, uniq, np.asarray(grad_rows))
+
+
+class AsyncCommunicator:
+    """Asynchronous push/pull for host tables (reference
+    operators/distributed/communicator.cc — SendThread:104 batches+merges
+    queued grads, RecvThread:200 refreshes params periodically; async-PS
+    semantics: no barriers, bounded staleness).
+
+    push_async() enqueues and returns immediately; a background thread
+    merges queued slabs per table (MergeAdd) and pushes.  pull() reads
+    through to the server (rows may be stale by whatever is still queued —
+    that staleness IS the async contract)."""
+
+    def __init__(self, client: KVClient, send_interval_s: float = 0.01):
+        self._client = client
+        self._interval = send_interval_s
+        self._queues: Dict[str, list] = {}
+        self._lock = threading.Lock()
+        self._drain_lock = threading.Lock()  # serializes in-flight drains
+        self._stop = threading.Event()
+        self._woke = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._send_loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def push_async(self, name: str, ids: np.ndarray, grads: np.ndarray):
+        if self._error is not None:
+            raise RuntimeError("AsyncCommunicator sender died") from self._error
+        with self._lock:
+            self._queues.setdefault(name, []).append(
+                (np.asarray(ids, np.int64).reshape(-1), np.asarray(grads)))
+        self._woke.set()
+
+    def pull(self, name: str, ids: np.ndarray) -> np.ndarray:
+        return self._client.pull(name, ids)
+
+    def _drain_one(self):
+        # _drain_lock makes drains mutually exclusive, so flush() returns
+        # only after any in-flight send completes (the barrier contract)
+        with self._drain_lock:
+            with self._lock:
+                items = {n: q for n, q in self._queues.items() if q}
+                self._queues = {}
+            for name, slabs in items.items():
+                ids = np.concatenate([i for i, _ in slabs])
+                grads = np.concatenate([g for _, g in slabs])
+                uniq, merged = _merge_rows(ids, grads)
+                self._client.push(name, uniq, merged)
+
+    def _send_loop(self):
+        while not self._stop.is_set():
+            self._woke.wait(timeout=self._interval)
+            self._woke.clear()
+            try:
+                self._drain_one()
+            except BaseException as e:  # surface on next push/flush
+                self._error = e
+                return
+
+    def flush(self):
+        """Synchronize: drain everything queued AND wait out any in-flight
+        send (barrier for eval/save)."""
+        if self._error is not None:
+            raise RuntimeError("AsyncCommunicator sender died") from self._error
+        self._drain_one()
+
+    def stop(self):
+        self._stop.set()
+        self._woke.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._drain_one()
+        if self._error is not None:
+            raise RuntimeError("AsyncCommunicator sender died") from self._error

@@ -137,3 +137,27 @@ def test_adagrad_push_merges_duplicates():
         c.close()
     finally:
         srv.stop()
+
+
+def test_async_communicator_converges_to_same_total():
+    from paddle_tpu.param_server import AsyncCommunicator
+
+    srv = ParameterServer(optimizer="sgd", lr=1.0).start()
+    try:
+        c = KVClient(srv.endpoint)
+        c.create("t", np.zeros((5, 2), "f4"))
+        comm = AsyncCommunicator(c, send_interval_s=0.002).start()
+        rng = np.random.RandomState(0)
+        total = np.zeros((5, 2), "f4")
+        for _ in range(50):
+            ids = rng.randint(0, 5, size=4)
+            g = rng.rand(4, 2).astype("f4")
+            comm.push_async("t", ids, g)
+            np.add.at(total, ids, g)
+        comm.stop()
+        after = c.fetch_table("t")
+        # async merging must not lose or double-count any gradient
+        np.testing.assert_allclose(after, -total, rtol=1e-5, atol=1e-5)
+        c.close()
+    finally:
+        srv.stop()
