@@ -43,6 +43,7 @@ from . import recordio  # noqa: F401
 from . import datasets  # noqa: F401
 from . import nets  # noqa: F401
 from . import debugger  # noqa: F401
+from . import install_check  # noqa: F401
 from .checkpoint_manager import CheckpointManager  # noqa: F401
 from . import fleet as _fleet_mod  # noqa: F401
 from .fleet import fleet  # the singleton (reference incubate.fleet)  # noqa: F401

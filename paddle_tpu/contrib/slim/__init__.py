@@ -1,0 +1,1 @@
+from .quantization import quant_aware, post_training_quantize  # noqa: F401

@@ -1,0 +1,99 @@
+"""Quantization (reference: contrib/slim/quantization — QAT pass inserting
+fake_quantize/dequantize pairs around conv/mul weights and activations,
+plus post-training weight quantization).
+
+TPU-first scope: int8 execution itself is XLA's business; what the slim
+subsystem owns is the PROGRAM REWRITE — fake-quant ops with
+straight-through gradients for QAT, and weight quant/dequant for PTQ size
+reduction.  Both operate on the Program IR through the pass machinery."""
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import numpy as np
+
+QUANTIZABLE = ("conv2d", "depthwise_conv2d", "mul", "matmul")
+# weight slot and activation slot per quantizable op type
+WEIGHT_SLOT = {"conv2d": "Filter", "depthwise_conv2d": "Filter",
+               "mul": "Y", "matmul": "Y"}
+ACT_SLOT = {"conv2d": "Input", "depthwise_conv2d": "Input",
+            "mul": "X", "matmul": "X"}
+
+
+def quant_aware(program, weight_bits: int = 8, activation_bits: int = 8,
+                quantizable_op_types: Optional[Iterable[str]] = None,
+                quantize_activations: bool = True):
+    """QAT instrumentation: fake_quantize_abs_max on every quantizable op's
+    weight (shared weights quantized once) and, when quantize_activations,
+    fake_quantize_abs_max on its activation input — training sees the
+    quantization error, gradients flow straight-through.  Returns the count
+    of fake-quant ops inserted."""
+    from ...core.program import Operator, Parameter
+
+    targets = tuple(quantizable_op_types or QUANTIZABLE)
+    block = program.global_block()
+    n = 0
+    new_ops = []
+    quantized_weights = {}  # shared weights -> existing @QUANT name
+
+    def make_qop(src, bits):
+        qname = f"{src}@QUANT"
+        sname = f"{src}@QSCALE"
+        v = block._find_var_recursive(src)
+        block.create_var(qname, shape=getattr(v, "shape", None),
+                         dtype=getattr(v, "dtype", "float32"))
+        block.create_var(sname, shape=(1,), dtype="float32")
+        return qname, Operator(block, "fake_quantize_abs_max", {"X": [src]},
+                               {"Out": [qname], "OutScale": [sname]},
+                               {"bit_length": bits})
+
+    for op in block.ops:
+        if op.type in targets:
+            wnames = op.inputs.get(WEIGHT_SLOT[op.type], [])
+            if wnames:
+                wname = wnames[0]
+                if wname in quantized_weights:
+                    op.inputs[WEIGHT_SLOT[op.type]] = [quantized_weights[wname]]
+                elif isinstance(block._find_var_recursive(wname), Parameter):
+                    qname, qop = make_qop(wname, weight_bits)
+                    new_ops.append(qop)
+                    quantized_weights[wname] = qname
+                    op.inputs[WEIGHT_SLOT[op.type]] = [qname]
+                    n += 1
+            if quantize_activations:
+                anames = op.inputs.get(ACT_SLOT[op.type], [])
+                if anames:
+                    qname, qop = make_qop(anames[0], activation_bits)
+                    new_ops.append(qop)
+                    op.inputs[ACT_SLOT[op.type]] = [qname]
+                    n += 1
+        new_ops.append(op)
+    block.ops = new_ops
+    program._bump()
+    return n
+
+
+def post_training_quantize(scope, program, weight_bits: int = 8):
+    """PTQ: round every trainable parameter of a quantizable op to
+    weight_bits symmetric grid IN PLACE in the scope (the deploy-time size
+    reduction; the dequantized float values stay in the var so the program
+    runs unchanged).  Returns {param_name: scale}."""
+    from ...core.program import Parameter
+
+    qmax = float(2 ** (weight_bits - 1) - 1)
+    scales = {}
+    block = program.global_block()
+    for op in block.ops:
+        slot = WEIGHT_SLOT.get(op.type)
+        if slot is None:
+            continue
+        for wname in op.inputs.get(slot, []):
+            wvar = block._find_var_recursive(wname)
+            if not isinstance(wvar, Parameter) or wname in scales:
+                continue
+            w = np.asarray(scope.find_var(wname))
+            scale = float(np.max(np.abs(w))) or 1e-8
+            q = np.round(w / scale * qmax)
+            scope.set_var(wname, (q * scale / qmax).astype(w.dtype))
+            scales[wname] = scale
+    return scales

@@ -1,0 +1,50 @@
+"""Self-test (reference: python/paddle/fluid/install_check.py run_check —
+builds a tiny model, runs a train step, prints success)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def run_check():
+    """One end-to-end step on the default device + an 8-way virtual-mesh
+    sanity pass when enough devices exist; raises on any failure."""
+    import jax
+
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 1
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(x, 1), y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup, scope=scope)
+    xv = np.random.rand(8, 4).astype("float32")
+    (l0,) = exe.run(main, feed={"x": xv, "y": xv.sum(1, keepdims=True)},
+                    fetch_list=[loss], scope=scope)
+    assert np.isfinite(np.asarray(l0)).all(), "non-finite loss in install check"
+
+    n = len(jax.devices())
+    if n >= 2:
+        from paddle_tpu.parallel import make_mesh
+
+        mesh = make_mesh((n,), ("dp",))
+        compiled = fluid.CompiledProgram(main).with_mesh(mesh)
+        scope2 = fluid.Scope()
+        exe.run(startup, scope=scope2)
+        xm = np.random.rand(2 * n, 4).astype("float32")  # divisible batch
+        (l1,) = exe.run(compiled, feed={"x": xm, "y": xm.sum(1, keepdims=True)},
+                        fetch_list=[loss], scope=scope2)
+        assert np.isfinite(np.asarray(l1)).all()
+        print(f"Your paddle_tpu works well on {n} devices (SPMD).")
+    else:
+        print("Your paddle_tpu works well on 1 device.")
+    print("install check passed.")
+
+
+if __name__ == "__main__":
+    run_check()

@@ -38,7 +38,15 @@ def cast(x, dtype):
 
 def concat(input, axis=0, name=None):
     helper = LayerHelper("concat", name=name)
-    out = helper.create_variable_for_type_inference(input[0].dtype)
+    shape = None
+    if all(v.shape is not None for v in input):
+        shapes = [tuple(v.shape) for v in input]
+        ax = axis % len(shapes[0])  # normalize negative axes
+        rest = {s[:ax] + s[ax + 1:] for s in shapes}
+        cat_dims = [s[ax] for s in shapes]
+        if len(rest) == 1 and all(d is not None and d >= 0 for d in cat_dims):
+            shape = shapes[0][:ax] + (sum(cat_dims),) + shapes[0][ax + 1:]
+    out = helper.create_variable_for_type_inference(input[0].dtype, shape=shape)
     helper.append_op(
         "concat",
         inputs={"X": [v.name for v in input]},

@@ -131,3 +131,27 @@ def build_stacked_dynamic_lstm(vocab_size=5000, emb_dim=64, hidden_dim=64,
         if with_optimizer:
             optimizer.Adam(learning_rate=learning_rate).minimize(loss)
     return main, startup, {"words": words, "label": label}, {"loss": loss, "acc": acc}
+
+
+# --- word2vec (book test: test_word2vec.py N-gram model) --------------------
+
+def build_word2vec(dict_size=1000, embed_size=32, hidden_size=64, n=4,
+                   learning_rate=0.01, with_optimizer=True):
+    """N-gram language model: (n-1) context words -> next-word softmax
+    (reference book/test_word2vec.py network)."""
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        words = [layers.data(f"w{i}", [1], dtype="int64") for i in range(n - 1)]
+        target = layers.data("target", [1], dtype="int64")
+        embs = [layers.embedding(w, size=[dict_size, embed_size],
+                                 param_attr=ParamAttr(name="w2v_emb"))
+                for w in words]
+        concat = layers.concat(embs, axis=1)
+        hidden = layers.fc(concat, hidden_size, act="sigmoid")
+        logits = layers.fc(hidden, dict_size)
+        loss = layers.mean(layers.softmax_with_cross_entropy(logits, target))
+        if with_optimizer:
+            optimizer.Adam(learning_rate=learning_rate).minimize(loss)
+    feeds = {f"w{i}": w for i, w in enumerate(words)}
+    feeds["target"] = target
+    return main, startup, feeds, {"loss": loss}

@@ -274,3 +274,44 @@ def _logical_not(ctx, op, ins):
 def _isfinite(ctx, op, ins):
     # reference isfinite_op.cc reduces to a single bool
     return {"Out": jnp.all(jnp.isfinite(first(ins, "X"))).reshape((1,))}
+
+
+@register_op("fake_quantize_abs_max")
+def _fake_quantize_abs_max(ctx, op, ins):
+    """reference fake_quantize_op.cc: symmetric abs-max fake quant — round
+    to bit_length-bit ints in the forward, straight-through in backward."""
+    x = first(ins, "X")
+    bits = op.attr("bit_length", 8)
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = jnp.max(jnp.abs(x))
+    safe = jnp.maximum(scale, 1e-8)
+    q = jnp.round(x / safe * qmax)
+    out = q * safe / qmax
+    # straight-through estimator: identity gradient
+    out = x + jax.lax.stop_gradient(out - x)
+    return {"Out": out, "OutScale": scale.reshape((1,))}
+
+
+@register_op("fake_quantize_moving_average_abs_max")
+def _fake_quantize_ma_abs_max(ctx, op, ins):
+    """reference: activation fake-quant with a moving-average scale state."""
+    x = first(ins, "X")
+    in_scale = first(ins, "InScale").reshape(())
+    bits = op.attr("bit_length", 8)
+    rate = op.attr("moving_rate", 0.9)
+    qmax = float(2 ** (bits - 1) - 1)
+    cur = jnp.max(jnp.abs(x))
+    scale = jnp.where(in_scale > 0, rate * in_scale + (1 - rate) * cur, cur)
+    safe = jnp.maximum(scale, 1e-8)
+    q = jnp.round(jnp.clip(x / safe, -1.0, 1.0) * qmax)
+    out = q * safe / qmax
+    out = x + jax.lax.stop_gradient(out - x)
+    return {"Out": out, "OutScale": scale.reshape((1,))}
+
+
+@register_op("fake_dequantize_max_abs")
+def _fake_dequantize_max_abs(ctx, op, ins):
+    x = first(ins, "X")
+    scale = first(ins, "Scale").reshape(())
+    max_range = op.attr("max_range", 127.0)
+    return {"Out": x * scale / max_range}

@@ -1,0 +1,88 @@
+"""Quantization (contrib.slim), install_check, word2vec."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib.slim import post_training_quantize, quant_aware
+
+
+def _mnist_ish():
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 4
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [1, 8, 8], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="int64")
+        c = fluid.layers.conv2d(x, 4, 3, padding=1, act="relu")
+        flat = fluid.layers.reshape(c, [-1, 4 * 8 * 8])
+        logits = fluid.layers.fc(flat, 4)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    return main, startup, loss
+
+
+def test_quant_aware_inserts_fake_quant_and_trains():
+    main, startup, loss = _mnist_ish()
+    n = quant_aware(main, weight_bits=8)
+    assert n >= 4  # weights + activations of conv and the fc muls
+    types = [op.type for op in main.global_block().ops]
+    assert "fake_quantize_abs_max" in types
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(25):
+        xv = rng.rand(16, 1, 8, 8).astype("f4")
+        yv = (xv.mean(axis=(1, 2, 3)) * 4).astype("int64").clip(0, 3)[:, None]
+        (lv,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0], (losses[0], losses[-1])  # STE grads flow
+
+
+def test_post_training_quantize_snaps_weights():
+    main, startup, loss = _mnist_ish()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    params = [p.name for p in main.all_parameters()
+              if np.asarray(scope.find_var(p.name)).ndim >= 2]
+    before = {n: np.asarray(scope.find_var(n)).copy() for n in params}
+    scales = post_training_quantize(scope, main)
+    assert set(scales) >= set(params)  # every weight of a quantizable op
+    for n, sc in scales.items():
+        w = np.asarray(scope.find_var(n))
+        q = w / sc * 127.0
+        np.testing.assert_allclose(q, np.round(q), atol=1e-3)  # on the grid
+        assert np.abs(w - before[n]).max() <= sc / 127.0 + 1e-7  # small error
+    # program still runs
+    exe.run(main, feed={"x": np.zeros((2, 1, 8, 8), "f4"),
+                        "y": np.zeros((2, 1), "int64")},
+            fetch_list=[loss], scope=scope)
+
+
+def test_install_check(capsys):
+    fluid.install_check.run_check()
+    out = capsys.readouterr().out
+    assert "install check passed" in out
+
+
+def test_word2vec_converges():
+    from paddle_tpu.models.vision import build_word2vec
+
+    main, startup, feeds, fetches = build_word2vec(dict_size=50, embed_size=8,
+                                                   hidden_size=16, n=4,
+                                                   learning_rate=0.05)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(60):
+        # deterministic rule: target = first context word (direct copy)
+        ws = [rng.randint(0, 50, (32, 1)).astype("int64") for _ in range(3)]
+        tgt = ws[0].copy()
+        feed = {f"w{i}": w for i, w in enumerate(ws)}
+        feed["target"] = tgt
+        (lv,) = exe.run(main, feed=feed, fetch_list=[fetches["loss"]], scope=scope)
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
