@@ -26,7 +26,10 @@ from .learning_rate_scheduler import (  # noqa: F401
 )
 from .metric import accuracy, auc, mean_iou  # noqa: F401
 from .detection import (  # noqa: F401
+    anchor_generator,
+    box_clip,
     box_coder,
+    density_prior_box,
     iou_similarity,
     multiclass_nms,
     prior_box,

@@ -117,3 +117,45 @@ def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
         attrs={"gamma": gamma, "alpha": alpha},
     )
     return out
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, stride,
+                     variance=(0.1, 0.1, 0.2, 0.2), offset=0.5, name=None):
+    helper = LayerHelper("anchor_generator", name=name)
+    anchors = _out(helper, "float32")
+    variances = _out(helper, "float32")
+    helper.append_op(
+        "anchor_generator", inputs={"Input": [input.name]},
+        outputs={"Anchors": [anchors.name], "Variances": [variances.name]},
+        attrs={"anchor_sizes": list(anchor_sizes),
+               "aspect_ratios": list(aspect_ratios), "stride": list(stride),
+               "variances": list(variance), "offset": offset},
+    )
+    return anchors, variances
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip", name=name)
+    out = _out(helper, input.dtype)
+    helper.append_op("box_clip",
+                     inputs={"Input": [input.name], "ImInfo": [im_info.name]},
+                     outputs={"Output": [out.name]})
+    return out
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios=(1.0,),
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, name=None):
+    helper = LayerHelper("density_prior_box", name=name)
+    boxes = _out(helper, "float32")
+    variances = _out(helper, "float32")
+    helper.append_op(
+        "density_prior_box",
+        inputs={"Input": [input.name], "Image": [image.name]},
+        outputs={"Boxes": [boxes.name], "Variances": [variances.name]},
+        attrs={"densities": list(densities), "fixed_sizes": list(fixed_sizes),
+               "fixed_ratios": list(fixed_ratios), "variances": list(variance),
+               "clip": clip, "step_w": float(steps[0]), "step_h": float(steps[1]),
+               "offset": offset},
+    )
+    return boxes, variances

@@ -349,3 +349,107 @@ def _sigmoid_focal_loss(ctx, op, ins):
     loss = jnp.where((label >= 0)[:, None], loss, 0.0)
     norm = jnp.maximum(fg.reshape(()).astype(x.dtype), 1.0)
     return {"Out": loss / norm}
+
+
+@register_op("anchor_generator")
+def _anchor_generator(ctx, op, ins):
+    """reference detection/anchor_generator_op.h:53-84, formula-exact:
+    x_ctr = w*stride + offset*(stride-1); base_w = round(sqrt(area/ar)),
+    base_h = round(base_w*ar) (ar = height/width), scaled by
+    anchor_size/stride; extents are +/-0.5*(anchor_size_px - 1)."""
+    feat = first(ins, "Input")
+    H, W = feat.shape[2], feat.shape[3]
+    sizes = list(op.attr("anchor_sizes"))
+    ratios = list(op.attr("aspect_ratios"))
+    variances = list(op.attr("variances", [0.1, 0.1, 0.2, 0.2]))
+    stride = list(op.attr("stride"))
+    offset = op.attr("offset", 0.5)
+    sw, sh = float(stride[0]), float(stride[1])
+    anchors = []
+    for h in range(H):
+        for w in range(W):
+            x_ctr = w * sw + offset * (sw - 1)
+            y_ctr = h * sh + offset * (sh - 1)
+            cell = []
+            for ar in ratios:
+                for size in sizes:
+                    area = sw * sh
+                    base_w = round(math.sqrt(area / ar))
+                    base_h = round(base_w * ar)
+                    aw = (size / sw) * base_w
+                    ah = (size / sh) * base_h
+                    cell.append([x_ctr - 0.5 * (aw - 1), y_ctr - 0.5 * (ah - 1),
+                                 x_ctr + 0.5 * (aw - 1), y_ctr + 0.5 * (ah - 1)])
+            anchors.append(cell)
+    A = len(ratios) * len(sizes)
+    out = np.asarray(anchors, np.float32).reshape(H, W, A, 4)
+    var = np.tile(np.asarray(variances, np.float32), (H, W, A, 1))
+    return {"Anchors": jnp.asarray(out), "Variances": jnp.asarray(var)}
+
+
+@register_op("box_clip")
+def _box_clip(ctx, op, ins):
+    """reference detection/box_clip_op.h over bbox_util.h ClipTiledBoxes:
+    boxes live in ORIGINAL-image coordinates, so the bound is
+    round(im_info/scale) - 1."""
+    boxes = first(ins, "Input")      # [..., 4]
+    im_info = first(ins, "ImInfo")   # [N, 3] (resized h, resized w, scale)
+    h = jnp.round(im_info[:, 0] / im_info[:, 2]) - 1.0
+    w = jnp.round(im_info[:, 1] / im_info[:, 2]) - 1.0
+    bshape = (-1,) + (1,) * (boxes.ndim - 2)
+    x0 = jnp.clip(boxes[..., 0], 0.0, w.reshape(bshape))
+    y0 = jnp.clip(boxes[..., 1], 0.0, h.reshape(bshape))
+    x1 = jnp.clip(boxes[..., 2], 0.0, w.reshape(bshape))
+    y1 = jnp.clip(boxes[..., 3], 0.0, h.reshape(bshape))
+    return {"Output": jnp.stack([x0, y0, x1, y1], axis=-1)}
+
+
+@register_op("density_prior_box")
+def _density_prior_box(ctx, op, ins):
+    """reference detection/density_prior_box_op.h: dense grids of shifted
+    square priors per (fixed_size, density)."""
+    feat = first(ins, "Input")
+    image = first(ins, "Image")
+    H, W = feat.shape[2], feat.shape[3]
+    IH, IW = image.shape[2], image.shape[3]
+    fixed_sizes = list(op.attr("fixed_sizes"))
+    fixed_ratios = list(op.attr("fixed_ratios", [1.0]))
+    densities = list(op.attr("densities"))
+    variances = list(op.attr("variances", [0.1, 0.1, 0.2, 0.2]))
+    step_w = op.attr("step_w", 0.0) or IW / W
+    step_h = op.attr("step_h", 0.0) or IH / H
+    offset = op.attr("offset", 0.5)
+    if len(fixed_sizes) != len(densities):
+        raise ValueError(
+            f"density_prior_box: len(fixed_sizes)={len(fixed_sizes)} must "
+            f"equal len(densities)={len(densities)}")
+    # reference density_prior_box_op.h:69-110: the density grid spreads over
+    # the (integer) step window, and every corner clamps to [0, 1]
+    # unconditionally (the clip attr is a redundant second clamp)
+    step_average = int((step_w + step_h) * 0.5)
+    boxes = []
+    for h in range(H):
+        for w in range(W):
+            cx = (w + offset) * step_w
+            cy = (h + offset) * step_h
+            cell = []
+            for size, density in zip(fixed_sizes, densities):
+                shift = step_average // density
+                for ratio in fixed_ratios:
+                    bw = size * math.sqrt(ratio)
+                    bh = size / math.sqrt(ratio)
+                    dcx = cx - step_average / 2.0 + shift / 2.0
+                    dcy = cy - step_average / 2.0 + shift / 2.0
+                    for di in range(density):
+                        for dj in range(density):
+                            ccx = dcx + dj * shift
+                            ccy = dcy + di * shift
+                            cell.append([max((ccx - bw / 2.0) / IW, 0.0),
+                                         max((ccy - bh / 2.0) / IH, 0.0),
+                                         min((ccx + bw / 2.0) / IW, 1.0),
+                                         min((ccy + bh / 2.0) / IH, 1.0)])
+            boxes.append(cell)
+    P_ = len(boxes[0])
+    out = np.asarray(boxes, np.float32).reshape(H, W, P_, 4)
+    var = np.tile(np.asarray(variances, np.float32), (H, W, P_, 1))
+    return {"Boxes": jnp.asarray(out), "Variances": jnp.asarray(var)}

@@ -209,3 +209,57 @@ def test_sigmoid_focal_loss_ignore_label():
 
     (out,) = _run(build)
     np.testing.assert_allclose(out, 0.0, atol=1e-7)  # ignored row: zero loss
+
+
+def test_anchor_generator_geometry():
+    def build():
+        feat = fluid.layers.data("feat", [8, 2, 2], dtype="float32")
+        anchors, variances = fluid.layers.anchor_generator(
+            feat, anchor_sizes=[32.0], aspect_ratios=[1.0, 2.0], stride=[16, 16])
+        return {"feat": np.zeros((1, 8, 2, 2), "f4")}, [anchors, variances]
+
+    anchors, variances = _run(build)
+    assert anchors.shape == (2, 2, 2, 4)
+    # reference formula: x_ctr = 0*16 + 0.5*15 = 7.5; base 16x16 scaled by
+    # 32/16 => 32x32; extents +/-0.5*31 => [-8, -8, 23, 23]
+    np.testing.assert_allclose(anchors[0, 0, 0], [-8, -8, 23, 23], atol=1e-4)
+    # ar = height/width = 2: base_w = round(sqrt(256/2)) = 11, base_h = 22
+    w = anchors[0, 0, 1, 2] - anchors[0, 0, 1, 0] + 1
+    h = anchors[0, 0, 1, 3] - anchors[0, 0, 1, 1] + 1
+    np.testing.assert_allclose([w, h], [22.0, 44.0], atol=1e-4)
+
+
+def test_box_clip():
+    def build():
+        b = fluid.layers.data("b", [2, 4], dtype="float32")
+        info = fluid.layers.data("info", [3], dtype="float32")
+        out = fluid.layers.box_clip(b, info)
+        bv = np.array([[[-5, -5, 50, 50], [10, 10, 200, 300]]], "f4")
+        iv = np.array([[200.0, 160.0, 2.0]], "f4")  # resized 200x160, scale 2
+        return {"b": bv, "info": iv}, [out]
+
+    (out,) = _run(build)
+    # original image is 100x80: bounds h-1=99, w-1=79 (im_info/scale)
+    np.testing.assert_allclose(out[0, 0], [0, 0, 50, 50])
+    np.testing.assert_allclose(out[0, 1], [10, 10, 79, 99])
+
+
+def test_density_prior_box_counts():
+    def build():
+        feat = fluid.layers.data("feat", [4, 2, 2], dtype="float32")
+        img = fluid.layers.data("img", [3, 32, 32], dtype="float32")
+        boxes, variances = fluid.layers.density_prior_box(
+            feat, img, densities=[2], fixed_sizes=[8.0], fixed_ratios=[1.0])
+        return {"feat": np.zeros((1, 4, 2, 2), "f4"),
+                "img": np.zeros((1, 3, 32, 32), "f4")}, [boxes, variances]
+
+    boxes, variances = _run(build)
+    # density 2 => 4 shifted priors per cell
+    assert boxes.shape == (2, 2, 4, 4)
+    # reference grid: step_average=16, shift=8; cell (0,0) centers at
+    # x in {4, 12}; 8x8 priors => first prior [0, 0, 8, 8]/32 (clamped)
+    np.testing.assert_allclose(boxes[0, 0, 0], [0, 0, 8 / 32, 8 / 32], atol=1e-6)
+    np.testing.assert_allclose(boxes[0, 0, 1, 0], (12 - 4) / 32, atol=1e-6)
+    # interior prior is a full 8x8 square
+    w = boxes[1, 1, 3, 2] - boxes[1, 1, 3, 0]
+    np.testing.assert_allclose(w, 8.0 / 32, atol=1e-6)
