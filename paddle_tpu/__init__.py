@@ -21,9 +21,10 @@ from .core.program import (  # noqa: F401
     default_main_program,
     default_startup_program,
     device_guard,
+    name_scope,
     program_guard,
 )
-from .core.scope import Scope, global_scope  # noqa: F401
+from .core.scope import Scope, global_scope, scope_guard  # noqa: F401
 from . import parallel  # noqa: F401
 from .parallel import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
 from . import parallel as compiler  # reference exposes fluid.compiler.CompiledProgram  # noqa: F401

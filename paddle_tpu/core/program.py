@@ -495,3 +495,16 @@ def switch_main_program(program: Program) -> Program:
     old = _main_program
     _main_program = program
     return old
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """reference framework.name_scope: prefixes generated op/var names for
+    readability (debugging/graphviz); purely cosmetic here too."""
+    from . import unique_name
+
+    if prefix:
+        with unique_name.guard(prefix + "/"):
+            yield
+    else:
+        yield

@@ -70,3 +70,18 @@ _global_scope = Scope()
 
 def global_scope() -> Scope:
     return _global_scope
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    """reference executor.py scope_guard: swap the global scope."""
+    global _global_scope
+    old = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = old

@@ -861,3 +861,33 @@ def gather(input, index, name=None):
     helper.append_op("gather", inputs={"X": [input.name], "Index": [index.name]},
                      outputs={"Out": [out.name]})
     return out
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Embed a host python callable in the program (reference layers.py_func
+    over py_func_op.cc).  `out` declares the output variables (shapes/dtypes
+    must be exact — XLA needs them static); backward_func is not supported
+    (the callback is opaque to autodiff; stop-gradient semantics)."""
+    from ..ops.control_flow_ops import register_py_func
+
+    if backward_func is not None:
+        raise NotImplementedError(
+            "py_func: backward_func is not supported — the host callback is "
+            "opaque to the vjp; compute gradients with program ops instead")
+    helper = LayerHelper("py_func")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    for o in outs:
+        if o.shape is None or any(s is None or s < 0 for s in o.shape):
+            raise ValueError(
+                f"py_func: output {o.name!r} needs a fully static shape")
+    fid = register_py_func(func)
+    helper.append_op(
+        "py_func",
+        inputs={"X": [v.name for v in xs]},
+        outputs={"Out": [o.name for o in outs]},
+        attrs={"func_id": fid,
+               "out_shapes": [list(o.shape) for o in outs],
+               "out_dtypes": [str(o.dtype) for o in outs]},
+    )
+    return out

@@ -188,3 +188,43 @@ def _array_read(ctx, op, ins):
 def _array_length(ctx, op, ins):
     arr = first(ins, "X")
     return {"Out": jnp.asarray([len(arr)], dtype=jnp.int32)}
+
+
+# Registry of python callables for py_func ops (the program stores an id —
+# callables aren't serializable; reference py_func_op.cc keeps the same
+# registry on the python side, py_func:PyFuncRegistry).
+_PY_FUNC_REGISTRY = {}
+
+
+def register_py_func(fn) -> int:
+    fid = len(_PY_FUNC_REGISTRY)
+    _PY_FUNC_REGISTRY[fid] = fn
+    return fid
+
+
+@register_op("py_func")
+def _py_func(ctx, op, ins):
+    """reference operators/py_func_op.cc (layers.py_func): run a python
+    callable on host inside the compiled program — lowered through
+    jax.pure_callback with the declared output shapes/dtypes."""
+    import numpy as np
+
+    from ..core.dtypes import as_np_dtype
+
+    fn = _PY_FUNC_REGISTRY[op.attr("func_id")]
+    xs = ins.get("X", [])
+    out_shapes = op.attr("out_shapes")
+    out_dtypes = op.attr("out_dtypes")
+    result_shape = [
+        jax.ShapeDtypeStruct(tuple(s), as_np_dtype(d))
+        for s, d in zip(out_shapes, out_dtypes)
+    ]
+
+    def host_fn(*arrays):
+        outs = fn(*[np.asarray(a) for a in arrays])
+        if not isinstance(outs, (list, tuple)):
+            outs = (outs,)
+        return tuple(np.asarray(o) for o in outs)
+
+    outs = jax.pure_callback(host_fn, tuple(result_shape), *xs)
+    return {"Out": list(outs)}

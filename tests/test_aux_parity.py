@@ -311,3 +311,36 @@ def test_dpsgd_trains_with_noise():
                         scope=scope)
         losses.append(float(np.asarray(lv).reshape(-1)[0]))
     assert losses[-1] < losses[0] * 0.5
+
+
+def test_scope_guard_and_name_scope():
+    s = fluid.Scope()
+    with fluid.scope_guard(s):
+        assert fluid.global_scope() is s
+    assert fluid.global_scope() is not s
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        with fluid.name_scope("encoder"):
+            x = fluid.layers.data("ns_x", [4], dtype="float32")
+            h = fluid.layers.fc(x, 4)
+        assert "encoder/" in h.name
+
+
+def test_py_func_host_callable():
+    def host_squared_plus(a, b):
+        return a * a + b
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [3], dtype="float32")
+        y = fluid.layers.data("y", [3], dtype="float32")
+        out = main.global_block().create_var("pyout", shape=(2, 3), dtype="float32")
+        fluid.layers.py_func(host_squared_plus, [x, y], out)
+        final = fluid.layers.scale(out, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    xv = np.arange(6, dtype="f4").reshape(2, 3)
+    yv = np.ones((2, 3), "f4")
+    (got,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[final], scope=scope)
+    np.testing.assert_allclose(got, (xv * xv + 1) * 2, atol=1e-6)
