@@ -500,11 +500,14 @@ def switch_main_program(program: Program) -> Program:
 @contextlib.contextmanager
 def name_scope(prefix=None):
     """reference framework.name_scope: prefixes generated op/var names for
-    readability (debugging/graphviz); purely cosmetic here too."""
+    readability (debugging/graphviz); purely cosmetic here too.  Repeated
+    sibling scopes dedup (encoder, encoder_1) and nesting composes
+    (outer/inner); counters are NOT reset, so layers in identically-named
+    scopes never collide."""
     from . import unique_name
 
     if prefix:
-        with unique_name.guard(prefix + "/"):
+        with unique_name.name_scope_guard(prefix):
             yield
     else:
         yield

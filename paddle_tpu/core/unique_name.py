@@ -22,9 +22,31 @@ class UniqueNameGenerator:
 
 generator = UniqueNameGenerator()
 
+# name_scope support (reference unique_name.py name_scope stack): a path of
+# scope names prefixes every generated name WITHOUT resetting counters, and
+# repeated sibling scopes dedup ("encoder", "encoder_1", ...)
+_scope_stack: list = []
+_scope_children: dict = defaultdict(lambda: defaultdict(int))
+
 
 def generate(key: str) -> str:
-    return generator(key)
+    name = generator(key)
+    if _scope_stack:
+        return "/".join(_scope_stack) + "/" + name
+    return name
+
+
+@contextlib.contextmanager
+def name_scope_guard(prefix: str):
+    parent = "/".join(_scope_stack)
+    n = _scope_children[parent][prefix]
+    _scope_children[parent][prefix] += 1
+    unique = prefix if n == 0 else f"{prefix}_{n}"
+    _scope_stack.append(unique)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
 
 
 @contextlib.contextmanager

@@ -192,14 +192,24 @@ def _array_length(ctx, op, ins):
 
 # Registry of python callables for py_func ops (the program stores an id —
 # callables aren't serializable; reference py_func_op.cc keeps the same
-# registry on the python side, py_func:PyFuncRegistry).
+# registry on the python side, py_func:PyFuncRegistry).  Ids come from a
+# monotonic counter so entries COULD be released without collisions;
+# lifetime matches the program that references the id.
+import itertools as _itertools
+
 _PY_FUNC_REGISTRY = {}
+_PY_FUNC_IDS = _itertools.count()
 
 
 def register_py_func(fn) -> int:
-    fid = len(_PY_FUNC_REGISTRY)
+    fid = next(_PY_FUNC_IDS)
     _PY_FUNC_REGISTRY[fid] = fn
     return fid
+
+
+def release_py_func(fid: int):
+    """Drop a registered callable (call when its program is discarded)."""
+    _PY_FUNC_REGISTRY.pop(fid, None)
 
 
 @register_op("py_func")
@@ -224,7 +234,14 @@ def _py_func(ctx, op, ins):
         outs = fn(*[np.asarray(a) for a in arrays])
         if not isinstance(outs, (list, tuple)):
             outs = (outs,)
-        return tuple(np.asarray(o) for o in outs)
+        if len(outs) != len(result_shape):
+            raise ValueError(
+                f"py_func returned {len(outs)} outputs, program declares "
+                f"{len(result_shape)}")
+        # cast to the DECLARED dtypes: python lists/scalars arrive float64
+        # and pure_callback hard-fails on any mismatch with an opaque error
+        return tuple(np.asarray(o, dtype=rs.dtype)
+                     for o, rs in zip(outs, result_shape))
 
     outs = jax.pure_callback(host_fn, tuple(result_shape), *xs)
     return {"Out": list(outs)}

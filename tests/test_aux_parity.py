@@ -320,15 +320,25 @@ def test_scope_guard_and_name_scope():
     assert fluid.global_scope() is not s
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
+        x = fluid.layers.data("ns_x", [4], dtype="float32")
         with fluid.name_scope("encoder"):
-            x = fluid.layers.data("ns_x", [4], dtype="float32")
-            h = fluid.layers.fc(x, 4)
-        assert "encoder/" in h.name
+            h1 = fluid.layers.fc(x, 4)
+        with fluid.name_scope("encoder"):  # sibling scope must dedup
+            h2 = fluid.layers.fc(x, 4)
+        with fluid.name_scope("outer"):
+            with fluid.name_scope("inner"):  # nesting composes
+                h3 = fluid.layers.fc(x, 4)
+    assert h1.name.startswith("encoder/")
+    assert h2.name.startswith("encoder_1/")
+    assert h1.name.split("/")[-1] != "" and h1.name != h2.name
+    assert h3.name.startswith("outer/inner/")
 
 
 def test_py_func_host_callable():
     def host_squared_plus(a, b):
-        return a * a + b
+        # returns a python-made float64 array: the lowering must cast to the
+        # declared float32 instead of crashing inside pure_callback
+        return (a * a + b).astype("float64")
 
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
