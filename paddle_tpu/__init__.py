@@ -13,6 +13,9 @@ from . import layers  # noqa: F401
 from . import optimizer  # noqa: F401
 from .core import initializer, regularizer, unique_name  # noqa: F401
 from .core.autodiff import append_backward, calc_gradient  # noqa: F401
+from . import backward  # noqa: F401
+from .backward import gradients  # noqa: F401
+from . import evaluator  # noqa: F401
 from .core.executor import CPUPlace, CUDAPlace, Executor, TPUPlace  # noqa: F401
 from .core.param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from .core.program import (  # noqa: F401

@@ -1,0 +1,8 @@
+"""fluid.backward module surface (reference python/paddle/fluid/backward.py:
+append_backward:432, calc_gradient:672, gradients)."""
+from .core.autodiff import append_backward, calc_gradient  # noqa: F401
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference backward.gradients — alias of calc_gradient."""
+    return calc_gradient(targets, inputs, target_gradients, no_grad_set)

@@ -1,0 +1,18 @@
+"""fluid.evaluator compatibility (reference python/paddle/fluid/evaluator.py
+— the deprecated pre-metrics API; each class points at its fluid.metrics
+replacement, which is exactly what the reference's deprecation notices do)."""
+from .metrics import (  # noqa: F401
+    Accuracy,
+    Auc,
+    CompositeMetric,
+    EditDistance,
+    Precision,
+    Recall,
+)
+
+
+class ChunkEvaluator:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "ChunkEvaluator: chunk-eval (NER span F1) is not implemented; "
+            "compute spans host-side from fetched predictions")

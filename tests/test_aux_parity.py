@@ -354,3 +354,19 @@ def test_py_func_host_callable():
     yv = np.ones((2, 3), "f4")
     (got,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[final], scope=scope)
     np.testing.assert_allclose(got, (xv * xv + 1) * 2, atol=1e-6)
+
+
+def test_backward_module_and_evaluator_shims():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("bx", [3], dtype="float32")
+        y = fluid.layers.scale(x, scale=4.0)
+        grads = fluid.gradients(y, [x])  # backward.gradients alias
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    (g,) = exe.run(main, feed={"bx": np.ones((2, 3), "f4")},
+                   fetch_list=[grads[0]], scope=scope)
+    np.testing.assert_allclose(g, 4.0)
+    m = fluid.evaluator.Accuracy()
+    assert m is not None
