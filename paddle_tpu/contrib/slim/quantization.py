@@ -35,6 +35,7 @@ def quant_aware(program, weight_bits: int = 8, activation_bits: int = 8,
     n = 0
     new_ops = []
     quantized_weights = {}  # shared weights -> existing @QUANT name
+    quantized_acts = {}  # shared activation sources -> existing @QUANT name
 
     def make_qop(src, bits):
         qname = f"{src}@QUANT"
@@ -63,10 +64,16 @@ def quant_aware(program, weight_bits: int = 8, activation_bits: int = 8,
             if quantize_activations:
                 anames = op.inputs.get(ACT_SLOT[op.type], [])
                 if anames:
-                    qname, qop = make_qop(anames[0], activation_bits)
-                    new_ops.append(qop)
-                    op.inputs[ACT_SLOT[op.type]] = [qname]
-                    n += 1
+                    aname = anames[0]
+                    # two consumers of one activation reuse ONE fake-quant op
+                    # (a second would duplicate the @QUANT writer — single-
+                    # writer violation, ADVICE r3)
+                    if aname not in quantized_acts:
+                        qname, qop = make_qop(aname, activation_bits)
+                        new_ops.append(qop)
+                        quantized_acts[aname] = qname
+                        n += 1
+                    op.inputs[ACT_SLOT[op.type]] = [quantized_acts[aname]]
         new_ops.append(op)
     block.ops = new_ops
     program._bump()

@@ -88,6 +88,12 @@ def remove_identity_ops(program, keep=()):
                 continue
             for op in other.ops:
                 outside_reads.update(op.input_arg_names)
+        # var -> index of its LAST write (one pass; keeps the hazard check
+        # below O(1) per candidate instead of a tail rescan)
+        last_write: Dict[str, int] = {}
+        for i, op in enumerate(block.ops):
+            for out_name in op.output_arg_names:
+                last_write[out_name] = i
         kept = []
         for i, op in enumerate(block.ops):
             is_identity = op.type == "assign" or (
@@ -104,6 +110,12 @@ def remove_identity_ops(program, keep=()):
             if (dst in keep or dst in outside_reads
                     or (dst_var is not None and dst_var.persistable)):
                 kept.append(op)  # fetched / captured / state: not removable
+                continue
+            # snapshot semantics: if any later op WRITES src or dst, the
+            # assign is a real copy (t = x; x += 1; use t) — rewiring reads
+            # of dst to src would observe the mutation.  Keep it.
+            if last_write.get(src, -1) > i or last_write.get(dst, -1) > i:
+                kept.append(op)
                 continue
             _rewire(block, dst, src, i + 1)
         block.ops = kept

@@ -19,8 +19,16 @@ from .common import bcast_y_to_x, first, match_dtype, normalize_axes
 
 def _ew(fn):
     def lower(ctx, op, ins):
+        from ..core.selected_rows import SelectedRows
+
         x = first(ins, "X")
-        y = match_dtype(x, bcast_y_to_x(x, first(ins, "Y"), op.attr("axis", -1)))
+        y = first(ins, "Y")
+        if isinstance(x, SelectedRows) and jnp.size(y) == 1:
+            # SelectedRows op scalar (AMP grad unscale, clip-by-value):
+            # apply to the value slab, keep the rows
+            yv = jnp.reshape(y, ()).astype(x.values.dtype)
+            return {"Out": SelectedRows(x.rows, fn(x.values, yv), x.height)}
+        y = match_dtype(x, bcast_y_to_x(x, y, op.attr("axis", -1)))
         return {"Out": fn(x, y)}
 
     return lower
@@ -272,8 +280,14 @@ def _logical_not(ctx, op, ins):
 
 @register_op("isfinite")
 def _isfinite(ctx, op, ins):
-    # reference isfinite_op.cc reduces to a single bool
-    return {"Out": jnp.all(jnp.isfinite(first(ins, "X"))).reshape((1,))}
+    from ..core.selected_rows import SelectedRows
+
+    # reference isfinite_op.cc reduces to a single bool; on a SelectedRows
+    # grad (AMP + is_sparse embeddings) only the touched-row slab is checked
+    x = first(ins, "X")
+    if isinstance(x, SelectedRows):
+        x = x.values
+    return {"Out": jnp.all(jnp.isfinite(x)).reshape((1,))}
 
 
 @register_op("fake_quantize_abs_max")

@@ -122,20 +122,29 @@ def _adam(ctx, op, ins):
     eps = op.attr("epsilon", 1e-8)
     lr = _lr(ins)
     if isinstance(g, SelectedRows):
-        # reference SparseAdamFunctor (adam_op.h): row-wise moment updates,
-        # beta powers advance globally
         m = g.merged()
-        lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
-        m1r = beta1 * _rows_gather(m1, m.rows) + (1.0 - beta1) * m.values
-        m2r = beta2 * _rows_gather(m2, m.rows) + (1.0 - beta2) * jnp.square(m.values)
-        upd = lr_t * m1r / (jnp.sqrt(m2r) + eps)
-        return {
-            "ParamOut": p.at[m.rows].add(-upd.astype(p.dtype), mode="drop"),
-            "Moment1Out": m1.at[m.rows].set(m1r.astype(m1.dtype), mode="drop"),
-            "Moment2Out": m2.at[m.rows].set(m2r.astype(m2.dtype), mode="drop"),
-            "Beta1PowOut": (b1p * beta1).reshape((1,)),
-            "Beta2PowOut": (b2p * beta2).reshape((1,)),
-        }
+        if not op.attr("lazy_mode", False):
+            # reference default (adam_op.h AdamFunctor over a densified
+            # grad): EVERY row decays its moments and moves, untouched rows
+            # with g=0.  Scatter the slab dense and fall through to the
+            # dense math — correct-by-construction; users wanting the
+            # touched-rows-only fast path opt in via lazy_mode=True.
+            g = jnp.zeros(p.shape, m.values.dtype).at[m.rows].add(
+                m.values, mode="drop")
+        else:
+            # lazy_mode: row-wise moment updates on touched rows only
+            # (reference SparseAdamFunctor), beta powers advance globally
+            lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)
+            m1r = beta1 * _rows_gather(m1, m.rows) + (1.0 - beta1) * m.values
+            m2r = beta2 * _rows_gather(m2, m.rows) + (1.0 - beta2) * jnp.square(m.values)
+            upd = lr_t * m1r / (jnp.sqrt(m2r) + eps)
+            return {
+                "ParamOut": p.at[m.rows].add(-upd.astype(p.dtype), mode="drop"),
+                "Moment1Out": m1.at[m.rows].set(m1r.astype(m1.dtype), mode="drop"),
+                "Moment2Out": m2.at[m.rows].set(m2r.astype(m2.dtype), mode="drop"),
+                "Beta1PowOut": (b1p * beta1).reshape((1,)),
+                "Beta2PowOut": (b2p * beta2).reshape((1,)),
+            }
     m1n = beta1 * m1 + (1.0 - beta1) * g
     m2n = beta2 * m2 + (1.0 - beta2) * jnp.square(g)
     lr_t = lr * jnp.sqrt(1.0 - b2p) / (1.0 - b1p)

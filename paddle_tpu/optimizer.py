@@ -203,6 +203,7 @@ class AdamOptimizer(Optimizer):
                  lazy_mode=False, **kw):
         super().__init__(learning_rate, **kw)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lazy_mode = lazy_mode
 
     def _eager_update(self, p, g, lr, state):
         import jax.numpy as jnp
@@ -250,7 +251,8 @@ class AdamOptimizer(Optimizer):
                 "Beta1PowOut": [b1p.name],
                 "Beta2PowOut": [b2p.name],
             },
-            attrs={"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon},
+            attrs={"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon,
+                   "lazy_mode": self._lazy_mode},
         )
 
 

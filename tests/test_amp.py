@@ -98,3 +98,66 @@ def test_amp_loss_scale_floor():
         exe.run(main, feed={"x": bad, "y": yv}, fetch_list=[loss], scope=scope)
     s = float(np.asarray(scope.find_var("loss_scaling_0"))[0])
     assert s == 1.0, s  # floored, never reaches 0
+
+
+def test_amp_with_sparse_embedding():
+    """AMP + is_sparse lookup_table: SelectedRows grads pass through the
+    isfinite/unscale pipeline (SelectedRows-aware elementwise lowerings)."""
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", [4], dtype="int64")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        emb = fluid.layers.embedding(ids, size=[50, 8], is_sparse=True)
+        pooled = fluid.layers.reshape(emb, [-1, 32])
+        pred = fluid.layers.fc(pooled, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        opt = decorate(fluid.optimizer.SGD(0.1), init_loss_scaling=8.0,
+                       incr_every_n_steps=100, decr_every_n_nan_or_inf=1)
+        opt.minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    ids_v = rng.randint(0, 50, (16, 4)).astype("int64")  # fixed batch: memorize
+    yv = rng.randn(16, 1).astype("f4")
+    losses = []
+    for _ in range(40):
+        (lv,) = exe.run(main, feed={"ids": ids_v, "y": yv}, fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.5, f"{losses[0]} -> {losses[-1]}"
+
+
+def test_sparse_adam_lazy_mode_semantics():
+    """lazy_mode=False (reference default): untouched rows' moments decay and
+    the param still moves; lazy_mode=True touches only gradient rows."""
+    def run(lazy):
+        main, startup = fluid.Program(), fluid.Program()
+        startup.random_seed = 3
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", [2], dtype="int64")
+            emb = fluid.layers.embedding(ids, size=[10, 4], is_sparse=True)
+            loss = fluid.layers.mean(emb)
+            fluid.optimizer.Adam(learning_rate=0.1, lazy_mode=lazy).minimize(loss)
+        wname = next(v.name for v in main.list_vars()
+                     if v.persistable and v.name.startswith("embedding"))
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        w0 = np.array(scope.find_var(wname))
+        # step 1 touches rows {0,1}; step 2 touches {2,3}
+        for step_ids in ([[0, 1]], [[2, 3]]):
+            exe.run(main, feed={"ids": np.array(step_ids, "int64")},
+                    fetch_list=[loss], scope=scope)
+        w = np.array(scope.find_var(wname))
+        return w0, w
+
+    w0l, wl = run(True)
+    # lazy: row 9 never touched -> unchanged
+    np.testing.assert_allclose(wl[9], w0l[9])
+    w0d, wd = run(False)
+    # dense-default: row 0's adam moment from step 1 keeps moving row 0 in
+    # step 2 even though step 2's grad for row 0 is zero
+    assert not np.allclose(wd[9], w0d[9]) or not np.allclose(wd[0], wl[0]), \
+        "lazy and non-lazy should diverge"
