@@ -173,7 +173,7 @@ def bench_bert(batch_size=256, seq_len=128, iters=4):
 
     main, startup, feeds, fetches = transformer.build_bert(
         vocab_size=30522, seq_len=seq_len, d_model=768, n_layers=12, n_heads=12,
-        d_ff=3072, dropout_prob=0.1, with_optimizer=True)
+        d_ff=3072, dropout_prob=0.1, with_optimizer=True, dtype="bfloat16")
     scope = fluid.Scope()
     exe = fluid.Executor(fluid.TPUPlace(0))
     exe.run(startup, scope=scope)

@@ -1,0 +1,60 @@
+"""Where does the Pallas flash kernel beat XLA's unfused attention?
+Sweep seq_len at fixed token count (B*L const), fwd+bwd through a
+minimal attention-only step, interleaved pairs."""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental.pallas.ops.tpu.flash_attention import flash_attention
+
+H, D = 12, 64
+TOK = 32768  # B*L
+
+
+def mk(L, kind):
+    B = TOK // L
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(k1, (B, H, L, D), jnp.bfloat16)
+    k = jax.random.normal(k2, (B, H, L, D), jnp.bfloat16)
+    v = jax.random.normal(k3, (B, H, L, D), jnp.bfloat16)
+    sc = 1.0 / np.sqrt(D)
+
+    if kind == "flash":
+        def att(q, k, v):
+            return flash_attention(q, k, v, sm_scale=sc)
+    else:
+        def att(q, k, v):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sc
+            p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(jnp.bfloat16)
+            return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    @jax.jit
+    def step(q, k, v):
+        def loss(q, k, v):
+            return jnp.sum(att(q, k, v).astype(jnp.float32))
+        l, g = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+        # keep grads live (sum to scalars) so backward isn't DCE'd
+        return l + sum(jnp.sum(x.astype(jnp.float32)) for x in g)
+
+    step(q, k, v).block_until_ready()  # compile
+    def run():
+        t0 = time.perf_counter()
+        for _ in range(8):
+            out = step(q, k, v)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / 8
+    return run
+
+
+for L in (128, 256, 512, 1024, 2048):
+    a = mk(L, "plain")
+    b = mk(L, "flash")
+    best_a = min(a(), a())
+    best_b = min(b(), b())
+    # interleave once more
+    best_a = min(best_a, a())
+    best_b = min(best_b, b())
+    print(f"L={L}: plain {best_a*1e3:.2f} ms  flash {best_b*1e3:.2f} ms  "
+          f"flash/plain {best_b/best_a:.2f}")

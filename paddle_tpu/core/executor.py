@@ -82,8 +82,9 @@ class _CompiledStep:
 
     def __init__(self, program: Program, feed_names: Sequence[str], fetch_names: Sequence[str], scope: Scope,
                  mesh=None, batch_axis: str = "dp", feed_shapes: Optional[Dict[str, tuple]] = None,
-                 n_steps: int = 1, remat: bool = False):
+                 n_steps: int = 1, remat: bool = False, platform: Optional[str] = None):
         self.mesh = mesh
+        self.platform = platform
         self.batch_axis = batch_axis
         self.n_steps = n_steps
         self.remat = remat
@@ -126,7 +127,7 @@ class _CompiledStep:
 
         def step(state_rw: Dict[str, jnp.ndarray], state_ro: Dict[str, jnp.ndarray],
                  feeds: Dict[str, jnp.ndarray], key):
-            ctx = LoweringContext(key, mesh=mesh)
+            ctx = LoweringContext(key, mesh=mesh, platform=self.platform)
             ctx.remat = self.remat
             env = dict(state_ro)
             env.update(state_rw)
@@ -424,11 +425,14 @@ class Executor:
         if compiled is not None:
             self._cache[cache_key] = compiled  # re-insert: true LRU order
         else:
+            mesh_platform = (
+                mesh.devices.flat[0].platform if mesh is not None else device.platform
+            )
             compiled = _CompiledStep(
                 program, list(jfeeds), fetch_names, scope,
                 mesh=mesh, batch_axis=batch_axis,
                 feed_shapes={n: v.shape for n, v in jfeeds.items()},
-                n_steps=steps, remat=remat,
+                n_steps=steps, remat=remat, platform=mesh_platform,
             )
             self._cache[cache_key] = compiled
             from ..flags import flag as _flagv

@@ -32,10 +32,14 @@ class LoweringContext:
     the scope, so randomness advances across `Executor.run` calls.
     """
 
-    def __init__(self, key, is_test: bool = False, mesh=None):
+    def __init__(self, key, is_test: bool = False, mesh=None, platform: Optional[str] = None):
         self.key = key
         self.is_test = is_test
         self.mesh = mesh
+        # target backend ("tpu"/"cpu"); lowerings that have a Pallas TPU
+        # kernel (fused_attention) pick it here and fall back to plain jnp
+        # math elsewhere so CPU tests and virtual meshes still run
+        self.platform = platform
         # current var env, set by run_ops; control-flow lowerings read it to
         # capture outer values and compute loop-carried state
         self.env: Dict[str, Any] = {}

@@ -660,7 +660,18 @@ def stack(x, axis=0):
 
 def slice(input, axes, starts, ends):
     helper = LayerHelper("slice")
-    out = _out(helper, input.dtype)
+    shape = None
+    if input.shape is not None:
+        shape = list(input.shape)
+        for ax, st, en in zip(axes, starts, ends):
+            dim = shape[ax]
+            if dim is None or dim < 0:
+                continue
+            st2 = max(st + dim, 0) if st < 0 else min(st, dim)
+            en2 = max(en + dim, 0) if en < 0 else min(en, dim)
+            shape[ax] = max(en2 - st2, 0)
+        shape = tuple(shape)
+    out = _out(helper, input.dtype, shape=shape)
     helper.append_op(
         "slice",
         inputs={"Input": [input.name]},
@@ -682,6 +693,41 @@ def ring_attention(q, k, v, causal=False, sp_axis="sp", batch_axis="dp", name=No
         outputs={"Out": [out.name]},
         attrs={"causal": causal, "sp_axis": sp_axis, "batch_axis": batch_axis},
     )
+    return out
+
+
+def space_to_depth(x, blocksize, name=None):
+    """reference layers/nn.py:10411 space_to_depth over space_to_depth_op:
+    [B, C, H, W] -> [B, C*bs^2, H/bs, W/bs] (C must divide bs^2 — the
+    reference InferShape enforces this quirk)."""
+    helper = LayerHelper("space_to_depth", name=name)
+    bs = int(blocksize)
+    shape = None
+    if x.shape is not None and None not in x.shape[1:]:
+        b, c, h, w = x.shape
+        shape = (b, c * bs * bs, h // bs, w // bs)
+    out = _out(helper, x.dtype, shape=shape)
+    helper.append_op("space_to_depth", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs={"blocksize": bs})
+    return out
+
+
+def fused_attention(q, k, v, bias=None, causal=False, scale=None, name=None):
+    """Fused scaled-dot-product attention over (B, H, L, dh) tensors.
+
+    Lowers to the Pallas flash-attention TPU kernel (score matrix never
+    materialized in HBM, fwd + bwd); plain-math fallback off-TPU.  `bias`
+    is an additive pre-softmax mask, (B, 1|H, Lq, Lk).  `scale` defaults
+    to 1/sqrt(dh)."""
+    helper = LayerHelper("fused_attention", name=name)
+    out = _out(helper, q.dtype, shape=q.shape)
+    inputs = {"Q": [q.name], "K": [k.name], "V": [v.name]}
+    if bias is not None:
+        inputs["Bias"] = [bias.name]
+    attrs = {"causal": causal}
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op("fused_attention", inputs=inputs, outputs={"Out": [out.name]}, attrs=attrs)
     return out
 
 

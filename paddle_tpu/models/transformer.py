@@ -24,7 +24,8 @@ def _attr(name):
 
 
 def multi_head_attention(x, seq_len, d_model, n_heads, prefix, dropout_prob=0.1, is_test=False,
-                         use_ring_attention=False, causal=False, kv=None, bias=None):
+                         use_ring_attention=False, causal=False, kv=None, bias=None,
+                         use_fused_attention=False):
     """Self- or cross-attention over [b, T, d] (T may be dynamic: head
     split/merge uses fluid's 0-copy-dim reshape).  `kv` switches to
     cross-attention (keys/values from another sequence); `bias` is an
@@ -41,7 +42,15 @@ def multi_head_attention(x, seq_len, d_model, n_heads, prefix, dropout_prob=0.1,
         return layers.transpose(t, [0, 2, 1, 3])  # (B, H, L, dh)
 
     q, k, v = split_heads(q), split_heads(k), split_heads(v)
-    if use_ring_attention:
+    if use_fused_attention:
+        # Pallas flash kernel: scores never hit HBM.  Attention-prob dropout
+        # can't run inside the fused kernel; the equivalent regularization
+        # goes on the attention output (same substitution as the ring path).
+        ctx = layers.fused_attention(q, k, v, bias=bias, causal=causal)
+        if dropout_prob and not is_test:
+            ctx = layers.dropout(ctx, dropout_prob, is_test=is_test,
+                                 dropout_implementation="upscale_in_train")
+    elif use_ring_attention:
         # sequence-parallel blockwise attention (L shards over the sp axis);
         # attention-prob dropout can't be applied inside the ring, so the
         # equivalent regularization goes on the attention output instead
@@ -65,9 +74,10 @@ def multi_head_attention(x, seq_len, d_model, n_heads, prefix, dropout_prob=0.1,
 
 
 def encoder_layer(x, seq_len, d_model, n_heads, d_ff, prefix, dropout_prob=0.1, is_test=False,
-                  use_ring_attention=False, causal=False):
+                  use_ring_attention=False, causal=False, use_fused_attention=False):
     attn_out = multi_head_attention(x, seq_len, d_model, n_heads, f"{prefix}.attn",
-                                    dropout_prob, is_test, use_ring_attention, causal)
+                                    dropout_prob, is_test, use_ring_attention, causal,
+                                    use_fused_attention=use_fused_attention)
     x = layers.layer_norm(layers.elementwise_add(x, attn_out), begin_norm_axis=2,
                           param_attr=_attr(f"{prefix}.ln1.w"), bias_attr=_attr(f"{prefix}.ln1.b"))
     ffn1 = layers.fc(x, d_ff, num_flatten_dims=2, act="gelu",
@@ -94,10 +104,15 @@ def build_bert(
     is_test=False,
     use_ring_attention=False,
     causal=False,
+    use_fused_attention=False,
+    dtype="float32",
 ):
     """BERT-base-style masked-LM pretraining program.
 
     feeds: ids (B,L) int64, labels (B,L) int64 (-100 = unmasked/ignored).
+    dtype="bfloat16" runs the encoder + LM head matmuls on the MXU in bf16
+    (master weights stay f32 via per-op match_dtype; LN stats and the loss
+    stay f32) — the TPU answer to the reference's fp16 AMP decorator.
     """
     main, startup = Program(), Program()
     with program_guard(main, startup):
@@ -109,11 +124,16 @@ def build_bert(
         x = layers.elementwise_add(tok, pos)
         x = layers.layer_norm(x, begin_norm_axis=2, param_attr=_attr("bert.emb_ln.w"),
                               bias_attr=_attr("bert.emb_ln.b"))
+        if dtype != "float32":
+            x = layers.cast(x, dtype)
         for i in range(n_layers):
             x = encoder_layer(x, seq_len, d_model, n_heads, d_ff, f"bert.l{i}",
-                              dropout_prob, is_test, use_ring_attention, causal)
+                              dropout_prob, is_test, use_ring_attention, causal,
+                              use_fused_attention=use_fused_attention)
         logits = layers.fc(x, vocab_size, num_flatten_dims=2,
                            param_attr=_attr("bert.lm_head.w"), bias_attr=_attr("bert.lm_head.b"))
+        if dtype != "float32":
+            logits = layers.cast(logits, "float32")
         flat_logits = layers.reshape(logits, [-1, vocab_size])
         flat_labels = layers.reshape(labels, [-1, 1])
         loss_per = layers.softmax_with_cross_entropy(flat_logits, flat_labels, ignore_index=-100)

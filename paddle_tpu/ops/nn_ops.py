@@ -286,16 +286,19 @@ def _layer_norm(ctx, op, ins):
     eps = op.attr("epsilon", 1e-5)
     begin = op.attr("begin_norm_axis", 1)
     axes = tuple(range(begin, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    # standard TPU LN numerics: stats/normalize in f32 even for bf16
+    # activations (bf16's 8-bit mantissa loses the mean under cancellation)
+    xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = ((xf - mean) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
     import numpy as _np
 
     norm_shape = (1,) * begin + tuple(x.shape[begin:])
     if scale is not None:
-        y = y * scale.reshape(norm_shape)
+        y = y * match_dtype(y, scale).reshape(norm_shape)
     if bias is not None:
-        y = y + bias.reshape(norm_shape)
+        y = y + match_dtype(y, bias).reshape(norm_shape)
     return {
         "Y": y,
         "Mean": mean.reshape(x.shape[:begin]),
@@ -445,6 +448,64 @@ def _ring_attention(ctx, op, ins):
     return {"Out": out}
 
 
+# fused_attention: shortest kv length that routes to the Pallas flash
+# kernel on TPU.  Interleaved full-model A/Bs (docs/perf_r04.md) measured
+# the Pallas kernel SLOWER than XLA's own fused attention at both seq 128
+# (398 vs 293 ms BERT step) and seq 512 (311 vs 242 ms) on v5e, so the
+# kernel is kept as a MEMORY guard only: beyond this length the [B,H,L,L]
+# score tensor (>=128 MB/layer at 2048) starts evicting activations, and
+# flash's O(L) memory wins regardless of kernel-vs-XLA throughput.
+_FLASH_MIN_SEQ = 2048
+
+
+@register_op("fused_attention")
+def _fused_attention(ctx, op, ins):
+    """Flash-style fused scaled-dot-product attention over (B, H, L, dh).
+
+    TPU-first replacement for the reference's unfused matmul/softmax/matmul
+    attention (and its fused_attention ambitions in operators/fused/): on a
+    real TPU this lowers to the Pallas flash-attention kernel — the
+    [B, H, Lq, Lk] score tensor never touches HBM, forward or backward
+    (custom VJP built into the kernel).  On CPU (tests, virtual meshes) it
+    falls back to mathematically-identical jnp attention with f32
+    softmax/accumulation, which is also what the Pallas kernel computes
+    internally, so goldens transfer across backends."""
+    q = first(ins, "Q")
+    k = first(ins, "K")
+    v = first(ins, "V")
+    bias = first(ins, "Bias") if "Bias" in ins and ins["Bias"] else None
+    causal = op.attr("causal", False)
+    scale = op.attr("scale", None)
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    if bias is not None and bias.shape[1] == 1 and q.shape[1] != 1:
+        bias = jnp.broadcast_to(bias, (bias.shape[0], q.shape[1]) + bias.shape[2:])
+
+    # Pallas pays off only once the score tile no longer fits XLA's own
+    # fusion sweet spot: a full-model interleaved A/B at seq 128 measured the
+    # kernel 35% SLOWER than XLA's fused unfused-attention (docs/perf_r04.md),
+    # so short sequences take the plain path even on TPU.
+    min_seq = op.attr("flash_min_seq", _FLASH_MIN_SEQ)
+    if ctx.platform == "tpu" and k.shape[2] >= min_seq:
+        from jax.experimental.pallas.ops.tpu.flash_attention import flash_attention
+
+        ab = bias.astype(jnp.float32) if bias is not None else None
+        out = flash_attention(q, k, v, ab=ab, causal=causal, sm_scale=scale)
+        return {"Out": out.astype(q.dtype)}
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        Lq, Lk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((Lq, Lk), bool), k=Lk - Lq)
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return {"Out": out.astype(q.dtype)}
+
+
 @register_op("top_k")
 def _top_k(ctx, op, ins):
     x = first(ins, "X")
@@ -588,8 +649,12 @@ def _auc(ctx, op, ins):
     }
 
 
-def _interp_2d(x, out_h, out_w, method, align_corners):
-    """Shared bilinear/nearest resize on NCHW (reference interpolate_op.h)."""
+def _interp_2d(x, out_h, out_w, method, align_corners, align_mode=1):
+    """Shared bilinear/nearest resize on NCHW (reference interpolate_op.h).
+
+    align_corners=False bilinear has TWO reference formulas, picked by
+    align_mode: 0 = half-pixel (src = (dst+0.5)*scale - 0.5), 1 (the
+    reference DEFAULT) = plain scaling (src = dst*scale)."""
     n, c, h, w = x.shape
     if method == "nearest":
         if align_corners:
@@ -602,10 +667,14 @@ def _interp_2d(x, out_h, out_w, method, align_corners):
     # bilinear
     if align_corners and out_h > 1:
         ys = jnp.linspace(0.0, h - 1.0, out_h)
+    elif align_mode == 1:
+        ys = jnp.arange(out_h) * (h / out_h)
     else:
         ys = jnp.maximum((jnp.arange(out_h) + 0.5) * (h / out_h) - 0.5, 0.0)
     if align_corners and out_w > 1:
         xs = jnp.linspace(0.0, w - 1.0, out_w)
+    elif align_mode == 1:
+        xs = jnp.arange(out_w) * (w / out_w)
     else:
         xs = jnp.maximum((jnp.arange(out_w) + 0.5) * (w / out_w) - 0.5, 0.0)
     y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
@@ -633,7 +702,8 @@ def _bilinear_interp(ctx, op, ins):
         out_h = int(x.shape[2] * scale)
         out_w = int(x.shape[3] * scale)
     return {"Out": _interp_2d(x, out_h, out_w, "bilinear",
-                              op.attr("align_corners", True))}
+                              op.attr("align_corners", True),
+                              op.attr("align_mode", 1))}
 
 
 @register_op("nearest_interp")
