@@ -1,0 +1,63 @@
+"""Interleaved A/B: ResNet-50 bs128 bf16, conv7 stem vs space-to-depth stem."""
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.models import resnet
+
+B, K = 128, 8
+
+
+def make(name, stem):
+    main, startup, feeds, fetches = resnet.build(
+        dtype="bfloat16", class_dim=1000, learning_rate=0.1, stem=stem)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    dev = fluid.TPUPlace(0).jax_device()
+    feed = {
+        "img": jax.device_put(jnp.asarray(rng.rand(K, B, 3, 224, 224), jnp.float32), dev),
+        "label": jax.device_put(jnp.asarray(rng.randint(0, 1000, (K, B, 1)), jnp.int32), dev),
+    }
+    loss_name = fetches["loss"].name
+
+    def dispatch():
+        return exe.run(main, feed=feed, fetch_list=[loss_name], scope=scope,
+                       steps=K, return_numpy=False)
+
+    for _ in range(2):
+        out = dispatch()
+    np.asarray(out[0])
+    return name, dispatch
+
+
+def window(dispatch, iters=3):
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = dispatch()
+    np.asarray(out[0])
+    return (time.perf_counter() - t0) / (iters * K)
+
+
+def main():
+    variants = [make("conv7", "conv7"), make("s2d", "space_to_depth")]
+    best = {n: float("inf") for n, _ in variants}
+    for rnd in range(4):
+        for n, d in variants:
+            dt = window(d)
+            best[n] = min(best[n], dt)
+            print(f"round {rnd} {n}: {dt*1e3:.2f} ms/step", file=sys.stderr)
+    for n, _ in variants:
+        dt = best[n]
+        imgs = B / dt
+        mfu = imgs * 3 * 4.089e9 / 197e12
+        print(f"{n}: best {dt*1e3:.2f} ms  {imgs:.0f} imgs/s  mfu {mfu:.3f}")
+
+
+if __name__ == "__main__":
+    main()

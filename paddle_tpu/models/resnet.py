@@ -66,9 +66,35 @@ _DEPTH = {
 }
 
 
-def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False, data_format="NCHW"):
+def _s2d_stem(input, is_test=False):
+    """MLPerf-style space-to-depth stem (NCHW): rearrange 224^2 x3 ->
+    112^2 x12 with reshape/transpose (channel = c*4 + dy*2 + dx), then a
+    4x4 STRIDE-1 conv — mathematically equivalent to the 7x7/s2 stem under
+    the weight embedding w4[o, c*4+dy*2+dx, r, s] = w8[o, c, 2r+dy, 2s+dx]
+    with w8 = 7x7 kernel zero-padded at offset (1,1) (tests/test_s2d_stem.py
+    asserts exact equality).  Why: the 7x7/s2 conv on 3 channels is the
+    worst-filled MXU op in the model (docs/perf_r03.md); stride-1 on 12
+    channels tiles far better.  Conv output is 113^2 (symmetric pad 2);
+    the last row/col is sliced off to match the 112^2 contract."""
+    b, c, h, w = input.shape
+    x6 = layers.reshape(input, [-1, c, h // 2, 2, w // 2, 2])   # b c j dy i dx
+    x6 = layers.transpose(x6, [0, 1, 3, 5, 2, 4])               # b c dy dx j i
+    s2d = layers.reshape(x6, [-1, c * 4, h // 2, w // 2])
+    conv = layers.conv2d(s2d, num_filters=64, filter_size=4, stride=1,
+                         padding=2, bias_attr=False)
+    conv = layers.slice(conv, axes=[2, 3], starts=[0, 0], ends=[h // 2, w // 2])
+    return layers.batch_norm(conv, act="relu", is_test=is_test)
+
+
+def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False, data_format="NCHW",
+                    stem="conv7"):
     block_fn, stages = _DEPTH[depth]
-    conv = conv_bn_layer(input, 64, 7, 2, 3, is_test=is_test, data_format=data_format)
+    if stem == "space_to_depth":
+        if data_format != "NCHW":
+            raise ValueError("space_to_depth stem is NCHW-only")
+        conv = _s2d_stem(input, is_test=is_test)
+    else:
+        conv = conv_bn_layer(input, 64, 7, 2, 3, is_test=is_test, data_format=data_format)
     pool = layers.pool2d(conv, pool_size=3, pool_stride=2, pool_padding=1, pool_type="max",
                          data_format=data_format)
     res = pool
@@ -83,7 +109,7 @@ def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False, data_format=
 
 def build(depth=50, class_dim=1000, image_shape=None, learning_rate=0.1,
           momentum=0.9, with_optimizer=True, dtype="float32", is_test=False,
-          data_format="NCHW"):
+          data_format="NCHW", stem="conv7"):
     """Returns (main, startup, feeds, fetches) for ImageNet-style training.
 
     dtype="bfloat16" casts the input into bf16 so every conv/matmul hits the
@@ -98,7 +124,7 @@ def build(depth=50, class_dim=1000, image_shape=None, learning_rate=0.1,
         label = layers.data("label", [1], dtype="int64")
         net_in = layers.cast(img, dtype) if dtype != "float32" else img
         logits = resnet_imagenet(net_in, class_dim=class_dim, depth=depth, is_test=is_test,
-                                 data_format=data_format)
+                                 data_format=data_format, stem=stem)
         logits = layers.cast(logits, "float32") if dtype != "float32" else logits
         loss = layers.mean(layers.softmax_with_cross_entropy(logits, label))
         acc = layers.accuracy(layers.softmax(logits), label)

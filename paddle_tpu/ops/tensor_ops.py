@@ -73,6 +73,28 @@ def _cast(ctx, op, ins):
     return {"Out": x.astype(np_dtype(op.attr("out_dtype", op.attr("dtype", "float32"))))}
 
 
+@register_op("space_to_depth")
+def _space_to_depth(ctx, op, ins):
+    """reference space_to_depth_op.h space_to_depth_compute: the flat buffer
+    is written as [B, C/bs^2, H*bs, W*bs] (channel k of the input splits into
+    offset=k//Cout picking the in-block (dy,dx) and c2=k%Cout) and then
+    REINTERPRETED as [B, C*bs^2, H/bs, W/bs] — matched bit-for-bit here via
+    reshape/transpose so OpTest goldens transfer."""
+    x = first(ins, "X")
+    bs = int(op.attr("blocksize"))
+    B, C, H, W = x.shape
+    if C % (bs * bs) != 0 or H % bs != 0 or W % bs != 0:
+        raise ValueError(
+            f"space_to_depth: C ({C}) must divide blocksize^2 and H/W ({H},{W}) "
+            f"must divide blocksize ({bs}) — reference InferShape contract")
+    cout = C // (bs * bs)
+    # x[b, (dy*bs+dx)*cout + c2, j, i] -> A[b, c2, j*bs+dy, i*bs+dx]
+    x6 = x.reshape(B, bs, bs, cout, H, W)           # [b, dy, dx, c2, j, i]
+    a = jnp.transpose(x6, (0, 3, 4, 1, 5, 2))        # [b, c2, j, dy, i, dx]
+    flat = a.reshape(B, cout, H * bs, W * bs)
+    return {"Out": flat.reshape(B, C * bs * bs, H // bs, W // bs)}
+
+
 @register_op("reshape2")
 def _reshape2(ctx, op, ins):
     x = first(ins, "X")
