@@ -38,6 +38,31 @@ from .detection import (  # noqa: F401
     yolo_box,
 )
 from .nn import *  # noqa: F401,F403
+from .misc import (  # noqa: F401
+    affine_channel,
+    affine_grid,
+    bpr_loss,
+    conv3d,
+    diag,
+    edit_distance,
+    grid_sampler,
+    hinge_loss,
+    hsigmoid,
+    im2sequence,
+    kldiv_loss,
+    log_loss,
+    lrn,
+    margin_rank_loss,
+    maxout,
+    multiplex,
+    nce,
+    pool3d,
+    rank_loss,
+    reverse,
+    row_conv,
+    selu,
+    spectral_norm,
+)
 from .sequence import (  # noqa: F401
     DynamicRNN,
     StaticRNN,

@@ -3,6 +3,7 @@ from . import (  # noqa: F401
     control_flow_ops,
     detection_ops,
     math_ops,
+    misc_ops,
     nn_ops,
     optimizer_ops,
     pipeline_ops,

@@ -1,0 +1,527 @@
+"""Round-4 op-tail lowerings: the loss family, normalization/activation
+stragglers, and small tensor utilities.
+
+Reference kernels (paddle/fluid/operators/): hinge_loss_op.h, log_loss_op.h,
+rank_loss_op.h, margin_rank_loss_op.h, bpr_loss_op.h, kldiv_loss_op.h,
+modified_huber_loss_op.h, selu_op.h, lrn_op.cc, math/maxouting.cc,
+multiplex_op.cc, reverse_op.cc, diag_op.cc, affine_channel_op.cc,
+grid_sampler_op.h, affine_grid_op.cc, spectral_norm_op.h, row_conv_op.cc,
+im2sequence_op.h, edit_distance_op.h, conv_op.cc (conv3d:579), pool_op.cc.
+Each lowering re-derives the math in jnp; goldens in
+tests/test_ops_round4.py follow the reference OpTest conventions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+from .common import canon_dtype, first, match_dtype
+
+
+# --- loss family -----------------------------------------------------------
+
+@register_op("hinge_loss")
+def _hinge_loss(ctx, op, ins):
+    x = first(ins, "Logits")
+    y = first(ins, "Labels")
+    return {"Loss": jnp.maximum(1.0 - x * (2.0 * y - 1.0), 0.0)}
+
+
+@register_op("log_loss")
+def _log_loss(ctx, op, ins):
+    p = first(ins, "Predicted")
+    y = first(ins, "Labels")
+    eps = op.attr("epsilon", 1e-4)
+    return {"Loss": -(y * jnp.log(p + eps)) - (1.0 - y) * jnp.log(1.0 - p + eps)}
+
+
+@register_op("rank_loss")
+def _rank_loss(ctx, op, ins):
+    label = first(ins, "Label")
+    left = first(ins, "Left")
+    right = first(ins, "Right")
+    return {"Out": jnp.log(1.0 + jnp.exp(left - right)) - label * (left - right)}
+
+
+@register_op("margin_rank_loss")
+def _margin_rank_loss(ctx, op, ins):
+    label = first(ins, "Label")
+    x1 = first(ins, "X1")
+    x2 = first(ins, "X2")
+    margin = op.attr("margin", 0.0)
+    out = jnp.maximum(-label * (x1 - x2) + margin, 0.0)
+    return {"Out": out, "Activated": (out > 0).astype(out.dtype)}
+
+
+@register_op("bpr_loss")
+def _bpr_loss(ctx, op, ins):
+    """Bayesian Personalized Ranking (bpr_loss_op.h): for each row, mean over
+    negatives j != label of log(1 + exp(x_j - x_label))."""
+    x = first(ins, "X")
+    label = first(ins, "Label")
+    nclass = x.shape[-1]
+    x2 = x.reshape(-1, nclass)
+    lbl = label.reshape(-1).astype(jnp.int32)
+    pos = jnp.take_along_axis(x2, lbl[:, None], axis=1)
+    # loss_i = -sum_{j != lbl} -log(1+exp(x_j - x_pos)) / (C-1)
+    lg = jnp.log1p(jnp.exp(x2 - pos))
+    mask = jax.nn.one_hot(lbl, nclass, dtype=x.dtype)
+    loss = jnp.sum(lg * (1.0 - mask), axis=1, keepdims=True) / (nclass - 1)
+    return {"Y": loss.astype(x.dtype)}
+
+
+@register_op("kldiv_loss")
+def _kldiv_loss(ctx, op, ins):
+    x = first(ins, "X")
+    target = first(ins, "Target")
+    red = op.attr("reduction", "mean")
+    out = jnp.where(target > 0, target * (jnp.log(jnp.where(target > 0, target, 1.0)) - x), 0.0)
+    if red == "none":
+        return {"Loss": out}
+    if red == "batchmean":
+        return {"Loss": (jnp.sum(out) / x.shape[0]).reshape(())}
+    if red == "sum":
+        return {"Loss": jnp.sum(out).reshape(())}
+    return {"Loss": jnp.mean(out).reshape(())}
+
+
+@register_op("modified_huber_loss")
+def _modified_huber_loss(ctx, op, ins):
+    x = first(ins, "X")
+    y = first(ins, "Y")
+    inter = x * (2.0 * y - 1.0)
+    loss = jnp.where(inter < -1.0, -4.0 * inter,
+                     jnp.where(inter < 1.0, jnp.square(1.0 - inter), 0.0))
+    return {"Out": loss, "IntermediateVal": inter}
+
+
+# --- activations / norms ---------------------------------------------------
+
+@register_op("selu")
+def _selu(ctx, op, ins):
+    x = first(ins, "X")
+    alpha = op.attr("alpha", 1.6732632423543772)
+    scale = op.attr("scale", 1.0507009873554805)
+    return {"Out": scale * jnp.where(x > 0, x, alpha * jnp.exp(x) - alpha)}
+
+
+@register_op("lrn")
+def _lrn(ctx, op, ins):
+    """lrn_op.cc LRNFunctor: mid = k + alpha * sliding-window channel sum of
+    x^2 (window n centered with pre_pad=(n-1)/2), out = x * mid^-beta."""
+    x = first(ins, "X")
+    n = op.attr("n", 5)
+    k = op.attr("k", 2.0)
+    alpha = op.attr("alpha", 1e-4)
+    beta = op.attr("beta", 0.75)
+    pre = (n - 1) // 2
+    sq = jnp.square(x)
+    pad = jnp.pad(sq, ((0, 0), (pre, n - 1 - pre), (0, 0), (0, 0)))
+    # windowed channel sum via cumsum difference (static shapes)
+    csum = jnp.cumsum(pad, axis=1)
+    csum = jnp.pad(csum, ((0, 0), (1, 0), (0, 0), (0, 0)))
+    C = x.shape[1]
+    win = csum[:, n:n + C] - csum[:, 0:C]
+    mid = k + alpha * win
+    return {"Out": x * jnp.power(mid, -beta), "MidOut": mid}
+
+
+@register_op("maxout")
+def _maxout(ctx, op, ins):
+    """math/maxouting.cc: out channel c = max over input channels
+    [c*groups, (c+1)*groups)."""
+    x = first(ins, "X")
+    g = op.attr("groups")
+    N, C, H, W = x.shape
+    return {"Out": x.reshape(N, C // g, g, H, W).max(axis=2)}
+
+
+@register_op("affine_channel")
+def _affine_channel(ctx, op, ins):
+    x = first(ins, "X")
+    scale = match_dtype(x, first(ins, "Scale"))
+    bias = match_dtype(x, first(ins, "Bias"))
+    if op.attr("data_layout", "NCHW") == "NHWC":
+        return {"Out": x * scale + bias}
+    shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    return {"Out": x * scale.reshape(shape) + bias.reshape(shape)}
+
+
+# --- tensor utilities ------------------------------------------------------
+
+@register_op("multiplex")
+def _multiplex(ctx, op, ins):
+    xs = jnp.stack(ins["X"], axis=0)  # [n_candidates, batch, ...]
+    ids = first(ins, "Ids").reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(ids.shape[0])
+    return {"Out": xs[ids, rows]}
+
+
+@register_op("reverse")
+def _reverse(ctx, op, ins):
+    x = first(ins, "X")
+    axes = op.attr("axis")
+    if isinstance(axes, int):
+        axes = [axes]
+    return {"Out": jnp.flip(x, axis=tuple(axes))}
+
+
+@register_op("diag")
+def _diag(ctx, op, ins):
+    return {"Out": jnp.diag(first(ins, "Diagonal").reshape(-1))}
+
+
+# --- 3-D conv / pool -------------------------------------------------------
+
+@register_op("conv3d")
+def _conv3d(ctx, op, ins):
+    """conv_op.cc:579 Conv3D — NCDHW activations, OIDHW filters."""
+    x = first(ins, "Input")
+    w = match_dtype(x, first(ins, "Filter"))
+    strides = tuple(op.attr("strides", [1, 1, 1]))
+    pads = op.attr("paddings", [0, 0, 0])
+    dilations = tuple(op.attr("dilations", [1, 1, 1]))
+    groups = op.attr("groups", 1) or 1
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=[(p, p) for p in pads],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups,
+    )
+    return {"Output": out}
+
+
+@register_op("pool3d")
+def _pool3d(ctx, op, ins):
+    x = first(ins, "X")
+    ptype = op.attr("pooling_type", "max")
+    ksize = list(op.attr("ksize", [2, 2, 2]))
+    strides = list(op.attr("strides", [1, 1, 1]))
+    pads = list(op.attr("paddings", [0, 0, 0]))
+    if op.attr("global_pooling", False):
+        ksize = list(x.shape[2:])
+        strides = [1, 1, 1]
+        pads = [0, 0, 0]
+    window = (1, 1) + tuple(ksize)
+    strides_full = (1, 1) + tuple(strides)
+    lo_hi = [[p, p] for p in pads]
+    if op.attr("ceil_mode", False):
+        # pad the high side so the last partial window is kept
+        for i in range(3):
+            span = x.shape[2 + i] + 2 * pads[i] - ksize[i]
+            rem = span % strides[i]
+            if rem:
+                lo_hi[i][1] += strides[i] - rem
+    padcfg = ((0, 0), (0, 0)) + tuple((lo, hi) for lo, hi in lo_hi)
+    if ptype == "max":
+        init = -jnp.inf
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, strides_full, padcfg)
+    else:
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides_full, padcfg)
+        if op.attr("exclusive", True):
+            ones = jnp.ones_like(x)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides_full, padcfg)
+            out = s / cnt
+        else:
+            out = s / float(np.prod(ksize))
+    return {"Out": out.astype(x.dtype)}
+
+
+# --- spatial transforms ----------------------------------------------------
+
+@register_op("affine_grid")
+def _affine_grid(ctx, op, ins):
+    """affine_grid_op.cc: theta (N,2,3) x normalized [-1,1] base grid ->
+    sampling grid (N,H,W,2).  Paddle 1.5 normalizes with align_corners=True
+    semantics (linspace -1..1 inclusive)."""
+    theta = first(ins, "Theta")
+    if "OutputShape" in ins and ins["OutputShape"]:
+        oshape = first(ins, "OutputShape")
+        h, w = int(oshape[2]), int(oshape[3])
+    else:
+        shape = op.attr("output_shape")
+        h, w = int(shape[2]), int(shape[3])
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gx, gy = jnp.meshgrid(xs, ys)  # (h, w)
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # (h, w, 3)
+    out = jnp.einsum("hwk,nck->nhwc", base.astype(theta.dtype), theta)
+    return {"Output": out}
+
+
+@register_op("grid_sampler")
+def _grid_sampler(ctx, op, ins):
+    """grid_sampler_op.h: bilinear sample x (N,C,H,W) at grid (N,H,W,2) in
+    [-1,1], zero padding outside, align_corners=True scaling
+    ((g+1)/2*(S-1))."""
+    x = first(ins, "X")
+    grid = first(ins, "Grid")
+    N, C, H, W = x.shape
+    gx = (grid[..., 0] + 1.0) / 2.0 * (W - 1)
+    gy = (grid[..., 1] + 1.0) / 2.0 * (H - 1)
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    x1 = x0 + 1
+    y1 = y0 + 1
+
+    def gather(yi, xi):
+        valid = (xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1)
+        xi_c = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        yi_c = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        # x: (N,C,H,W); index per-batch grid points
+        v = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(x, yi_c, xi_c)  # (N, C, Hg, Wg)?
+        return v, valid
+
+    v00, m00 = gather(y0, x0)
+    v01, m01 = gather(y0, x1)
+    v10, m10 = gather(y1, x0)
+    v11, m11 = gather(y1, x1)
+    wx1 = (gx - x0).astype(x.dtype)
+    wy1 = (gy - y0).astype(x.dtype)
+    wx0 = 1.0 - wx1
+    wy0 = 1.0 - wy1
+
+    def term(v, m, wgt):
+        return v * (wgt * m.astype(x.dtype))[:, None]
+
+    out = (term(v00, m00, wy0 * wx0) + term(v01, m01, wy0 * wx1)
+           + term(v10, m10, wy1 * wx0) + term(v11, m11, wy1 * wx1))
+    return {"Output": out}
+
+
+# --- spectral norm ---------------------------------------------------------
+
+@register_op("spectral_norm")
+def _spectral_norm(ctx, op, ins):
+    """spectral_norm_op.h: power-iterate U/V (as inputs, NOT updated in the
+    program — matches the reference kernel which writes only Out), then
+    Out = W / sigma with sigma = u^T W v."""
+    w = first(ins, "Weight")
+    u = first(ins, "U").reshape(-1)
+    v = first(ins, "V").reshape(-1)
+    dim = op.attr("dim", 0)
+    power_iters = op.attr("power_iters", 1)
+    eps = op.attr("eps", 1e-12)
+    perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+    wmat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+
+    def l2norm(a):
+        return a / (jnp.linalg.norm(a) + eps)
+
+    for _ in range(power_iters):
+        v = l2norm(wmat.T @ u)
+        u = l2norm(wmat @ v)
+    u = jax.lax.stop_gradient(u)
+    v = jax.lax.stop_gradient(v)
+    sigma = u @ wmat @ v
+    return {"Out": w / sigma}
+
+
+# --- sequence stragglers ---------------------------------------------------
+
+@register_op("row_conv")
+def _row_conv(ctx, op, ins):
+    """row_conv_op.cc lookahead convolution on a PADDED batch (B, T, D):
+    out[t] = sum_{j=0..ctx-1} W[j] * x[t+j] (zeros past the end).  The
+    ragged path feeds padded carriers (paddle_tpu/lod.py)."""
+    x = first(ins, "X")
+    w = match_dtype(x, first(ins, "Filter"))  # (future_context, D)
+    fc = w.shape[0]
+    out = jnp.zeros_like(x)
+    for j in range(fc):
+        shifted = jnp.pad(x[:, j:, :], ((0, 0), (0, j), (0, 0)))
+        out = out + shifted * w[j]
+    return {"Out": out}
+
+
+@register_op("im2sequence")
+def _im2sequence(ctx, op, ins):
+    """im2sequence_op.h: extract kernel patches row-major into a sequence
+    [N*oh*ow, kh*kw*C] (channel-minor per the reference's im2col layout:
+    each row is [c0 patch, c1 patch, ...] flattened C-major)."""
+    x = first(ins, "X")
+    kh, kw = op.attr("kernels")
+    strides = op.attr("strides", [1, 1])
+    pads = op.attr("paddings", [0, 0, 0, 0])  # up, left, down, right
+    N, C, H, W = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3])))
+    Hp, Wp = xp.shape[2], xp.shape[3]
+    oh = (Hp - kh) // strides[0] + 1
+    ow = (Wp - kw) // strides[1] + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, (kh, kw), tuple(strides), padding=[(0, 0), (0, 0)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))  # (N, C*kh*kw, oh, ow)
+    seq = jnp.transpose(patches, (0, 2, 3, 1)).reshape(N * oh * ow, C * kh * kw)
+    return {"Out": seq}
+
+
+@register_op("edit_distance")
+def _edit_distance(ctx, op, ins):
+    """edit_distance_op.h Levenshtein DP over PADDED int batches
+    (B, Tmax) + companion length vectors via the @LOD convention; the DP
+    runs as a lax.scan over the hypothesis axis (static trip count)."""
+    hyp = first(ins, "Hyps")
+    ref = first(ins, "Refs")
+    hyp_lens = first(ins, "HypsLen")
+    ref_lens = first(ins, "RefsLen")
+    norm = op.attr("normalized", False)
+    # ragged carriers arrive (B, T, 1) (paddle_tpu/lod.py); tokens are (B, T)
+    if hyp.ndim == 3 and hyp.shape[-1] == 1:
+        hyp = hyp[..., 0]
+    if ref.ndim == 3 and ref.shape[-1] == 1:
+        ref = ref[..., 0]
+    B, Th = hyp.shape[0], hyp.shape[1]
+    Tr = ref.shape[1]
+    hyp_lens = hyp_lens.reshape(-1).astype(jnp.int32)
+    ref_lens = ref_lens.reshape(-1).astype(jnp.int32)
+
+    # DP row: d[j] = edit distance between hyp[:i] and ref[:j]
+    init = jnp.broadcast_to(jnp.arange(Tr + 1, dtype=jnp.float32), (B, Tr + 1))
+
+    def step(carry, i):
+        prev = carry  # (B, Tr+1)
+        hi = hyp[:, i]  # (B,)
+        in_hyp = (i < hyp_lens)
+        cost = (hi[:, None] != ref).astype(jnp.float32)  # (B, Tr)
+        # cur[0] = i+1; build left-to-right with the running value as carry
+        def scan_j(cur, j):
+            sub = prev[:, j] + cost[:, j]
+            ins_ = cur + 1.0
+            del_ = prev[:, j + 1] + 1.0
+            nxt = jnp.minimum(jnp.minimum(sub, ins_), del_)
+            return nxt, nxt
+
+        first_col = jnp.full((B,), i + 1.0)
+        _, rest = jax.lax.scan(scan_j, first_col, jnp.arange(Tr))
+        cur = jnp.concatenate([first_col[:, None], jnp.transpose(rest)], axis=1)
+        cur = jnp.where(in_hyp[:, None], cur, prev)
+        return cur, None
+
+    final, _ = jax.lax.scan(step, init, jnp.arange(Th))
+    dist = jnp.take_along_axis(final, ref_lens[:, None], axis=1).reshape(-1)
+    # empty-ref convention (edit_distance_op.h): distance = hyp_len
+    dist = jnp.where(ref_lens == 0, hyp_lens.astype(jnp.float32), dist)
+    if norm:
+        dist = dist / jnp.maximum(ref_lens.astype(jnp.float32), 1.0)
+    seq_num = jnp.asarray([B], jnp.int64 if False else jnp.int32)
+    return {"Out": dist.reshape(-1, 1), "SequenceNum": seq_num}
+
+
+# --- sampled / tree classifiers -------------------------------------------
+
+@register_op("nce")
+def _nce(ctx, op, ins):
+    """nce_op.h: noise-contrastive estimation.  Per example the sampled-label
+    row is [true labels | negative samples]; o = exp(logit), b = q(class) *
+    num_neg, cost = -log(o/(o+b)) on true columns and -log(b/(o+b)) on
+    negatives.  Negative sampling is in-trace (uniform / log-uniform via the
+    threaded PRNG key; fixed custom_neg_classes for OpTest determinism).
+    The reference's alias-table custom sampler (sampler=2) is served by the
+    same categorical draw over CustomDistProbs."""
+    x = first(ins, "Input")                      # (B, D)
+    label = first(ins, "Label").astype(jnp.int32)  # (B, num_true)
+    w = first(ins, "Weight")                     # (C, D)
+    bias = first(ins, "Bias")                    # (C,) or None
+    sample_weight = first(ins, "SampleWeight")
+    num_total = op.attr("num_total_classes")
+    num_neg = op.attr("num_neg_samples", 10)
+    sampler = op.attr("sampler", 0)
+    custom_negs = op.attr("custom_neg_classes", None)
+    B = x.shape[0]
+    num_true = label.shape[1] if label.ndim > 1 else 1
+    label = label.reshape(B, num_true)
+
+    if custom_negs:
+        negs = jnp.broadcast_to(jnp.asarray(custom_negs, jnp.int32)[None, :],
+                                (B, len(custom_negs)))
+        num_neg = len(custom_negs)
+    elif sampler == 1:
+        # log-uniform: P(k) = log((k+2)/(k+1)) / log(range+2); sample via
+        # inverse CDF of the continuous approximation (TF/candidate-sampling
+        # trick): k = floor(exp(u * log(range+2)) - 1)
+        u = jax.random.uniform(ctx.next_key(), (B, num_neg))
+        rng_range = num_total - 1
+        negs = jnp.floor(jnp.exp(u * np.log(rng_range + 2.0)) - 1.0).astype(jnp.int32)
+        negs = jnp.clip(negs, 0, rng_range)
+    elif sampler == 2:
+        probs = first(ins, "CustomDistProbs")
+        negs = jax.random.categorical(
+            ctx.next_key(), jnp.log(jnp.maximum(probs, 1e-30))[None, :],
+            shape=(B, num_neg)).astype(jnp.int32)
+    else:
+        negs = jax.random.randint(ctx.next_key(), (B, num_neg), 0, num_total,
+                                  dtype=jnp.int32)
+
+    samples = jnp.concatenate([label, negs], axis=1)       # (B, S)
+    ws = jnp.take(w, samples, axis=0)                      # (B, S, D)
+    logits = jnp.einsum("bsd,bd->bs", ws, x)
+    if bias is not None:
+        logits = logits + jnp.take(bias.reshape(-1), samples)
+    o = jnp.exp(logits)
+
+    if sampler == 1:
+        rng_range = num_total - 1
+        q = (jnp.log((samples + 2.0) / (samples + 1.0))
+             / np.log(rng_range + 2.0))
+    elif sampler == 2:
+        probs = first(ins, "CustomDistProbs")
+        q = jnp.take(probs, samples)
+    else:
+        q = jnp.full(samples.shape, 1.0 / num_total)
+    b = q * num_neg
+
+    is_true = jnp.arange(samples.shape[1])[None, :] < num_true
+    cost = jnp.where(is_true, -jnp.log(o / (o + b)), -jnp.log(b / (o + b)))
+    total = jnp.sum(cost, axis=1, keepdims=True)
+    if sample_weight is not None:
+        total = total * sample_weight.reshape(B, 1)
+    return {"Cost": total.astype(x.dtype), "SampleLogits": logits,
+            "SampleLabels": samples.astype(canon_dtype("int64"))}
+
+
+@register_op("hierarchical_sigmoid")
+def _hierarchical_sigmoid(ctx, op, ins):
+    """hierarchical_sigmoid_op.h + math/matrix_bit_code.h SimpleCode: leaf
+    encoding c = label + num_classes; path node for bit j is (c>>(j+1))-1,
+    branch bit is (c>>j)&1; loss = sum softplus(clip(pre,-40,40)) over ALL
+    code_length columns (out-of-path columns contribute softplus(0)=log 2,
+    faithfully reproducing the reference's recorded quirk) minus sum of
+    bit*pre over in-path columns."""
+    x = first(ins, "X")                      # (B, D)
+    w = first(ins, "W")                      # (num_classes-1, D)
+    label = first(ins, "Label").astype(jnp.int32).reshape(-1)  # (B,)
+    bias = first(ins, "Bias")
+    path_table = first(ins, "PathTable")
+    path_code = first(ins, "PathCode")
+    num_classes = op.attr("num_classes")
+    B = x.shape[0]
+
+    if path_table is not None:
+        # custom tree: per-class rows of node ids / branch codes, -1 padded
+        nodes = jnp.take(path_table, label, axis=0).astype(jnp.int32)  # (B, L)
+        bits = jnp.take(path_code, label, axis=0).astype(jnp.int32)
+        valid = nodes >= 0
+        nodes_c = jnp.maximum(nodes, 0)
+    else:
+        code_length = int(num_classes - 1).bit_length()
+        c = label + num_classes
+        js = jnp.arange(code_length, dtype=jnp.int32)
+        shifted = jnp.right_shift(c[:, None], js[None, :] + 1)
+        nodes = shifted - 1
+        bits = jnp.bitwise_and(jnp.right_shift(c[:, None], js[None, :]), 1)
+        valid = shifted > 0
+        nodes_c = jnp.maximum(nodes, 0)
+
+    pre = jnp.einsum("bld,bd->bl", jnp.take(w, nodes_c, axis=0), x)
+    if bias is not None:
+        pre = pre + jnp.take(bias.reshape(-1), nodes_c)
+    pre = jnp.clip(pre, -40.0, 40.0)
+    pre = jnp.where(valid, pre, 0.0)
+    softplus = jnp.log1p(jnp.exp(pre))
+    out = jnp.sum(softplus, axis=1, keepdims=True) - jnp.sum(
+        jnp.where(valid, bits * pre, 0.0), axis=1, keepdims=True)
+    return {"Out": out.astype(x.dtype), "PreOut": pre}

@@ -1,0 +1,159 @@
+"""nce + hierarchical_sigmoid goldens and convergence (reference
+nce_op.h / hierarchical_sigmoid_op.h + math/matrix_bit_code.h; OpTest
+models: test_nce.py, test_hsigmoid_op.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.program import Program, program_guard
+
+from op_test import OpTest
+
+
+def _nce_ref(x, w, b, label, negs, num_total):
+    """Transcription of nce_op.h forward with uniform sampler."""
+    B, num_true = label.shape
+    samples = np.concatenate([label, np.tile(negs, (B, 1))], axis=1)
+    cost = np.zeros((B, 1), "float64")
+    for i in range(B):
+        for j, t in enumerate(samples[i]):
+            o = np.exp(x[i] @ w[t] + b[t])
+            bb = (1.0 / num_total) * negs.size
+            cost[i, 0] += -np.log(o / (o + bb)) if j < num_true else -np.log(bb / (o + bb))
+    return cost.astype("float32"), samples
+
+
+def test_nce_golden_custom_negs():
+    rng = np.random.RandomState(11)
+    B, D, C = 5, 8, 20
+    x = rng.randn(B, D).astype("float32") * 0.3
+    w = rng.randn(C, D).astype("float32") * 0.3
+    b = rng.randn(C).astype("float32") * 0.1
+    label = rng.randint(0, C, (B, 1)).astype("int64")
+    negs = np.array([1, 4, 7, 11], "int64")
+    expect, samples = _nce_ref(x, w, b, label, negs, C)
+
+    class T(OpTest):
+        def setUp(self):
+            self.op_type = "nce"
+            self.inputs = {"Input": x, "Label": label, "Weight": w, "Bias": b}
+            self.attrs = {"num_total_classes": C, "sampler": 0,
+                          "custom_neg_classes": [1, 4, 7, 11],
+                          "num_neg_samples": 4}
+            self.outputs = {"Cost": expect}
+
+    T().check_output(atol=1e-4, no_check_set=["SampleLogits", "SampleLabels"])
+
+
+def _simple_code(label, num_classes):
+    c = label + num_classes
+    length = c.bit_length() - 1
+    nodes = [(c >> (j + 1)) - 1 for j in range(length)]
+    bits = [(c >> j) & 1 for j in range(length)]
+    return nodes, bits
+
+
+def _hsigmoid_ref(x, w, b, label, num_classes):
+    B = x.shape[0]
+    code_length = int(num_classes - 1).bit_length()
+    out = np.zeros((B, 1), "float64")
+    for i in range(B):
+        nodes, bits = _simple_code(int(label[i, 0]), num_classes)
+        pre = np.zeros(code_length)
+        for j, (node, bit) in enumerate(zip(nodes, bits)):
+            pre[j] = np.clip(x[i] @ w[node] + b[node], -40, 40)
+        # the reference's recorded quirk: softplus over ALL code_length
+        # columns (out-of-path zeros contribute log 2)
+        out[i, 0] = np.log1p(np.exp(pre)).sum() - sum(
+            bit * pre[j] for j, bit in enumerate(bits))
+    return out.astype("float32")
+
+
+def test_hsigmoid_golden():
+    rng = np.random.RandomState(12)
+    B, D, C = 6, 5, 11
+    x = rng.randn(B, D).astype("float32") * 0.4
+    w = rng.randn(C - 1, D).astype("float32") * 0.4
+    b = rng.randn(C - 1).astype("float32") * 0.1
+    label = rng.randint(0, C, (B, 1)).astype("int64")
+    expect = _hsigmoid_ref(x, w, b, label, C)
+
+    class T(OpTest):
+        def setUp(self):
+            self.op_type = "hierarchical_sigmoid"
+            self.inputs = {"X": x, "Label": label, "W": w, "Bias": b}
+            self.attrs = {"num_classes": C}
+            self.outputs = {"Out": expect}
+
+    T().check_output(atol=1e-4, no_check_set=["PreOut"])
+
+
+def test_hsigmoid_custom_tree_golden():
+    """Custom path_table/path_code equals the SimpleCode tree when the table
+    encodes the same paths."""
+    rng = np.random.RandomState(13)
+    B, D, C = 4, 5, 8
+    x = rng.randn(B, D).astype("float32") * 0.4
+    w = rng.randn(C - 1, D).astype("float32") * 0.4
+    b = rng.randn(C - 1).astype("float32") * 0.1
+    label = rng.randint(0, C, (B, 1)).astype("int64")
+    code_length = int(C - 1).bit_length()
+    table = np.full((C, code_length), -1, "int64")
+    code = np.full((C, code_length), -1, "int64")
+    for cls in range(C):
+        nodes, bits = _simple_code(cls, C)
+        table[cls, :len(nodes)] = nodes
+        code[cls, :len(bits)] = bits
+    expect = _hsigmoid_ref(x, w, b, label, C)
+
+    class T(OpTest):
+        def setUp(self):
+            self.op_type = "hierarchical_sigmoid"
+            self.inputs = {"X": x, "Label": label, "W": w, "Bias": b,
+                           "PathTable": table, "PathCode": code}
+            self.attrs = {"num_classes": C}
+            self.outputs = {"Out": expect}
+
+    T().check_output(atol=1e-4, no_check_set=["PreOut"])
+
+
+def _word2vec_style(loss_layer):
+    """Tiny skip-gram-ish model: embedding -> loss_layer(emb, ctx_word)."""
+    main, startup = Program(), Program()
+    startup.random_seed = 9
+    V, D = 30, 16
+    with program_guard(main, startup):
+        wrd = layers.data("w", [1], dtype="int64")
+        ctx = layers.data("c", [1], dtype="int64")
+        emb = layers.embedding(wrd, size=[V, D])
+        emb = layers.reshape(emb, [-1, D])
+        loss = layers.mean(loss_layer(emb, ctx, V))
+        fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    # deterministic co-occurrence: context = (word + 1) % V
+    wv = rng.randint(0, 30, (64, 1)).astype("int64")
+    cv = (wv + 1) % 30
+    losses = []
+    for _ in range(30):
+        (lv,) = exe.run(main, feed={"w": wv, "c": cv}, fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8, f"{losses[0]} -> {losses[-1]}"
+
+
+def test_nce_word2vec_converges():
+    _word2vec_style(lambda emb, ctx, V: layers.nce(
+        emb, ctx, num_total_classes=V, num_neg_samples=5))
+
+
+def test_nce_log_uniform_converges():
+    _word2vec_style(lambda emb, ctx, V: layers.nce(
+        emb, ctx, num_total_classes=V, num_neg_samples=5, sampler="log_uniform"))
+
+
+def test_hsigmoid_word2vec_converges():
+    _word2vec_style(lambda emb, ctx, V: layers.hsigmoid(
+        emb, ctx, num_classes=V))
