@@ -54,7 +54,8 @@ def bench_resnet50(batch_size=128, K=8, iters=4):
     from paddle_tpu.models import resnet
 
     main, startup, feeds, fetches = resnet.build(
-        dtype="bfloat16", class_dim=1000, learning_rate=0.1, with_optimizer=True)
+        dtype="bfloat16", class_dim=1000, learning_rate=0.1, with_optimizer=True,
+        stem="space_to_depth")
     scope = fluid.Scope()
     exe = fluid.Executor(fluid.TPUPlace(0))
     exe.run(startup, scope=scope)

@@ -41,16 +41,23 @@ from .nn import *  # noqa: F401,F403
 from .misc import (  # noqa: F401
     affine_channel,
     affine_grid,
+    beam_search,
+    beam_search_decode,
     bpr_loss,
     conv3d,
     diag,
     edit_distance,
+    expand,
     grid_sampler,
     hinge_loss,
     hsigmoid,
     im2sequence,
+    key_padding_bias,
     kldiv_loss,
     log_loss,
+    logical_and,
+    logical_not,
+    logical_or,
     lrn,
     margin_rank_loss,
     maxout,

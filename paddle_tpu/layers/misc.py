@@ -405,3 +405,90 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
                      outputs={"Out": [out.name], "PreOut": [pre.name]},
                      attrs={"num_classes": int(num_classes)})
     return out
+
+
+# --- in-program beam search -----------------------------------------------
+
+def beam_search(logits, seqs, scores, finished, step_idx, beam_size, end_id,
+                name=None):
+    """One in-program beam step (reference layers/nn.py beam_search over
+    beam_search_op; LoD state redesigned as static [b, k] tensors — see
+    ops/misc_ops.py).  Writes seqs/scores/finished IN PLACE so they carry
+    through a surrounding layers.While."""
+    helper = LayerHelper("beam_search", name=name)
+    helper.append_op(
+        "beam_search",
+        inputs={"Logits": [logits.name], "Seqs": [seqs.name],
+                "Scores": [scores.name], "Finished": [finished.name],
+                "StepIdx": [step_idx.name]},
+        outputs={"SelectedSeqs": [seqs.name], "SelectedScores": [scores.name],
+                 "FinishedOut": [finished.name]},
+        attrs={"beam_size": int(beam_size), "end_id": int(end_id)},
+    )
+    return seqs, scores, finished
+
+
+def beam_search_decode(seqs, scores, end_id, length_penalty=0.0, name=None):
+    """Extract the best beam per row (reference beam_search_decode_op)."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    b, k, L = seqs.shape
+    ids = _out(helper, seqs.dtype, shape=(b, L))
+    best = _out(helper, "float32", shape=(b,))
+    helper.append_op(
+        "beam_search_decode",
+        inputs={"Seqs": [seqs.name], "Scores": [scores.name]},
+        outputs={"SentenceIds": [ids.name], "SentenceScores": [best.name]},
+        attrs={"end_id": int(end_id), "length_penalty": float(length_penalty)},
+    )
+    return ids, best
+
+
+def key_padding_bias(mask, name=None):
+    """[b, Tk] 0/1 key mask -> additive [b, 1, 1, Tk] pre-softmax bias
+    (0 where attendable, -1e9 on padding)."""
+    helper = LayerHelper("key_padding_bias", name=name)
+    out = _out(helper, "float32")
+    helper.append_op("key_padding_bias", inputs={"X": [mask.name]},
+                     outputs={"Out": [out.name]}, attrs={})
+    return out
+
+
+def logical_and(x, y, out=None, name=None):
+    helper = LayerHelper("logical_and", name=name)
+    if out is None:
+        out = _out(helper, "bool", shape=x.shape)
+    helper.append_op("logical_and", inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [out.name]}, attrs={})
+    return out
+
+
+def logical_or(x, y, out=None, name=None):
+    helper = LayerHelper("logical_or", name=name)
+    if out is None:
+        out = _out(helper, "bool", shape=x.shape)
+    helper.append_op("logical_or", inputs={"X": [x.name], "Y": [y.name]},
+                     outputs={"Out": [out.name]}, attrs={})
+    return out
+
+
+def logical_not(x, out=None, name=None):
+    helper = LayerHelper("logical_not", name=name)
+    if out is None:
+        out = _out(helper, "bool", shape=x.shape)
+    helper.append_op("logical_not", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]}, attrs={})
+    return out
+
+
+def expand(x, expand_times, name=None):
+    """reference layers/nn.py expand over expand_op (jnp.tile)."""
+    helper = LayerHelper("expand", name=name)
+    shape = None
+    if x.shape is not None:
+        shape = tuple(
+            (d * t) if (d is not None and d >= 0) else d
+            for d, t in zip(x.shape, expand_times))
+    out = _out(helper, x.dtype, shape=shape)
+    helper.append_op("expand", inputs={"X": [x.name]}, outputs={"Out": [out.name]},
+                     attrs={"expand_times": [int(t) for t in expand_times]})
+    return out

@@ -103,13 +103,16 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1, gro
     w = helper.create_parameter(param_attr, filter_shape, input.dtype, default_initializer=default_init)
     out_shape = None
     h_axis, w_axis = (2, 3) if data_format == "NCHW" else (1, 2)
+    # padding may be [ph, pw] (symmetric) or [top, bottom, left, right]
+    pad_hw = ((padding[0], padding[1]), (padding[2], padding[3])) \
+        if len(padding) == 4 else ((padding[0], padding[0]), (padding[1], padding[1]))
     if input.shape is not None and input.shape[h_axis] is not None:
-        def _osz(i, k, p, s, d):
+        def _osz(i, k, p2, s, d):
             if i is None or i < 0:
                 return -1
-            return (i + 2 * p - (d * (k - 1) + 1)) // s + 1
-        oh = _osz(input.shape[h_axis], filter_size[0], padding[0], stride[0], dilation[0])
-        ow = _osz(input.shape[w_axis], filter_size[1], padding[1], stride[1], dilation[1])
+            return (i + p2[0] + p2[1] - (d * (k - 1) + 1)) // s + 1
+        oh = _osz(input.shape[h_axis], filter_size[0], pad_hw[0], stride[0], dilation[0])
+        ow = _osz(input.shape[w_axis], filter_size[1], pad_hw[1], stride[1], dilation[1])
         if data_format == "NCHW":
             out_shape = (input.shape[0], num_filters, oh, ow)
         else:

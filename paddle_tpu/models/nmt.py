@@ -212,3 +212,108 @@ def beam_search_decode(exe, infer_program, logits_var, scope, src_rows,
     best = np.argmax(scores, axis=1)
     return (np.stack([seqs[i, best[i]] for i in range(b)]),
             np.asarray([scores[i, best[i]] for i in range(b)]))
+
+
+def build_beam_decode(
+    src_vocab=1000,
+    tgt_vocab=1000,
+    d_model=256,
+    n_layers=2,
+    n_heads=4,
+    d_ff=1024,
+    batch_size=4,
+    src_len=16,
+    beam_size=4,
+    max_len=12,
+    bos=1,
+    eos=2,
+    length_penalty=0.0,
+):
+    """Whole-beam-search decode compiled END TO END: encoder once, then a
+    layers.While whose body runs the full decoder + the beam_search op over
+    static [b, k, L] state — ONE XLA program, zero host round-trips per
+    step (the TPU-native answer to the reference's
+    while_op + LoDTensorArray + beam_search_op pipeline, layers/nn.py
+    beam_search / operators/math/beam_search.cc:24).
+
+    Parameter names match build_transformer_nmt exactly, so weights trained
+    there load directly (same scope).  Static bucket: (batch_size, src_len);
+    feeds: src_word [b, src_len] int64 (0-padded), src_len_vec [b] int32.
+    Fetches: out_ids [b, max_len], out_scores [b].
+    """
+    import numpy as np
+
+    from ..core.program import Program, program_guard
+
+    b, k, L, Ts = batch_size, beam_size, max_len, src_len
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        src = layers.data("src_word", [Ts], dtype="int64")
+        src_lens = layers.data("src_len_vec", [], dtype="int32")
+
+        # ---- encoder (params: src.emb, enc{i}.*; _embed/_mha shared with
+        # the training builder so names line up) ----------------------------
+        # additive key mask from lengths: (b, 1, 1, Ts), 0 inside, -1e9 pad
+        mask = layers.sequence_mask(src_lens, Ts, dtype="float32")  # [b,Ts]
+        enc_bias = layers.key_padding_bias(mask)                 # [b,1,1,Ts]
+
+        enc = _embed(src, src_vocab, d_model, "src", 0.0, True)
+        for i in range(n_layers):
+            p = f"enc{i}"
+            enc = _add_norm(enc, _mha(enc, enc, enc_bias, d_model, n_heads,
+                                      f"{p}.attn", 0.0, True), f"{p}.a", 0.0, True)
+            enc = _add_norm(enc, _ffn(enc, d_model, d_ff, f"{p}.ffn", 0.0, True),
+                            f"{p}.f", 0.0, True)
+
+        # repeat encoder output + cross bias per beam (row-major [b*k])
+        enc4 = layers.reshape(enc, [-1, 1, Ts, d_model])
+        enc4 = layers.expand(enc4, [1, k, 1, 1])
+        enc_rep = layers.reshape(enc4, [-1, Ts, d_model])        # [bk, Ts, d]
+        cb4 = layers.expand(layers.reshape(enc_bias, [-1, 1, 1, Ts]), [1, k, 1, 1])
+        cross_bias = layers.reshape(cb4, [-1, 1, 1, Ts])         # [bk,1,1,Ts]
+
+        # ---- beam state ---------------------------------------------------
+        seqs0 = np.full((b, k, L), eos, dtype="int64")
+        seqs0[:, :, 0] = bos
+        scores0 = np.full((b, k), -1e9, dtype="float32")
+        scores0[:, 0] = 0.0
+        seqs = layers.assign(seqs0)
+        scores = layers.assign(scores0)
+        finished = layers.assign(np.zeros((b, k), dtype="bool"))
+        t = layers.assign(np.asarray([1], dtype="int32"))
+        max_t = layers.assign(np.asarray([L], dtype="int32"))
+        bk_total = layers.assign(np.asarray([float(b * k)], dtype="float32"))
+        causal = np.triu(np.full((L, L), -1e9, np.float32), k=1).reshape(1, 1, L, L)
+        self_bias = layers.assign(causal)
+
+        cond = layers.less_than(t, max_t)
+        w = layers.While(cond)
+        with w.block():
+            trg = layers.reshape(seqs, [-1, L])                  # [bk, L]
+            dec = _embed(trg, tgt_vocab, d_model, "tgt", 0.0, True)
+            for i in range(n_layers):
+                p = f"dec{i}"
+                dec = _add_norm(dec, _mha(dec, dec, self_bias, d_model,
+                                          n_heads, f"{p}.self", 0.0, True),
+                                f"{p}.s", 0.0, True)
+                dec = _add_norm(dec, _mha(dec, enc_rep, cross_bias, d_model,
+                                          n_heads, f"{p}.cross", 0.0, True),
+                                f"{p}.c", 0.0, True)
+                dec = _add_norm(dec, _ffn(dec, d_model, d_ff, f"{p}.ffn", 0.0, True),
+                                f"{p}.f", 0.0, True)
+            logits = layers.fc(dec, tgt_vocab, num_flatten_dims=2,
+                               param_attr=_attr("proj.w"), bias_attr=_attr("proj.b"))
+            layers.beam_search(logits, seqs, scores, finished, t,
+                               beam_size=k, end_id=eos)
+            layers.increment(t, value=1)
+            # continue while t < L and any beam alive
+            n_done = layers.reduce_sum(layers.cast(finished, "float32"))
+            still_t = layers.less_than(t, max_t)
+            alive = layers.less_than(layers.reshape(n_done, [1]), bk_total)
+            layers.logical_and(still_t, alive, out=cond)
+
+        out_ids, out_scores = layers.beam_search_decode(
+            seqs, scores, end_id=eos, length_penalty=length_penalty)
+
+    feeds = {"src_word": src, "src_len_vec": src_lens}
+    return main, startup, feeds, {"out_ids": out_ids, "out_scores": out_scores}

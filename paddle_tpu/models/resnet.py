@@ -74,15 +74,18 @@ def _s2d_stem(input, is_test=False):
     with w8 = 7x7 kernel zero-padded at offset (1,1) (tests/test_s2d_stem.py
     asserts exact equality).  Why: the 7x7/s2 conv on 3 channels is the
     worst-filled MXU op in the model (docs/perf_r03.md); stride-1 on 12
-    channels tiles far better.  Conv output is 113^2 (symmetric pad 2);
-    the last row/col is sliced off to match the 112^2 contract."""
+    channels tiles better.  Asymmetric padding (2 top/left, 1 bottom/right)
+    yields exactly the 112^2 output positions of the original stem — the
+    symmetric-pad-2 + slice variant was a measured regression
+    (docs/perf_r04.md)."""
     b, c, h, w = input.shape
     x6 = layers.reshape(input, [-1, c, h // 2, 2, w // 2, 2])   # b c j dy i dx
     x6 = layers.transpose(x6, [0, 1, 3, 5, 2, 4])               # b c dy dx j i
     s2d = layers.reshape(x6, [-1, c * 4, h // 2, w // 2])
+    # asymmetric pad (2,1): exactly the 112 positions of the 7x7/s2 stem,
+    # no off-by-one column + slice copy
     conv = layers.conv2d(s2d, num_filters=64, filter_size=4, stride=1,
-                         padding=2, bias_attr=False)
-    conv = layers.slice(conv, axes=[2, 3], starts=[0, 0], ends=[h // 2, w // 2])
+                         padding=[2, 1, 2, 1], bias_attr=False)
     return layers.batch_norm(conv, act="relu", is_test=is_test)
 
 

@@ -525,3 +525,73 @@ def _hierarchical_sigmoid(ctx, op, ins):
     out = jnp.sum(softplus, axis=1, keepdims=True) - jnp.sum(
         jnp.where(valid, bits * pre, 0.0), axis=1, keepdims=True)
     return {"Out": out.astype(x.dtype), "PreOut": pre}
+
+
+# --- in-program beam search ------------------------------------------------
+
+@register_op("beam_search")
+def _beam_search(ctx, op, ins):
+    """One beam-search selection step — the TPU-native redesign of the
+    reference's LoD-walking beam_search op (operators/math/beam_search.cc:24,
+    beam_search_op.cc): state is STATIC [b, k] tensors carried through a
+    lax.while_loop instead of LoDTensorArrays, so the whole decode compiles
+    to one XLA program.
+
+    Inputs: Logits (b*k, L, V) full decoder logits (the step row is
+    dynamically indexed at StepIdx-1, folding the reference's per-step
+    lod_tensor_array read into the op); Seqs (b, k, L) int64; Scores (b, k)
+    f32; Finished (b, k) bool; StepIdx (1,) int.
+    Finished beams extend only with end_id at zero cost (the reference's
+    is_finished handling)."""
+    logits = first(ins, "Logits")
+    seqs = first(ins, "Seqs")
+    scores = first(ins, "Scores")
+    fin = first(ins, "Finished").astype(bool)
+    t = jnp.reshape(first(ins, "StepIdx"), ()).astype(jnp.int32)
+    k = op.attr("beam_size")
+    eos = op.attr("end_id")
+    b, kk, L = seqs.shape
+    step_logits = jax.lax.dynamic_slice_in_dim(logits, t - 1, 1, axis=1)[:, 0, :]
+    V = step_logits.shape[-1]
+    logp = jax.nn.log_softmax(step_logits.astype(jnp.float32), axis=-1).reshape(b, k, V)
+    fin_row = jnp.full((V,), -1e9, jnp.float32).at[eos].set(0.0)
+    logp = jnp.where(fin[:, :, None], fin_row[None, None, :], logp)
+    cand = scores.astype(jnp.float32)[:, :, None] + logp
+    top_scores, top_idx = jax.lax.top_k(cand.reshape(b, k * V), k)
+    parent = top_idx // V
+    token = (top_idx % V).astype(seqs.dtype)
+    new_seqs = jnp.take_along_axis(seqs, parent[:, :, None], axis=1)
+    col = (jnp.arange(L) == t)[None, None, :]
+    new_seqs = jnp.where(col, token[:, :, None], new_seqs)
+    new_fin = jnp.take_along_axis(fin, parent, axis=1) | (token == eos)
+    return {"SelectedSeqs": new_seqs, "SelectedScores": top_scores.astype(scores.dtype),
+            "FinishedOut": new_fin}
+
+
+@register_op("beam_search_decode")
+def _beam_search_decode(ctx, op, ins):
+    """Final-beam extraction (reference beam_search_decode_op.cc backtracked
+    a LoDTensorArray; the static state makes it an argmax + gather).
+    The length penalty matches the host-loop reference implementation:
+    scores / len(seq)^alpha when the length_penalty attr is nonzero (len
+    counts non-end_id tokens)."""
+    seqs = first(ins, "Seqs")
+    scores = first(ins, "Scores").astype(jnp.float32)
+    eos = op.attr("end_id")
+    lp = op.attr("length_penalty", 0.0)
+    if lp:
+        lengths = jnp.sum((seqs != eos).astype(jnp.float32), axis=-1)
+        scores = scores / jnp.power(lengths, lp)
+    best = jnp.argmax(scores, axis=1)
+    ids = jnp.take_along_axis(seqs, best[:, None, None], axis=1)[:, 0, :]
+    best_scores = jnp.take_along_axis(scores, best[:, None], axis=1)[:, 0]
+    return {"SentenceIds": ids, "SentenceScores": best_scores}
+
+
+@register_op("key_padding_bias")
+def _key_padding_bias(ctx, op, ins):
+    """[b, Tk] 0/1 mask -> additive [b, 1, 1, Tk] bias (dense sibling of
+    attention_bias, which derives its mask from LoD lengths)."""
+    m = first(ins, "X")
+    bias = (1.0 - m.astype(jnp.float32)) * -1e9
+    return {"Out": bias[:, None, None, :]}

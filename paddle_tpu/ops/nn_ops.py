@@ -52,7 +52,12 @@ def _conv2d(ctx, op, ins):
     pads = op.attr("paddings", [0, 0])
     dilations = tuple(op.attr("dilations", [1, 1]))
     groups = op.attr("groups", 1) or 1
-    padding = [(pads[0], pads[0]), (pads[1], pads[1])]
+    if len(pads) == 4:
+        # [top, bottom, left, right] — asymmetric (XLA-native; the s2d stem
+        # needs (2,1) to avoid an off-by-one output row/col + slice copy)
+        padding = [(pads[0], pads[1]), (pads[2], pads[3])]
+    else:
+        padding = [(pads[0], pads[0]), (pads[1], pads[1])]
     if op.attr("data_format", "NCHW") == "NHWC":
         # whole-model channels-last path: activations are NHWC end to end
         # (zero transposes in the program); the filter stays OIHW so params
