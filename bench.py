@@ -17,6 +17,7 @@ step); labeled `*_analytic`.
 from __future__ import annotations
 
 import json
+import statistics
 import sys
 import time
 
@@ -32,18 +33,27 @@ def _sync(x):
 
 def _timed_steps(dispatch, n_warm=2, iters=3, windows=1):
     """best-of-N timing windows: the shared-chip pool shows ~±20% run-to-run
-    throughput variance, so the minimum window is the honest compute time."""
+    throughput variance, so the minimum window is the honest compute time.
+    All window times are returned so results can report spread —
+    round-over-round deltas are only meaningful against it."""
     for _ in range(n_warm):
         out = dispatch()
     _sync(out[0])
-    best = float("inf")
+    ws = []
     for _ in range(windows):
         t0 = time.perf_counter()
         for _ in range(iters):
             out = dispatch()
         _sync(out[0])
-        best = min(best, (time.perf_counter() - t0) / iters)
-    return best, out
+        ws.append((time.perf_counter() - t0) / iters)
+    return min(ws), out, [round(w * 1e3, 3) for w in ws]
+
+
+def _spread(ws):
+    """(max-min)/median over windows, %; same stat as tools/opbench.py."""
+    if len(ws) < 2:
+        return 0.0
+    return round((max(ws) - min(ws)) / statistics.median(ws) * 100, 1)
 
 
 def bench_resnet50(batch_size=128, K=8, iters=4):
@@ -72,8 +82,9 @@ def bench_resnet50(batch_size=128, K=8, iters=4):
         return exe.run(main, feed=feed, fetch_list=[loss_name], scope=scope,
                        steps=K, return_numpy=False)
 
-    dt, out = _timed_steps(dispatch, iters=iters, windows=3)
+    dt, out, ws = _timed_steps(dispatch, iters=iters, windows=3)
     dt /= K
+    ws = [round(w / K, 3) for w in ws]
     lossN = float(np.asarray(out[0]).reshape(-1)[-1])
     assert np.isfinite(lossN), f"non-finite resnet loss {lossN}"
     imgs = batch_size / dt
@@ -81,7 +92,8 @@ def bench_resnet50(batch_size=128, K=8, iters=4):
     print(f"resnet50: {dt*1e3:.1f} ms  {imgs:.0f} imgs/s  mfu {mfu:.3f}", file=sys.stderr)
     return {"metric": "resnet50_train_imgs_per_sec_per_chip", "value": round(imgs, 2),
             "unit": "imgs/sec", "mfu_bf16_analytic": round(mfu, 4),
-            "batch_size": batch_size, "steps_per_dispatch": K}
+            "batch_size": batch_size, "steps_per_dispatch": K,
+            "windows_ms": ws, "spread_pct": _spread(ws)}
 
 
 def bench_mnist(batch_size=128, steps=40):
@@ -187,7 +199,7 @@ def bench_bert(batch_size=256, seq_len=128, iters=4):
         return exe.run(main, feed=batch, fetch_list=[loss_name], scope=scope,
                        return_numpy=False)
 
-    dt, out = _timed_steps(dispatch, iters=iters, windows=2)
+    dt, out, ws = _timed_steps(dispatch, iters=iters, windows=2)
     lossN = float(np.asarray(out[0]).reshape(-1)[-1])
     assert np.isfinite(lossN)
     seqs = batch_size / dt
@@ -197,7 +209,8 @@ def bench_bert(batch_size=256, seq_len=128, iters=4):
     print(f"bert: {dt*1e3:.1f} ms  {seqs:.0f} seqs/s  mfu {mfu:.3f}", file=sys.stderr)
     return {"metric": "bert_base_train_seqs_per_sec_per_chip", "value": round(seqs, 2),
             "unit": "seqs/sec", "mfu_bf16_analytic": round(mfu, 4),
-            "batch_size": batch_size, "seq_len": seq_len}
+            "batch_size": batch_size, "seq_len": seq_len,
+            "windows_ms": ws, "spread_pct": _spread(ws)}
 
 
 def bench_deepfm(batch_size=4096, iters=8):
@@ -220,7 +233,7 @@ def bench_deepfm(batch_size=4096, iters=8):
         return exe.run(main, feed=feed, fetch_list=[fetches["loss"]], scope=scope,
                        return_numpy=False)
 
-    dt, out = _timed_steps(dispatch, iters=iters)
+    dt, out, ws = _timed_steps(dispatch, iters=iters)
     lossN = float(np.asarray(out[0]).reshape(-1)[0])
     assert np.isfinite(lossN)
     sparse = sorted(lowering.LAST_TRACE_REPORT.get("sparse_grad_params", []))
@@ -264,6 +277,8 @@ def main():
         "vs_baseline": round(imgs / ROUND1_IMGS_PER_SEC, 4) if imgs else 0.0,
         "extra": {
             "mfu_bf16_analytic": flag.get("mfu_bf16_analytic"),
+            "spread_pct": flag.get("spread_pct"),
+            "windows_ms": flag.get("windows_ms"),
             "batch_size": flag.get("batch_size"),
             "steps_per_dispatch": flag.get("steps_per_dispatch"),
             "vs_baseline_is": "this_round_imgs_per_sec / round1_imgs_per_sec",

@@ -1,0 +1,50 @@
+"""tools/opbench.py smoke: the interleaved-A/B driver and the one-op CLI
+path (reference role: operators/benchmark/op_tester.cc)."""
+import json
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])  # repo root for tools/
+
+from tools import opbench
+
+
+def test_interleave_stats_shape():
+    calls = {"a": [], "b": []}
+
+    def mk(name):
+        def f():
+            calls[name].append(1)
+            return np.zeros(3)
+        return f
+
+    stats = opbench.interleave({"a": mk("a"), "b": mk("b")}, rounds=3, iters=2,
+                               warmup=1)
+    assert set(stats) == {"a", "b"}
+    for s in stats.values():
+        assert len(s["windows_ms"]) == 3
+        assert s["best_ms"] <= s["median_ms"]
+        assert s["spread_pct"] >= 0
+    # warmup(1) + rounds*iters(6) dispatches per variant, interleaved equally
+    assert len(calls["a"]) == len(calls["b"]) == 7
+
+
+def test_op_dispatch_fwd_and_grad():
+    import paddle_tpu as fluid
+
+    d = opbench.build_op_dispatch(
+        "relu", {"X": np.random.RandomState(0).randn(4, 8).astype("float32")},
+        grad=True, place=fluid.CPUPlace())
+    out = d()
+    assert np.isfinite(np.asarray(out[0])).all()
+    assert len(out) == 2  # loss + dX
+
+
+def test_cli_json_line(capsys):
+    opbench.main(["--op", "scale", "--input", "X=4x4", "--attr", "scale=2.0",
+                  "--cpu", "--rounds", "2", "--iters", "2"])
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["op"] == "scale"
+    assert rec["attrs"] == {"scale": 2.0}
+    assert rec["best_ms"] > 0
