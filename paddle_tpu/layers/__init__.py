@@ -71,6 +71,8 @@ from .misc import (  # noqa: F401
     spectral_norm,
 )
 from .sequence import (  # noqa: F401
+    crf_decoding,
+    linear_chain_crf,
     DynamicRNN,
     StaticRNN,
     dynamic_gru,

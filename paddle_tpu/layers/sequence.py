@@ -532,3 +532,46 @@ class StaticRNN(DynamicRNN):
     def __init__(self, name=None):
         super().__init__(name=name)
         self._allow_dense = True
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    """CRF negative log-likelihood layer (reference layers/nn.py
+    linear_chain_crf).  `input`: ragged [*, D] unnormalized tag scores;
+    `label`: ragged [*, 1] int tags.  Creates the [D+2, D] transition
+    parameter (rows 0/1 = start/end weights) and returns the per-sequence
+    [b, 1] cost.  Share the parameter with crf_decoding via a named
+    ParamAttr (reference convention: name="crfw")."""
+    helper = LayerHelper("linear_chain_crf")
+    in_lod = _lod_of(input)
+    lbl_lod = _lod_of(label)
+    tag_num = int(input.shape[-1])
+    w = helper.create_parameter(param_attr, [tag_num + 2, tag_num], "float32")
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "linear_chain_crf",
+        inputs={"Emission": [input.name], "XLod": [in_lod.name],
+                "Transition": [w.name],
+                "Label": [label.name], "LabelLod": [lbl_lod.name]},
+        outputs={"LogLikelihood": [out.name]},
+    )
+    return out
+
+
+def crf_decoding(input, param_attr, label=None):
+    """Viterbi decode layer (reference layers/nn.py crf_decoding).  Reuses
+    the transition parameter trained by linear_chain_crf (same named
+    ParamAttr).  Without `label`: [b, T] int64 best tag paths (0 past each
+    row's length).  With `label`: per-position 0/1 correctness indicator."""
+    helper = LayerHelper("crf_decoding")
+    in_lod = _lod_of(input)
+    tag_num = int(input.shape[-1])
+    w = helper.create_parameter(param_attr, [tag_num + 2, tag_num], "float32")
+    out = helper.create_variable_for_type_inference("int64")
+    inputs = {"Emission": [input.name], "XLod": [in_lod.name],
+              "Transition": [w.name]}
+    if label is not None:
+        inputs["Label"] = [label.name]
+    helper.append_op("crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": [out.name]})
+    _set_lod(out, in_lod)
+    return out

@@ -550,3 +550,111 @@ def _warpctc(ctx, op, ins):
     if norm_by_times:
         loss = loss / jnp.maximum(logit_lens.astype(jnp.float32), 1.0)
     return {"Loss": loss.reshape(b, 1)}
+
+
+@register_op("linear_chain_crf")
+def _linear_chain_crf(ctx, op, ins):
+    """Linear-chain CRF negative log-likelihood (reference
+    linear_chain_crf_op.h:54 ForwardOneSequence).
+
+    Transition layout matches the reference: row 0 = start weights, row 1 =
+    end weights, rows 2.. = tag->tag transitions, so [D+2, D] for D tags.
+    The reference computes a normalized-probability alpha recursion with L1
+    renormalization per step; here the same quantity in log space is one
+    lax.scan (logsumexp is the stable equivalent of its normalize-and-log).
+    Its hand-written backward (alpha*beta marginals) is subsumed by autodiff
+    through the scan.
+
+    Inputs: Emission [b, T, D] padded + XLod lens [b]; Transition [D+2, D];
+    Label [b, T] (or [b, T, 1]) + LabelLod.  Output LogLikelihood [b, 1] =
+    logZ - path_score (the reference's negated ll; 0 for empty rows).
+    """
+    x = first(ins, "Emission").astype(jnp.float32)  # [b, T, D]
+    w = first(ins, "Transition").astype(jnp.float32)  # [D+2, D]
+    label = first(ins, "Label").astype(jnp.int32)
+    if label.ndim == 3 and label.shape[-1] == 1:
+        label = label[..., 0]
+    lens = first(ins, "XLod")
+    b, T, D = x.shape
+    w_start, w_end, w_trans = w[0], w[1], w[2:]  # [D], [D], [D, D]
+
+    # --- log partition: alpha recursion, frozen past each row's length ----
+    alpha0 = w_start[None, :] + x[:, 0, :]  # [b, D]
+
+    def step(alpha, t):
+        # [b, j, i]: alpha[j] + trans[j -> i]; logsumexp over j, add emission
+        scores = alpha[:, :, None] + w_trans[None, :, :]
+        new = jax.nn.logsumexp(scores, axis=1) + x[:, t, :]
+        active = (t < lens).reshape(b, 1)
+        return jnp.where(active, new, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T)) if T > 1 else (alpha0, None)
+    log_z = jax.nn.logsumexp(alpha + w_end[None, :], axis=1)  # [b]
+
+    # --- labeled path score ----------------------------------------------
+    t_idx = jnp.arange(T)[None, :]
+    emit = jnp.take_along_axis(x, label[:, :, None], axis=2)[..., 0]  # [b, T]
+    m = t_idx < lens[:, None]
+    emit_sum = jnp.sum(jnp.where(m, emit, 0.0), axis=1)
+    trans = w_trans[label[:, :-1], label[:, 1:]] if T > 1 else jnp.zeros((b, 0))
+    m_tr = t_idx[:, 1:] < lens[:, None]  # transition k-1 -> k valid for k < len
+    trans_sum = jnp.sum(jnp.where(m_tr, trans, 0.0), axis=1)
+    last = jnp.take_along_axis(label, jnp.maximum(lens - 1, 0)[:, None].astype(jnp.int32), axis=1)[:, 0]
+    score = w_start[label[:, 0]] + emit_sum + trans_sum + w_end[last]
+
+    nll = jnp.where(lens > 0, log_z - score, 0.0)
+    return {"LogLikelihood": nll.reshape(b, 1)}
+
+
+@register_op("crf_decoding")
+def _crf_decoding(ctx, op, ins):
+    """Viterbi decode (reference crf_decoding_op.h:69 Decode).
+
+    Same max-product recursion as the reference's jitted CPU kernel, as one
+    forward lax.scan recording argmax tracks plus one reverse scan for the
+    backtrack — ragged rows freeze their alpha past their length and start
+    the backtrack at position len-1.  With Label given the output is the
+    per-position correctness indicator (reference: path[i] = label==path),
+    zeroed outside each row's length.
+    """
+    x = first(ins, "Emission").astype(jnp.float32)  # [b, T, D]
+    w = first(ins, "Transition").astype(jnp.float32)
+    lens = first(ins, "XLod")
+    b, T, D = x.shape
+    w_start, w_end, w_trans = w[0], w[1], w[2:]
+
+    alpha0 = w_start[None, :] + x[:, 0, :]
+
+    def fwd(alpha, t):
+        scores = alpha[:, :, None] + w_trans[None, :, :]  # [b, j, i]
+        best = jnp.max(scores, axis=1) + x[:, t, :]
+        track = jnp.argmax(scores, axis=1).astype(jnp.int32)  # [b, i]
+        active = (t < lens).reshape(b, 1)
+        return jnp.where(active, best, alpha), jnp.where(active, track, 0)
+
+    if T > 1:
+        alpha, tracks = jax.lax.scan(fwd, alpha0, jnp.arange(1, T))
+        tracks = jnp.concatenate([jnp.zeros((1, b, D), jnp.int32), tracks])  # [T, b, D]
+    else:
+        alpha, tracks = alpha0, jnp.zeros((1, b, D), jnp.int32)
+    best_end = jnp.argmax(alpha + w_end[None, :], axis=1).astype(jnp.int32)  # [b]
+
+    def back(cur, t):
+        # arriving at t, cur = decoded tag at t+1 (valid when t+1 <= len-1)
+        from_track = jnp.take_along_axis(tracks[jnp.minimum(t + 1, T - 1)], cur[:, None], axis=1)[:, 0]
+        tag = jnp.where(t == lens - 1, best_end,
+                        jnp.where(t < lens - 1, from_track, 0))
+        return tag, tag
+
+    _, path_rev = jax.lax.scan(back, jnp.zeros((b,), jnp.int32),
+                               jnp.arange(T - 1, -1, -1))
+    path = jnp.flip(path_rev.T, axis=1)  # [b, T]
+
+    m = jnp.arange(T)[None, :] < lens[:, None]
+    path = jnp.where(m, path, 0).astype(jnp.int64)
+    if "Label" in ins and ins["Label"]:
+        label = first(ins, "Label").astype(jnp.int64)
+        if label.ndim == 3 and label.shape[-1] == 1:
+            label = label[..., 0]
+        path = jnp.where(m, (label == path).astype(jnp.int64), 0)
+    return {"ViterbiPath": path}

@@ -1,0 +1,171 @@
+"""linear_chain_crf + crf_decoding vs brute-force enumeration (reference
+kernels: operators/linear_chain_crf_op.h:54, crf_decoding_op.h:69; reference
+tests: tests/unittests/test_linear_chain_crf_op.py, test_crf_decoding_op.py).
+
+Transition layout: row 0 start, row 1 end, rows 2.. tag->tag."""
+import itertools
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import LoDTensor
+
+
+def _score(x, w, path):
+    D = x.shape[1]
+    s = w[0, path[0]] + w[1, path[-1]] + sum(x[t, p] for t, p in enumerate(path))
+    s += sum(w[2 + path[t - 1], path[t]] for t in range(1, len(path)))
+    return s
+
+
+def _np_crf_nll(x, w, label):
+    T, D = x.shape
+    scores = [_score(x, w, p) for p in itertools.product(range(D), repeat=T)]
+    m = max(scores)
+    log_z = m + np.log(sum(np.exp(s - m) for s in scores))
+    return log_z - _score(x, w, list(label))
+
+
+def _np_viterbi(x, w):
+    T, D = x.shape
+    best, path = -np.inf, None
+    for p in itertools.product(range(D), repeat=T):
+        s = _score(x, w, p)
+        if s > best:
+            best, path = s, list(p)
+    return path
+
+
+def _build(with_label_decode=False):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        emis = fluid.layers.data("emis", [3], dtype="float32", lod_level=1)
+        label = fluid.layers.data("label", [1], dtype="int64", lod_level=1)
+        attr = fluid.ParamAttr(name="crfw")
+        nll = fluid.layers.linear_chain_crf(emis, label, param_attr=attr)
+        path = fluid.layers.crf_decoding(
+            emis, param_attr=attr, label=label if with_label_decode else None)
+    return main, startup, nll, path
+
+
+def _run(main, startup, fetches, rows, lbls, w=None):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    if w is not None:
+        scope.set_var("crfw", w)
+    outs = exe.run(main, feed={"emis": LoDTensor(rows), "label": LoDTensor(lbls)},
+                   fetch_list=fetches, scope=scope)
+    return [np.asarray(o) for o in outs]
+
+
+RNG = np.random.RandomState(7)
+ROWS = [RNG.randn(4, 3).astype("f4"), RNG.randn(2, 3).astype("f4"),
+        RNG.randn(3, 3).astype("f4")]
+LBLS = [np.array([[0], [2], [1], [1]], "int64"), np.array([[1], [0]], "int64"),
+        np.array([[2], [2], [0]], "int64")]
+W = (RNG.randn(5, 3) * 0.8).astype("f4")
+
+
+def test_nll_matches_bruteforce():
+    main, startup, nll, _ = _build()
+    (got,) = _run(main, startup, [nll], ROWS, LBLS, w=W)
+    got = got.reshape(-1)
+    for i, (x, l) in enumerate(zip(ROWS, LBLS)):
+        np.testing.assert_allclose(got[i], _np_crf_nll(x, W, l[:, 0]),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_viterbi_matches_bruteforce():
+    main, startup, _, path = _build()
+    (got,) = _run(main, startup, [path], ROWS, LBLS, w=W)
+    for i, x in enumerate(ROWS):
+        T = x.shape[0]
+        assert got[i, :T].tolist() == _np_viterbi(x, W), i
+        assert (got[i, T:] == 0).all()
+
+
+def test_decode_label_mode_is_correctness_indicator():
+    main, startup, _, path = _build(with_label_decode=True)
+    (got,) = _run(main, startup, [path], ROWS, LBLS, w=W)
+    for i, (x, l) in enumerate(zip(ROWS, LBLS)):
+        T = x.shape[0]
+        expect = (np.array(_np_viterbi(x, W)) == l[:, 0]).astype("int64")
+        assert got[i, :T].tolist() == expect.tolist(), i
+        assert (got[i, T:] == 0).all()
+
+
+def test_crf_grad_finite_difference():
+    """d nll / d transition via autodiff vs central differences."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        emis = fluid.layers.data("emis", [3], dtype="float32", lod_level=1)
+        label = fluid.layers.data("label", [1], dtype="int64", lod_level=1)
+        nll = fluid.layers.linear_chain_crf(
+            emis, label, param_attr=fluid.ParamAttr(name="crfw"))
+        loss = fluid.layers.mean(nll)
+        (gw,) = fluid.calc_gradient(loss, [main.global_block().var("crfw")])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    scope.set_var("crfw", W)
+    feed = {"emis": LoDTensor(ROWS), "label": LoDTensor(LBLS)}
+    (g,) = exe.run(main, feed=feed, fetch_list=[gw], scope=scope)
+    g = np.asarray(g)
+
+    def f(wv):
+        return float(np.mean([_np_crf_nll(x, wv, l[:, 0])
+                              for x, l in zip(ROWS, LBLS)]))
+
+    eps = 1e-3
+    for idx in [(0, 1), (1, 2), (2, 0), (4, 1)]:
+        wp, wm = W.astype("f8").copy(), W.astype("f8").copy()
+        wp[idx] += eps
+        wm[idx] -= eps
+        num = (f(wp) - f(wm)) / (2 * eps)
+        np.testing.assert_allclose(g[idx], num, rtol=2e-2, atol=1e-3)
+
+
+def test_crf_trains_sequence_tagger():
+    """label_semantic_roles-style slice: fc emissions + CRF loss trains to
+    decreasing cost and the shared-param Viterbi decode fits the data."""
+    rng = np.random.RandomState(3)
+    D, C = 6, 4
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [D], dtype="float32", lod_level=1)
+        label = fluid.layers.data("label", [1], dtype="int64", lod_level=1)
+        emis = fluid.layers.fc(x, C, num_flatten_dims=2)
+        attr = fluid.ParamAttr(name="crfw")
+        nll = fluid.layers.linear_chain_crf(emis, label, param_attr=attr)
+        loss = fluid.layers.mean(nll)
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    decode_prog = main.clone(for_test=True)
+    with fluid.program_guard(decode_prog):
+        path = fluid.layers.crf_decoding(decode_prog.global_block().var(emis.name),
+                                         param_attr=attr)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    # tokens carry their tag in a feature channel
+    lens = [5, 3, 4, 6]
+    lbls = [rng.randint(0, C, (t, 1)).astype("int64") for t in lens]
+    rows = [(rng.randn(t, D) * 0.1).astype("f4") for t in lens]
+    for r, l in zip(rows, lbls):
+        r[np.arange(len(l)), l[:, 0]] += 2.0
+    feed = {"x": LoDTensor(rows), "label": LoDTensor(lbls)}
+    losses = []
+    for _ in range(60):
+        (lv,) = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+    (paths,) = exe.run(decode_prog, feed=feed, fetch_list=[path], scope=scope)
+    paths = np.asarray(paths)
+    correct = total = 0
+    for i, l in enumerate(lbls):
+        correct += (paths[i, :len(l)] == l[:, 0]).sum()
+        total += len(l)
+    assert correct / total > 0.9, (correct, total)

@@ -31,6 +31,7 @@ import os
 import statistics
 import sys
 import time
+import zlib
 
 if __name__ == "__main__":  # `python tools/opbench.py` from the repo root
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -93,7 +94,7 @@ def interleave(variants: Dict[str, Callable], rounds: int = 4, iters: int = 8,
 
 def build_op_dispatch(op_type: str, inputs: Dict[str, np.ndarray],
                       attrs: dict | None = None, grad: bool = False,
-                      place=None, steps: int = 1) -> Callable:
+                      place=None) -> Callable:
     """One-op program -> executor dispatch closure.
 
     With grad=True the op's (mean-reduced) first output is differentiated
@@ -175,7 +176,7 @@ def _parse_input(spec: str):
     if ":" in shape:
         shape, dtype = shape.rsplit(":", 1)
     dims = tuple(int(d) for d in shape.split("x"))
-    rng = np.random.RandomState(hash(slot) % (2**31))
+    rng = np.random.RandomState(zlib.crc32(slot.encode()) % (2**31))
     if np.issubdtype(np.dtype(dtype), np.integer):
         arr = rng.randint(0, 10, dims).astype(dtype)
     else:
@@ -190,9 +191,16 @@ def _parse_attr(spec: str):
         return k, True
     if v in ("false", "False"):
         return k, False
+    if "," in v:
+        parts = v.split(",")
+        try:
+            return k, [int(x) for x in parts]
+        except ValueError:
+            try:
+                return k, [float(x) for x in parts]
+            except ValueError:
+                return k, v
     try:
-        if "," in v:
-            return k, [int(x) for x in v.split(",")]
         return k, int(v)
     except ValueError:
         pass
