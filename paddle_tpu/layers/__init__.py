@@ -27,15 +27,22 @@ from .learning_rate_scheduler import (  # noqa: F401
 from .metric import accuracy, auc, mean_iou  # noqa: F401
 from .detection import (  # noqa: F401
     anchor_generator,
+    bipartite_match,
     box_clip,
     box_coder,
     density_prior_box,
+    detection_map,
+    generate_proposals,
     iou_similarity,
     multiclass_nms,
     prior_box,
     roi_align,
+    roi_pool,
+    rpn_target_assign,
     sigmoid_focal_loss,
+    target_assign,
     yolo_box,
+    yolov3_loss,
 )
 from .nn import *  # noqa: F401,F403
 from .misc import (  # noqa: F401

@@ -159,3 +159,173 @@ def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios=(1.0,),
                "offset": offset},
     )
     return boxes, variances
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    """YOLOv3 loss (reference layers/detection.py:763).  gt_box [N, B, 4]
+    normalized center xywh, gt_label [N, B]; returns [N] loss."""
+    helper = LayerHelper("yolov3_loss", name=name)
+    loss = _out(helper, x.dtype)
+    obj_mask = _out(helper, x.dtype)
+    match_mask = _out(helper, "int32")
+    inputs = {"X": [x.name], "GTBox": [gt_box.name], "GTLabel": [gt_label.name]}
+    if gt_score is not None:
+        inputs["GTScore"] = [gt_score.name]
+    helper.append_op(
+        "yolov3_loss", inputs=inputs,
+        outputs={"Loss": [loss.name], "ObjectnessMask": [obj_mask.name],
+                 "GTMatchMask": [match_mask.name]},
+        attrs={"anchors": list(anchors), "anchor_mask": list(anchor_mask),
+               "class_num": class_num, "ignore_thresh": ignore_thresh,
+               "downsample_ratio": downsample_ratio,
+               "use_label_smooth": use_label_smooth},
+    )
+    return loss
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
+             rois_batch=None, name=None):
+    """Quantized-bin RoI max pool (reference layers/nn.py roi_pool); dense
+    [R, 4] rois + optional [R] batch-index vector (static-shape form)."""
+    helper = LayerHelper("roi_pool", name=name)
+    out = _out(helper, input.dtype)
+    argmax = _out(helper, "int64")
+    inputs = {"X": [input.name], "ROIs": [rois.name]}
+    if rois_batch is not None:
+        inputs["RoisBatch"] = [rois_batch.name]
+    helper.append_op(
+        "roi_pool", inputs=inputs,
+        outputs={"Out": [out.name], "Argmax": [argmax.name]},
+        attrs={"pooled_height": pooled_height, "pooled_width": pooled_width,
+               "spatial_scale": spatial_scale},
+    )
+    return out
+
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
+                    row_lengths=None, name=None):
+    """Greedy bipartite matching (reference layers/detection.py:1059).
+    dist_matrix [N, R, C] dense (padded rows; pass row_lengths [N] for
+    ragged gt counts).  Returns (match_indices [N, C], match_dist [N, C])."""
+    helper = LayerHelper("bipartite_match", name=name)
+    idx = _out(helper, "int32")
+    dist = _out(helper, "float32")
+    inputs = {"DistMat": [dist_matrix.name]}
+    if row_lengths is not None:
+        inputs["RowLod"] = [row_lengths.name]
+    helper.append_op(
+        "bipartite_match", inputs=inputs,
+        outputs={"ColToRowMatchIndices": [idx.name],
+                 "ColToRowMatchDist": [dist.name]},
+        attrs={"match_type": match_type, "dist_threshold": dist_threshold},
+    )
+    return idx, dist
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    """Gather per-batch targets by match index (reference
+    layers/detection.py:1145).  input [N, B, K] dense padded.  Returns
+    (out [N, M, K], out_weight [N, M, 1])."""
+    helper = LayerHelper("target_assign", name=name)
+    out = _out(helper, input.dtype)
+    wt = _out(helper, "float32")
+    inputs = {"X": [input.name], "MatchIndices": [matched_indices.name]}
+    if negative_indices is not None:
+        inputs["NegIndices"] = [negative_indices.name]
+    helper.append_op(
+        "target_assign", inputs=inputs,
+        outputs={"Out": [out.name], "OutWeight": [wt.name]},
+        attrs={"mismatch_value": mismatch_value},
+    )
+    return out, wt
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True,
+                      gt_lengths=None):
+    """RPN target assignment (reference layers/detection.py:221).
+
+    STATIC-SHAPE deviation from the reference: the reference gathers
+    sampled anchors into dynamic [F, 4]/[F+B, 1] tensors; XLA needs fixed
+    shapes, so every return spans all M anchors and sampling lives in
+    weights.  Returns (predicted_scores [N, M, 1], predicted_location
+    [N, M, 4], target_label [N, M], target_bbox [N, M, 4],
+    bbox_inside_weight [N, M, 4], score_weight [N, M]); the RPN loss is
+    sigmoid_ce(scores, label) * score_weight + |loc - target| *
+    inside_weight, identical math to the reference's gathered form."""
+    helper = LayerHelper("rpn_target_assign")
+    label = _out(helper, "int32")
+    score_w = _out(helper, "float32")
+    tgt = _out(helper, anchor_box.dtype)
+    inw = _out(helper, anchor_box.dtype)
+    inputs = {"Anchor": [anchor_box.name], "GtBoxes": [gt_boxes.name]}
+    if is_crowd is not None:
+        inputs["IsCrowd"] = [is_crowd.name]
+    if im_info is not None:
+        inputs["ImInfo"] = [im_info.name]
+    if gt_lengths is not None:
+        inputs["GtLod"] = [gt_lengths.name]
+    helper.append_op(
+        "rpn_target_assign", inputs=inputs,
+        outputs={"TargetLabel": [label.name], "ScoreWeight": [score_w.name],
+                 "TargetBBox": [tgt.name], "BBoxInsideWeight": [inw.name]},
+        attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+               "rpn_straddle_thresh": rpn_straddle_thresh,
+               "rpn_fg_fraction": rpn_fg_fraction,
+               "rpn_positive_overlap": rpn_positive_overlap,
+               "rpn_negative_overlap": rpn_negative_overlap,
+               "use_random": use_random},
+    )
+    return cls_logits, bbox_pred, label, tgt, inw, score_w
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    """RPN proposals (reference layers/detection.py:2390).  Returns
+    (rpn_rois [N, post_nms_top_n, 4], rpn_roi_probs [N, post_nms_top_n, 1])
+    padded static blocks (prob 0 = empty slot) in place of the reference's
+    LoD output."""
+    if eta != 1.0:
+        raise NotImplementedError("generate_proposals: adaptive NMS (eta != 1)")
+    helper = LayerHelper("generate_proposals", name=name)
+    rois = _out(helper, scores.dtype)
+    probs = _out(helper, scores.dtype)
+    helper.append_op(
+        "generate_proposals",
+        inputs={"Scores": [scores.name], "BboxDeltas": [bbox_deltas.name],
+                "ImInfo": [im_info.name], "Anchors": [anchors.name],
+                "Variances": [variances.name]},
+        outputs={"RpnRois": [rois.name], "RpnRoiProbs": [probs.name]},
+        attrs={"pre_nms_topN": pre_nms_top_n, "post_nms_topN": post_nms_top_n,
+               "nms_thresh": nms_thresh, "min_size": min_size},
+    )
+    return rois, probs
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  ap_version="integral", gt_lengths=None):
+    """Batch mAP (reference layers/detection.py:966).  detect_res
+    [N, D, 6] (label, score, box; label -1 pad — multiclass_nms output),
+    label [N, B, 5] (class, box) padded.  Cross-batch accumulation:
+    metrics.DetectionMAP."""
+    helper = LayerHelper("detection_map")
+    out = _out(helper, "float32")
+    inputs = {"DetectRes": [detect_res.name], "Label": [label.name]}
+    if gt_lengths is not None:
+        inputs["GtLod"] = [gt_lengths.name]
+    helper.append_op(
+        "detection_map", inputs=inputs, outputs={"MAP": [out.name]},
+        attrs={"class_num": class_num, "background_label": background_label,
+               "overlap_threshold": overlap_threshold,
+               "evaluate_difficult": evaluate_difficult,
+               "ap_type": ap_version},
+    )
+    return out

@@ -453,3 +453,558 @@ def _density_prior_box(ctx, op, ins):
     out = np.asarray(boxes, np.float32).reshape(H, W, P_, 4)
     var = np.tile(np.asarray(variances, np.float32), (H, W, P_, 1))
     return {"Boxes": jnp.asarray(out), "Variances": jnp.asarray(var)}
+
+
+def _cbox_iou(x1, y1, w1, h1, x2, y2, w2, h2):
+    """IoU of center-format boxes, broadcasting."""
+    inter_w = jnp.maximum(
+        jnp.minimum(x1 + w1 / 2, x2 + w2 / 2) - jnp.maximum(x1 - w1 / 2, x2 - w2 / 2), 0.0)
+    inter_h = jnp.maximum(
+        jnp.minimum(y1 + h1 / 2, y2 + h2 / 2) - jnp.maximum(y1 - h1 / 2, y2 - h2 / 2), 0.0)
+    inter = inter_w * inter_h
+    return inter / jnp.maximum(w1 * h1 + w2 * h2 - inter, 1e-10)
+
+
+def _sce(logit, label):
+    """sigmoid cross-entropy, the reference's numerically-safe form
+    (yolov3_loss_op.h:105 SigmoidCrossEntropy)."""
+    return jnp.maximum(logit, 0.0) - logit * label + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+
+@register_op("yolov3_loss")
+def _yolov3_loss(ctx, op, ins):
+    """YOLOv3 training loss (reference detection/yolov3_loss_op.h:254).
+
+    Same three terms as the reference's per-cell loops, vectorized:
+      * ignore mask: decoded pred boxes vs every valid gt, best IoU >
+        ignore_thresh drops that cell's objectness loss (matching is under
+        stop_gradient, as the reference treats it as constant);
+      * per-gt positive assignment: best full-anchor-set IoU on (w, h) at
+        the origin picks the anchor; gts whose anchor is outside
+        anchor_mask contribute nothing (GTMatchMask = -1);
+      * location (SCE on tx/ty, L1 on tw/th, scaled by (2 - w*h) * score),
+        label SCE with optional smoothing, and objectness SCE.
+    Outputs Loss [n], ObjectnessMask [n, mask, h, w], GTMatchMask [n, b];
+    gradients flow to X by autodiff (the reference hand-writes them).
+    """
+    x = first(ins, "X").astype(jnp.float32)            # [n, m*(5+C), h, w]
+    gt_box = first(ins, "GTBox").astype(jnp.float32)   # [n, b, 4] center xywh
+    gt_label = first(ins, "GTLabel").astype(jnp.int32) # [n, b]
+    if gt_label.ndim == 3:
+        gt_label = gt_label[..., 0]
+    anchors = list(op.attr("anchors"))
+    anchor_mask = list(op.attr("anchor_mask"))
+    C = int(op.attr("class_num"))
+    ignore_thresh = float(op.attr("ignore_thresh"))
+    downsample = int(op.attr("downsample_ratio"))
+    smooth = op.attr("use_label_smooth", True)
+
+    n, _, h, w = x.shape
+    m = len(anchor_mask)
+    an_num = len(anchors) // 2
+    b = gt_box.shape[1]
+    input_size = downsample * h
+    if "GTScore" in ins and ins["GTScore"]:
+        gt_score = first(ins, "GTScore").astype(jnp.float32)
+        if gt_score.ndim == 3:
+            gt_score = gt_score[..., 0]
+    else:
+        gt_score = jnp.ones((n, b), jnp.float32)
+
+    xr = x.reshape(n, m, 5 + C, h, w)
+    tx, ty, tw, th, tobj = xr[:, :, 0], xr[:, :, 1], xr[:, :, 2], xr[:, :, 3], xr[:, :, 4]
+    tcls = xr[:, :, 5:]  # [n, m, C, h, w]
+
+    aw = jnp.asarray([anchors[2 * i] for i in anchor_mask], jnp.float32)
+    ah = jnp.asarray([anchors[2 * i + 1] for i in anchor_mask], jnp.float32)
+    grid_x = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+
+    gx, gy, gw, gh = gt_box[..., 0], gt_box[..., 1], gt_box[..., 2], gt_box[..., 3]
+    gt_valid = (gw > 0) & (gh > 0)  # reference GtValid: w or h <= 0 -> skip
+
+    # --- ignore mask (stop_gradient: constants to the loss) ---------------
+    px = jax.lax.stop_gradient((grid_x + jax.nn.sigmoid(tx)) / w)  # [n,m,h,w]
+    py = jax.lax.stop_gradient((grid_y + jax.nn.sigmoid(ty)) / h)
+    pw = jax.lax.stop_gradient(jnp.exp(tw) * aw[None, :, None, None] / input_size)
+    ph = jax.lax.stop_gradient(jnp.exp(th) * ah[None, :, None, None] / input_size)
+    iou = _cbox_iou(px[..., None], py[..., None], pw[..., None], ph[..., None],
+                    gx[:, None, None, None, :], gy[:, None, None, None, :],
+                    gw[:, None, None, None, :], gh[:, None, None, None, :])
+    iou = jnp.where(gt_valid[:, None, None, None, :], iou, 0.0)
+    best_iou = jnp.max(iou, axis=-1) if b > 0 else jnp.zeros_like(px)
+    obj_mask = jnp.where(best_iou > ignore_thresh, -1.0, 0.0)  # [n, m, h, w]
+
+    # --- positive assignment per gt --------------------------------------
+    all_aw = jnp.asarray(anchors[0::2], jnp.float32) / input_size
+    all_ah = jnp.asarray(anchors[1::2], jnp.float32) / input_size
+    an_iou = _cbox_iou(0.0, 0.0, all_aw[None, None, :], all_ah[None, None, :],
+                       0.0, 0.0, gw[..., None], gh[..., None])  # [n, b, an]
+    best_n = jnp.argmax(an_iou, axis=-1)  # [n, b]
+    mask_lut = -jnp.ones((an_num,), jnp.int32)
+    for mi, a in enumerate(anchor_mask):
+        mask_lut = mask_lut.at[a].set(mi)
+    mask_idx = jnp.where(gt_valid, mask_lut[best_n], -1)  # [n, b]
+    matched = mask_idx >= 0
+
+    gi = jnp.clip((gx * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gy * h).astype(jnp.int32), 0, h - 1)
+    ni = jnp.arange(n)[:, None]
+    midx = jnp.maximum(mask_idx, 0)
+
+    # targets at the matched cell
+    t_x = gx * w - gi
+    t_y = gy * h - gj
+    anc_w = jnp.take(jnp.asarray(anchors[0::2], jnp.float32), best_n)
+    anc_h = jnp.take(jnp.asarray(anchors[1::2], jnp.float32), best_n)
+    safe = jnp.maximum(gw * input_size, 1e-9), jnp.maximum(gh * input_size, 1e-9)
+    t_w = jnp.log(safe[0] / anc_w)
+    t_h = jnp.log(safe[1] / anc_h)
+    scale = (2.0 - gw * gh) * gt_score
+
+    p_tx = tx[ni, midx, gj, gi]  # [n, b]
+    p_ty = ty[ni, midx, gj, gi]
+    p_tw = tw[ni, midx, gj, gi]
+    p_th = th[ni, midx, gj, gi]
+    loc = (_sce(p_tx, t_x) + _sce(p_ty, t_y)
+           + jnp.abs(p_tw - t_w) + jnp.abs(p_th - t_h)) * scale
+    loc_loss = jnp.sum(jnp.where(matched, loc, 0.0), axis=1)  # [n]
+
+    if smooth:
+        delta = min(1.0 / C, 1.0 / 40)
+        pos, neg = 1.0 - delta, delta
+    else:
+        pos, neg = 1.0, 0.0
+    p_cls = tcls[ni, midx, :, gj, gi]  # [n, b, C]
+    onehot = jax.nn.one_hot(gt_label, C, dtype=jnp.float32)
+    cls_tgt = onehot * pos + (1.0 - onehot) * neg
+    cls = jnp.sum(_sce(p_cls, cls_tgt), axis=-1) * gt_score
+    cls_loss = jnp.sum(jnp.where(matched, cls, 0.0), axis=1)
+
+    # positive cells override ignore in the objectness mask (reference
+    # writes -1 first, then score at matched cells).  Unmatched/padded gt
+    # rows must not scatter at all — with duplicate indices their stale
+    # read-back could clobber a real gt's write — so they are routed to a
+    # dummy cell that is dropped afterwards.
+    flat = obj_mask.reshape(n, -1)
+    flat = jnp.concatenate([flat, jnp.zeros((n, 1), flat.dtype)], axis=1)
+    cell = (midx * h + gj) * w + gi
+    cell = jnp.where(matched, cell, m * h * w)  # dummy slot for non-matches
+    flat = flat.at[ni, cell].set(jnp.where(matched, gt_score, 0.0))
+    obj_mask = flat[:, :-1].reshape(n, m, h, w)
+    obj_mask = jax.lax.stop_gradient(obj_mask)
+    obj_pos = jnp.where(obj_mask > 1e-5, _sce(tobj, 1.0) * obj_mask, 0.0)
+    obj_neg = jnp.where((obj_mask <= 1e-5) & (obj_mask > -0.5), _sce(tobj, 0.0), 0.0)
+    obj_loss = jnp.sum(obj_pos + obj_neg, axis=(1, 2, 3))
+
+    loss = loc_loss + cls_loss + obj_loss
+    return {"Loss": loss, "ObjectnessMask": obj_mask,
+            "GTMatchMask": mask_idx.astype(jnp.int32)}
+
+
+@register_op("roi_pool")
+def _roi_pool(ctx, op, ins):
+    """reference roi_pool_op.h CPUROIPoolOpKernel: quantized-bin max pool.
+    Same rounding/bin math (round coords, floor/ceil bin edges, malformed
+    rois forced 1x1, empty bins -> 0); dense [R, 4] rois + RoisBatch vector
+    replace the LoD (static-shape form, as roi_align above)."""
+    x = first(ins, "X")                   # [N, C, H, W]
+    rois = first(ins, "ROIs")             # [R, 4]
+    batch_idx = ins.get("RoisBatch")
+    batch_idx = (batch_idx[0].reshape(-1).astype(jnp.int32)
+                 if batch_idx else jnp.zeros((rois.shape[0],), jnp.int32))
+    ph = op.attr("pooled_height", 1)
+    pw = op.attr("pooled_width", 1)
+    scale = op.attr("spatial_scale", 1.0)
+    H, W = x.shape[2], x.shape[3]
+    NEG = jnp.finfo(jnp.float32).min
+
+    def one_roi(roi, bi):
+        img = x[bi].astype(jnp.float32)  # [C, H, W]
+        x0 = jnp.round(roi[0] * scale).astype(jnp.int32)
+        y0 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        x1 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y1 - y0 + 1, 1).astype(jnp.float32)
+        rw = jnp.maximum(x1 - x0 + 1, 1).astype(jnp.float32)
+        bh, bw = rh / ph, rw / pw
+        hs = jnp.clip(jnp.floor(jnp.arange(ph) * bh).astype(jnp.int32) + y0, 0, H)
+        he = jnp.clip(jnp.ceil((jnp.arange(ph) + 1) * bh).astype(jnp.int32) + y0, 0, H)
+        ws = jnp.clip(jnp.floor(jnp.arange(pw) * bw).astype(jnp.int32) + x0, 0, W)
+        we = jnp.clip(jnp.ceil((jnp.arange(pw) + 1) * bw).astype(jnp.int32) + x0, 0, W)
+        mh = ((jnp.arange(H)[None, :] >= hs[:, None])
+              & (jnp.arange(H)[None, :] < he[:, None]))          # [ph, H]
+        mw = ((jnp.arange(W)[None, :] >= ws[:, None])
+              & (jnp.arange(W)[None, :] < we[:, None]))          # [pw, W]
+        # masked max in two reductions: over W per pw bin, then H per ph bin
+        vw = jnp.max(jnp.where(mw[None, None, :, :], img[:, :, None, :], NEG), axis=-1)  # [C, H, pw]
+        out = jnp.max(jnp.where(mh[None, :, :, None], vw[:, None, :, :], NEG), axis=2)  # [C, ph, pw]
+        empty = ((he <= hs)[:, None] | (we <= ws)[None, :])  # [ph, pw]
+        return jnp.where(empty[None], 0.0, out)
+
+    out = jax.vmap(one_roi)(rois, batch_idx)
+    return {"Out": out.astype(x.dtype), "Argmax": jnp.zeros(out.shape, jnp.int32)}
+
+
+_MATCH_EPS = 1e-6
+
+
+@register_op("bipartite_match")
+def _bipartite_match(ctx, op, ins):
+    """reference detection/bipartite_match_op.cc BipartiteMatch: greedy
+    global-argmax matching — each of R rounds matches the largest remaining
+    (row, col) entry with dist >= eps; optional per_prediction pass then
+    argmax-matches leftover columns above dist_threshold.
+
+    Dense redesign of the LoD contract: DistMat [N, R, C] padded (+RowLod
+    valid-row counts) in place of the [sum_rows, C] LoD tensor; outputs keep
+    the reference shapes [N, C]."""
+    dist = first(ins, "DistMat").astype(jnp.float32)
+    if dist.ndim == 2:
+        dist = dist[None]
+    row_lens = (first(ins, "RowLod").astype(jnp.int32) if ins.get("RowLod")
+                else jnp.full((dist.shape[0],), dist.shape[1], jnp.int32))
+    match_type = op.attr("match_type", "bipartite")
+    thresh = op.attr("dist_threshold", 0.5)
+    N, R, C = dist.shape
+
+    def one(d, nrow):
+        valid_row = jnp.arange(R) < nrow
+
+        def body(_, state):
+            col_to_row, col_dist, row_used = state
+            avail = (valid_row & ~row_used)[:, None] & (col_to_row < 0)[None, :]
+            cand = jnp.where(avail & (d >= _MATCH_EPS), d, -1.0)
+            flat = jnp.argmax(cand)
+            r, c = flat // C, flat % C
+            ok = cand[r, c] > 0
+            col_to_row = jnp.where(ok, col_to_row.at[c].set(r.astype(jnp.int32)), col_to_row)
+            col_dist = jnp.where(ok, col_dist.at[c].set(d[r, c]), col_dist)
+            row_used = jnp.where(ok, row_used.at[r].set(True), row_used)
+            return col_to_row, col_dist, row_used
+
+        init = (jnp.full((C,), -1, jnp.int32), jnp.zeros((C,), jnp.float32),
+                jnp.zeros((R,), bool))
+        col_to_row, col_dist, _ = jax.lax.fori_loop(0, R, body, init)
+
+        if match_type == "per_prediction":
+            cand = jnp.where(valid_row[:, None] & (d >= _MATCH_EPS) & (d >= thresh), d, -1.0)
+            best = jnp.argmax(cand, axis=0).astype(jnp.int32)
+            bd = jnp.max(cand, axis=0)
+            fresh = (col_to_row < 0) & (bd > 0)
+            col_to_row = jnp.where(fresh, best, col_to_row)
+            col_dist = jnp.where(fresh, bd, col_dist)
+        return col_to_row, col_dist
+
+    idx, dst = jax.vmap(one)(dist, row_lens)
+    return {"ColToRowMatchIndices": idx, "ColToRowMatchDist": dst}
+
+
+@register_op("target_assign")
+def _target_assign(ctx, op, ins):
+    """reference detection/target_assign_op.h TargetAssignFunctor: gather
+    per-batch entities by match index; -1 -> mismatch_value with weight 0;
+    NegIndices rows get weight 1 (out stays mismatch_value).
+
+    Dense redesign: X [N, B, K] padded replaces the [sum_b, 1, K] LoD input;
+    NegIndices is [N, Q] padded with -1."""
+    x = first(ins, "X").astype(jnp.float32)          # [N, B, K]
+    match = first(ins, "MatchIndices").astype(jnp.int32)  # [N, M]
+    mismatch = op.attr("mismatch_value", 0)
+    N, B, K = x.shape
+    safe = jnp.clip(match, 0, B - 1)
+    out = jnp.take_along_axis(x, safe[:, :, None], axis=1)  # [N, M, K]
+    hit = (match >= 0)[:, :, None]
+    out = jnp.where(hit, out, float(mismatch))
+    wt = hit.astype(jnp.float32)
+    if ins.get("NegIndices"):
+        neg = first(ins, "NegIndices").astype(jnp.int32)  # [N, Q], -1 pad
+        M = match.shape[1]
+        # scatter 1s at negative slots; -1 pads go to a dropped dummy column
+        nw = jnp.zeros((N, M + 1), jnp.float32)
+        ni = jnp.arange(N)[:, None]
+        nw = nw.at[ni, jnp.where(neg >= 0, neg, M)].set(1.0)
+        wt = jnp.maximum(wt, nw[:, :M, None])
+    return {"Out": out, "OutWeight": wt}
+
+
+def _corner_iou(a, b):
+    """IoU of corner-format boxes a [M, 4] vs b [B, 4] -> [M, B]."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum((a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1]), 0.0)
+    area_b = jnp.maximum((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]), 0.0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _box_to_delta(anchor, gt):
+    """encode gt relative to anchor (reference operators/detection/
+    bbox_util.h BoxToDelta, unit weights)."""
+    aw = anchor[:, 2] - anchor[:, 0] + 1.0
+    ah = anchor[:, 3] - anchor[:, 1] + 1.0
+    acx = anchor[:, 0] + aw * 0.5
+    acy = anchor[:, 1] + ah * 0.5
+    gw = gt[:, 2] - gt[:, 0] + 1.0
+    gh = gt[:, 3] - gt[:, 1] + 1.0
+    gcx = gt[:, 0] + gw * 0.5
+    gcy = gt[:, 1] + gh * 0.5
+    return jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                      jnp.log(jnp.maximum(gw, 1e-9) / aw),
+                      jnp.log(jnp.maximum(gh, 1e-9) / ah)], axis=1)
+
+
+@register_op("rpn_target_assign")
+def _rpn_target_assign(ctx, op, ins):
+    """RPN anchor labeling + subsampling (reference
+    detection/rpn_target_assign_op.cc).  Same rules: straddle filter,
+    positives = per-gt best anchor or IoU >= positive_overlap, negatives =
+    max-IoU < negative_overlap, subsample to rpn_batch_size_per_im with
+    rpn_fg_fraction positives (random under use_random via the trace RNG
+    key, top-IoU otherwise), crowd gts excluded.
+
+    STATIC-SHAPE redesign: instead of the reference's gathered [F, 4]/[F+B]
+    outputs (dynamic shapes), every output spans all anchors and the
+    sampling lives in weights: TargetLabel [N, M], ScoreWeight [N, M] (1 on
+    sampled fg+bg), TargetBBox [N, M, 4], BBoxInsideWeight [N, M, 4] (1 on
+    fg rows).  Losses multiply by the weights, which is mathematically the
+    reference's gather."""
+    anchors = first(ins, "Anchor").astype(jnp.float32).reshape(-1, 4)  # [M, 4]
+    gt = first(ins, "GtBoxes").astype(jnp.float32)    # [N, B, 4]
+    if gt.ndim == 2:
+        gt = gt[None]
+    N, B, _ = gt.shape
+    gt_lens = (first(ins, "GtLod").astype(jnp.int32) if ins.get("GtLod")
+               else jnp.full((N,), B, jnp.int32))
+    is_crowd = (first(ins, "IsCrowd").reshape(N, -1).astype(jnp.int32)
+                if ins.get("IsCrowd") else jnp.zeros((N, B), jnp.int32))
+    if ins.get("ImInfo"):
+        im_info = first(ins, "ImInfo").astype(jnp.float32).reshape(N, -1)  # [N, 3] h, w, scale
+    else:
+        # no image extents -> the straddle filter cannot run; keep all anchors
+        im_info = jnp.full((N, 3), jnp.inf, jnp.float32)
+    batch_size = op.attr("rpn_batch_size_per_im", 256)
+    straddle = op.attr("rpn_straddle_thresh", 0.0)
+    fg_frac = op.attr("rpn_fg_fraction", 0.5)
+    pos_ov = op.attr("rpn_positive_overlap", 0.7)
+    neg_ov = op.attr("rpn_negative_overlap", 0.3)
+    use_random = op.attr("use_random", True)
+    M = anchors.shape[0]
+    num_fg_target = int(fg_frac * batch_size)
+
+    keys = jax.random.split(ctx.next_key(), N) if use_random else None
+
+    def one(i):
+        g, nlen, crowd, info = gt[i], gt_lens[i], is_crowd[i], im_info[i]
+        h, w = info[0], info[1]
+        if straddle >= 0:
+            inside = ((anchors[:, 0] >= -straddle) & (anchors[:, 1] >= -straddle)
+                      & (anchors[:, 2] < w + straddle) & (anchors[:, 3] < h + straddle))
+        else:
+            inside = jnp.ones((M,), bool)
+        gt_valid = (jnp.arange(B) < nlen) & (crowd == 0)
+        iou = _corner_iou(anchors, g)                      # [M, B]
+        iou = jnp.where(gt_valid[None, :] & inside[:, None], iou, 0.0)
+        a2g_max = jnp.max(iou, axis=1) if B else jnp.zeros((M,))
+        a2g_arg = jnp.argmax(iou, axis=1) if B else jnp.zeros((M,), jnp.int32)
+        g_max = jnp.max(iou, axis=0)                       # [B]
+        is_best = jnp.any((iou == g_max[None, :]) & (g_max[None, :] > 0)
+                          & gt_valid[None, :], axis=1)
+        fg_cand = inside & (is_best | (a2g_max >= pos_ov))
+        bg_cand = inside & ~fg_cand & (a2g_max < neg_ov)
+
+        if use_random:
+            pri = jax.random.uniform(keys[i], (M,))
+        else:
+            pri = a2g_max  # deterministic: highest-IoU first
+        # rank fg candidates by priority; keep the top num_fg_target
+        order = jnp.argsort(jnp.where(fg_cand, -pri, jnp.inf))
+        rank = jnp.zeros((M,), jnp.int32).at[order].set(jnp.arange(M, dtype=jnp.int32))
+        fg = fg_cand & (rank < num_fg_target)
+        n_fg = jnp.sum(fg)
+        n_bg_target = batch_size - n_fg
+        order_bg = jnp.argsort(jnp.where(bg_cand, -pri, jnp.inf))
+        rank_bg = jnp.zeros((M,), jnp.int32).at[order_bg].set(jnp.arange(M, dtype=jnp.int32))
+        bg = bg_cand & (rank_bg < n_bg_target)
+
+        label = fg.astype(jnp.int32)
+        score_w = (fg | bg).astype(jnp.float32)
+        tgt = _box_to_delta(anchors, g[jnp.clip(a2g_arg, 0, max(B - 1, 0))])
+        tgt = jnp.where(fg[:, None], tgt, 0.0)
+        inw = jnp.where(fg[:, None], 1.0, 0.0)
+        return label, score_w, tgt, inw
+
+    label, score_w, tgt, inw = jax.vmap(one)(jnp.arange(N))
+    return {"TargetLabel": label, "ScoreWeight": score_w,
+            "TargetBBox": tgt, "BBoxInsideWeight": inw}
+
+
+@register_op("generate_proposals")
+def _generate_proposals(ctx, op, ins):
+    """RPN proposal generation (reference
+    detection/generate_proposals_op.cc ProposalForOneImage): score top-k ->
+    delta decode with variances -> clip to image -> min-size filter -> NMS
+    -> post_nms_topN.  The reference emits LoD-concatenated rois; here each
+    image yields padded static [post_nms_topN, 4] + prob blocks (prob 0 =
+    empty slot), the accelerator formulation multiclass_nms above uses."""
+    scores = first(ins, "Scores").astype(jnp.float32)       # [N, A, H, W]
+    deltas = first(ins, "BboxDeltas").astype(jnp.float32)   # [N, 4A, H, W]
+    im_info = first(ins, "ImInfo").astype(jnp.float32).reshape(scores.shape[0], -1)
+    anchors = first(ins, "Anchors").astype(jnp.float32).reshape(-1, 4)  # [H*W*A, 4]
+    variances = first(ins, "Variances").astype(jnp.float32).reshape(-1, 4)
+    pre_n = op.attr("pre_nms_topN", 6000)
+    post_n = op.attr("post_nms_topN", 1000)
+    nms_thresh = op.attr("nms_thresh", 0.7)
+    min_size = op.attr("min_size", 0.1)
+    N, A, H, W = scores.shape
+    K = A * H * W
+
+    # [N, A, H, W] -> [N, H, W, A] flat, matching anchors' [H, W, A] layout
+    sc = scores.transpose(0, 2, 3, 1).reshape(N, K)
+    dl = deltas.reshape(N, A, 4, H, W).transpose(0, 3, 4, 1, 2).reshape(N, K, 4)
+
+    def decode(anc, d, var):
+        aw = anc[:, 2] - anc[:, 0] + 1.0
+        ah = anc[:, 3] - anc[:, 1] + 1.0
+        acx = anc[:, 0] + aw * 0.5
+        acy = anc[:, 1] + ah * 0.5
+        bbox_clip = jnp.log(1000.0 / 16.0)
+        dx, dy, dw, dh = (d[:, 0] * var[:, 0], d[:, 1] * var[:, 1],
+                          jnp.minimum(d[:, 2] * var[:, 2], bbox_clip),
+                          jnp.minimum(d[:, 3] * var[:, 3], bbox_clip))
+        cx = dx * aw + acx
+        cy = dy * ah + acy
+        w_ = jnp.exp(dw) * aw
+        h_ = jnp.exp(dh) * ah
+        return jnp.stack([cx - w_ / 2, cy - h_ / 2,
+                          cx + w_ / 2 - 1, cy + h_ / 2 - 1], axis=1)
+
+    def one(s, d, info):
+        n_pre = min(pre_n, K)
+        top_s, top_i = jax.lax.top_k(s, n_pre)
+        boxes = decode(anchors[top_i], d[top_i], variances[top_i])
+        h, w = info[0], info[1]
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, w - 1),
+                           jnp.clip(boxes[:, 1], 0, h - 1),
+                           jnp.clip(boxes[:, 2], 0, w - 1),
+                           jnp.clip(boxes[:, 3], 0, h - 1)], axis=1)
+        ms = max(min_size, 1.0) * info[2]  # reference FilterBoxes clamps to >= 1px
+        bw = boxes[:, 2] - boxes[:, 0] + 1.0
+        bh = boxes[:, 3] - boxes[:, 1] + 1.0
+        keep = (bw >= ms) & (bh >= ms)
+        s_kept = jnp.where(keep, top_s, -1.0)
+        b, s_out = _nms_single_class(boxes, s_kept, nms_thresh, n_pre,
+                                     normalized=False)
+        return b[:post_n], jnp.maximum(s_out[:post_n], 0.0)
+
+    rois, probs = jax.vmap(one)(sc, dl, im_info)
+    return {"RpnRois": rois, "RpnRoiProbs": probs[..., None]}
+
+
+def _np_detection_map(det, gt_label, gt_box, gt_difficult, gt_lens, class_num,
+                      overlap_threshold, ap_type, background_label,
+                      evaluate_difficult):
+    """numpy mAP (reference detection_map_op.h CalcTrueAndFalsePositive):
+    per-class score-sorted greedy matching against gt at overlap_threshold
+    (strict >, pred boxes clipped to [0, 1] as ClipBBox does), AP by
+    11-point interpolation or integral.  With evaluate_difficult=False,
+    difficult gts leave npos and matches to them count neither TP nor FP."""
+    aps = []
+    for c in range(class_num):
+        if c == background_label:
+            continue
+        npos = 0
+        records = []  # (score, tp)
+        for i in range(det.shape[0]):
+            g_idx = [t for t in range(int(gt_lens[i]))
+                     if int(gt_label[i, t]) == c]
+            npos += sum(1 for t in g_idx
+                        if evaluate_difficult or not gt_difficult[i, t])
+            used = set()
+            dets = [(float(det[i, j, 1]), det[i, j, 2:6])
+                    for j in range(det.shape[1]) if int(det[i, j, 0]) == c]
+            dets.sort(key=lambda r: -r[0])
+            for score, box in dets:
+                box = np.clip(box, 0.0, 1.0)  # reference ClipBBox
+                best, best_t = -1.0, -1
+                for t in g_idx:
+                    gb = gt_box[i, t]
+                    ix = max(0.0, min(box[2], gb[2]) - max(box[0], gb[0]))
+                    iy = max(0.0, min(box[3], gb[3]) - max(box[1], gb[1]))
+                    inter = ix * iy
+                    ua = (max(box[2] - box[0], 0) * max(box[3] - box[1], 0)
+                          + max(gb[2] - gb[0], 0) * max(gb[3] - gb[1], 0) - inter)
+                    ov = inter / ua if ua > 0 else 0.0
+                    if ov > best:
+                        best, best_t = ov, t
+                if best > overlap_threshold:
+                    if not evaluate_difficult and gt_difficult[i, best_t]:
+                        continue  # matched a difficult gt: neither TP nor FP
+                    tp = best_t not in used
+                    if tp:
+                        used.add(best_t)
+                    records.append((score, 1.0 if tp else 0.0))
+                else:
+                    records.append((score, 0.0))
+        if npos == 0:
+            continue
+        records.sort(key=lambda r: -r[0])
+        tps = np.cumsum([r[1] for r in records]) if records else np.zeros(0)
+        fps = np.cumsum([1 - r[1] for r in records]) if records else np.zeros(0)
+        rec = tps / npos
+        prec = tps / np.maximum(tps + fps, 1e-12)
+        if ap_type == "11point":
+            ap = 0.0
+            for th in np.arange(0.0, 1.01, 0.1):
+                p = prec[rec >= th].max() if np.any(rec >= th) else 0.0
+                ap += p / 11.0
+        else:  # integral
+            ap = 0.0
+            prev_rec = 0.0
+            for k in range(len(rec)):
+                ap += prec[k] * (rec[k] - prev_rec)
+                prev_rec = rec[k]
+        aps.append(ap)
+    return np.float32(np.mean(aps) if aps else 0.0)
+
+
+@register_op("detection_map")
+def _detection_map(ctx, op, ins):
+    """mAP metric (reference detection/detection_map_op.h).  Pure metric —
+    not a training-path op — so it runs as a host callback over the padded
+    static inputs: DetectRes [N, D, 6] (label, score, box; label < 0 pad,
+    the multiclass_nms output format), Label [N, B, >=5] (label, box
+    [, difficult]) + GtLod lens.  Output: batch mAP scalar; cross-batch
+    accumulation lives in metrics.DetectionMAP (the reference's
+    accumulative POS-count states are host state there)."""
+    det = first(ins, "DetectRes").astype(jnp.float32)
+    gt = first(ins, "Label").astype(jnp.float32)
+    if gt.ndim == 2:
+        gt = gt[None]
+    N, B = gt.shape[0], gt.shape[1]
+    gt_lens = (first(ins, "GtLod").astype(jnp.int32) if ins.get("GtLod")
+               else jnp.full((N,), B, jnp.int32))
+    class_num = op.attr("class_num")
+    overlap_threshold = op.attr("overlap_threshold", 0.5)
+    ap_type = op.attr("ap_type", "integral")
+    background_label = op.attr("background_label", 0)
+    evaluate_difficult = op.attr("evaluate_difficult", True)
+
+    def host(det_v, gt_v, lens_v):
+        # Label rows: [label, box] (5 cols) or [label, difficult, box]
+        # (6 cols), the reference GetBoxes contract
+        if gt_v.shape[2] >= 6:
+            difficult = gt_v[:, :, 1] != 0
+            box = gt_v[:, :, 2:6]
+        else:
+            difficult = np.zeros(gt_v.shape[:2], bool)
+            box = gt_v[:, :, 1:5]
+        return _np_detection_map(det_v, gt_v[:, :, 0], box, difficult, lens_v,
+                                 class_num, overlap_threshold, ap_type,
+                                 background_label, evaluate_difficult)
+
+    out = jax.pure_callback(host, jax.ShapeDtypeStruct((), jnp.float32),
+                            det, gt, gt_lens)
+    return {"MAP": out.reshape(1)}
