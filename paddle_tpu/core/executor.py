@@ -82,7 +82,8 @@ class _CompiledStep:
 
     def __init__(self, program: Program, feed_names: Sequence[str], fetch_names: Sequence[str], scope: Scope,
                  mesh=None, batch_axis: str = "dp", feed_shapes: Optional[Dict[str, tuple]] = None,
-                 n_steps: int = 1, remat: bool = False, platform: Optional[str] = None):
+                 n_steps: int = 1, remat: bool = False, platform: Optional[str] = None,
+                 local_sgd: bool = False):
         self.mesh = mesh
         self.platform = platform
         self.batch_axis = batch_axis
@@ -153,14 +154,76 @@ class _CompiledStep:
                 )
             inner = step
 
-            def step(state_rw, state_ro, feeds, key):
-                def body(carry, feed_t):
-                    srw, k = carry
-                    fetches_t, new_state, k2 = inner(srw, state_ro, feed_t, k)
-                    return (new_state, k2), fetches_t
+            if local_sgd:
+                # LocalSGD round (reference transpiler/collective.py:249
+                # LocalSGD: snapshot + allreduce param deltas every k steps).
+                # TPU-native: each dp worker runs the k scanned steps on ITS
+                # OWN diverging copy of the state inside a shard_map — no
+                # collective between steps — then one pmean re-syncs.  One
+                # dispatch = one round; the scope's single logical copy means
+                # optimizer accumulators are averaged at the sync too (the
+                # reference keeps them worker-local; recorded deviation).
+                if mesh is None or not dict(mesh.shape).get(batch_axis):
+                    raise ValueError(
+                        "local_sgd needs a mesh with a batch axis "
+                        "(CompiledProgram.with_local_sgd on a dp mesh)")
+                if self.multiprocess:
+                    # the shard_map in_specs below assume single-controller
+                    # global batches; per-process slice assembly is not wired
+                    raise NotImplementedError(
+                        "with_local_sgd on a multi-process mesh is not "
+                        "supported yet; use a single-controller dp mesh")
+                from jax.sharding import PartitionSpec as P
 
-                (srw, key2), stacked = jax.lax.scan(body, (state_rw, key), feeds)
-                return stacked, srw, key2
+                def _ls_feed_spec(n):
+                    shape = feed_shapes.get(n, ())
+                    n_dp = dict(mesh.shape)[batch_axis]
+                    if len(shape) > 1 and shape[1] % n_dp == 0:
+                        return P(None, batch_axis)
+                    return P()
+
+                ls_in_feeds = {n: _ls_feed_spec(n) for n in self.feed_names}
+                rw_repl = {n: P() for n in self.rw_names}
+                ro_repl = {n: P() for n in self.ro_names}
+                out_state_spec = {n: P() for n in written}
+
+                def worker(state_rw, state_ro, feeds, key):
+                    wk = jax.random.fold_in(key, jax.lax.axis_index(batch_axis))
+
+                    def body(carry, feed_t):
+                        srw, k = carry
+                        fetches_t, new_state, k2 = inner(srw, state_ro, feed_t, k)
+                        return (new_state, k2), fetches_t
+
+                    (srw, _), stacked = jax.lax.scan(body, (state_rw, wk), feeds)
+                    srw = jax.tree_util.tree_map(
+                        lambda a: jax.lax.pmean(a, batch_axis), srw)
+                    # fetch semantics under LocalSGD: the dp-MEAN of each
+                    # worker's value (right for scalar losses/metrics; for
+                    # per-sample outputs run a separate eval dispatch)
+                    stacked = jax.tree_util.tree_map(
+                        lambda a: jax.lax.pmean(a, batch_axis), stacked)
+                    return stacked, srw
+
+                smapped = jax.shard_map(
+                    worker, mesh=mesh,
+                    in_specs=(rw_repl, ro_repl, ls_in_feeds, P()),
+                    out_specs=([P()] * len(self.fetch_names), out_state_spec),
+                    check_vma=False,
+                )
+
+                def step(state_rw, state_ro, feeds, key):
+                    stacked, srw = smapped(state_rw, state_ro, feeds, key)
+                    return stacked, srw, jax.random.fold_in(key, n_steps)
+            else:
+                def step(state_rw, state_ro, feeds, key):
+                    def body(carry, feed_t):
+                        srw, k = carry
+                        fetches_t, new_state, k2 = inner(srw, state_ro, feed_t, k)
+                        return (new_state, k2), fetches_t
+
+                    (srw, key2), stacked = jax.lax.scan(body, (state_rw, key), feeds)
+                    return stacked, srw, key2
 
         if mesh is None:
             self.jfn = jax.jit(step, donate_argnums=(0,))
@@ -320,6 +383,7 @@ class Executor:
         mesh = None
         batch_axis = "dp"
         remat = False
+        local_sgd_every = 0
         if hasattr(program, "program") and hasattr(program, "mesh"):  # CompiledProgram
             mesh = program.mesh
             batch_axis = getattr(program, "batch_axis", "dp")
@@ -328,7 +392,17 @@ class Executor:
             # (the XLA-native descendant of the reference's
             # memory_optimize_pass: trade FLOPs for activation memory)
             remat = bool(getattr(bs, "memory_optimize", False))
+            local_sgd_every = int(getattr(program, "local_sgd_every", 0) or 0)
             program = program.program
+        if local_sgd_every:
+            if steps == 1:
+                steps = local_sgd_every  # one dispatch = one LocalSGD round
+            elif steps != local_sgd_every:
+                raise ValueError(
+                    f"with_local_sgd(sync_every={local_sgd_every}): each "
+                    f"dispatch runs exactly one round; pass steps="
+                    f"{local_sgd_every} (got {steps}) with feeds stacked "
+                    f"[sync_every, ...]")
         scope = scope if scope is not None else global_scope()
         feed = feed or {}
         fetch_names = [f.name if isinstance(f, Variable) else str(f) for f in (fetch_list or [])]
@@ -419,6 +493,7 @@ class Executor:
             (tuple(mesh.shape.items()), batch_axis) if mesh is not None else None,
             steps,
             remat,
+            local_sgd_every,
             _lowering_flags(),
         )
         compiled = self._cache.pop(cache_key, None)
@@ -433,6 +508,7 @@ class Executor:
                 mesh=mesh, batch_axis=batch_axis,
                 feed_shapes={n: v.shape for n, v in jfeeds.items()},
                 n_steps=steps, remat=remat, platform=mesh_platform,
+                local_sgd=bool(local_sgd_every),
             )
             self._cache[cache_key] = compiled
             from ..flags import flag as _flagv

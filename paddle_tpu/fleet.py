@@ -112,12 +112,6 @@ class Fleet:
     def distributed_optimizer(self, optimizer, strategy: Optional[DistributedStrategy] = None):
         if strategy is not None:
             self._strategy = strategy
-        if self._strategy.use_local_sgd:
-            raise NotImplementedError(
-                "DistributedStrategy.use_local_sgd: program-integrated "
-                "LocalSGD is not wired into Fleet; use "
-                "paddle_tpu.parallel.local_sgd.local_sgd_train directly "
-                "(k local steps + one pmean per round)")
         return _DistributedOptimizer(self, optimizer)
 
     def main_program(self, program):
@@ -127,7 +121,13 @@ class Fleet:
 
         bs = BuildStrategy()
         bs.memory_optimize = self._strategy.memory_optimize
-        return CompiledProgram(program, build_strategy=bs).with_mesh(self.mesh)
+        cp = CompiledProgram(program, build_strategy=bs).with_mesh(self.mesh)
+        if self._strategy.use_local_sgd:
+            # DistributedStrategy.use_local_sgd (reference collective.py
+            # LocalSGD mode): k communication-free local steps per worker,
+            # one pmean per round — executor runs one round per dispatch
+            cp = cp.with_local_sgd(self._strategy.local_sgd_steps)
+        return cp
 
     def save_inference_model(self, executor, dirname, feeded_var_names,
                              target_vars, main_program=None, scope=None):

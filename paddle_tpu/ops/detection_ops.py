@@ -637,13 +637,19 @@ def _roi_pool(ctx, op, ins):
         mw = ((jnp.arange(W)[None, :] >= ws[:, None])
               & (jnp.arange(W)[None, :] < we[:, None]))          # [pw, W]
         # masked max in two reductions: over W per pw bin, then H per ph bin
-        vw = jnp.max(jnp.where(mw[None, None, :, :], img[:, :, None, :], NEG), axis=-1)  # [C, H, pw]
-        out = jnp.max(jnp.where(mh[None, :, :, None], vw[:, None, :, :], NEG), axis=2)  # [C, ph, pw]
+        masked_w = jnp.where(mw[None, None, :, :], img[:, :, None, :], NEG)  # [C, H, pw, W]
+        vw = jnp.max(masked_w, axis=-1)                                      # [C, H, pw]
+        aw = jnp.argmax(masked_w, axis=-1).astype(jnp.int32)                 # best w per (h, pw)
+        masked_h = jnp.where(mh[None, :, :, None], vw[:, None, :, :], NEG)   # [C, ph, H, pw]
+        out = jnp.max(masked_h, axis=2)                                      # [C, ph, pw]
+        ah = jnp.argmax(masked_h, axis=2).astype(jnp.int32)                  # best h per (ph, pw)
+        w_best = jnp.take_along_axis(aw, ah, axis=1)  # [C, ph, pw]
+        arg = ah * W + w_best                       # flat index, reference Argmax layout
         empty = ((he <= hs)[:, None] | (we <= ws)[None, :])  # [ph, pw]
-        return jnp.where(empty[None], 0.0, out)
+        return jnp.where(empty[None], 0.0, out), jnp.where(empty[None], 0, arg)
 
-    out = jax.vmap(one_roi)(rois, batch_idx)
-    return {"Out": out.astype(x.dtype), "Argmax": jnp.zeros(out.shape, jnp.int32)}
+    out, argmax = jax.vmap(one_roi)(rois, batch_idx)
+    return {"Out": out.astype(x.dtype), "Argmax": argmax}
 
 
 _MATCH_EPS = 1e-6
@@ -708,14 +714,14 @@ def _target_assign(ctx, op, ins):
 
     Dense redesign: X [N, B, K] padded replaces the [sum_b, 1, K] LoD input;
     NegIndices is [N, Q] padded with -1."""
-    x = first(ins, "X").astype(jnp.float32)          # [N, B, K]
+    x = first(ins, "X")                              # [N, B, K], any dtype
     match = first(ins, "MatchIndices").astype(jnp.int32)  # [N, M]
     mismatch = op.attr("mismatch_value", 0)
     N, B, K = x.shape
     safe = jnp.clip(match, 0, B - 1)
     out = jnp.take_along_axis(x, safe[:, :, None], axis=1)  # [N, M, K]
     hit = (match >= 0)[:, :, None]
-    out = jnp.where(hit, out, float(mismatch))
+    out = jnp.where(hit, out, jnp.asarray(mismatch, x.dtype))
     wt = hit.astype(jnp.float32)
     if ins.get("NegIndices"):
         neg = first(ins, "NegIndices").astype(jnp.int32)  # [N, Q], -1 pad

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.registry import register_op
 from ..core.selected_rows import SelectedRows
@@ -386,3 +387,60 @@ def _dpsgd(ctx, op, ins):
     noise = sigma * clip * jax.random.normal(ctx.next_key(), g.shape, jnp.float32)
     upd = (gf * scale + noise) / batch_size
     return {"ParamOut": p - lr * upd}
+
+
+@register_op("dgc")
+def _dgc(ctx, op, ins):
+    """Deep Gradient Compression transform (reference dgc_op.cc, appended
+    by DGCMomentumOptimizer optimizer.py:786): U = m*U + G, V += U, send
+    top-k of |V|, clear BOTH buffers at the sent coordinates (momentum
+    factor masking).  GradOut is the dense scatter of the selected values;
+    the regular momentum op consumes it downstream, as in the reference.
+
+    TPU notes: under GSPMD the gradient arrives already summed over dp (the
+    wire-compression role is subsumed by XLA's ICI all-reduce; the genuine
+    multi-worker sparse exchange lives in parallel/dgc.py for DCN-spanning
+    deployments), so this op preserves the part that shapes training
+    dynamics — sparsified updates with error feedback — with W=1 semantics.
+    The data-dependent k is handled statically: top_k at the largest ramp k,
+    then a rank mask for the current step's k."""
+    g = first(ins, "Grad").astype(jnp.float32)
+    u = first(ins, "U").astype(jnp.float32)
+    v = first(ins, "V").astype(jnp.float32)
+    step = first(ins, "CurrentStep").reshape(()).astype(jnp.float32)
+    m = op.attr("m", 0.9)
+    rampup_begin = float(op.attr("rampup_begin_step", 0.0))
+    rampup_step = float(op.attr("rampup_step", 1.0))
+    sparsity = list(op.attr("sparsity", [0.999]))
+    clip_norm = float(op.attr("clip_norm", 0.0))
+
+    if clip_norm > 0:  # reference dgc_clip_by_norm on the local grad
+        norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        g = g * (clip_norm / jnp.maximum(norm, clip_norm))
+
+    numel = int(np.prod(g.shape))
+    k_list = [max(1, int(numel * (1.0 - s))) for s in sparsity]
+    k_max = max(k_list)
+    # sparsity ramp: index advances every rampup_step/len(sparsity) steps
+    period = max(rampup_step / len(sparsity), 1e-9)
+    idx = jnp.clip(jnp.floor((step - rampup_begin) / period),
+                   0, len(sparsity) - 1).astype(jnp.int32)
+    k_cur = jnp.take(jnp.asarray(k_list, jnp.int32), idx)
+
+    u2 = m * u + g
+    v2 = v + u2
+    flat = v2.reshape(-1)
+    _, top_idx = jax.lax.top_k(jnp.abs(flat), k_max)
+    sel = jnp.arange(k_max) < k_cur  # top_k is sorted: rank < k_cur
+    dense = jnp.zeros_like(flat).at[top_idx].set(
+        jnp.where(sel, flat[top_idx], 0.0))
+    cleared = jnp.zeros_like(flat, dtype=bool).at[top_idx].set(sel)
+    u3 = jnp.where(cleared.reshape(g.shape), 0.0, u2)
+    v3 = jnp.where(cleared.reshape(g.shape), 0.0, v2)
+
+    active = step >= rampup_begin
+    return {
+        "GradOut": jnp.where(active, dense.reshape(g.shape), g),
+        "UOut": jnp.where(active, u3, u),
+        "VOut": jnp.where(active, v3, v),
+    }

@@ -551,6 +551,93 @@ class LarsMomentumOptimizer(MomentumOptimizer):
         )
 
 
+class DGCMomentumOptimizer(MomentumOptimizer):
+    """Deep Gradient Compression momentum (reference optimizer.py:786
+    DGCMomentumOptimizer, arXiv:1712.01887): before each momentum update a
+    `dgc` op sparsifies the gradient — top-(1-sparsity) of the
+    error-feedback buffer with momentum correction and factor masking,
+    ramping sparsity over rampup_step beginning at rampup_begin_step.
+    As in the reference, parameters with < 16384 elements, SelectedRows
+    grads, and non-fp32 params bypass compression; also as in the
+    reference, the momentum op still consumes the compressed grad (the
+    dgc op ALSO momentum-corrects U — reference optimizer.py:786 does not
+    override _append_optimize_op), so effective steps compound: deploy
+    with rampup warmup and an accordingly modest lr.
+
+    TPU deviation (recorded): under GSPMD the grad is already summed over
+    dp — wire compression is XLA's job on ICI — so the op runs with
+    single-worker semantics on the summed grad; the multi-worker sparse
+    slab exchange for DCN-spanning topologies is parallel/dgc.py."""
+
+    _DGC_MIN_NUMEL = 16384  # reference _append_dgc_ops threshold
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step,
+                 rampup_step=1, sparsity=(0.999,), use_nesterov=False,
+                 local_grad_clip_norm=None, num_trainers=None,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, momentum, use_nesterov,
+                         regularization=regularization, name=name)
+        self._rampup_begin_step = float(rampup_begin_step)
+        self._rampup_step = float(rampup_step)
+        self._sparsity = [float(s) for s in sparsity]
+        self._clip_norm = 0.0
+        if local_grad_clip_norm is not None:
+            if not isinstance(num_trainers, int) or num_trainers <= 0:
+                raise ValueError("DGCMomentumOptimizer: local_grad_clip_norm "
+                                 "needs a positive int num_trainers")
+            self._clip_norm = float(local_grad_clip_norm) / (num_trainers * num_trainers)
+        self._counter_var = None
+
+    def _dgc_eligible(self, param, grad):
+        numel = 1
+        for d in param.shape:
+            numel *= int(d)
+        return (numel >= self._DGC_MIN_NUMEL
+                and str(param.dtype) in ("float32", "fp32")
+                and getattr(grad, "type", None) != "selected_rows")
+
+    def _ensure_counter(self, block):
+        if self._counter_var is not None:
+            return self._counter_var
+        name = unique_name.generate("dgc_counter")
+        self._counter_var = block.create_var(name, shape=(1,), dtype="float32",
+                                             persistable=True)
+        startup = default_startup_program().global_block()
+        startup.create_var(name, shape=(1,), dtype="float32", persistable=True)
+        startup.append_op("fill_constant", outputs={"Out": [name]},
+                          attrs={"shape": [1], "dtype": "float32", "value": -1.0})
+        # counter reads `step` starting at 0 (reference begins at begin-1
+        # and prepends the increment)
+        block.append_op("increment", inputs={"X": [name]},
+                        outputs={"Out": [name]}, attrs={"step": 1.0})
+        return self._counter_var
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        if self._dgc_eligible(p, g):
+            counter = self._ensure_counter(block)
+            # u/v allocated lazily so ineligible params don't carry two
+            # param-sized fp32 buffers for nothing
+            u = self._add_accumulator("dgc_u", p)
+            v = self._add_accumulator("dgc_v", p)
+            g_out = block.create_var(unique_name.generate(f"{g.name}@DGC"),
+                                     shape=g.shape, dtype=g.dtype)
+            block.append_op(
+                "dgc",
+                inputs={"Grad": [g.name], "U": [u.name], "V": [v.name],
+                        "CurrentStep": [counter.name]},
+                outputs={"GradOut": [g_out.name], "UOut": [u.name],
+                         "VOut": [v.name]},
+                attrs={"m": self._momentum,
+                       "rampup_begin_step": self._rampup_begin_step,
+                       "rampup_step": self._rampup_step,
+                       "sparsity": self._sparsity,
+                       "clip_norm": self._clip_norm},
+            )
+            g = g_out
+        return super()._append_optimize_op(block, (p, g))
+
+
 class ExponentialMovingAverage:
     """EMA shadow parameters (reference optimizer.py:2431):
     `update()` appends shadow := decay*shadow + (1-decay)*param ops into the
@@ -932,3 +1019,4 @@ Ftrl = FtrlOptimizer
 Lamb = LambOptimizer
 Dpsgd = DpsgdOptimizer
 LarsMomentum = LarsMomentumOptimizer
+DGCMomentum = DGCMomentumOptimizer

@@ -47,6 +47,7 @@ class CompiledProgram:
         self.mesh = None
         self.loss_name = None
         self.batch_axis = "dp"
+        self.local_sgd_every = 0
 
     def with_data_parallel(
         self,
@@ -76,4 +77,18 @@ class CompiledProgram:
         Parameter placement comes from program.sharding_hints."""
         self.mesh = mesh
         self.batch_axis = batch_axis
+        return self
+
+    def with_local_sgd(self, sync_every: int = 4) -> "CompiledProgram":
+        """LocalSGD mode (reference transpiler/collective.py:249 +
+        DistributedStrategy.use_local_sgd): each dp worker runs `sync_every`
+        communication-free local steps on its own diverging state, then one
+        pmean re-syncs — one executor dispatch per round with feeds stacked
+        [sync_every, ...].  Requires a single-controller mesh
+        (with_data_parallel/with_mesh first).  Fetches come back as the
+        dp-mean of per-worker values: exact for scalar losses/metrics; for
+        per-sample outputs run a separate (non-LocalSGD) eval dispatch."""
+        if sync_every < 1:
+            raise ValueError(f"with_local_sgd: sync_every must be >= 1, got {sync_every}")
+        self.local_sgd_every = int(sync_every)
         return self

@@ -517,3 +517,29 @@ def test_rpn_target_assign_without_im_info():
         "a": anchors, "g": gt,
         "bp": np.zeros((1, 16, 4), "f4"), "cl": np.zeros((1, 16, 1), "f4")})
     assert label.sum() >= 1 and score_w.sum() <= 8
+
+
+def test_roi_pool_argmax_golden():
+    """Argmax holds the flat h*W+w index of each bin's max (reference
+    roi_pool_op.h records it for the backward; here it's an output-parity
+    check — autodiff owns the gradient)."""
+    rng = np.random.RandomState(13)
+    x = rng.randn(1, 2, 6, 6).astype("f4")
+    rois = np.array([[0, 0, 5, 5]], "f4")
+
+    def build():
+        xv = fluid.layers.data("x", [2, 6, 6], dtype="float32")
+        rv = fluid.layers.data("rois", [4], dtype="float32")
+        out = fluid.layers.roi_pool(xv, rv, 2, 2, 1.0)
+        prog = fluid.default_main_program()
+        argmax_name = [o for o in prog.global_block().ops
+                       if o.type == "roi_pool"][0].output("Argmax")[0]
+        return [out, argmax_name]
+
+    out, arg = _run_prog(build, {"x": x, "rois": rois})
+    H = W = 6
+    for c in range(2):
+        for i in range(2):
+            for j in range(2):
+                flat = int(arg[0, c, i, j])
+                assert x[0, c, flat // W, flat % W] == out[0, c, i, j]
