@@ -16,7 +16,7 @@ from .core.autodiff import append_backward, calc_gradient  # noqa: F401
 from . import backward  # noqa: F401
 from .backward import gradients  # noqa: F401
 from . import evaluator  # noqa: F401
-from .core.executor import CPUPlace, CUDAPlace, Executor, TPUPlace  # noqa: F401
+from .core.executor import CUDAPinnedPlace, cpu_places, cuda_pinned_places, cuda_places, CPUPlace, CUDAPlace, Executor, TPUPlace  # noqa: F401
 from .core.param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from .core.program import (  # noqa: F401
     Program,
@@ -29,11 +29,11 @@ from .core.program import (  # noqa: F401
 )
 from .core.scope import Scope, global_scope, scope_guard  # noqa: F401
 from . import parallel  # noqa: F401
-from .parallel import BuildStrategy, CompiledProgram, ExecutionStrategy  # noqa: F401
+from .parallel import BuildStrategy, CompiledProgram, ExecutionStrategy, ParallelExecutor  # noqa: F401
 from . import parallel as compiler  # reference exposes fluid.compiler.CompiledProgram  # noqa: F401
 from . import clip  # noqa: F401
 from . import io  # noqa: F401
-from .lod import LoDTensor, create_lod_tensor  # noqa: F401
+from .lod import LoDTensor, LoDTensorArray, create_lod_tensor  # noqa: F401
 from . import models  # noqa: F401
 from . import reader  # noqa: F401
 from .reader import DataFeeder, DataLoader, PyReader  # noqa: F401
@@ -55,7 +55,28 @@ from . import transpiler  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .core import passes  # noqa: F401
 from . import dygraph  # noqa: F401
+from . import dygraph_grad_clip  # noqa: F401
+from . import recordio_writer  # noqa: F401
 from . import metrics  # noqa: F401
 from . import profiler  # noqa: F401
 
 __version__ = "0.1.0"
+
+
+def in_dygraph_mode():
+    """reference fluid.in_dygraph_mode."""
+    from .dygraph import base as _dy
+
+    return _dy.enabled()
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place, low, high):
+    """reference fluid.create_random_int_lodtensor."""
+    import numpy as np
+
+    seqs = [np.random.randint(low, high + 1, (ln,) + tuple(base_shape)).astype("int64")
+            for ln in recursive_seq_lens[0]]
+    return LoDTensor(seqs)
+
+
+from .transpiler import memory_optimize, release_memory  # noqa: F401,E402

@@ -1,2 +1,3 @@
 from . import mixed_precision  # noqa: F401
 from . import slim  # noqa: F401
+from .rnn_impl import BasicGRUUnit, BasicLSTMUnit  # noqa: F401

@@ -63,6 +63,40 @@ class CPUPlace(Place):
 CUDAPlace = TPUPlace
 
 
+class CUDAPinnedPlace(Place):
+    """reference CUDAPinnedPlace: host-pinned staging memory.  PJRT manages
+    transfer staging itself, so this is the host (CPU) place."""
+
+    def __init__(self):
+        self.device_id = 0
+
+    def jax_device(self):
+        return CPUPlace().jax_device()
+
+
+def cpu_places(device_count=None):
+    """reference fluid.cpu_places."""
+    import os
+
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """reference fluid.cuda_places: accelerator places (TPU chips here)."""
+    if device_ids is None:
+        n = len([d for d in jax.local_devices() if d.platform != "cpu"]) or 1
+        device_ids = range(n)
+    return [TPUPlace(i) for i in device_ids]
+
+
+def cuda_pinned_places(device_count=None):
+    import os
+
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CUDAPinnedPlace() for _ in range(n)]
+
+
 def _runnable_ops(block):
     return [op for op in block.ops if op.type not in ("feed", "fetch")]
 

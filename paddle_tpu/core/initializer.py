@@ -140,3 +140,49 @@ Normal = NormalInitializer
 TruncatedNormal = TruncatedNormalInitializer
 Xavier = XavierInitializer
 MSRA = MSRAInitializer
+
+
+class BilinearInitializer(Initializer):
+    """reference initializer.py BilinearInitializer: bilinear upsampling
+    kernel for conv_transpose weights [c_out, c_in, k, k]."""
+
+    def _value(self, shape, dtype):
+        import numpy as np
+
+        weight = np.zeros(shape, dtype="float32")
+        k = shape[-1]
+        f = int(np.ceil(k / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for flat in range(int(np.prod(shape))):
+            idx = np.unravel_index(flat, shape)
+            x, y = idx[-1], idx[-2]
+            weight[idx] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return weight.astype(dtype)
+
+    def __call__(self, var, block):
+        import numpy as np
+
+        value = self._value(tuple(int(d) for d in var.shape), "float32")
+        block.append_op(
+            "assign_value",
+            outputs={"Out": [var.name]},
+            attrs={"shape": list(value.shape), "dtype": "float32",
+                   "values": value.reshape(-1).tolist()},
+        )
+
+
+def force_init_on_cpu():
+    """reference initializer.force_init_on_cpu: always False here — there
+    is no separate CPU init placement under XLA (PJRT owns placement)."""
+    return False
+
+
+class init_on_cpu:
+    """reference initializer.init_on_cpu context: accepted no-op (PJRT owns
+    placement)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False

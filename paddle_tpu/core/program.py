@@ -343,6 +343,23 @@ class Program:
     def _bump(self):
         self.version += 1
 
+    def block(self, index: int):
+        """reference Program.block(index)."""
+        return self.blocks[index]
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        """reference Program.to_string: the serialized program text."""
+        import json
+
+        return json.dumps(self.to_dict(), indent=2, default=str)
+
+    @staticmethod
+    def parse_from_string(s: str):
+        """reference Program.parse_from_string over the JSON serde."""
+        import json
+
+        return Program.from_dict(json.loads(s))
+
     def global_block(self) -> Block:
         return self.blocks[0]
 

@@ -59,3 +59,12 @@ def guard(new_prefix: str = ""):
         yield
     finally:
         generator = old
+
+
+def switch(new_generator=None):
+    """reference unique_name.switch: swap the generator state, returning
+    the old one (tests isolate name streams with it)."""
+    global generator
+    old = generator
+    generator = new_generator if new_generator is not None else UniqueNameGenerator()
+    return old

@@ -5,15 +5,45 @@ from .checkpoint import load_persistables, save_persistables  # noqa: F401
 from .layers import Layer  # noqa: F401
 from .nn import (  # noqa: F401
     FC,
+    NCE,
     BatchNorm,
+    BilinearTensorProduct,
     Conv2D,
     Conv2DTranspose,
+    Conv3D,
+    Conv3DTranspose,
     Dropout,
     Embedding,
+    GroupNorm,
     GRUUnit,
     LayerNorm,
     Linear,
     Pool2D,
     PRelu,
+    SpectralNorm,
+)
+from .learning_rate_scheduler import (  # noqa: F401
+    CosineDecay,
+    ExponentialDecay,
+    InverseTimeDecay,
+    LearningRateDecay,
+    NaturalExpDecay,
+    NoamDecay,
+    PiecewiseDecay,
+    PolynomialDecay,
 )
 from .parallel import DataParallel  # noqa: F401
+
+
+def prepare_context(strategy=None):
+    """reference dygraph.parallel.prepare_context: bootstrap cross-process
+    dygraph DP.  Delegates to the coordination-service bootstrap; returns
+    the strategy (the reference returns a ParallelStrategy)."""
+    from ..parallel import distributed as _dist
+
+    if not _dist.is_initialized():
+        try:
+            _dist.init_distributed()
+        except ValueError:
+            pass  # single-process: nothing to bootstrap
+    return strategy

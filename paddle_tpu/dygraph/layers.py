@@ -45,6 +45,22 @@ class Layer:
         self._parameters[name] = param
         return param
 
+    def create_variable(self, name=None, persistable=None, dtype=None,
+                        type=None):
+        """reference dygraph Layer.create_variable: a non-parameter state
+        holder scoped to this layer."""
+        import numpy as _np
+
+        return VarBase(_np.zeros((1,), dtype or self._dtype),
+                       stop_gradient=True,
+                       name=name or unique_name.generate(f"{self._full_name}.var"),
+                       persistable=bool(persistable))
+
+    def backward(self, *inputs):
+        """reference dygraph Layer.backward hook (unused by built-ins)."""
+        raise ValueError("Layer.backward is not implemented (reference "
+                         "raises the same)")
+
     def add_sublayer(self, name: str, layer: "Layer") -> "Layer":
         self._sub_layers[name] = layer
         return layer

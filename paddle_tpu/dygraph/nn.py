@@ -330,3 +330,204 @@ class GRUUnit(Layer):
         hn = _apply("gru_hidden", lambda pv: pv[:, :d], packed)
         reset_h = _apply("gru_reset_h", lambda pv: pv[:, d:], packed)
         return hn, reset_h, None  # gate tensor intentionally None
+
+
+class GroupNorm(Layer):
+    """reference dygraph/nn.py GroupNorm over group_norm_op semantics."""
+
+    def __init__(self, channels, groups, epsilon=1e-05, param_attr=None,
+                 bias_attr=None, act=None, data_layout="NCHW", dtype="float32"):
+        super().__init__("group_norm", dtype)
+        self._groups = groups
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            [channels], attr=param_attr,
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter([channels], attr=bias_attr, is_bias=True)
+        self.act = act
+
+    def forward(self, x):
+        g, eps = self._groups, self._epsilon
+
+        def fn(xv, w, b):
+            n, c = xv.shape[0], xv.shape[1]
+            xf = xv.astype(jnp.float32).reshape((n, g, c // g) + xv.shape[2:])
+            axes = tuple(range(2, xf.ndim))
+            m = jnp.mean(xf, axis=axes, keepdims=True)
+            v = jnp.var(xf, axis=axes, keepdims=True)
+            y = ((xf - m) * jax.lax.rsqrt(v + eps)).reshape(xv.shape)
+            cshape = (1, c) + (1,) * (xv.ndim - 2)
+            return (y * w.reshape(cshape) + b.reshape(cshape)).astype(xv.dtype)
+
+        return _activation(_apply("group_norm", fn, x, self.weight, self.bias),
+                           self.act)
+
+
+class SpectralNorm(Layer):
+    """reference dygraph/nn.py SpectralNorm (spectral_norm_op.cc): weight /
+    sigma with sigma from `power_iters` u-v iterations; u/v persist as
+    non-trainable state."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__("spectral_norm", dtype)
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        h = int(weight_shape[dim])
+        w = int(np.prod(weight_shape)) // h
+        rng = np.random.RandomState(0)
+        self._u = jnp.asarray(rng.randn(h).astype(dtype))
+        self._v = jnp.asarray(rng.randn(w).astype(dtype))
+
+    def forward(self, weight):
+        dim, iters, eps = self._dim, self._power_iters, self._eps
+
+        # advance the power iteration OUTSIDE the tape (the reference op
+        # writes U/V back in place each forward, as constants to the grad)
+        wv = jnp.asarray(weight.value if hasattr(weight, "value") else weight)
+        perm = (dim,) + tuple(i for i in range(wv.ndim) if i != dim)
+        mat = jnp.transpose(wv, perm).reshape(wv.shape[dim], -1)
+        u, v = self._u, self._v
+        for _ in range(iters):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        self._u, self._v = u, v
+
+        def fn(w):
+            m = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+            sigma = u @ m @ v
+            return w / sigma
+
+        return _apply("spectral_norm", fn, weight)
+
+
+class BilinearTensorProduct(Layer):
+    """reference dygraph/nn.py BilinearTensorProduct
+    (bilinear_tensor_product_op.h): out[n, k] = x[n] W[k] y[n]^T + b."""
+
+    def __init__(self, input1_dim, input2_dim, output_dim, name=None,
+                 act=None, param_attr=None, bias_attr=None, dtype="float32"):
+        super().__init__("bilinear_tensor_product", dtype)
+        self.weight = self.create_parameter(
+            [output_dim, input1_dim, input2_dim], attr=param_attr)
+        self.bias = self.create_parameter([1, output_dim], attr=bias_attr,
+                                          is_bias=True)
+        self.act = act
+
+    def forward(self, x, y):
+        def fn(xv, yv, w, b):
+            return jnp.einsum("nd,kde,ne->nk", xv, w, yv) + b
+
+        return _activation(
+            _apply("bilinear_tensor_product", fn, x, y, self.weight, self.bias),
+            self.act)
+
+
+class NCE(Layer):
+    """reference dygraph/nn.py NCE over nce_op.h: noise-contrastive
+    estimation with uniform negative sampling (the op lowering's math,
+    eager)."""
+
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=10,
+                 sampler="uniform", custom_dist=None, seed=0,
+                 is_sparse=False, dtype="float32"):
+        super().__init__("nce", dtype)
+        if sampler != "uniform" or custom_dist is not None:
+            raise NotImplementedError(
+                "dygraph NCE: only the uniform sampler is wired; use the "
+                "static layers.nce for log_uniform/custom_dist")
+        self._num_total = num_total_classes
+        self._num_neg = num_neg_samples
+        self._rng = np.random.RandomState(seed or 0)
+        self.weight = self.create_parameter([num_total_classes, dim],
+                                            attr=param_attr)
+        self.bias = self.create_parameter([num_total_classes],
+                                          attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label):
+        B = int(np.asarray(input.value).shape[0])
+        negs = jnp.asarray(self._rng.randint(
+            0, self._num_total, (B, self._num_neg)).astype("int32"))
+        num_neg, num_total = self._num_neg, self._num_total
+
+        def fn(xv, lab, w, b):
+            lab = lab.reshape(B, -1).astype(jnp.int32)
+            samples = jnp.concatenate([lab, negs], axis=1)
+            ws = jnp.take(w, samples, axis=0)
+            logits = jnp.einsum("bsd,bd->bs", ws, xv) + jnp.take(b, samples)
+            o = jnp.exp(logits)
+            q = jnp.full(samples.shape, 1.0 / num_total)
+            bb = q * num_neg
+            num_true = lab.shape[1]
+            true_cost = -jnp.log(o[:, :num_true] / (o[:, :num_true] + bb[:, :num_true]))
+            neg_cost = -jnp.log(bb[:, num_true:] / (o[:, num_true:] + bb[:, num_true:]))
+            return (jnp.sum(true_cost, axis=1) + jnp.sum(neg_cost, axis=1)).reshape(B, 1)
+
+        return _apply("nce", fn, input, label, self.weight, self.bias)
+
+
+class Conv3D(Layer):
+    """reference dygraph/nn.py Conv3D (conv_op.cc conv3d)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__("conv3d", dtype)
+        fs = [filter_size] * 3 if isinstance(filter_size, int) else list(filter_size)
+        self._stride = [stride] * 3 if isinstance(stride, int) else list(stride)
+        self._padding = [padding] * 3 if isinstance(padding, int) else list(padding)
+        self._dilation = [dilation] * 3 if isinstance(dilation, int) else list(dilation)
+        self._groups = groups or 1
+        fan_in = (num_channels // self._groups) * int(np.prod(fs))
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // self._groups] + fs, attr=param_attr,
+            default_initializer=NormalInitializer(0.0, float(np.sqrt(2.0 / fan_in))))
+        self.bias = self.create_parameter([num_filters], attr=bias_attr, is_bias=True)
+        self.act = act
+
+    def forward(self, x):
+        s, p, d, g = (tuple(self._stride), self._padding,
+                      tuple(self._dilation), self._groups)
+
+        def fn(xv, w, b):
+            out = jax.lax.conv_general_dilated(
+                xv, w, window_strides=s,
+                padding=[(p[0], p[0]), (p[1], p[1]), (p[2], p[2])],
+                rhs_dilation=d,
+                dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+                feature_group_count=g)
+            return out + b.reshape(1, -1, 1, 1, 1)
+
+        return _activation(_apply("conv3d", fn, x, self.weight, self.bias),
+                           self.act)
+
+
+class Conv3DTranspose(Layer):
+    """reference dygraph/nn.py Conv3DTranspose (fluid filter layout)."""
+
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, param_attr=None, bias_attr=None, act=None,
+                 dtype="float32"):
+        super().__init__("conv3d_transpose", dtype)
+        fs = [filter_size] * 3 if isinstance(filter_size, int) else list(filter_size)
+        self._stride = [stride] * 3 if isinstance(stride, int) else list(stride)
+        self._padding = [padding] * 3 if isinstance(padding, int) else list(padding)
+        self.weight = self.create_parameter(
+            [num_channels, num_filters] + fs, attr=param_attr)
+        self.bias = self.create_parameter([num_filters], attr=bias_attr, is_bias=True)
+        self.act = act
+
+    def forward(self, x):
+        s, p = self._stride, self._padding
+
+        def fn(xv, w, b):
+            from ..ops.nn_ops import conv3d_transpose_math
+
+            return conv3d_transpose_math(xv, w, strides=s, pads=p) + b.reshape(1, -1, 1, 1, 1)
+
+        return _activation(_apply("conv3d_transpose", fn, x, self.weight, self.bias),
+                           self.act)

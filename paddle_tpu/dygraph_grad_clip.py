@@ -1,0 +1,53 @@
+"""Dygraph gradient clipping (reference
+python/paddle/fluid/dygraph_grad_clip.py): callable objects applied to
+(param, grad) pairs before the eager optimizer update."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class GradClipBase:
+    def __call__(self, params_grads):
+        return [(p, self._clip(p, g)) if g is not None else (p, g)
+                for p, g in params_grads]
+
+
+class GradClipByValue(GradClipBase):
+    """clip each grad element into [min, max]."""
+
+    def __init__(self, min_value, max_value=None):
+        if max_value is None:
+            max_value = abs(min_value)
+            min_value = -max_value
+        self.min_value = float(min_value)
+        self.max_value = float(max_value)
+
+    def _clip(self, p, g):
+        return jnp.clip(g, self.min_value, self.max_value)
+
+
+class GradClipByNorm(GradClipBase):
+    """scale each grad so its own l2 norm is <= clip_norm."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip(self, p, g):
+        n = jnp.sqrt(jnp.sum(jnp.square(g)))
+        return g * (self.clip_norm / jnp.maximum(n, self.clip_norm))
+
+
+class GradClipByGlobalNorm(GradClipBase):
+    """scale ALL grads by clip_norm / max(global_norm, clip_norm)."""
+
+    def __init__(self, max_global_norm):
+        self.max_global_norm = float(max_global_norm)
+
+    def __call__(self, params_grads):
+        gs = [g for _, g in params_grads if g is not None]
+        if not gs:
+            return params_grads
+        global_norm = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in gs))
+        scale = self.max_global_norm / jnp.maximum(global_norm,
+                                                   self.max_global_norm)
+        return [(p, g * scale if g is not None else g) for p, g in params_grads]

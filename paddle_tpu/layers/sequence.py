@@ -533,6 +533,14 @@ class StaticRNN(DynamicRNN):
         super().__init__(name=name)
         self._allow_dense = True
 
+    def step(self):
+        """reference StaticRNN.step: alias of the with-block context."""
+        return self.block()
+
+    def step_output(self, o):
+        """reference StaticRNN.step_output: single-output form of output()."""
+        return self.output(o)
+
 
 def linear_chain_crf(input, label, param_attr=None):
     """CRF negative log-likelihood layer (reference layers/nn.py

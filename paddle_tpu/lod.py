@@ -71,6 +71,46 @@ class LoDTensor:
             seqs = [s.astype(dtype) for s in seqs]
         self.sequences = seqs
 
+    # --- reference pybind LoDTensor surface -------------------------------
+    def lod(self):
+        """offset-style LoD table [[0, l1, l1+l2, ...]] (reference
+        LoDTensor.lod)."""
+        offs = [0]
+        for s in self.sequences:
+            offs.append(offs[-1] + len(s))
+        return [offs]
+
+    def set_lod(self, lod):
+        """re-segment the flat payload by an offset table."""
+        flat = np.concatenate(self.sequences, axis=0)
+        offs = lod[0]
+        self.sequences = [flat[offs[i]:offs[i + 1]]
+                          for i in range(len(offs) - 1)]
+
+    def recursive_sequence_lengths(self):
+        return [[len(s) for s in self.sequences]]
+
+    def set_recursive_sequence_lengths(self, lengths):
+        flat = np.concatenate(self.sequences, axis=0)
+        out, pos = [], 0
+        for ln in lengths[0]:
+            out.append(flat[pos:pos + ln])
+            pos += ln
+        self.sequences = out
+
+    def has_valid_recursive_sequence_lengths(self):
+        """structurally valid: at least one sequence and consistent feature
+        dims (the offset-table monotonicity of the reference is implied by
+        the list-of-arrays representation)."""
+        if not self.sequences:
+            return False
+        feat = self.sequences[0].shape[1:]
+        return all(s.shape[1:] == feat for s in self.sequences)
+
+    def shape(self):
+        total = sum(len(s) for s in self.sequences)
+        return (total,) + tuple(self.sequences[0].shape[1:])
+
     def __len__(self):
         return len(self.sequences)
 
@@ -139,3 +179,10 @@ def create_lod_tensor(data, recursive_seq_lens, place=None) -> LoDTensor:
         seqs.append(flat[off : off + l])
         off += l
     return LoDTensor(seqs)
+
+
+class LoDTensorArray(list):
+    """reference pybind LoDTensorArray: a python list of LoDTensors."""
+
+    def append(self, t):  # noqa: A003 - reference name
+        list.append(self, t)

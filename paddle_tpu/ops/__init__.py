@@ -8,5 +8,6 @@ from . import (  # noqa: F401
     optimizer_ops,
     pipeline_ops,
     sequence_ops,
+    tail_ops,
     tensor_ops,
 )

@@ -812,3 +812,39 @@ def _instance_norm(ctx, op, ins):
     return {"Y": y.astype(x.dtype),
             "SavedMean": mean.reshape(n, c),
             "SavedVariance": var.reshape(n, c)}
+
+
+def conv3d_transpose_math(x, w, strides=(1, 1, 1), pads=(0, 0, 0),
+                          dilations=(1, 1, 1), groups=1):
+    """3-D analogue of conv2d_transpose_math (fluid layout
+    (in, out/groups, kd, kh, kw)); shared by graph + dygraph paths."""
+    kd, kh, kw = w.shape[2], w.shape[3], w.shape[4]
+    pad = [dilations[i] * (k - 1) - pads[i] for i, k in enumerate((kd, kh, kw))]
+    wt = jnp.flip(w, axis=(2, 3, 4))
+    if groups > 1:
+        cin, cog = w.shape[0], w.shape[1]
+        wt = wt.reshape(groups, cin // groups, cog, kd, kh, kw)
+        wt = jnp.swapaxes(wt, 1, 2)
+        wt = wt.reshape(groups * cog, cin // groups, kd, kh, kw)
+    else:
+        wt = jnp.swapaxes(wt, 0, 1)
+    return jax.lax.conv_general_dilated(
+        x, wt, window_strides=(1, 1, 1),
+        padding=[(p, p) for p in pad],
+        lhs_dilation=tuple(strides), rhs_dilation=tuple(dilations),
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups,
+    )
+
+
+@register_op("conv3d_transpose")
+def _conv3d_transpose(ctx, op, ins):
+    """reference conv_transpose_op.cc conv3d_transpose."""
+    x = first(ins, "Input")
+    w = first(ins, "Filter")
+    strides = op.attr("strides", [1, 1, 1])
+    pads = op.attr("paddings", [0, 0, 0])
+    dilations = op.attr("dilations", [1, 1, 1])
+    groups = op.attr("groups", 1)
+    return {"Output": conv3d_transpose_math(x, w, strides, pads, dilations,
+                                            groups)}

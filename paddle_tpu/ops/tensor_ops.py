@@ -287,9 +287,13 @@ def _range(ctx, op, ins):
     s = op.attr("start_v", None)
     e = op.attr("end_v", None)
     st = op.attr("step_v", None)
+    dtype = op.attr("dtype", None)
+    out_dtype = np_dtype(dtype) if dtype else None
     if s is not None:
-        return {"Out": jnp.arange(s, e, st, dtype=start.dtype if start is not None else jnp.int64)}
-    return {"Out": jnp.arange(int(start), int(end), int(step))}
+        fallback = start.dtype if start is not None else jnp.int32
+        return {"Out": jnp.arange(s, e, st, dtype=out_dtype or fallback)}
+    out = jnp.arange(int(start), int(end), int(step))
+    return {"Out": out.astype(out_dtype) if out_dtype else out}
 
 
 @register_op("gather_nd")

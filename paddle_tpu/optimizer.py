@@ -112,6 +112,27 @@ class Optimizer:
                  callbacks=None):
         return append_backward(loss, parameter_list, no_grad_set, callbacks)
 
+    def apply_optimize(self, loss, startup_program, params_grads):
+        """reference optimizer.py apply_optimize: the apply_gradients half
+        of minimize (grad clip etc. included)."""
+        return self.apply_gradients(params_grads)
+
+    def get_opti_var_name_list(self):
+        """reference optimizer.py get_opti_var_name_list: names of the
+        accumulator variables this optimizer created."""
+        return [v.name for by_param in self._accumulators.values()
+                for v in by_param.values()]
+
+    def load(self, stat_dict):
+        """reference optimizer.py load (dygraph checkpoints): install
+        accumulator values by name."""
+        for name, by_param in self._accumulators.items():
+            for pname, var in by_param.items():
+                if var.name in stat_dict:
+                    from .core.scope import global_scope
+
+                    global_scope().set_var(var.name, stat_dict[var.name])
+
     def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
         from .dygraph import base as _dy
 
@@ -551,6 +572,40 @@ class LarsMomentumOptimizer(MomentumOptimizer):
         )
 
 
+class DecayedAdagradOptimizer(Optimizer):
+    """reference optimizer.py DecayedAdagradOptimizer over
+    decayed_adagrad_op.h: exponentially-decayed squared-gradient moment."""
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6, **kw):
+        super().__init__(learning_rate, **kw)
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _eager_update(self, p, g, lr, state):
+        import jax.numpy as jnp
+
+        m = state.get("moment")
+        m = jnp.zeros_like(p) if m is None else m
+        m = self._decay * m + (1.0 - self._decay) * g * g
+        state["moment"] = m
+        return p - lr * g / (jnp.sqrt(m) + self._epsilon)
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            "decayed_adagrad",
+            inputs={"Param": [p.name], "Grad": [g.name], "Moment": [m.name],
+                    "LearningRate": [self._lr_var.name]},
+            outputs={"ParamOut": [p.name], "MomentOut": [m.name]},
+            attrs={"decay": self._decay, "epsilon": self._epsilon},
+        )
+
+
 class DGCMomentumOptimizer(MomentumOptimizer):
     """Deep Gradient Compression momentum (reference optimizer.py:786
     DGCMomentumOptimizer, arXiv:1712.01887): before each momentum update a
@@ -723,7 +778,7 @@ class ExponentialMovingAverage:
         pass  # the apply() context restores; kept for API parity
 
 
-class ModelAverage:
+class ModelAverage(Optimizer):
     """Bounded-window parameter averaging (reference optimizer.py:2241,
     which rotates sum_1/sum_2/sum_3 windows of max_average_window steps;
     here a single sum+count pair halves on reaching max_average_window —
@@ -733,6 +788,7 @@ class ModelAverage:
 
     def __init__(self, average_window_rate=0.15, min_average_window=10000,
                  max_average_window=10000, name=None):
+        super().__init__(0.0, name=name)
         self._max_window = max_average_window
         self._name = name or "model_avg"
         self._pairs = []
@@ -1020,3 +1076,4 @@ Lamb = LambOptimizer
 Dpsgd = DpsgdOptimizer
 LarsMomentum = LarsMomentumOptimizer
 DGCMomentum = DGCMomentumOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer

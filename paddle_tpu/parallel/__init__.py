@@ -7,7 +7,7 @@ batch-sharded over the `dp` mesh axis, parameters are replicated (or sharded
 over `tp`/`mp` axes by sharding hints), and XLA inserts the collectives the
 reference emitted as c_allreduce ops.  `ring_id` -> named mesh axis.
 """
-from .compiled_program import CompiledProgram, ExecutionStrategy, BuildStrategy  # noqa: F401
+from .compiled_program import BuildStrategy, CompiledProgram, ExecutionStrategy, ParallelExecutor  # noqa: F401
 from .mesh import make_mesh  # noqa: F401
 from . import distributed  # noqa: F401
 from .distributed import init_distributed  # noqa: F401

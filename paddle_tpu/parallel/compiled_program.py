@@ -92,3 +92,33 @@ class CompiledProgram:
             raise ValueError(f"with_local_sgd: sync_every must be >= 1, got {sync_every}")
         self.local_sgd_every = int(sync_every)
         return self
+
+
+
+class ParallelExecutor:
+    """reference parallel_executor.py ParallelExecutor: compat shim over
+    CompiledProgram.with_data_parallel + Executor (the SSA-graph executor
+    it wrapped is subsumed by XLA/GSPMD)."""
+
+    def __init__(self, use_cuda=True, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None, build_strategy=None,
+                 num_trainers=1, trainer_id=0, scope=None):
+        from ..core.executor import Executor, TPUPlace, CPUPlace
+        from ..core.program import default_main_program
+        from ..core.scope import global_scope
+
+        self._program = main_program or default_main_program()
+        self._compiled = CompiledProgram(
+            self._program, build_strategy=build_strategy
+        ).with_data_parallel(loss_name=loss_name)
+        self._exe = Executor(TPUPlace(0) if use_cuda else CPUPlace())
+        self._scope = scope or global_scope()
+
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        return self._exe.run(self._compiled, feed=feed or feed_dict,
+                             fetch_list=fetch_list, scope=self._scope,
+                             return_numpy=return_numpy)
+
+    def drop_local_exe_scopes(self):
+        """reference: drop per-device scopes; no residue (single scope)."""
+        return None

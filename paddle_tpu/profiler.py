@@ -197,3 +197,13 @@ def merge_chrome_traces(named_paths, out_path):
     with open(out_path, "w") as f:
         json.dump({"traceEvents": merged}, f)
     return out_path
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    """reference profiler.cuda_profiler (nvprof hooks): accepted no-op on
+    TPU — use profiler() / FLAGS_xla_dump_to for traces."""
+    yield

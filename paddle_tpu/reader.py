@@ -158,6 +158,22 @@ class DataFeeder:
     def __init__(self, feed_list: Sequence, place=None, program=None):
         self.feed_vars = list(feed_list)
 
+    def decorate_reader(self, reader, multi_devices=False, num_places=None,
+                        drop_last=True):
+        """reference DataFeeder.decorate_reader: wrap a sample-batch reader
+        into a feed-dict reader."""
+        def _feeder():
+            for batch in reader():
+                yield self.feed(batch)
+
+        return _feeder
+
+    def feed_parallel(self, iterable, num_places=None):
+        """reference DataFeeder.feed_parallel: under SPMD one global feed
+        dict serves every device (GSPMD shards it), so this is feed()."""
+        for item in iterable:
+            yield self.feed(item)
+
     def feed(self, samples: Iterable) -> Dict[str, np.ndarray]:
         cols = None
         for sample in samples:

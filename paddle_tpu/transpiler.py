@@ -76,6 +76,12 @@ class DistributeTranspiler:
             raise RuntimeError("call transpile() first")
         return CompiledProgram(self._program).with_mesh(global_mesh())
 
+    def get_pserver_programs(self, endpoint):
+        """reference DistributeTranspiler.get_pserver_programs: the
+        (pserver_program, startup) pair."""
+        main = self.get_pserver_program(endpoint)
+        return main, getattr(self, "_startup", None)
+
     def get_pserver_program(self, endpoint):
         raise NotImplementedError(
             "get_pserver_program: no pserver role exists in the TPU build — "
@@ -86,3 +92,63 @@ class DistributeTranspiler:
         from .core.program import default_startup_program
 
         return startup_program if startup_program is not None else default_startup_program()
+
+
+class PSDispatcher:
+    """reference transpiler/ps_dispatcher.py: assign parameter slices to
+    pserver endpoints."""
+
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class RoundRobin(PSDispatcher):
+    """reference ps_dispatcher.RoundRobin: cycle endpoints in order."""
+
+    def dispatch(self, varlist):
+        out = []
+        for _ in varlist:
+            out.append(self._eps[self._step])
+            self._step = (self._step + 1) % len(self._eps)
+        return out
+
+
+class HashName(PSDispatcher):
+    """reference ps_dispatcher.HashName: endpoint by name-hash bucket."""
+
+    @staticmethod
+    def _hash_block(block_str, total):
+        import zlib
+
+        return zlib.crc32(block_str.encode()) % total
+
+    def dispatch(self, varlist):
+        return [self._eps[self._hash_block(v.name if hasattr(v, "name") else str(v),
+                                           len(self._eps))]
+                for v in varlist]
+
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=False):
+    """reference transpiler.memory_optimize (var reuse pass): accepted
+    no-op — XLA buffer assignment + executor donation own memory reuse;
+    BuildStrategy.memory_optimize drives rematerialization instead."""
+    return None
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """reference transpiler.release_memory: accepted no-op (XLA live-range
+    analysis frees buffers)."""
+    return None

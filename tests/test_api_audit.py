@@ -1,0 +1,41 @@
+"""API-surface audit gate (VERDICT r3 #6): every entry of the reference
+/root/reference/paddle/fluid/API.spec must either resolve on paddle_tpu or
+be recorded with a rationale in API_DEVIATIONS.md — exactly one of the two."""
+import os
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.fixture(scope="module")
+def audit():
+    import api_audit
+
+    if not os.path.exists(api_audit.REF_SPEC):
+        pytest.skip("reference API.spec not available")
+    return api_audit.audit()
+
+
+def test_every_reference_entry_resolved_or_recorded(audit):
+    resolved, recorded, unrecorded = audit
+    assert not unrecorded, (
+        f"{len(unrecorded)} reference API entries neither resolve on "
+        f"paddle_tpu nor appear in API_DEVIATIONS.md: {unrecorded[:15]}"
+    )
+
+
+def test_audit_covers_the_full_reference_surface(audit):
+    resolved, recorded, unrecorded = audit
+    total = len(resolved) + len(recorded) + len(unrecorded)
+    assert total > 900, total  # the reference spec has ~921 entries
+    # the deviations file must not swallow entries that actually resolve
+    # (a recorded name that now resolves should be deleted from the file)
+    import api_audit
+
+    stale = [n for n in api_audit.recorded_deviations()
+             if "." not in n and api_audit.resolves(n)]
+    assert not stale, f"API_DEVIATIONS.md records now-resolving names: {stale}"
