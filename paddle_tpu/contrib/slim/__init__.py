@@ -1,1 +1,3 @@
 from .quantization import quant_aware, post_training_quantize  # noqa: F401
+from .distillation import FSPDistiller, L2Distiller, SoftLabelDistiller  # noqa: F401
+from .prune import Pruner, StructurePruner, apply_masks, prune_parameters, sparsity  # noqa: F401

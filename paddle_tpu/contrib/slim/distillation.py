@@ -1,0 +1,74 @@
+"""Distillation (reference contrib/slim/distillation/distiller.py:
+L2Distiller, FSPDistiller, SoftLabelDistiller — each contributes a loss
+over (teacher, student) variable pairs in the merged graph).
+
+TPU-first shape: the reference merges two fluid graphs and renames teacher
+vars; here teacher and student are built in ONE program (teacher params
+frozen by excluding them from the optimizer's parameter_list or loading
+them with stop_gradient), and each distiller composes its loss from
+program ops — fsp uses the `fsp` op (reference fsp_op.cc)."""
+from __future__ import annotations
+
+from ... import layers
+
+
+class L2Distiller:
+    """reference distiller.py L2Distiller: mean-square error between a
+    teacher feature map and a student feature map."""
+
+    def __init__(self, student_feature_map, teacher_feature_map,
+                 distillation_loss_weight=1.0):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, student_var=None, teacher_var=None):
+        s = student_var if student_var is not None else self.student_feature_map
+        t = teacher_var if teacher_var is not None else self.teacher_feature_map
+        diff = s - t
+        return layers.reduce_mean(diff * diff) * self.weight
+
+
+class FSPDistiller:
+    """reference distiller.py FSPDistiller: L2 between teacher and student
+    flow-of-solution-procedure matrices of feature-map pairs."""
+
+    def __init__(self, student_pairs, teacher_pairs,
+                 distillation_loss_weight=1.0):
+        self.student_pairs = list(student_pairs)
+        self.teacher_pairs = list(teacher_pairs)
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self):
+        losses = []
+        for (s0, s1), (t0, t1) in zip(self.student_pairs, self.teacher_pairs):
+            sf = layers.fsp_matrix(s0, s1)
+            tf = layers.fsp_matrix(t0, t1)
+            diff = sf - tf
+            losses.append(layers.reduce_mean(diff * diff))
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        return total * self.weight
+
+
+class SoftLabelDistiller:
+    """reference distiller.py SoftLabelDistiller: cross entropy between
+    temperature-softened teacher and student logits."""
+
+    def __init__(self, student_feature_map=None, teacher_feature_map=None,
+                 student_temperature=1.0, teacher_temperature=1.0,
+                 distillation_loss_weight=1.0):
+        self.student_feature_map = student_feature_map
+        self.teacher_feature_map = teacher_feature_map
+        self.student_temperature = student_temperature
+        self.teacher_temperature = teacher_temperature
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, student_var=None, teacher_var=None):
+        s = student_var if student_var is not None else self.student_feature_map
+        t = teacher_var if teacher_var is not None else self.teacher_feature_map
+        s_soft = layers.softmax(s * (1.0 / self.student_temperature))
+        t_soft = layers.softmax(t * (1.0 / self.teacher_temperature))
+        ce = layers.cross_entropy(s_soft, t_soft, soft_label=True)
+        return layers.reduce_mean(ce) * self.weight

@@ -1,0 +1,97 @@
+"""Pruning (reference contrib/slim/prune/pruner.py Pruner/StructurePruner +
+prune_strategy.py SensitivePruneStrategy).
+
+TPU-first scope: XLA has no sparse kernels to exploit irregular zeros, so
+what pruning owns here is (a) the reference's group-selection math
+(StructurePruner.cal_pruned_idx over l1_norm groups, same contract) and
+(b) a mask-based prune-retrain loop over the Program: `prune_parameters`
+zeroes the selected groups in the scope and returns masks;
+`apply_masks_after_step` re-applies them after optimizer updates so
+retraining keeps the pruned structure (the reference's lazy-prune mode).
+Physically shrinking shapes (hard prune) is a deploy-time transform left
+to save-time slicing."""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class Pruner:
+    """reference prune/pruner.py Pruner base."""
+
+    def prune(self, param):
+        raise NotImplementedError
+
+
+class StructurePruner(Pruner):
+    """reference StructurePruner: group parameters along `pruning_axis`
+    and rank groups by `criterions` (l1_norm) for pruning."""
+
+    def __init__(self, pruning_axis: Optional[Dict[str, int]] = None,
+                 criterions: Optional[Dict[str, str]] = None):
+        self.pruning_axis = pruning_axis or {"*": 0}
+        self.criterions = criterions or {"*": "l1_norm"}
+
+    def _lookup(self, table, name):
+        return table.get(name, table.get("*"))
+
+    def cal_pruned_idx(self, name, param, ratio, axis=None):
+        """indices of the lowest-l1 groups on `axis` to reach `ratio`
+        (reference cal_pruned_idx)."""
+        if axis is None:
+            axis = self._lookup(self.pruning_axis, name)
+        criterion = self._lookup(self.criterions, name)
+        if criterion != "l1_norm":
+            raise ValueError(f"StructurePruner: unsupported criterion {criterion!r}")
+        prune_num = int(round(param.shape[axis] * ratio))
+        reduce_axes = tuple(i for i in range(param.ndim) if i != axis)
+        scores = np.sum(np.abs(param), axis=reduce_axes)
+        return np.argsort(scores)[:prune_num]
+
+    def prune_tensor(self, tensor, pruned_idx, pruned_axis, lazy=False):
+        """reference prune_tensor: lazy=True zeroes the groups in place,
+        lazy=False drops them (shape shrinks)."""
+        if lazy:
+            out = np.array(tensor)
+            sl = [slice(None)] * out.ndim
+            sl[pruned_axis] = pruned_idx
+            out[tuple(sl)] = 0.0
+            return out
+        return np.delete(tensor, pruned_idx, axis=pruned_axis)
+
+
+def prune_parameters(program, scope, params, ratios, pruner: Optional[StructurePruner] = None):
+    """Magnitude-prune named parameters in the scope (lazy/mask mode) and
+    return {param_name: mask} for retraining."""
+    pruner = pruner or StructurePruner()
+    masks = {}
+    for name, ratio in zip(params, ratios):
+        w = np.asarray(scope.find_var(name))
+        axis = pruner._lookup(pruner.pruning_axis, name)
+        idx = pruner.cal_pruned_idx(name, w, ratio, axis=axis)
+        pruned = pruner.prune_tensor(w, idx, axis, lazy=True)
+        mask = np.ones_like(w)
+        sl = [slice(None)] * w.ndim
+        sl[axis] = idx
+        mask[tuple(sl)] = 0.0
+        scope.set_var(name, pruned.astype(w.dtype))
+        masks[name] = mask
+    return masks
+
+
+def apply_masks(scope, masks):
+    """Re-zero pruned groups (call after each optimizer step so retraining
+    preserves the pruned structure)."""
+    for name, mask in masks.items():
+        w = np.asarray(scope.find_var(name))
+        scope.set_var(name, (w * mask).astype(w.dtype))
+
+
+def sparsity(scope, masks):
+    """Fraction of masked-out weights across the pruned params."""
+    zeros = total = 0
+    for name, mask in masks.items():
+        zeros += int((mask == 0).sum())
+        total += mask.size
+    return zeros / max(total, 1)

@@ -22,7 +22,8 @@ ACT_SLOT = {"conv2d": "Input", "depthwise_conv2d": "Input",
 
 def quant_aware(program, weight_bits: int = 8, activation_bits: int = 8,
                 quantizable_op_types: Optional[Iterable[str]] = None,
-                quantize_activations: bool = True):
+                quantize_activations: bool = True,
+                weight_quantize_type: str = "abs_max"):
     """QAT instrumentation: fake_quantize_abs_max on every quantizable op's
     weight (shared weights quantized once) and, when quantize_activations,
     fake_quantize_abs_max on its activation input — training sees the
@@ -37,16 +38,23 @@ def quant_aware(program, weight_bits: int = 8, activation_bits: int = 8,
     quantized_weights = {}  # shared weights -> existing @QUANT name
     quantized_acts = {}  # shared activation sources -> existing @QUANT name
 
-    def make_qop(src, bits):
+    if weight_quantize_type not in ("abs_max", "channel_wise_abs_max"):
+        raise ValueError(f"quant_aware: unknown weight_quantize_type "
+                         f"{weight_quantize_type!r}")
+    w_op_type = ("fake_channel_wise_quantize_abs_max"
+                 if weight_quantize_type == "channel_wise_abs_max"
+                 else "fake_quantize_abs_max")
+
+    def make_qop(src, bits, op_type="fake_quantize_abs_max", quant_axis=0):
         qname = f"{src}@QUANT"
         sname = f"{src}@QSCALE"
         v = block._find_var_recursive(src)
         block.create_var(qname, shape=getattr(v, "shape", None),
                          dtype=getattr(v, "dtype", "float32"))
-        block.create_var(sname, shape=(1,), dtype="float32")
-        return qname, Operator(block, "fake_quantize_abs_max", {"X": [src]},
+        block.create_var(sname, dtype="float32")
+        return qname, Operator(block, op_type, {"X": [src]},
                                {"Out": [qname], "OutScale": [sname]},
-                               {"bit_length": bits})
+                               {"bit_length": bits, "quant_axis": quant_axis})
 
     for op in block.ops:
         if op.type in targets:
@@ -56,7 +64,11 @@ def quant_aware(program, weight_bits: int = 8, activation_bits: int = 8,
                 if wname in quantized_weights:
                     op.inputs[WEIGHT_SLOT[op.type]] = [quantized_weights[wname]]
                 elif isinstance(block._find_var_recursive(wname), Parameter):
-                    qname, qop = make_qop(wname, weight_bits)
+                    # per-output-channel axis: conv filters are [O, I, kh, kw]
+                    # (axis 0); mul/matmul Y weights are [in, out] (axis 1) —
+                    # reference fake_quantize_op.cc quant_axis contract
+                    qaxis = 1 if op.type in ("mul", "matmul") else 0
+                    qname, qop = make_qop(wname, weight_bits, w_op_type, qaxis)
                     new_ops.append(qop)
                     quantized_weights[wname] = qname
                     op.inputs[WEIGHT_SLOT[op.type]] = [qname]

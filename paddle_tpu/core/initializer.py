@@ -149,15 +149,15 @@ class BilinearInitializer(Initializer):
     def _value(self, shape, dtype):
         import numpy as np
 
-        weight = np.zeros(shape, dtype="float32")
-        k = shape[-1]
-        f = int(np.ceil(k / 2.0))
+        # the value depends only on the last two axes: build one k x k tile
+        # and broadcast it (O(k^2), not O(prod(shape)))
+        kh, kw = shape[-2], shape[-1]
+        f = int(np.ceil(kw / 2.0))
         c = (2 * f - 1 - f % 2) / (2.0 * f)
-        for flat in range(int(np.prod(shape))):
-            idx = np.unravel_index(flat, shape)
-            x, y = idx[-1], idx[-2]
-            weight[idx] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
-        return weight.astype(dtype)
+        xs = 1 - np.abs(np.arange(kw) / f - c)
+        ys = 1 - np.abs(np.arange(kh) / f - c)
+        tile = np.outer(ys, xs).astype("float32")
+        return np.broadcast_to(tile, shape).astype(dtype).copy()
 
     def __call__(self, var, block):
         import numpy as np

@@ -195,7 +195,6 @@ def dice_loss(input, label, epsilon=1e-5):
 def npair_loss(anchor, positive, labels, l2_reg=0.002):
     """reference layers/nn.py npair_loss: composed cross-entropy over
     anchor @ positive^T similarity + l2 on embeddings."""
-    batch = anchor.shape[0]
     labels = _tensor.cast(_nn.reshape(labels, [-1, 1]), "float32")
     same = _tensor.cast(_eq_matrix(labels), "float32")
     norm = _nn.reduce_sum(same, dim=1, keep_dim=True)
@@ -203,8 +202,9 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
     sim = _nn.matmul(anchor, positive, transpose_y=True)
     ce = _nn.softmax_with_cross_entropy(sim, target, soft_label=True)
     celoss = _nn.reduce_mean(ce)
-    l2 = _nn.scale(_nn.reduce_sum(anchor * anchor + positive * positive),
-                   scale=l2_reg / max(batch, 1))
+    # batch-mean of per-row squared norms (robust to dynamic batch dim)
+    row_l2 = _nn.reduce_sum(anchor * anchor + positive * positive, dim=1)
+    l2 = _nn.scale(_nn.reduce_mean(row_l2), scale=l2_reg)
     return celoss + l2
 
 
@@ -268,6 +268,11 @@ def pad_constant_like(x, y, pad_value=0.0, name=None):
 def unstack(x, axis=0, num=None):
     helper = LayerHelper("unstack")
     n = num if num is not None else x.shape[axis]
+    if n is None or int(n) < 0:
+        raise ValueError(
+            f"unstack: dim {axis} is dynamic ({n}); pass num= explicitly "
+            "(reference raises the same)")
+    n = int(n)
     outs = [_out(helper, x.dtype) for _ in builtins.range(n)]
     helper.append_op("unstack", inputs={"X": [x.name]},
                      outputs={"Y": [o.name for o in outs]},
@@ -591,7 +596,18 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
     def _triple(v):
         return [v] * 3 if isinstance(v, int) else list(v)
 
-    fs = _triple(filter_size)
+    st = _triple(stride)
+    pd = _triple(padding)
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("conv3d_transpose: give filter_size or "
+                             "output_size")
+        # out = (in-1)*stride - 2*pad + filter  =>  solve for filter
+        osz = _triple(output_size)
+        fs = [osz[i] - (int(input.shape[2 + i]) - 1) * st[i] + 2 * pd[i]
+              for i in range(3)]
+    else:
+        fs = _triple(filter_size)
     num_channels = input.shape[1]
     w = helper.create_parameter(
         param_attr, [num_channels, num_filters // groups, fs[0], fs[1], fs[2]],
@@ -601,7 +617,7 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
         "conv3d_transpose",
         inputs={"Input": [input.name], "Filter": [w.name]},
         outputs={"Output": [pre_bias.name]},
-        attrs={"strides": _triple(stride), "paddings": _triple(padding),
+        attrs={"strides": st, "paddings": pd,
                "dilations": _triple(dilation), "groups": groups},
     )
     pre_act = helper.append_bias_op(pre_bias, bias_attr, [num_filters], dim_start=1)

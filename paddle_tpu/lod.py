@@ -87,9 +87,6 @@ class LoDTensor:
         self.sequences = [flat[offs[i]:offs[i + 1]]
                           for i in range(len(offs) - 1)]
 
-    def recursive_sequence_lengths(self):
-        return [[len(s) for s in self.sequences]]
-
     def set_recursive_sequence_lengths(self, lengths):
         flat = np.concatenate(self.sequences, axis=0)
         out, pos = [], 0

@@ -80,7 +80,6 @@ _UNARY = {
     "gelu": lambda x: jax.nn.gelu(x, approximate=False),
     "softplus": jax.nn.softplus,
     "softsign": jax.nn.soft_sign,
-    "softshrink": lambda x: jnp.where(x > 0.5, x - 0.5, jnp.where(x < -0.5, x + 0.5, 0.0)),
     "tanh_shrink": lambda x: x - jnp.tanh(x),
     "erf": jax.lax.erf,
     "sign": jnp.sign,
@@ -278,6 +277,15 @@ def _logical_not(ctx, op, ins):
     return {"Out": jnp.logical_not(first(ins, "X"))}
 
 
+@register_op("softshrink")
+def _softshrink(ctx, op, ins):
+    """reference activation_op.h SoftShrinkFunctor: threshold attr `lambda`."""
+    x = first(ins, "X")
+    lam = op.attr("lambda", 0.5)
+    return {"Out": jnp.where(x > lam, x - lam,
+                             jnp.where(x < -lam, x + lam, 0.0))}
+
+
 @register_op("isfinite")
 def _isfinite(ctx, op, ins):
     from ..core.selected_rows import SelectedRows
@@ -304,6 +312,25 @@ def _fake_quantize_abs_max(ctx, op, ins):
     # straight-through estimator: identity gradient
     out = x + jax.lax.stop_gradient(out - x)
     return {"Out": out, "OutScale": scale.reshape((1,))}
+
+
+@register_op("fake_channel_wise_quantize_abs_max")
+def _fake_channel_wise_quantize_abs_max(ctx, op, ins):
+    """reference fake_quantize_op.cc fake_channel_wise_quantize_abs_max:
+    per-output-channel (dim 0) symmetric abs-max grids — the conv/mul
+    weight quantization granularity int8 deployment actually uses."""
+    x = first(ins, "X")
+    bits = op.attr("bit_length", 8)
+    axis = op.attr("quant_axis", 0)  # conv filters: 0; mul/matmul Y: 1
+    qmax = float(2 ** (bits - 1) - 1)
+    moved = jnp.moveaxis(x, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    scale = jnp.max(jnp.abs(flat), axis=1)           # [C_out]
+    safe = jnp.maximum(scale, 1e-8).reshape((-1,) + (1,) * (x.ndim - 1))
+    q = jnp.round(moved / safe * qmax)
+    out = jnp.moveaxis(q * safe / qmax, 0, axis)
+    out = x + jax.lax.stop_gradient(out - x)         # STE
+    return {"Out": out, "OutScale": scale}
 
 
 @register_op("fake_quantize_moving_average_abs_max")

@@ -289,10 +289,14 @@ def _range(ctx, op, ins):
     st = op.attr("step_v", None)
     dtype = op.attr("dtype", None)
     out_dtype = np_dtype(dtype) if dtype else None
-    if s is not None:
+    if s is not None and e is not None and st is not None:
         fallback = start.dtype if start is not None else jnp.int32
         return {"Out": jnp.arange(s, e, st, dtype=out_dtype or fallback)}
-    out = jnp.arange(int(start), int(end), int(step))
+    # mixed scalar/tensor operands: resolve each from attr or input
+    sv = s if s is not None else int(start)
+    ev = e if e is not None else int(end)
+    stv = st if st is not None else int(step)
+    out = jnp.arange(sv, ev, stv)
     return {"Out": out.astype(out_dtype) if out_dtype else out}
 
 
