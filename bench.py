@@ -56,7 +56,7 @@ def _spread(ws):
     return round((max(ws) - min(ws)) / statistics.median(ws) * 100, 1)
 
 
-def bench_resnet50(batch_size=128, K=8, iters=4):
+def bench_resnet50(batch_size=256, K=4, iters=4):
     import jax
     import jax.numpy as jnp
 

@@ -1,0 +1,49 @@
+"""ResNet-50 batch-size A/B on the real chip: does bs256/bs64 change
+per-image throughput vs the bench's bs128?  Interleaved protocol
+(tools/opbench.interleave)."""
+import sys
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.models import resnet
+from tools.opbench import interleave
+
+
+def make(bs, K=4):
+    main, startup, feeds, fetches = resnet.build(
+        dtype="bfloat16", class_dim=1000, learning_rate=0.1,
+        with_optimizer=True, stem="space_to_depth")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    dev = fluid.TPUPlace(0).jax_device()
+    feed = {
+        "img": jax.device_put(jnp.asarray(rng.rand(K, bs, 3, 224, 224), jnp.float32), dev),
+        "label": jax.device_put(jnp.asarray(rng.randint(0, 1000, (K, bs, 1)), jnp.int32), dev),
+    }
+    loss_name = fetches["loss"].name
+
+    def dispatch():
+        return exe.run(main, feed=feed, fetch_list=[loss_name], scope=scope,
+                       steps=K, return_numpy=False)
+
+    return dispatch, bs, K
+
+
+variants = {}
+for bs in (64, 128, 256):
+    d, b, K = make(bs)
+    variants[f"bs{bs}"] = d
+
+stats = interleave(variants, rounds=4, iters=3)
+for name, st in stats.items():
+    bs = int(name[2:])
+    K = 4
+    step_ms = st["best_ms"] / K
+    print(f"{name}: step {step_ms:.2f} ms  {bs / (step_ms / 1e3):.0f} imgs/s  "
+          f"(median {st['median_ms']/K:.2f}, spread {st['spread_pct']}%)")
