@@ -329,3 +329,114 @@ def detection_map(detect_res, label, class_num, background_label=0,
                "ap_type": ap_version},
     )
     return out
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True, sample_size=None,
+             gt_lengths=None):
+    """SSD multibox loss (reference layers/detection.py:1242).  Dense gt:
+    gt_box [N, B, 4] padded + gt_lengths [N]; returns [N, 1] loss."""
+    if mining_type != "max_negative":
+        raise ValueError("Only support mining_type == max_negative now.")
+    helper = LayerHelper("ssd_loss")
+    out = _out(helper, location.dtype)
+    inputs = {"Location": [location.name], "Confidence": [confidence.name],
+              "GtBox": [gt_box.name], "GtLabel": [gt_label.name],
+              "PriorBox": [prior_box.name]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var.name]
+    if gt_lengths is not None:
+        inputs["GtLod"] = [gt_lengths.name]
+    helper.append_op(
+        "ssd_loss", inputs=inputs, outputs={"Loss": [out.name]},
+        attrs={"background_label": background_label,
+               "overlap_threshold": overlap_threshold,
+               "neg_pos_ratio": neg_pos_ratio, "neg_overlap": neg_overlap,
+               "loc_loss_weight": loc_loss_weight,
+               "conf_loss_weight": conf_loss_weight, "normalize": normalize,
+               "match_type": match_type},
+    )
+    return out
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head (reference layers/detection.py multi_box_head):
+    per-feature-map prior_box + loc/conf conv branches, concatenated to
+    [N, num_priors, 4] / [N, num_priors, num_classes] plus the stacked
+    priors/variances."""
+    from . import nn as _nn
+    from . import tensor as _tensor
+
+    n_layer = len(inputs)
+    if min_sizes is None:
+        assert min_ratio is not None and max_ratio is not None
+        min_sizes, max_sizes = [], []
+        step = int((max_ratio - min_ratio) / (n_layer - 2)) if n_layer > 2 else 0
+        for ratio in range(min_ratio, max_ratio + 1, max(step, 1)):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes[:n_layer - 1]
+        max_sizes = [base_size * 0.20] + max_sizes[:n_layer - 1]
+
+    locs, confs, boxes_l, vars_l = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ms = min_sizes[i]
+        ms_list = ms if isinstance(ms, (list, tuple)) else [ms]
+        mx = None
+        if max_sizes:
+            mxi = max_sizes[i]
+            mx = mxi if isinstance(mxi, (list, tuple)) else [mxi]
+        ar = aspect_ratios[i]
+        ar = ar if isinstance(ar, (list, tuple)) else [ar]
+        st = steps[i] if steps else (step_w[i] if step_w else 0.0,
+                                     step_h[i] if step_h else 0.0)
+        st = st if isinstance(st, (list, tuple)) else (st, st)
+        box, var = prior_box(feat, image, ms_list, mx, ar, variance, flip,
+                             clip, (float(st[0]), float(st[1])), offset,
+                             min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+        boxes_l.append(_nn.reshape(box, [-1, 4]))
+        vars_l.append(_nn.reshape(var, [-1, 4]))
+        from ..ops.detection_ops import expand_aspect_ratios
+
+        npriors = (len(ms_list) * len(expand_aspect_ratios(ar, flip))
+                   + (len(mx) if mx else 0))
+        loc = _nn.conv2d(feat, npriors * 4, kernel_size, padding=pad,
+                         stride=stride)
+        loc = _nn.transpose(loc, [0, 2, 3, 1])
+        locs.append(_nn.reshape(loc, [0, -1, 4]))
+        cnf = _nn.conv2d(feat, npriors * num_classes, kernel_size,
+                         padding=pad, stride=stride)
+        cnf = _nn.transpose(cnf, [0, 2, 3, 1])
+        confs.append(_nn.reshape(cnf, [0, -1, num_classes]))
+
+    mbox_locs = _tensor.concat(locs, axis=1)
+    mbox_confs = _tensor.concat(confs, axis=1)
+    boxes = _tensor.concat(boxes_l, axis=0)
+    variances = _tensor.concat(vars_l, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """SSD inference head (reference layers/detection.py:440): decode loc
+    deltas against priors, softmax scores, multiclass NMS.  Static-shape
+    output: [N, keep_top_k, 6] padded (label -1 empty slots)."""
+    from . import nn as _nn
+
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    probs = _nn.softmax(scores)             # [N, P, C]
+    probs_t = _nn.transpose(probs, [0, 2, 1])  # [N, C, P]
+    return multiclass_nms(decoded, probs_t, score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold, nms_eta=nms_eta,
+                          background_label=background_label)

@@ -492,3 +492,47 @@ def expand(x, expand_times, name=None):
     helper.append_op("expand", inputs={"X": [x.name]}, outputs={"Out": [out.name]},
                      attrs={"expand_times": [int(t) for t in expand_times]})
     return out
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """reference layers/nn.py ctc_greedy_decoder.  Ragged [*, C] input ->
+    ragged decoded int tokens (padded carrier + lengths companion)."""
+    from ..core.layer_helper import LayerHelper
+    from .sequence import _lod_of, _set_lod
+
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    lod = _lod_of(input)
+    out = helper.create_variable_for_type_inference("int32")
+    out_lod = helper.create_variable_for_type_inference("int32")
+    helper.append_op("ctc_greedy_decoder",
+                     inputs={"Input": [input.name], "XLod": [lod.name]},
+                     outputs={"Out": [out.name], "OutLod": [out_lod.name]},
+                     attrs={"blank": blank})
+    _set_lod(out, out_lod)
+    return out
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_info=None):
+    """reference layers/nn.py chunk_eval.  Ragged int tag sequences ->
+    (precision, recall, f1, num_infer, num_label, num_correct)."""
+    from ..core.layer_helper import LayerHelper
+    from .sequence import _lod_of
+
+    helper = LayerHelper("chunk_eval")
+    lod = _lod_of(input)
+    outs = [helper.create_variable_for_type_inference(dt)
+            for dt in ("float32", "float32", "float32", "int32", "int32", "int32")]
+    helper.append_op(
+        "chunk_eval",
+        inputs={"Inference": [input.name], "Label": [label.name],
+                "XLod": [lod.name]},
+        outputs={"Precision": [outs[0].name], "Recall": [outs[1].name],
+                 "F1-Score": [outs[2].name], "NumInferChunks": [outs[3].name],
+                 "NumLabelChunks": [outs[4].name],
+                 "NumCorrectChunks": [outs[5].name]},
+        attrs={"chunk_scheme": chunk_scheme,
+               "num_chunk_types": num_chunk_types,
+               "excluded_chunk_types": list(excluded_chunk_types or [])},
+    )
+    return tuple(outs)

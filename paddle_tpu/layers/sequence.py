@@ -583,3 +583,79 @@ def crf_decoding(input, param_attr, label=None):
                      outputs={"ViterbiPath": [out.name]})
     _set_lod(out, in_lod)
     return out
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None):
+    """Projection LSTM (reference layers/nn.py dynamic_lstmp over
+    lstmp_op.cc).  `input` ragged [*, 4D]; returns (projection [*, P],
+    cell [*, D]); the projection activation (reference default 'tanh',
+    lstmp_op.h) is applied to h @ W_proj inside the recurrence."""
+    if gate_activation != "sigmoid" or cell_activation != "tanh" or \
+            candidate_activation != "tanh":
+        raise NotImplementedError("dynamic_lstmp: only the default activations")
+    if proj_activation not in ("tanh", "sigmoid", "relu", "identity"):
+        raise NotImplementedError(
+            f"dynamic_lstmp: proj_activation {proj_activation!r}")
+    helper = LayerHelper("dynamic_lstmp", name=name)
+    hidden = size // 4
+    lod = _lod_of(input)
+    weight = helper.create_parameter(param_attr, [proj_size, 4 * hidden], dtype)
+    proj_weight = helper.create_parameter(param_attr, [hidden, proj_size], dtype)
+    bias_size = [1, 7 * hidden] if use_peepholes else [1, 4 * hidden]
+    bias = helper.create_parameter(bias_attr, bias_size, dtype, is_bias=True)
+    pshape = cshape = None
+    if input.shape is not None:
+        pshape = (input.shape[0], input.shape[1], proj_size)
+        cshape = (input.shape[0], input.shape[1], hidden)
+    proj_out = helper.create_variable_for_type_inference(dtype, shape=pshape)
+    cell_out = helper.create_variable_for_type_inference(dtype, shape=cshape)
+    helper.append_op(
+        "dynamic_lstmp",
+        inputs={"Input": [input.name], "XLod": [lod.name],
+                "Weight": [weight.name], "ProjWeight": [proj_weight.name],
+                "Bias": [bias.name]},
+        outputs={"Projection": [proj_out.name], "Cell": [cell_out.name]},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "proj_activation": proj_activation},
+    )
+    _set_lod(proj_out, lod)
+    _set_lod(cell_out, lod)
+    return proj_out, cell_out
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """Multi-layer LSTM over dense [b, T, I] input (reference layers/nn.py
+    lstm over cudnn_lstm_op).  Returns (rnn_out [b, T, D*dirs],
+    last_h [L*dirs, b, D], last_c [L*dirs, b, D]).  The flat weight layout
+    is documented in the cudnn_lstm lowering (per layer+direction:
+    Wx, Wh, bx, bh; gates i,f,c,o)."""
+    helper = LayerHelper("lstm", name=name)
+    dirs = 2 if is_bidirec else 1
+    I = int(input.shape[-1])
+    D = hidden_size
+    total = 0
+    for layer in range(num_layers):
+        in_dim = I if layer == 0 else D * dirs
+        total += dirs * (4 * D * in_dim + 4 * D * D + 8 * D)
+    w = helper.create_parameter(None, [total], input.dtype,
+                                default_initializer=default_initializer)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    last_h = helper.create_variable_for_type_inference(input.dtype)
+    last_c = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "cudnn_lstm",
+        inputs={"Input": [input.name], "W": [w.name],
+                "InitH": [init_h.name], "InitC": [init_c.name]},
+        outputs={"Out": [out.name], "LastH": [last_h.name],
+                 "LastC": [last_c.name]},
+        attrs={"hidden_size": hidden_size, "num_layers": num_layers,
+               "is_bidirec": is_bidirec, "dropout_prob": dropout_prob,
+               "is_test": is_test},
+    )
+    return out, last_h, last_c

