@@ -374,10 +374,16 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
     priors/variances."""
     from . import nn as _nn
     from . import tensor as _tensor
+    from ..ops.detection_ops import expand_aspect_ratios
 
     n_layer = len(inputs)
     if min_sizes is None:
         assert min_ratio is not None and max_ratio is not None
+        if n_layer < 3:
+            raise ValueError(
+                "multi_box_head: ratio-based sizing needs >= 3 feature maps "
+                "(the reference divides by num_layer - 2); pass min_sizes/"
+                "max_sizes explicitly for fewer")
         min_sizes, max_sizes = [], []
         step = int((max_ratio - min_ratio) / (n_layer - 2)) if n_layer > 2 else 0
         for ratio in range(min_ratio, max_ratio + 1, max(step, 1)):
@@ -404,8 +410,6 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
                              min_max_aspect_ratios_order=min_max_aspect_ratios_order)
         boxes_l.append(_nn.reshape(box, [-1, 4]))
         vars_l.append(_nn.reshape(var, [-1, 4]))
-        from ..ops.detection_ops import expand_aspect_ratios
-
         npriors = (len(ms_list) * len(expand_aspect_ratios(ar, flip))
                    + (len(mx) if mx else 0))
         loc = _nn.conv2d(feat, npriors * 4, kernel_size, padding=pad,
@@ -440,3 +444,21 @@ def detection_output(loc, scores, prior_box, prior_box_var,
                           nms_top_k=nms_top_k, keep_top_k=keep_top_k,
                           nms_threshold=nms_threshold, nms_eta=nms_eta,
                           background_label=background_label)
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_batch=None, name=None):
+    """Position-sensitive RoI pool (reference layers/nn.py psroi_pool);
+    dense [R, 4] rois + optional batch-index vector."""
+    helper = LayerHelper("psroi_pool", name=name)
+    out = _out(helper, input.dtype)
+    inputs = {"X": [input.name], "ROIs": [rois.name]}
+    if rois_batch is not None:
+        inputs["RoisBatch"] = [rois_batch.name]
+    helper.append_op(
+        "psroi_pool", inputs=inputs, outputs={"Out": [out.name]},
+        attrs={"output_channels": output_channels,
+               "spatial_scale": spatial_scale,
+               "pooled_height": pooled_height, "pooled_width": pooled_width},
+    )
+    return out

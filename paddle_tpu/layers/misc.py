@@ -497,7 +497,6 @@ def expand(x, expand_times, name=None):
 def ctc_greedy_decoder(input, blank, name=None):
     """reference layers/nn.py ctc_greedy_decoder.  Ragged [*, C] input ->
     ragged decoded int tokens (padded carrier + lengths companion)."""
-    from ..core.layer_helper import LayerHelper
     from .sequence import _lod_of, _set_lod
 
     helper = LayerHelper("ctc_greedy_decoder", name=name)
@@ -515,12 +514,12 @@ def ctc_greedy_decoder(input, blank, name=None):
 def chunk_eval(input, label, chunk_scheme, num_chunk_types,
                excluded_chunk_types=None, seq_info=None):
     """reference layers/nn.py chunk_eval.  Ragged int tag sequences ->
-    (precision, recall, f1, num_infer, num_label, num_correct)."""
-    from ..core.layer_helper import LayerHelper
+    (precision, recall, f1, num_infer, num_label, num_correct); padded
+    dense inputs may pass their lengths vector as seq_info instead."""
     from .sequence import _lod_of
 
     helper = LayerHelper("chunk_eval")
-    lod = _lod_of(input)
+    lod = seq_info if seq_info is not None else _lod_of(input)
     outs = [helper.create_variable_for_type_inference(dt)
             for dt in ("float32", "float32", "float32", "int32", "int32", "int32")]
     helper.append_op(
@@ -536,3 +535,39 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,
                "excluded_chunk_types": list(excluded_chunk_types or [])},
     )
     return tuple(outs)
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """reference layers/nn.py sampled_softmax_with_cross_entropy: sample
+    classes (log-uniform), correct the sampled logits, regular softmax CE
+    over the sampled set.  Returns [N, 1] loss."""
+    if use_customized_samples:
+        raise NotImplementedError(
+            "sampled_softmax_with_cross_entropy: customized_samples")
+    if num_true != 1:
+        raise NotImplementedError(
+            "sampled_softmax_with_cross_entropy: num_true > 1 (the final "
+            "hard-label CE indexes one true column per row)")
+    from . import nn as _nn
+
+    helper = LayerHelper("sample_logits")
+    sampled = helper.create_variable_for_type_inference(logits.dtype)
+    sampled_labels = helper.create_variable_for_type_inference("int32")
+    samples = helper.create_variable_for_type_inference("int32")
+    probs = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "sample_logits",
+        inputs={"Logits": [logits.name], "Labels": [label.name]},
+        outputs={"SampledLogits": [sampled.name],
+                 "SampledLabels": [sampled_labels.name],
+                 "Samples": [samples.name], "Probabilities": [probs.name]},
+        attrs={"num_samples": num_samples,
+               "remove_accidental_hits": remove_accidental_hits,
+               "uniq": True},
+    )
+    return _nn.softmax_with_cross_entropy(sampled, sampled_labels)

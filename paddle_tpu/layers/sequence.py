@@ -659,3 +659,18 @@ def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
                "is_test": is_test},
     )
     return out, last_h, last_c
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """reference layers/nn.py sequence_scatter: add ragged per-row updates
+    into the dense input at ragged column indices."""
+    helper = LayerHelper("sequence_scatter", name=name)
+    idx_lod = _lod_of(index)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "sequence_scatter",
+        inputs={"X": [input.name], "Ids": [index.name],
+                "IdsLod": [idx_lod.name], "Updates": [updates.name]},
+        outputs={"Out": [out.name]},
+    )
+    return out

@@ -1084,13 +1084,18 @@ def _ssd_loss(ctx, op, ins):
         logp = jax.nn.log_softmax(cf, axis=-1)
         ce = -jnp.take_along_axis(logp, tgt_label[:, None], axis=1)[:, 0]  # [P]
 
-        # max_negative mining: unmatched priors ranked by conf CE desc
+        # max_negative mining (reference mine_hard_examples_op.h): candidate
+        # = unmatched AND match_dist < neg_dist_threshold (dist is 0 for
+        # unmatched columns, so the guard is literal reference semantics),
+        # ranked by conf CE desc
+        neg_overlap = op.attr("neg_overlap", 0.5)
+        cand_neg = ~matched & (dist < neg_overlap)
         npos = jnp.sum(matched)
         n_neg = (neg_ratio * npos).astype(jnp.int32)
-        neg_score = jnp.where(~matched, jax.lax.stop_gradient(ce), -jnp.inf)
+        neg_score = jnp.where(cand_neg, jax.lax.stop_gradient(ce), -jnp.inf)
         order = jnp.argsort(-neg_score)
         rank = jnp.zeros((P,), jnp.int32).at[order].set(jnp.arange(P, dtype=jnp.int32))
-        neg = ~matched & (rank < n_neg)
+        neg = cand_neg & (rank < n_neg)
 
         # regression targets: encode matched gt against priors with variance
         gsel = g[safe]
@@ -1116,3 +1121,45 @@ def _ssd_loss(ctx, op, ins):
     if op.attr("normalize", True):
         losses = losses / jnp.maximum(jnp.sum(npos).astype(jnp.float32), 1.0)
     return {"Loss": losses.reshape(N, 1)}
+
+
+@register_op("psroi_pool")
+def _psroi_pool(ctx, op, ins):
+    """Position-sensitive RoI average pool (reference psroi_pool_op.h):
+    input channel (c*PH+ph)*PW+pw feeds output bin (c, ph, pw); float bin
+    edges floor/ceil'd and clipped, empty bins -> 0.  Dense [R, 4] rois +
+    RoisBatch vector (static-shape form, as roi_pool/roi_align)."""
+    x = first(ins, "X")                    # [N, C_in, H, W]
+    rois = first(ins, "ROIs").astype(jnp.float32)
+    batch_idx = ins.get("RoisBatch")
+    batch_idx = (batch_idx[0].reshape(-1).astype(jnp.int32)
+                 if batch_idx else jnp.zeros((rois.shape[0],), jnp.int32))
+    oc = op.attr("output_channels")
+    ph = op.attr("pooled_height", 1)
+    pw = op.attr("pooled_width", 1)
+    scale = op.attr("spatial_scale", 1.0)
+    N, C_in, H, W = x.shape
+
+    def one_roi(roi, bi):
+        v = x[bi].astype(jnp.float32).reshape(oc, ph, pw, H, W)
+        x0 = jnp.round(roi[0]) * scale
+        y0 = jnp.round(roi[1]) * scale
+        x1 = (jnp.round(roi[2]) + 1.0) * scale
+        y1 = (jnp.round(roi[3]) + 1.0) * scale
+        rh = jnp.maximum(y1 - y0, 0.1)
+        rw = jnp.maximum(x1 - x0, 0.1)
+        bh, bw = rh / ph, rw / pw
+        hs = jnp.clip(jnp.floor(jnp.arange(ph) * bh + y0), 0, H)
+        he = jnp.clip(jnp.ceil((jnp.arange(ph) + 1) * bh + y0), 0, H)
+        ws = jnp.clip(jnp.floor(jnp.arange(pw) * bw + x0), 0, W)
+        we = jnp.clip(jnp.ceil((jnp.arange(pw) + 1) * bw + x0), 0, W)
+        mh = ((jnp.arange(H)[None, :] >= hs[:, None])
+              & (jnp.arange(H)[None, :] < he[:, None])).astype(jnp.float32)
+        mw = ((jnp.arange(W)[None, :] >= ws[:, None])
+              & (jnp.arange(W)[None, :] < we[:, None])).astype(jnp.float32)
+        s = jnp.einsum("cpqhw,ph,qw->cpq", v, mh, mw)
+        area = (he - hs)[:, None] * (we - ws)[None, :]
+        return jnp.where(area > 0, s / jnp.maximum(area, 1.0), 0.0)
+
+    out = jax.vmap(one_roi)(rois, batch_idx)
+    return {"Out": out.astype(x.dtype)}

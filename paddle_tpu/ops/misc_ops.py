@@ -719,3 +719,41 @@ def _chunk_eval(ctx, op, ins):
     return {"Precision": p.reshape(1), "Recall": r.reshape(1),
             "F1-Score": f1.reshape(1), "NumInferChunks": ni.reshape(1),
             "NumLabelChunks": nl.reshape(1), "NumCorrectChunks": nc.reshape(1)}
+
+
+@register_op("sample_logits")
+def _sample_logits(ctx, op, ins):
+    """Sampled softmax (reference sample_logits_op.cc, the kernel behind
+    layers.sampled_softmax_with_cross_entropy): per row, unite the true
+    labels with log-uniform negative samples, adjust each sampled logit by
+    -log(expected_probability) (the sampled-softmax correction), mask
+    accidental hits, and return the sampled logits + the in-sample label
+    positions for a regular softmax CE."""
+    logits = first(ins, "Logits")        # [B, C]
+    label = first(ins, "Labels").astype(jnp.int32)  # [B, num_true]
+    num_samples = op.attr("num_samples")
+    remove_accidental = op.attr("remove_accidental_hits", True)
+    B, C = logits.shape
+    num_true = label.shape[1] if label.ndim > 1 else 1
+    label = label.reshape(B, num_true)
+
+    # log-uniform sampling (same inverse-CDF trick as the nce lowering)
+    u = jax.random.uniform(ctx.next_key(), (B, num_samples))
+    rng_range = C - 1
+    negs = jnp.floor(jnp.exp(u * np.log(rng_range + 2.0)) - 1.0).astype(jnp.int32)
+    negs = jnp.clip(negs, 0, rng_range)
+    samples = jnp.concatenate([label, negs], axis=1)      # [B, T+S]
+
+    q = (jnp.log((samples + 2.0) / (samples + 1.0)) / np.log(rng_range + 2.0))
+    sampled = jnp.take_along_axis(logits, samples, axis=1)
+    sampled = sampled - jnp.log(jnp.maximum(q * num_samples, 1e-20))
+    if remove_accidental:
+        # a negative that equals one of the row's true labels is removed
+        acc = (negs[:, :, None] == label[:, None, :]).any(-1)  # [B, S]
+        mask = jnp.concatenate(
+            [jnp.zeros((B, num_true), bool), acc], axis=1)
+        sampled = jnp.where(mask, -1e20, sampled)
+    pos = jnp.broadcast_to(jnp.arange(num_true, dtype=jnp.int32)[None, :],
+                           (B, num_true))
+    return {"SampledLogits": sampled, "SampledLabels": pos,
+            "Samples": samples, "Probabilities": q}

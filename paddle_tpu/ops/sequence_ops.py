@@ -766,3 +766,27 @@ def _cudnn_lstm(ctx, op, ins):
             mask = jax.random.bernoulli(ctx.next_key(), keep, out.shape)
             out = jnp.where(mask, out / keep, 0.0)
     return {"Out": out, "LastH": jnp.stack(last_h), "LastC": jnp.stack(last_c)}
+
+
+@register_op("sequence_scatter")
+def _sequence_scatter(ctx, op, ins):
+    """reference sequence_scatter_op.h: per batch row i, Out[i] = X[i] with
+    Updates[i] ADDED at column positions Ids[i] (LoD-aligned rows).  Padded
+    form: Ids [b, L] + IdsLod lens, Updates [b, L] + same lens; padding
+    slots are routed to a dropped dummy column."""
+    x = first(ins, "X")                 # [b, D]
+    ids = first(ins, "Ids").astype(jnp.int32)
+    if ids.ndim == 3:
+        ids = ids[..., 0]
+    upd = first(ins, "Updates")
+    if upd.ndim == 3 and upd.shape[-1] == 1:
+        upd = upd[..., 0]
+    lens = first(ins, "IdsLod")
+    b, D = x.shape
+    L = ids.shape[1]
+    valid = jnp.arange(L)[None, :] < lens[:, None]
+    padded = jnp.concatenate([x, jnp.zeros((b, 1), x.dtype)], axis=1)
+    tgt = jnp.where(valid, ids, D)  # dummy column for padding
+    bi = jnp.arange(b)[:, None]
+    out = padded.at[bi, tgt].add(jnp.where(valid, upd, 0).astype(x.dtype))
+    return {"Out": out[:, :D]}

@@ -713,3 +713,94 @@ def test_lstm_multilayer_bidirectional():
     assert np.asarray(h).shape == (2 * L, b, D)
     (l2,) = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
     assert np.isfinite(np.asarray(l2)).all()
+
+
+def test_psroi_pool_golden():
+    rng = np.random.RandomState(6)
+    # C_in = oc * ph * pw = 2 * 2 * 2 = 8
+    x = rng.randn(1, 8, 6, 6).astype("f4")
+    rois = np.array([[0, 0, 5, 5]], "f4")
+
+    def np_psroi(x, roi, oc, PH, PW, scale):
+        _, C, H, W = x.shape
+        out = np.zeros((oc, PH, PW), "f8")
+        x0, y0 = round(roi[0]) * scale, round(roi[1]) * scale
+        x1, y1 = (round(roi[2]) + 1) * scale, (round(roi[3]) + 1) * scale
+        rh, rw = max(y1 - y0, 0.1), max(x1 - x0, 0.1)
+        bh, bw = rh / PH, rw / PW
+        for c in range(oc):
+            for ph in range(PH):
+                for pw in range(PW):
+                    hs = min(max(int(np.floor(ph * bh + y0)), 0), H)
+                    he = min(max(int(np.ceil((ph + 1) * bh + y0)), 0), H)
+                    ws = min(max(int(np.floor(pw * bw + x0)), 0), W)
+                    we = min(max(int(np.ceil((pw + 1) * bw + x0)), 0), W)
+                    ch = (c * PH + ph) * PW + pw
+                    if he <= hs or we <= ws:
+                        continue
+                    out[c, ph, pw] = x[0, ch, hs:he, ws:we].mean()
+        return out
+
+    main, startup = fluid.Program(), fluid.Program()
+    with program_guard(main, startup):
+        xv = fluid.layers.data("x", [8, 6, 6], dtype="float32")
+        rv = fluid.layers.data("r", [4], dtype="float32")
+        out = fluid.layers.psroi_pool(xv, rv, 2, 1.0, 2, 2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    (got,) = exe.run(main, feed={"x": x, "r": rois}, fetch_list=[out],
+                     scope=scope)
+    np.testing.assert_allclose(np.asarray(got)[0],
+                               np_psroi(x, rois[0], 2, 2, 2, 1.0),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_scatter_golden():
+    from paddle_tpu import LoDTensor
+
+    x = np.ones((2, 6), "f4")
+    ids = [np.array([[1], [3], [1]], "int64"), np.array([[0]], "int64")]
+    upd = [np.array([[1.0], [2.0], [3.0]], "f4"), np.array([[5.0]], "f4")]
+    main, startup = fluid.Program(), fluid.Program()
+    with program_guard(main, startup):
+        xv = fluid.layers.data("x", [6], dtype="float32")
+        iv = fluid.layers.data("i", [1], dtype="int64", lod_level=1)
+        uv = fluid.layers.data("u", [1], dtype="float32", lod_level=1)
+        out = fluid.layers.sequence_scatter(xv, iv, uv)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    (got,) = exe.run(main, feed={"x": x, "i": LoDTensor(ids),
+                                 "u": LoDTensor(upd)},
+                     fetch_list=[out], scope=scope)
+    got = np.asarray(got)
+    # row 0: +1 and +3 at col 1, +2 at col 3; row 1: +5 at col 0
+    np.testing.assert_allclose(got[0], [1, 5, 1, 3, 1, 1])
+    np.testing.assert_allclose(got[1], [6, 1, 1, 1, 1, 1])
+
+
+def test_sampled_softmax_trains():
+    rng = np.random.RandomState(8)
+    C = 500
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 5
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", [16], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="int64")
+        logits = fluid.layers.fc(x, C)
+        loss = fluid.layers.mean(
+            fluid.layers.sampled_softmax_with_cross_entropy(logits, y, 20))
+        fluid.optimizer.Adam(0.02).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    yv = rng.randint(0, 8, (32, 1)).astype("int64")  # 8 live classes
+    xv = np.zeros((32, 16), "f4")
+    xv[np.arange(32), yv[:, 0]] = 2.0
+    losses = []
+    for _ in range(50):
+        (lv,) = exe.run(main, feed={"x": xv, "y": yv}, fetch_list=[loss],
+                        scope=scope)
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
