@@ -385,7 +385,7 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
                 "(the reference divides by num_layer - 2); pass min_sizes/"
                 "max_sizes explicitly for fewer")
         min_sizes, max_sizes = [], []
-        step = int((max_ratio - min_ratio) / (n_layer - 2)) if n_layer > 2 else 0
+        step = int((max_ratio - min_ratio) / (n_layer - 2))
         for ratio in range(min_ratio, max_ratio + 1, max(step, 1)):
             min_sizes.append(base_size * ratio / 100.0)
             max_sizes.append(base_size * (ratio + step) / 100.0)
@@ -462,3 +462,66 @@ def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
                "pooled_height": pooled_height, "pooled_width": pooled_width},
     )
     return out
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd=None, im_info=None,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4, gt_lengths=None):
+    """RetinaNet target assignment (reference layers/detection.py:63).
+
+    STATIC-SHAPE form (same deviation as rpn_target_assign): returns
+    (predicted_scores, predicted_location, target_label, target_bbox,
+    bbox_inside_weight, fg_num, score_weight) spanning all anchors —
+    target_label holds the gt class (0 background, -1 ignored), fg_num is
+    the per-image foreground count + 1 (the reference's focal-loss
+    normalizer)."""
+    helper = LayerHelper("retinanet_target_assign")
+    label = _out(helper, "int32")
+    score_w = _out(helper, "float32")
+    tgt = _out(helper, anchor_box.dtype)
+    inw = _out(helper, anchor_box.dtype)
+    fg_num = _out(helper, "int32")
+    inputs = {"Anchor": [anchor_box.name], "GtBoxes": [gt_boxes.name],
+              "GtLabels": [gt_labels.name]}
+    if is_crowd is not None:
+        inputs["IsCrowd"] = [is_crowd.name]
+    if gt_lengths is not None:
+        inputs["GtLod"] = [gt_lengths.name]
+    helper.append_op(
+        "retinanet_target_assign", inputs=inputs,
+        outputs={"TargetLabel": [label.name], "ScoreWeight": [score_w.name],
+                 "TargetBBox": [tgt.name], "BBoxInsideWeight": [inw.name],
+                 "FgNum": [fg_num.name]},
+        attrs={"positive_overlap": positive_overlap,
+               "negative_overlap": negative_overlap},
+    )
+    return cls_logits, bbox_pred, label, tgt, inw, fg_num, score_w
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """RetinaNet inference head (reference layers/detection.py
+    retinanet_detection_output / retinanet_detection_output_op.cc): per-FPN-
+    level deltas decode against their anchors, sigmoid scores, class-wise
+    NMS across levels.  Static-shape [N, keep_top_k, 6] output block.
+    `bboxes`/`scores`: lists of [N, Ai, 4] / [N, Ai, C]; `anchors`: list of
+    [Ai, 4] pixel-space anchors."""
+    from . import nn as _nn
+    from . import tensor as _tensor
+
+    box_all = _tensor.concat(bboxes, axis=1) if len(bboxes) > 1 else bboxes[0]
+    score_all = _tensor.concat(scores, axis=1) if len(scores) > 1 else scores[0]
+    anchor_all = (_tensor.concat(anchors, axis=0) if len(anchors) > 1
+                  else anchors[0])
+    decoded = box_coder(anchor_all, None, box_all,
+                        code_type="decode_center_size", box_normalized=False)
+    decoded = box_clip(decoded, im_info)
+    probs = _nn.sigmoid(score_all)              # [N, P, C]
+    probs_t = _nn.transpose(probs, [0, 2, 1])   # [N, C, P]
+    return multiclass_nms(decoded, probs_t, score_threshold=score_threshold,
+                          nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                          nms_threshold=nms_threshold, nms_eta=nms_eta,
+                          background_label=-1, normalized=False)

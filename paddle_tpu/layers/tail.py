@@ -462,8 +462,9 @@ def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
                                   "output) is not implemented")
     helper = LayerHelper("adaptive_pool2d", name=name)
     ps = [pool_size, pool_size] if isinstance(pool_size, int) else list(pool_size)
-    out = _out(helper, input.dtype,
-               shape=(input.shape[0], input.shape[1], ps[0], ps[1]))
+    oshape = ((input.shape[0], input.shape[1], ps[0], ps[1])
+              if input.shape is not None else None)
+    out = _out(helper, input.dtype, shape=oshape)
     helper.append_op("adaptive_pool2d", inputs={"X": [input.name]},
                      outputs={"Out": [out.name]},
                      attrs={"pooled_size": ps, "pooling_type": pool_type})
@@ -763,3 +764,46 @@ def load(out, file_path, load_as_fp16=None):
     raise NotImplementedError(
         "layers.load: use fluid.io.load_vars/load_persistables (program-"
         "level load ops have no XLA residue; IO happens host-side)")
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size, stride=1,
+                    padding=0, dilation=1, groups=None,
+                    deformable_groups=None, im2col_step=None,
+                    param_attr=None, bias_attr=None, modulated=True,
+                    name=None):
+    """Deformable conv v1/v2 (reference layers/nn.py:11965).  `mask` None
+    (or modulated=False) selects v1."""
+    helper = LayerHelper("deformable_conv", name=name)
+    groups = groups or 1
+    deformable_groups = deformable_groups or 1
+
+    def _pair(v):
+        return [v, v] if isinstance(v, int) else list(v)
+
+    fs = _pair(filter_size)
+    if input.shape is None:
+        raise ValueError("deformable_conv: input needs a static channel "
+                         "count (shape is None)")
+    num_channels = int(input.shape[1])
+    w = helper.create_parameter(
+        param_attr, [num_filters, num_channels // groups, fs[0], fs[1]],
+        input.dtype)
+    st, pd, dl = _pair(stride), _pair(padding), _pair(dilation)
+    oh = (int(input.shape[2]) + 2 * pd[0] - (dl[0] * (fs[0] - 1) + 1)) // st[0] + 1
+    ow = (int(input.shape[3]) + 2 * pd[1] - (dl[1] * (fs[1] - 1) + 1)) // st[1] + 1
+    pre_bias = _out(helper, input.dtype,
+                    shape=(input.shape[0], num_filters, oh, ow))
+    inputs = {"Input": [input.name], "Offset": [offset.name],
+              "Filter": [w.name]}
+    if modulated and mask is not None:
+        inputs["Mask"] = [mask.name]
+    helper.append_op(
+        "deformable_conv", inputs=inputs,
+        outputs={"Output": [pre_bias.name]},
+        attrs={"strides": _pair(stride), "paddings": _pair(padding),
+               "dilations": _pair(dilation), "groups": groups,
+               "deformable_groups": deformable_groups},
+    )
+    pre_act = helper.append_bias_op(pre_bias, bias_attr, [num_filters],
+                                    dim_start=1)
+    return pre_act

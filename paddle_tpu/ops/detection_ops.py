@@ -1163,3 +1163,51 @@ def _psroi_pool(ctx, op, ins):
 
     out = jax.vmap(one_roi)(rois, batch_idx)
     return {"Out": out.astype(x.dtype)}
+
+
+@register_op("retinanet_target_assign")
+def _retinanet_target_assign(ctx, op, ins):
+    """RetinaNet anchor labeling (reference retinanet_target_assign_op.cc):
+    same best-anchor / IoU-threshold rules as the RPN assigner but with NO
+    subsampling (focal loss owns the imbalance), class labels instead of a
+    binary objectness target, and a fg_num output for loss normalization.
+
+    STATIC-SHAPE form like rpn_target_assign: TargetLabel [N, M] (gt class,
+    0 background, -1 ignore), ScoreWeight [N, M] (1 for fg+bg, 0 ignored),
+    TargetBBox [N, M, 4], BBoxInsideWeight [N, M, 4], FgNum [N, 1]."""
+    anchors = first(ins, "Anchor").astype(jnp.float32).reshape(-1, 4)
+    gt = first(ins, "GtBoxes").astype(jnp.float32)
+    if gt.ndim == 2:
+        gt = gt[None]
+    N, B, _ = gt.shape
+    gt_labels = first(ins, "GtLabels").reshape(N, -1).astype(jnp.int32)
+    gt_lens = (first(ins, "GtLod").astype(jnp.int32) if ins.get("GtLod")
+               else jnp.full((N,), B, jnp.int32))
+    is_crowd = (first(ins, "IsCrowd").reshape(N, -1).astype(jnp.int32)
+                if ins.get("IsCrowd") else jnp.zeros((N, B), jnp.int32))
+    pos_ov = op.attr("positive_overlap", 0.5)
+    neg_ov = op.attr("negative_overlap", 0.4)
+    M = anchors.shape[0]
+
+    def one(i):
+        g, nlen, crowd = gt[i], gt_lens[i], is_crowd[i]
+        gt_valid = (jnp.arange(B) < nlen) & (crowd == 0)
+        iou = jnp.where(gt_valid[None, :], _corner_iou(anchors, g), 0.0)
+        a2g_max = jnp.max(iou, axis=1)
+        a2g_arg = jnp.argmax(iou, axis=1)
+        g_max = jnp.max(iou, axis=0)
+        is_best = jnp.any((iou == g_max[None, :]) & (g_max[None, :] > 0)
+                          & gt_valid[None, :], axis=1)
+        fg = is_best | (a2g_max >= pos_ov)
+        bg = ~fg & (a2g_max < neg_ov)
+        label = jnp.where(fg, gt_labels[i][jnp.clip(a2g_arg, 0, max(B - 1, 0))],
+                          jnp.where(bg, 0, -1)).astype(jnp.int32)
+        score_w = (fg | bg).astype(jnp.float32)
+        tgt = _box_to_delta(anchors, g[jnp.clip(a2g_arg, 0, max(B - 1, 0))])
+        tgt = jnp.where(fg[:, None], tgt, 0.0)
+        inw = jnp.where(fg[:, None], 1.0, 0.0)
+        return label, score_w, tgt, inw, jnp.sum(fg).astype(jnp.int32)
+
+    label, score_w, tgt, inw, fg_num = jax.vmap(one)(jnp.arange(N))
+    return {"TargetLabel": label, "ScoreWeight": score_w, "TargetBBox": tgt,
+            "BBoxInsideWeight": inw, "FgNum": fg_num.reshape(N, 1) + 1}

@@ -747,12 +747,17 @@ def _sample_logits(ctx, op, ins):
     q = (jnp.log((samples + 2.0) / (samples + 1.0)) / np.log(rng_range + 2.0))
     sampled = jnp.take_along_axis(logits, samples, axis=1)
     sampled = sampled - jnp.log(jnp.maximum(q * num_samples, 1e-20))
+    # `uniq` semantics, static-shape form: a duplicate draw within the row
+    # (and, with remove_accidental_hits, any draw equal to a true label)
+    # is masked out of the sampled softmax instead of being resampled
+    dup = (negs[:, :, None] == negs[:, None, :]) & (
+        jnp.arange(num_samples)[None, :, None]
+        > jnp.arange(num_samples)[None, None, :])
+    drop = dup.any(-1)                                     # [B, S]
     if remove_accidental:
-        # a negative that equals one of the row's true labels is removed
-        acc = (negs[:, :, None] == label[:, None, :]).any(-1)  # [B, S]
-        mask = jnp.concatenate(
-            [jnp.zeros((B, num_true), bool), acc], axis=1)
-        sampled = jnp.where(mask, -1e20, sampled)
+        drop = drop | (negs[:, :, None] == label[:, None, :]).any(-1)
+    mask = jnp.concatenate([jnp.zeros((B, num_true), bool), drop], axis=1)
+    sampled = jnp.where(mask, -1e20, sampled)
     pos = jnp.broadcast_to(jnp.arange(num_true, dtype=jnp.int32)[None, :],
                            (B, num_true))
     return {"SampledLogits": sampled, "SampledLabels": pos,

@@ -848,3 +848,97 @@ def _conv3d_transpose(ctx, op, ins):
     groups = op.attr("groups", 1)
     return {"Output": conv3d_transpose_math(x, w, strides, pads, dilations,
                                             groups)}
+
+
+def _bilinear_sample_grid(img, ys, xs):
+    """Bilinear sample img [C, H, W] at float grids ys/xs [*spatial].
+    Reference deformable_im2col_bilinear semantics: each of the four
+    corners contributes only if it lies inside the image — a sample within
+    1px of the border attenuates rather than clamping to the edge pixel."""
+    H, W = img.shape[1], img.shape[2]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = ys - y0
+    wx = xs - x0
+    y0i = y0.astype(jnp.int32)
+    x0i = x0.astype(jnp.int32)
+
+    def corner(yi, xi):
+        ok = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        v = img[:, jnp.clip(yi, 0, H - 1), jnp.clip(xi, 0, W - 1)]
+        return jnp.where(ok[None], v, 0.0)
+
+    v00 = corner(y0i, x0i)
+    v01 = corner(y0i, x0i + 1)
+    v10 = corner(y0i + 1, x0i)
+    v11 = corner(y0i + 1, x0i + 1)
+    return ((v00 * (1 - wx) + v01 * wx) * (1 - wy)
+            + (v10 * (1 - wx) + v11 * wx) * wy)
+
+
+@register_op("deformable_conv")
+def _deformable_conv(ctx, op, ins):
+    """Deformable convolution v1/v2 (reference deformable_conv_op.cc /
+    deformable_conv_v1; DCN arXiv:1703.06211, modulated arXiv:1811.11168).
+
+    Each kernel tap samples the input at its integer position plus a
+    learned per-position offset (bilinear), optionally scaled by a learned
+    modulation mask (v2).  The sampled-patch tensor contracts with the
+    filter as a plain einsum — the MXU sees one big matmul, the gathers are
+    the only irregular part.  Gradients (incl. through the sampling
+    coordinates to Offset/Mask) come from autodiff; the reference hand-
+    writes the three backward kernels."""
+    x = first(ins, "Input").astype(jnp.float32)     # [N, C, H, W]
+    offset = first(ins, "Offset").astype(jnp.float32)  # [N, 2*dg*kh*kw, Ho, Wo]
+    mask = (first(ins, "Mask").astype(jnp.float32)
+            if ins.get("Mask") else None)              # [N, dg*kh*kw, Ho, Wo]
+    w = first(ins, "Filter").astype(jnp.float32)     # [O, C/g, kh, kw]
+    strides = op.attr("strides", [1, 1])
+    pads = op.attr("paddings", [0, 0])
+    dilations = op.attr("dilations", [1, 1])
+    groups = op.attr("groups", 1) or 1
+    dg = op.attr("deformable_groups", 1) or 1
+    N, C, H, W = x.shape
+    O, _, kh, kw = w.shape
+    Ho = (H + 2 * pads[0] - (dilations[0] * (kh - 1) + 1)) // strides[0] + 1
+    Wo = (W + 2 * pads[1] - (dilations[1] * (kw - 1) + 1)) // strides[1] + 1
+
+    base_y = (jnp.arange(Ho) * strides[0] - pads[0])[:, None]  # [Ho, 1]
+    base_x = (jnp.arange(Wo) * strides[1] - pads[1])[None, :]  # [1, Wo]
+    cpg = C // dg  # channels per deformable group
+
+    def one_image(img, off, mk):
+        cols = []
+        for k in range(kh * kw):
+            i, j = k // kw, k % kw
+            taps = []
+            for g in range(dg):
+                dy = off[2 * (g * kh * kw + k)]       # [Ho, Wo]
+                dx = off[2 * (g * kh * kw + k) + 1]
+                ys = base_y + i * dilations[0] + dy
+                xs = base_x + j * dilations[1] + dx
+                v = _bilinear_sample_grid(img[g * cpg:(g + 1) * cpg], ys, xs)
+                if mk is not None:
+                    v = v * mk[g * kh * kw + k][None]
+                taps.append(v)
+            cols.append(jnp.concatenate(taps, axis=0))  # [C, Ho, Wo]
+        return jnp.stack(cols, axis=1)  # [C, kh*kw, Ho, Wo]
+
+    if mask is None:
+        patches = jax.vmap(lambda a, b: one_image(a, b, None))(x, offset)
+    else:
+        patches = jax.vmap(one_image)(x, offset, mask)
+    # grouped contraction: [N, C, K, Ho, Wo] x [O, C/g, K] -> [N, O, Ho, Wo]
+    cg = C // groups
+    og = O // groups
+    wk = w.reshape(O, cg, kh * kw)
+    outs = []
+    for g in range(groups):
+        outs.append(jnp.einsum(
+            "nckhw,ock->nohw",
+            patches[:, g * cg:(g + 1) * cg], wk[g * og:(g + 1) * og]))
+    out = jnp.concatenate(outs, axis=1) if groups > 1 else outs[0]
+    return {"Output": out.astype(first(ins, "Input").dtype)}
+
+
+register_op("deformable_conv_v1")(_deformable_conv)

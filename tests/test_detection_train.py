@@ -590,3 +590,65 @@ def test_ssd_end_to_end_trains():
     (det,) = exe.run(infer_prog, feed=feed, fetch_list=[nmsed], scope=scope)
     det = np.asarray(det)
     assert det.shape == (n, 10, 6)
+
+
+def test_retinanet_target_assign_and_focal_training():
+    """RetinaNet assignment rules + a focal-loss head training end-to-end
+    (class targets, no subsampling, fg_num normalizer)."""
+    anchors = _grid_anchors()
+    gt = np.array([[[6, 6, 26, 26], [40, 40, 60, 60]]], "f4")
+    gt_lab = np.array([[1, 2]], "int32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        av = fluid.layers.data("a", [4], dtype="float32")
+        gv = fluid.layers.data("g", [2, 4], dtype="float32")
+        lv = fluid.layers.data("gl", [2], dtype="int32")
+        bp = fluid.layers.data("bp", [16, 4], dtype="float32")
+        cl = fluid.layers.data("cl", [16, 3], dtype="float32")
+        rets = fluid.layers.retinanet_target_assign(
+            bp, cl, av, None, gv, lv, positive_overlap=0.5,
+            negative_overlap=0.4)
+        _, _, label, tgt, inw, fg_num, score_w = rets
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    feed = {"a": anchors, "g": gt, "gl": gt_lab,
+            "bp": np.zeros((1, 16, 4), "f4"), "cl": np.zeros((1, 16, 3), "f4")}
+    lab, t, w_in, fg, sw = exe.run(
+        main, feed=feed, fetch_list=[label, tgt, inw, fg_num, score_w],
+        scope=scope)
+    lab = np.asarray(lab)[0]
+    # best anchors carry the gt CLASS labels
+    assert set(lab[lab > 0].tolist()) == {1, 2}
+    assert int(np.asarray(fg).reshape(-1)[0]) == (lab > 0).sum() + 1
+    # no subsampling: every anchor is fg or bg or ignored, none dropped
+    sw = np.asarray(sw)[0]
+    assert ((lab == -1) == (sw == 0)).all()
+
+
+def test_retinanet_detection_output_shapes():
+    rng = np.random.RandomState(11)
+    anchors = _grid_anchors()  # [16, 4]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        b1 = fluid.layers.data("b1", [16, 4], dtype="float32")
+        s1 = fluid.layers.data("s1", [16, 3], dtype="float32")
+        av = fluid.layers.data("a", [4], dtype="float32")
+        im = fluid.layers.data("im", [3], dtype="float32")
+        out = fluid.layers.retinanet_detection_output(
+            [b1], [s1], [av], im, keep_top_k=5, score_threshold=0.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    (det,) = exe.run(main, feed={
+        "b1": rng.randn(2, 16, 4).astype("f4") * 0.1,
+        "s1": rng.randn(2, 16, 3).astype("f4"),
+        "a": anchors, "im": np.array([[64, 64, 1.0]] * 2, "f4")},
+        fetch_list=[out], scope=scope)
+    det = np.asarray(det)
+    assert det.shape == (2, 5, 6)
+    valid = det[det[:, :, 0] >= 0]
+    assert np.isfinite(valid).all()

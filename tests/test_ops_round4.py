@@ -804,3 +804,69 @@ def test_sampled_softmax_trains():
                         scope=scope)
         losses.append(float(np.asarray(lv).reshape(-1)[0]))
     assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_deformable_conv_zero_offset_equals_conv2d():
+    """zero offsets + unit mask reduce deformable conv to plain conv2d."""
+    rng = np.random.RandomState(9)
+    x = rng.randn(2, 4, 7, 7).astype("f4")
+    kh = kw = 3
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 6
+    with program_guard(main, startup):
+        xv = fluid.layers.data("x", [4, 7, 7], dtype="float32")
+        off = fluid.layers.data("off", [2 * kh * kw, 7, 7], dtype="float32")
+        msk = fluid.layers.data("msk", [kh * kw, 7, 7], dtype="float32")
+        dcn = fluid.layers.deformable_conv(
+            xv, off, msk, 6, 3, padding=1,
+            param_attr=fluid.ParamAttr(name="dcn_w"), bias_attr=False)
+        ref = fluid.layers.conv2d(
+            xv, 6, 3, padding=1,
+            param_attr=fluid.ParamAttr(name="dcn_w"), bias_attr=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    feed = {"x": x, "off": np.zeros((2, 2 * kh * kw, 7, 7), "f4"),
+            "msk": np.ones((2, kh * kw, 7, 7), "f4")}
+    a, b = exe.run(main, feed=feed, fetch_list=[dcn, ref], scope=scope)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deformable_conv_offsets_shift_sampling():
+    """an integer offset of +1 in x equals sampling the shifted image, and
+    the whole thing trains (grads flow to offsets too)."""
+    rng = np.random.RandomState(10)
+    x = rng.randn(1, 2, 6, 6).astype("f4")
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 2
+    with program_guard(main, startup):
+        xv = fluid.layers.data("x", [2, 6, 6], dtype="float32")
+        off_in = fluid.layers.data("off", [2 * 9, 6, 6], dtype="float32")
+        dcn = fluid.layers.deformable_conv(
+            xv, off_in, None, 3, 3, padding=1, modulated=False,
+            bias_attr=False)
+        loss = fluid.layers.mean(dcn)
+        (g_off,) = fluid.calc_gradient(loss, [off_in])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    out0, g = exe.run(main, feed={
+        "x": x, "off": np.zeros((1, 18, 6, 6), "f4")},
+        fetch_list=[dcn, g_off], scope=scope)
+    # offset grads exist and are finite (autodiff through bilinear coords)
+    assert np.isfinite(np.asarray(g)).all()
+    # +1 x-offset everywhere == conv over x shifted left by 1
+    off1 = np.zeros((1, 18, 6, 6), "f4")
+    off1[:, 1::2] = 1.0
+    (out1,) = exe.run(main, feed={"x": x, "off": off1}, fetch_list=[dcn],
+                      scope=scope)
+    x_shift = np.zeros_like(x)
+    x_shift[..., :-1] = x[..., 1:]
+    (out_ref,) = exe.run(main, feed={
+        "x": x_shift, "off": np.zeros((1, 18, 6, 6), "f4")},
+        fetch_list=[dcn], scope=scope)
+    got, ref = np.asarray(out1), np.asarray(out_ref)
+    # interior columns match exactly (borders differ: zero-pad vs shift)
+    np.testing.assert_allclose(got[..., 1:-2], ref[..., 1:-2], rtol=1e-4,
+                               atol=1e-4)
