@@ -525,3 +525,51 @@ def retinanet_detection_output(bboxes, scores, anchors, im_info,
                           nms_top_k=nms_top_k, keep_top_k=keep_top_k,
                           nms_threshold=nms_threshold, nms_eta=nms_eta,
                           background_label=-1, normalized=False)
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info=None, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False,
+                             gt_lengths=None):
+    """RCNN stage-2 RoI sampling (reference layers/detection.py
+    generate_proposal_labels).  STATIC-SHAPE deviation: each image emits
+    exactly batch_size_per_im rows and a SampleWeight column marks drawn
+    rows — returns (rois, labels_int32, bbox_targets, bbox_inside_weights,
+    bbox_outside_weights, sample_weight)."""
+    if is_cls_agnostic or is_cascade_rcnn:
+        raise NotImplementedError(
+            "generate_proposal_labels: cls-agnostic / cascade modes")
+    if class_nums is None:
+        raise ValueError("generate_proposal_labels: class_nums is required")
+    helper = LayerHelper("generate_proposal_labels")
+    rois = _out(helper, rpn_rois.dtype)
+    labels = _out(helper, "int32")
+    tgt = _out(helper, rpn_rois.dtype)
+    inw = _out(helper, rpn_rois.dtype)
+    outw = _out(helper, rpn_rois.dtype)
+    sw = _out(helper, "float32")
+    inputs = {"RpnRois": [rpn_rois.name], "GtClasses": [gt_classes.name],
+              "GtBoxes": [gt_boxes.name]}
+    if is_crowd is not None:
+        inputs["IsCrowd"] = [is_crowd.name]
+    if im_info is not None:
+        inputs["ImInfo"] = [im_info.name]
+    if gt_lengths is not None:
+        inputs["GtLod"] = [gt_lengths.name]
+    helper.append_op(
+        "generate_proposal_labels", inputs=inputs,
+        outputs={"Rois": [rois.name], "LabelsInt32": [labels.name],
+                 "BboxTargets": [tgt.name], "BboxInsideWeights": [inw.name],
+                 "BboxOutsideWeights": [outw.name],
+                 "SampleWeight": [sw.name]},
+        attrs={"batch_size_per_im": batch_size_per_im,
+               "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+               "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo,
+               "bbox_reg_weights": list(bbox_reg_weights),
+               "class_nums": class_nums, "use_random": use_random},
+    )
+    return rois, labels, tgt, inw, outw, sw

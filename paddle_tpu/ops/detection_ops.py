@@ -764,6 +764,16 @@ def _corner_iou(a, b):
     return jnp.where(union > 0, inter / union, 0.0)
 
 
+def _rank_select(cand, pri, k):
+    """Select up to k True entries of `cand`, highest `pri` first (the
+    static-shape subsampling device shared by the rpn/retinanet/proposal
+    assigners): returns the selection mask."""
+    n = cand.shape[0]
+    order = jnp.argsort(jnp.where(cand, -pri, jnp.inf))
+    rank = jnp.zeros((n,), jnp.int32).at[order].set(jnp.arange(n, dtype=jnp.int32))
+    return cand & (rank < k), rank
+
+
 def _box_to_delta(anchor, gt):
     """encode gt relative to anchor (reference operators/detection/
     bbox_util.h BoxToDelta, unit weights)."""
@@ -844,14 +854,9 @@ def _rpn_target_assign(ctx, op, ins):
         else:
             pri = a2g_max  # deterministic: highest-IoU first
         # rank fg candidates by priority; keep the top num_fg_target
-        order = jnp.argsort(jnp.where(fg_cand, -pri, jnp.inf))
-        rank = jnp.zeros((M,), jnp.int32).at[order].set(jnp.arange(M, dtype=jnp.int32))
-        fg = fg_cand & (rank < num_fg_target)
+        fg, _ = _rank_select(fg_cand, pri, num_fg_target)
         n_fg = jnp.sum(fg)
-        n_bg_target = batch_size - n_fg
-        order_bg = jnp.argsort(jnp.where(bg_cand, -pri, jnp.inf))
-        rank_bg = jnp.zeros((M,), jnp.int32).at[order_bg].set(jnp.arange(M, dtype=jnp.int32))
-        bg = bg_cand & (rank_bg < n_bg_target)
+        bg, _ = _rank_select(bg_cand, pri, batch_size - n_fg)
 
         label = fg.astype(jnp.int32)
         score_w = (fg | bg).astype(jnp.float32)
@@ -1211,3 +1216,102 @@ def _retinanet_target_assign(ctx, op, ins):
     label, score_w, tgt, inw, fg_num = jax.vmap(one)(jnp.arange(N))
     return {"TargetLabel": label, "ScoreWeight": score_w, "TargetBBox": tgt,
             "BBoxInsideWeight": inw, "FgNum": fg_num.reshape(N, 1) + 1}
+
+
+@register_op("generate_proposal_labels")
+def _generate_proposal_labels(ctx, op, ins):
+    """RCNN stage-2 RoI sampling (reference
+    detection/generate_proposal_labels_op.cc): append gts to the proposals,
+    label by IoU (fg >= fg_thresh, bg in [bg_thresh_lo, bg_thresh_hi)),
+    subsample to batch_size_per_im with fg_fraction foregrounds, and emit
+    per-class-expanded regression targets.
+
+    STATIC-SHAPE form: every image yields exactly batch_size_per_im rows;
+    sampling lives in SampleWeight (1 = drawn, 0 = padding), the same
+    rank-mask device the RPN assigner uses.  Outputs: Rois [N, R, 4],
+    LabelsInt32 [N, R], BboxTargets [N, R, 4C], BboxInsideWeights /
+    BboxOutsideWeights [N, R, 4C], SampleWeight [N, R]."""
+    rois_in = first(ins, "RpnRois").astype(jnp.float32)   # [N, P, 4]
+    if ins.get("ImInfo"):
+        # reference divides proposals by im_scale so they share the gt frame
+        im_info = first(ins, "ImInfo").astype(jnp.float32).reshape(-1, 3)
+        rois_in = rois_in / im_info[:, 2][:, None, None]
+    gt_classes = first(ins, "GtClasses").astype(jnp.int32)
+    gt_boxes = first(ins, "GtBoxes").astype(jnp.float32)  # [N, B, 4]
+    if gt_boxes.ndim == 2:
+        gt_boxes = gt_boxes[None]
+    N, B = gt_boxes.shape[0], gt_boxes.shape[1]
+    gt_classes = gt_classes.reshape(N, -1)
+    is_crowd = (first(ins, "IsCrowd").reshape(N, -1).astype(jnp.int32)
+                if ins.get("IsCrowd") else jnp.zeros((N, B), jnp.int32))
+    gt_lens = (first(ins, "GtLod").astype(jnp.int32) if ins.get("GtLod")
+               else jnp.full((N,), B, jnp.int32))
+    R = op.attr("batch_size_per_im", 256)
+    fg_fraction = op.attr("fg_fraction", 0.25)
+    fg_thresh = op.attr("fg_thresh", 0.5)
+    bg_hi = op.attr("bg_thresh_hi", 0.5)
+    bg_lo = op.attr("bg_thresh_lo", 0.0)
+    weights = op.attr("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2])
+    C = op.attr("class_nums")
+    use_random = op.attr("use_random", True)
+    P = rois_in.shape[1]
+    fg_target = int(fg_fraction * R)
+    wvec = jnp.asarray(weights, jnp.float32)
+
+    keys = jax.random.split(ctx.next_key(), N) if use_random else None
+
+    def one(i):
+        gt_valid = (jnp.arange(B) < gt_lens[i]) & (is_crowd[i] == 0)
+        # gts join the candidate pool (reference concatenates them)
+        cand = jnp.concatenate([rois_in[i], gt_boxes[i]], axis=0)  # [P+B, 4]
+        iou = jnp.where(gt_valid[None, :], _corner_iou(cand, gt_boxes[i]), 0.0)
+        max_iou = jnp.max(iou, axis=1)
+        argmax = jnp.argmax(iou, axis=1)
+        gt_rows_valid = jnp.concatenate(
+            [jnp.ones((P,), bool), gt_valid], axis=0)
+        fg_cand = gt_rows_valid & (max_iou >= fg_thresh)
+        bg_cand = gt_rows_valid & (max_iou < bg_hi) & (max_iou >= bg_lo)
+
+        pri = (jax.random.uniform(keys[i], (P + B,)) if use_random
+               else max_iou)
+        fg, rank_fg = _rank_select(fg_cand, pri, fg_target)
+        n_fg = jnp.sum(fg)
+        bg, rank_bg = _rank_select(bg_cand, pri, R - n_fg)
+
+        # pack drawn rows to the front: fg band [0, fg_target), bg band
+        # [fg_target, fg_target + n_cand), undrawn after both; pool smaller
+        # than R repeats the last slot as padding (weight 0)
+        n_cand = P + B
+        sel_rank = jnp.where(fg, rank_fg,
+                             jnp.where(bg, fg_target + rank_bg,
+                                       fg_target + n_cand + jnp.arange(n_cand)))
+        order_full = jnp.argsort(sel_rank)
+        if n_cand >= R:
+            order = order_full[:R]
+            in_pool = jnp.ones((R,), bool)
+        else:
+            order = jnp.concatenate(
+                [order_full, jnp.broadcast_to(order_full[-1:], (R - n_cand,))])
+            in_pool = jnp.arange(R) < n_cand
+        drawn = (fg | bg)[order] & in_pool
+
+        rois = cand[order]
+        fg_row = fg[order] & in_pool
+        labels = jnp.where(fg_row,
+                           gt_classes[i][jnp.clip(argmax[order], 0, max(B - 1, 0))],
+                           0).astype(jnp.int32)
+        tgt = _box_to_delta(rois, gt_boxes[i][jnp.clip(argmax[order], 0,
+                                                       max(B - 1, 0))])
+        tgt = tgt / wvec[None, :]
+        # per-class expansion: targets land in the label's 4-col block
+        onehot = jax.nn.one_hot(labels, C, dtype=jnp.float32)  # [R, C]
+        expanded = (onehot[:, :, None] * tgt[:, None, :]).reshape(R, 4 * C)
+        inw = jnp.repeat(onehot, 4, axis=1) * fg_row[:, None]  # [R, 4C]
+        expanded = jnp.where(fg_row[:, None], expanded, 0.0)
+        return (rois, labels, expanded, inw,
+                drawn.astype(jnp.float32))
+
+    rois, labels, tgt, inw, sw = jax.vmap(one)(jnp.arange(N))
+    return {"Rois": rois, "LabelsInt32": labels, "BboxTargets": tgt,
+            "BboxInsideWeights": inw, "BboxOutsideWeights": inw,
+            "SampleWeight": sw}

@@ -652,3 +652,60 @@ def test_retinanet_detection_output_shapes():
     assert det.shape == (2, 5, 6)
     valid = det[det[:, :, 0] >= 0]
     assert np.isfinite(valid).all()
+
+
+def test_generate_proposal_labels_and_faster_rcnn_stage2():
+    """proposals + gts sampled into a fixed-size RoI batch with per-class
+    regression targets; a stage-2 head (roi_pool -> fc) trains on them —
+    the Faster-RCNN assembly gate."""
+    rng = np.random.RandomState(12)
+    R, C = 16, 3
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 21
+    with fluid.program_guard(main, startup):
+        feat = fluid.layers.data("feat", [4, 16, 16], dtype="float32")
+        props = fluid.layers.data("props", [12, 4], dtype="float32")
+        gcls = fluid.layers.data("gcls", [2], dtype="int32")
+        gbox = fluid.layers.data("gbox", [2, 4], dtype="float32")
+        rois, labels, tgt, inw, outw, sw = fluid.layers.generate_proposal_labels(
+            props, gcls, None, gbox, batch_size_per_im=R, fg_thresh=0.5,
+            class_nums=C, use_random=False)
+        flat_rois = fluid.layers.reshape(rois, [-1, 4])
+        pooled = fluid.layers.roi_pool(feat, flat_rois, 4, 4,
+                                       spatial_scale=0.25)
+        fcin = fluid.layers.reshape(pooled, [-1, 4 * 16])
+        cls_logits = fluid.layers.fc(fcin, C)
+        flat_lab = fluid.layers.reshape(labels, [-1, 1])
+        ce = fluid.layers.softmax_with_cross_entropy(
+            cls_logits, fluid.layers.cast(flat_lab, "int64"))
+        w = fluid.layers.reshape(sw, [-1, 1])
+        loss = fluid.layers.reduce_sum(ce * w) / (fluid.layers.reduce_sum(w) + 1.0)
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    props_v = rng.uniform(0, 40, (1, 12, 4)).astype("f4")
+    props_v[..., 2:] = props_v[..., :2] + rng.uniform(8, 20, (1, 12, 2))
+    gt_v = np.array([[[4, 4, 20, 20], [30, 30, 50, 50]]], "f4")
+    feed = {"feat": rng.rand(1, 4, 16, 16).astype("f4"),
+            "props": props_v, "gcls": np.array([[1, 2]], "int32"),
+            "gbox": gt_v}
+    out = exe.run(main, feed=feed,
+                  fetch_list=[rois, labels, tgt, inw, sw, loss], scope=scope)
+    rois_v, lab_v, tgt_v, inw_v, sw_v, _ = [np.asarray(o) for o in out]
+    assert rois_v.shape == (1, R, 4) and lab_v.shape == (1, R)
+    assert tgt_v.shape == (1, R, 4 * C)
+    # the gt boxes themselves are fg candidates, so fg exists with class 1/2
+    assert set(lab_v[0][lab_v[0] > 0].tolist()) <= {1, 2}
+    assert (lab_v[0] > 0).sum() >= 2
+    # inside weights fire exactly on the label's 4-col block for fg rows
+    fg_rows = np.where(lab_v[0] > 0)[0]
+    for r in fg_rows[:3]:
+        c = lab_v[0, r]
+        blk = inw_v[0, r].reshape(C, 4)
+        assert (blk[c] == 1).all() and blk.sum() == 4
+    losses = []
+    for _ in range(20):
+        (lv,) = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
+        losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
