@@ -573,3 +573,76 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
                "class_nums": class_nums, "use_random": use_random},
     )
     return rois, labels, tgt, inw, outw, sw
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, name=None):
+    """FPN level routing (reference layers/detection.py
+    distribute_fpn_proposals).  STATIC-SHAPE deviation: rois are not
+    physically split; every level receives the full roi tensor plus a
+    [R] selection mask (pool on every level, select by mask — the
+    accelerator FPN formulation), and restore_ind is the identity.
+    Returns (multi_rois, restore_ind, multi_masks)."""
+    helper = LayerHelper("distribute_fpn_proposals", name=name)
+    L = max_level - min_level + 1
+    mask = _out(helper, "float32")
+    restore = _out(helper, "int32")
+    helper.append_op(
+        "distribute_fpn_proposals",
+        inputs={"FpnRois": [fpn_rois.name]},
+        outputs={"MultiLevelMask": [mask.name], "RestoreIndex": [restore.name]},
+        attrs={"min_level": min_level, "max_level": max_level,
+               "refer_level": refer_level, "refer_scale": refer_scale},
+    )
+    from . import nn as _nn
+
+    multi_rois = [fpn_rois] * L
+    # slice the [L, R] mask into per-level [R] rows
+    multi_masks = []
+    for i in range(L):
+        row = _nn.slice(mask, axes=[0], starts=[i], ends=[i + 1])
+        multi_masks.append(_nn.reshape(row, [-1]))
+    return multi_rois, restore, multi_masks
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, name=None):
+    """reference layers/detection.py collect_fpn_proposals: global top-k
+    over the concatenated per-level proposals.  Returns a padded
+    [post_nms_top_n, 4] block (score 0 = empty slot)."""
+    helper = LayerHelper("collect_fpn_proposals", name=name)
+    rois = _out(helper, multi_rois[0].dtype)
+    scores = _out(helper, "float32")
+    helper.append_op(
+        "collect_fpn_proposals",
+        inputs={"MultiLevelRois": [r.name for r in multi_rois],
+                "MultiLevelScores": [s.name for s in multi_scores]},
+        outputs={"FpnRois": [rois.name], "RoisScores": [scores.name]},
+        attrs={"post_nms_topN": post_nms_top_n},
+    )
+    return rois
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip=None, name=None):
+    """reference layers/detection.py box_decoder_and_assign (R-FCN):
+    per-class decode + best-class assignment.  prior_box_var here is the
+    4-list of variances (the reference also accepts a tensor)."""
+    import numpy as _np
+
+    helper = LayerHelper("box_decoder_and_assign", name=name)
+    decoded = _out(helper, target_box.dtype)
+    assigned = _out(helper, target_box.dtype)
+    inputs = {"PriorBox": [prior_box.name], "TargetBox": [target_box.name],
+              "BoxScore": [box_score.name]}
+    attrs = {"box_clip": float(box_clip) if box_clip is not None
+             else float(_np.log(1000.0 / 16.0))}
+    if isinstance(prior_box_var, (list, tuple)):
+        attrs["box_var"] = list(prior_box_var)
+    elif prior_box_var is not None:  # tensor variances
+        inputs["PriorBoxVar"] = [prior_box_var.name]
+    helper.append_op("box_decoder_and_assign", inputs=inputs,
+                     outputs={"DecodeBox": [decoded.name],
+                              "OutputAssignBox": [assigned.name]},
+                     attrs=attrs)
+    return decoded, assigned

@@ -1315,3 +1315,90 @@ def _generate_proposal_labels(ctx, op, ins):
     return {"Rois": rois, "LabelsInt32": labels, "BboxTargets": tgt,
             "BboxInsideWeights": inw, "BboxOutsideWeights": inw,
             "SampleWeight": sw}
+
+
+@register_op("distribute_fpn_proposals")
+def _distribute_fpn_proposals(ctx, op, ins):
+    """FPN level routing (reference
+    detection/distribute_fpn_proposals_op.cc): each roi maps to level
+    floor(log2(sqrt(area) / refer_scale + 1e-6)) + refer_level, clipped to
+    [min_level, max_level].
+
+    STATIC-SHAPE form: instead of variable-length per-level splits, emit a
+    [L, R] one-hot level mask; the layer pools every roi on every level
+    and selects by mask (the standard accelerator FPN formulation), so
+    RestoreIndex is the identity."""
+    rois = first(ins, "FpnRois").astype(jnp.float32).reshape(-1, 4)
+    min_level = op.attr("min_level")
+    max_level = op.attr("max_level")
+    refer_level = op.attr("refer_level")
+    refer_scale = op.attr("refer_scale")
+    L = max_level - min_level + 1
+    w = jnp.maximum(rois[:, 2] - rois[:, 0] + 1.0, 0.0)  # reference BBoxArea
+    h = jnp.maximum(rois[:, 3] - rois[:, 1] + 1.0, 0.0)
+    scale = jnp.sqrt(w * h)
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    mask = jax.nn.one_hot(lvl - min_level, L, dtype=jnp.float32).T  # [L, R]
+    restore = jnp.arange(rois.shape[0], dtype=jnp.int32)
+    return {"MultiLevelMask": mask, "RestoreIndex": restore[:, None]}
+
+
+@register_op("collect_fpn_proposals")
+def _collect_fpn_proposals(ctx, op, ins):
+    """reference detection/collect_fpn_proposals_op.cc: concat per-level
+    proposals and keep the global top post_nms_topN by score.  Static
+    shape: inputs are the padded per-level blocks; output is a padded
+    [post_nms_topN, 4] block + kept scores (0 = empty slot)."""
+    rois_list = [r if r.ndim == 3 else r[None] for r in ins["MultiLevelRois"]]
+    scores_list = [s if s.ndim == 2 else s[None]
+                   for s in ins["MultiLevelScores"]]
+    post_n = op.attr("post_nms_topN")
+    rois = jnp.concatenate(rois_list, axis=1)      # [N, sum_R, 4]
+    scores = jnp.concatenate(scores_list, axis=1)  # [N, sum_R]
+    k = min(post_n, scores.shape[1])
+
+    def one(s, r):
+        top_s, top_i = jax.lax.top_k(s, k)
+        out = r[top_i]
+        if k < post_n:
+            out = jnp.pad(out, ((0, post_n - k), (0, 0)))
+            top_s = jnp.pad(top_s, (0, post_n - k))
+        return out, top_s
+
+    out_rois, top_s = jax.vmap(one)(scores, rois)  # [N, post_n, 4]
+    return {"FpnRois": out_rois, "RoisScores": top_s[..., None]}
+
+
+@register_op("box_decoder_and_assign")
+def _box_decoder_and_assign(ctx, op, ins):
+    """reference detection/box_decoder_and_assign_op.cc (R-FCN): decode
+    per-class deltas against the prior, then assign each roi its best
+    class's decoded box (background column excluded)."""
+    prior = first(ins, "PriorBox").astype(jnp.float32)      # [R, 4]
+    deltas = first(ins, "TargetBox").astype(jnp.float32)    # [R, 4C]
+    score = first(ins, "BoxScore").astype(jnp.float32)      # [R, C]
+    clip = op.attr("box_clip", float(np.log(1000.0 / 16.0)))
+    R = prior.shape[0]
+    C = score.shape[1]
+    if ins.get("PriorBoxVar"):
+        var = first(ins, "PriorBoxVar").astype(jnp.float32).reshape(R, 1, 4)
+    else:
+        var = jnp.asarray(op.attr("box_var", [0.1, 0.1, 0.2, 0.2]),
+                          jnp.float32)[None, None, :]
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    d = deltas.reshape(R, C, 4) * var
+    cx = d[..., 0] * pw[:, None] + pcx[:, None]
+    cy = d[..., 1] * ph[:, None] + pcy[:, None]
+    bw = jnp.exp(jnp.minimum(d[..., 2], clip)) * pw[:, None]
+    bh = jnp.exp(jnp.minimum(d[..., 3], clip)) * ph[:, None]
+    decoded = jnp.stack([cx - bw / 2, cy - bh / 2,
+                         cx + bw / 2 - 1, cy + bh / 2 - 1], axis=-1)  # [R, C, 4]
+    best = jnp.argmax(score[:, 1:], axis=1) + 1  # skip background col 0
+    assigned = jnp.take_along_axis(
+        decoded, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
+    return {"DecodeBox": decoded.reshape(R, 4 * C),
+            "OutputAssignBox": assigned}

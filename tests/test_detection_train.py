@@ -709,3 +709,71 @@ def test_generate_proposal_labels_and_faster_rcnn_stage2():
         (lv,) = exe.run(main, feed=feed, fetch_list=[loss], scope=scope)
         losses.append(float(np.asarray(lv).reshape(-1)[0]))
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_fpn_distribute_and_collect():
+    rng = np.random.RandomState(13)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        rois = fluid.layers.data("rois", [8, 4], dtype="float32")
+        flat = fluid.layers.reshape(rois, [-1, 4])
+        multi_rois, restore, masks = fluid.layers.distribute_fpn_proposals(
+            flat, 2, 5, 4, 224)
+        r1 = fluid.layers.data("r1", [6, 4], dtype="float32")
+        s1 = fluid.layers.data("s1", [6, 1], dtype="float32")
+        r2 = fluid.layers.data("r2", [6, 4], dtype="float32")
+        s2 = fluid.layers.data("s2", [6, 1], dtype="float32")
+        fs1 = fluid.layers.reshape(s1, [0, -1])
+        fs2 = fluid.layers.reshape(s2, [0, -1])
+        collected = fluid.layers.collect_fpn_proposals(
+            [r1, r2], [fs1, fs2], 2, 5, post_nms_top_n=5)
+        fetches = masks + [collected]
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    # sizes chosen to land on distinct levels: 224 -> level 4
+    sizes = [16, 32, 64, 112, 224, 224, 448, 900]
+    rois_v = np.zeros((1, 8, 4), "f4")
+    for i, s in enumerate(sizes):
+        rois_v[0, i] = [0, 0, s - 1, s - 1]  # +1-offset area convention
+    r1v = rng.uniform(0, 50, (1, 6, 4)).astype("f4")
+    r2v = rng.uniform(0, 50, (1, 6, 4)).astype("f4")
+    s1v = rng.rand(1, 6, 1).astype("f4")
+    s2v = rng.rand(1, 6, 1).astype("f4")
+    out = exe.run(main, feed={"rois": rois_v, "r1": r1v, "s1": s1v,
+                              "r2": r2v, "s2": s2v},
+                  fetch_list=fetches, scope=scope)
+    m = [np.asarray(o) for o in out[:4]]
+    # every roi routed to exactly one level
+    total = sum(mm for mm in m)
+    np.testing.assert_allclose(total, np.ones(8), atol=1e-6)
+    # small rois to low levels, big to high
+    assert m[0][0] == 1.0 and m[3][-1] == 1.0
+    col = np.asarray(out[4])[0]
+    assert col.shape == (5, 4)
+    # collected rois are the 5 highest-scoring across both levels
+    all_s = np.concatenate([s1v.reshape(-1), s2v.reshape(-1)])
+    all_r = np.concatenate([r1v.reshape(-1, 4), r2v.reshape(-1, 4)])
+    expect = all_r[np.argsort(-all_s)[:5]]
+    np.testing.assert_allclose(col, expect, rtol=1e-6)
+
+
+def test_box_decoder_and_assign_golden():
+    prior = np.array([[0, 0, 9, 9]], "f4")
+    deltas = np.zeros((1, 8), "f4")  # C=2, zero deltas decode to the prior
+    score = np.array([[0.1, 0.9]], "f4")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pv = fluid.layers.data("p", [4], dtype="float32")
+        dv = fluid.layers.data("d", [8], dtype="float32")
+        sv = fluid.layers.data("s", [2], dtype="float32")
+        dec, asg = fluid.layers.box_decoder_and_assign(pv, [0.1, 0.1, 0.2, 0.2],
+                                                       dv, sv)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    d_out, a_out = exe.run(main, feed={"p": prior, "d": deltas, "s": score},
+                           fetch_list=[dec, asg], scope=scope)
+    np.testing.assert_allclose(np.asarray(a_out)[0], [0, 0, 9, 9], atol=1e-4)
+    np.testing.assert_allclose(np.asarray(d_out)[0].reshape(2, 4)[1],
+                               [0, 0, 9, 9], atol=1e-4)
