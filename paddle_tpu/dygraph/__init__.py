@@ -21,6 +21,7 @@ from .nn import (  # noqa: F401
     Pool2D,
     PRelu,
     SpectralNorm,
+    TreeConv,
 )
 from .learning_rate_scheduler import (  # noqa: F401
     CosineDecay,

@@ -531,3 +531,40 @@ class Conv3DTranspose(Layer):
 
         return _activation(_apply("conv3d_transpose", fn, x, self.weight, self.bias),
                            self.act)
+
+
+class TreeConv(Layer):
+    """reference dygraph/nn.py TreeConv: TBCNN tree convolution (eager
+    form of the tree_conv op; math shared via ops.misc_ops.tree_conv_math)."""
+
+    def __init__(self, feature_size, output_size, num_filters=1, max_depth=2,
+                 act="tanh", param_attr=None, bias_attr=None, name=None,
+                 dtype="float32"):
+        super().__init__(name or "tree_conv", dtype)
+        self._output_size = output_size
+        self._num_filters = num_filters
+        self._max_depth = max_depth
+        self._act = act
+        self.weight = self.create_parameter(
+            [feature_size, 3, output_size, num_filters], attr=param_attr)
+        self.bias = (self.create_parameter([num_filters], attr=bias_attr,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+
+    def forward(self, nodes_vector, edge_set):
+        from ..ops.misc_ops import tree_conv_math
+
+        md = self._max_depth
+
+        def fn(nv, es, w, *b):
+            out = jax.vmap(lambda n, e: tree_conv_math(
+                n, e.astype(jnp.int32), w, md))(nv, es)
+            if b:
+                out = out + b[0]
+            return out
+
+        args = [nodes_vector, edge_set, self.weight]
+        if self.bias is not None:
+            args.append(self.bias)
+        out = _apply("tree_conv", fn, *args)
+        return _activation(out, self._act)

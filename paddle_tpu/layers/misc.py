@@ -571,3 +571,25 @@ def sampled_softmax_with_cross_entropy(logits, label, num_samples,
                "uniq": True},
     )
     return _nn.softmax_with_cross_entropy(sampled, sampled_labels)
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1, max_depth=2,
+              act="tanh", param_attr=None, bias_attr=None, name=None):
+    """TBCNN tree convolution (reference layers/nn.py tree_conv over
+    tree_conv_op.h).  nodes_vector [B, N, F], edge_set [B, E, 2]
+    (1-indexed, zero-padded); returns [B, N, output_size, num_filters]."""
+    helper = LayerHelper("tree_conv", name=name, act=act)
+    F = int(nodes_vector.shape[-1])
+    w = helper.create_parameter(param_attr, [F, 3, output_size, num_filters],
+                                nodes_vector.dtype)
+    out = helper.create_variable_for_type_inference(nodes_vector.dtype)
+    helper.append_op(
+        "tree_conv",
+        inputs={"NodesVector": [nodes_vector.name], "EdgeSet": [edge_set.name],
+                "Filter": [w.name]},
+        outputs={"Out": [out.name]},
+        attrs={"max_depth": max_depth},
+    )
+    if bias_attr is not False:
+        out = helper.append_bias_op(out, bias_attr, [num_filters], dim_start=3)
+    return helper.append_activation(out)

@@ -1351,8 +1351,13 @@ def _collect_fpn_proposals(ctx, op, ins):
     shape: inputs are the padded per-level blocks; output is a padded
     [post_nms_topN, 4] block + kept scores (0 = empty slot)."""
     rois_list = [r if r.ndim == 3 else r[None] for r in ins["MultiLevelRois"]]
-    scores_list = [s if s.ndim == 2 else s[None]
-                   for s in ins["MultiLevelScores"]]
+
+    def _canon_scores(s):
+        if s.ndim == 3 and s.shape[-1] == 1:  # generate_proposals' [N, R, 1]
+            s = s[..., 0]
+        return s if s.ndim == 2 else s[None]
+
+    scores_list = [_canon_scores(s) for s in ins["MultiLevelScores"]]
     post_n = op.attr("post_nms_topN")
     rois = jnp.concatenate(rois_list, axis=1)      # [N, sum_R, 4]
     scores = jnp.concatenate(scores_list, axis=1)  # [N, sum_R]

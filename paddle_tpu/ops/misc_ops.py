@@ -762,3 +762,76 @@ def _sample_logits(ctx, op, ins):
                            (B, num_true))
     return {"SampledLogits": sampled, "SampledLabels": pos,
             "Samples": samples, "Probabilities": q}
+
+
+def tree_conv_math(nodes, edges, w, max_depth):
+    """TBCNN tree convolution (reference tree_conv_op.h +
+    math/tree2col.cc).  nodes [N, F]; edges [E, 2] 1-indexed (0,0) padded;
+    w [F, 3, out, nf].
+
+    tree2col, traced: the DFS patch of root u = u plus descendants at
+    depth d < max_depth; descendant-at-depth masks come from boolean
+    powers of the child adjacency, and each node's continuous position
+    weights (eta_t/l/r over depth, sibling index, sibling count) are
+    node-local, so the whole patch tensor is one [N, N, 3] contraction —
+    the MXU sees two matmuls."""
+    N, F = nodes.shape
+    E = edges.shape[0]
+    valid = (edges[:, 0] > 0) & (edges[:, 1] > 0)
+    par = jnp.where(valid, edges[:, 0], 0)  # 1-indexed parents
+    chd = jnp.where(valid, edges[:, 1], 0)
+    node_count = jnp.sum(valid) + 1
+
+    # sibling order: rank of edge among earlier edges with the same parent
+    same = (par[None, :] == par[:, None]) & valid[None, :] & valid[:, None]
+    earlier = same & (jnp.arange(E)[None, :] < jnp.arange(E)[:, None])
+    index = jnp.sum(earlier, axis=1) + 1               # [E], 1-based
+    pclen = jnp.sum(same, axis=1)                      # [E]
+
+    # per-node (index, pclen) scattered from edges (0-indexed node slots)
+    idx_of = jnp.ones((N + 1,), jnp.float32).at[chd].set(
+        jnp.where(valid, index.astype(jnp.float32), 1.0))
+    pclen_of = jnp.ones((N + 1,), jnp.float32).at[chd].set(
+        jnp.where(valid, pclen.astype(jnp.float32), 1.0))
+    idx_of = idx_of[1:]      # [N] (slot i = node i+1)
+    pclen_of = pclen_of[1:]
+
+    # child adjacency A[u, v] = v is child of u (0-indexed slots)
+    A = jnp.zeros((N + 1, N + 1), jnp.float32).at[par, chd].add(
+        jnp.where(valid, 1.0, 0.0))
+    A = jnp.minimum(A[1:, 1:], 1.0)
+
+    fd = float(max_depth)
+    out3 = jnp.zeros((N, N, 3), jnp.float32)
+    # depth 0: the root itself (index 1, pclen 1): eta_t=1, eta_l=eta_r=0
+    out3 = out3.at[jnp.arange(N), jnp.arange(N), 2].set(1.0)
+    reach = A
+    for d in range(1, max_depth):
+        eta_t = (fd - d) / fd
+        temp = jnp.where(pclen_of == 1.0, 0.5,
+                         (idx_of - 1.0) / jnp.maximum(pclen_of - 1.0, 1e-12))
+        eta_l = (1.0 - eta_t) * temp
+        eta_r = (1.0 - eta_t) * (1.0 - eta_l)
+        out3 = out3.at[:, :, 0].add(reach * eta_l[None, :])
+        out3 = out3.at[:, :, 1].add(reach * eta_r[None, :])
+        out3 = out3.at[:, :, 2].add(reach * eta_t)
+        if d + 1 < max_depth:
+            reach = jnp.minimum(reach @ A, 1.0)
+
+    patch = jnp.einsum("uvk,vf->ufk", out3, nodes.astype(jnp.float32))
+    patch = patch.reshape(N, 3 * F)               # (f, k)-major = W's flatten
+    out = patch @ w.reshape(3 * F, -1)            # [N, out*nf]
+    out_size, nf = w.shape[2], w.shape[3]
+    is_node = (jnp.arange(N) < node_count)[:, None, None]
+    return jnp.where(is_node, out.reshape(N, out_size, nf), 0.0)
+
+
+@register_op("tree_conv")
+def _tree_conv(ctx, op, ins):
+    nodes = first(ins, "NodesVector")   # [B, N, F]
+    edges = first(ins, "EdgeSet").astype(jnp.int32)  # [B, E, 2]
+    w = first(ins, "Filter").astype(jnp.float32)     # [F, 3, out, nf]
+    max_depth = op.attr("max_depth", 2)
+    out = jax.vmap(lambda n, e: tree_conv_math(n, e, w, max_depth))(
+        nodes, edges)
+    return {"Out": out.astype(nodes.dtype)}

@@ -870,3 +870,123 @@ def test_deformable_conv_offsets_shift_sampling():
     # interior columns match exactly (borders differ: zero-pad vs shift)
     np.testing.assert_allclose(got[..., 1:-2], ref[..., 1:-2], rtol=1e-4,
                                atol=1e-4)
+
+
+def _np_tree_conv(nodes, edges, w, max_depth):
+    """numpy transcription of math/tree2col.cc construct_patch + the
+    interleaved eta accumulation."""
+    N, F = nodes.shape
+    tr = {}
+    node_count = 0
+    for u, v in edges:
+        if u != 0 and v != 0:
+            tr.setdefault(int(u), []).append(int(v))
+            node_count += 1
+    node_count += 1
+    out_size, nf = w.shape[2], w.shape[3]
+    out = np.zeros((N, out_size, nf))
+    wflat = w.reshape(3 * F, out_size * nf)
+    for root in range(1, node_count + 1):
+        # DFS collecting (node, index, pclen, depth)
+        patch = [(root, 1, 1, 0)]
+        stack = [(root, 0)]
+        visited = {root}
+        while stack:
+            node, depth = stack[-1]
+            children = tr.get(node, [])
+            advanced = False
+            for i, v in enumerate(children):
+                if v not in visited and depth + 1 < max_depth:
+                    visited.add(v)
+                    stack.append((v, depth + 1))
+                    patch.append((v, i + 1, len(children), depth + 1))
+                    advanced = True
+            if not advanced:
+                stack.pop()
+        vec = np.zeros(3 * F)
+        for nd, idx, pclen, depth in patch:
+            eta_t = (max_depth - depth) / max_depth
+            temp = 0.5 if pclen == 1 else (idx - 1.0) / (pclen - 1.0)
+            eta_l = (1 - eta_t) * temp
+            eta_r = (1 - eta_t) * (1 - eta_l)
+            f = nodes[nd - 1]
+            vec[0::3] += eta_l * f
+            vec[1::3] += eta_r * f
+            vec[2::3] += eta_t * f
+        out[root - 1] = (vec @ wflat).reshape(out_size, nf)
+    return out
+
+
+def test_tree_conv_golden_and_training():
+    rng = np.random.RandomState(14)
+    N, F, E = 6, 4, 5
+    # tree: 1 -> (2, 3), 2 -> (4, 5)
+    edges = np.zeros((1, E, 2), "int32")
+    edges[0, :4] = [[1, 2], [1, 3], [2, 4], [2, 5]]
+    nodes = rng.randn(1, N, F).astype("f4")
+
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 4
+    with program_guard(main, startup):
+        nv = fluid.layers.data("n", [N, F], dtype="float32")
+        ev = fluid.layers.data("e", [E, 2], dtype="int32")
+        out = fluid.layers.tree_conv(nv, ev, 3, 2, max_depth=2, act=None,
+                                     bias_attr=False,
+                                     param_attr=fluid.ParamAttr(name="tc_w"))
+        loss = fluid.layers.mean(out)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    w = np.asarray(scope.find_var("tc_w"))
+    (got,) = exe.run(main, feed={"n": nodes, "e": edges}, fetch_list=[out],
+                     scope=scope)
+    expect = _np_tree_conv(nodes[0], edges[0], w, 2)
+    np.testing.assert_allclose(np.asarray(got)[0], expect, rtol=1e-4,
+                               atol=1e-4)
+    (l2,) = exe.run(main, feed={"n": nodes, "e": edges}, fetch_list=[loss],
+                    scope=scope)
+    assert np.isfinite(np.asarray(l2)).all()
+
+
+def test_tree_conv_depth3_golden():
+    """exercises the multi-hop reach propagation (max_depth >= 3)."""
+    rng = np.random.RandomState(16)
+    N, F, E = 7, 3, 6
+    # chain + branch: 1 -> (2, 3), 2 -> 4, 4 -> 5 (depth 3 from root), 3 -> 6
+    edges = np.zeros((1, E, 2), "int32")
+    edges[0, :5] = [[1, 2], [1, 3], [2, 4], [4, 5], [3, 6]]
+    nodes = rng.randn(1, N, F).astype("f4")
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 9
+    with program_guard(main, startup):
+        nv = fluid.layers.data("n", [N, F], dtype="float32")
+        ev = fluid.layers.data("e", [E, 2], dtype="int32")
+        out = fluid.layers.tree_conv(nv, ev, 2, 2, max_depth=3, act=None,
+                                     bias_attr=False,
+                                     param_attr=fluid.ParamAttr(name="tc3_w"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    w = np.asarray(scope.find_var("tc3_w"))
+    (got,) = exe.run(main, feed={"n": nodes, "e": edges}, fetch_list=[out],
+                     scope=scope)
+    expect = _np_tree_conv(nodes[0], edges[0], w, 3)
+    np.testing.assert_allclose(np.asarray(got)[0], expect, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_tree_conv_dygraph_matches_static():
+    from paddle_tpu import dygraph
+
+    rng = np.random.RandomState(15)
+    edges = np.zeros((1, 4, 2), "int32")
+    edges[0, :2] = [[1, 2], [1, 3]]
+    nodes = rng.randn(1, 4, 3).astype("f4")
+    with dygraph.guard():
+        tc = dygraph.TreeConv(3, 2, 2, max_depth=2, act=None, bias_attr=False)
+        dy = tc(dygraph.to_variable(nodes),
+                dygraph.to_variable(edges)).numpy()
+        w = np.asarray(tc.weight.value)
+    expect = _np_tree_conv(nodes[0], edges[0], w, 2)
+    np.testing.assert_allclose(dy[0], expect, rtol=1e-4, atol=1e-4)
