@@ -541,8 +541,6 @@ class TreeConv(Layer):
                  act="tanh", param_attr=None, bias_attr=None, name=None,
                  dtype="float32"):
         super().__init__(name or "tree_conv", dtype)
-        self._output_size = output_size
-        self._num_filters = num_filters
         self._max_depth = max_depth
         self._act = act
         self.weight = self.create_parameter(

@@ -646,3 +646,32 @@ def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
                               "OutputAssignBox": [assigned.name]},
                      attrs=attrs)
     return decoded, assigned
+
+
+def polygon_box_transform(input, name=None):
+    """reference layers/detection.py polygon_box_transform (EAST)."""
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = _out(helper, input.dtype, shape=input.shape)
+    helper.append_op("polygon_box_transform", inputs={"Input": [input.name]},
+                     outputs={"Output": [out.name]})
+    return out
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              rois_batch=None, name=None):
+    """reference layers/detection.py roi_perspective_transform; dense
+    [R, 8] quad rois + optional batch-index vector."""
+    helper = LayerHelper("roi_perspective_transform", name=name)
+    out = _out(helper, input.dtype)
+    inputs = {"X": [input.name], "ROIs": [rois.name]}
+    if rois_batch is not None:
+        inputs["RoisBatch"] = [rois_batch.name]
+    helper.append_op(
+        "roi_perspective_transform", inputs=inputs,
+        outputs={"Out": [out.name]},
+        attrs={"transformed_height": transformed_height,
+               "transformed_width": transformed_width,
+               "spatial_scale": spatial_scale},
+    )
+    return out

@@ -593,3 +593,13 @@ def tree_conv(nodes_vector, edge_set, output_size, num_filters=1, max_depth=2,
     if bias_attr is not False:
         out = helper.append_bias_op(out, bias_attr, [num_filters], dim_start=3)
     return helper.append_activation(out)
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """reference layers/nn.py similarity_focus."""
+    helper = LayerHelper("similarity_focus", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("similarity_focus", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"axis": axis, "indexes": list(indexes)})
+    return out

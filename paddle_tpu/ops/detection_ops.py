@@ -1407,3 +1407,78 @@ def _box_decoder_and_assign(ctx, op, ins):
         decoded, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
     return {"DecodeBox": decoded.reshape(R, 4 * C),
             "OutputAssignBox": assigned}
+
+
+@register_op("polygon_box_transform")
+def _polygon_box_transform(ctx, op, ins):
+    """reference detection/polygon_box_transform_op.cc: EAST geometry maps
+    to absolute quad coordinates — even channels 4*w_idx - in, odd
+    channels 4*h_idx - in."""
+    x = first(ins, "Input")  # [N, 8k, H, W]
+    N, G, H, W = x.shape
+    wgrid = 4.0 * jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    hgrid = 4.0 * jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    even = (jnp.arange(G) % 2 == 0).reshape(1, G, 1, 1)
+    return {"Output": jnp.where(even, wgrid - x, hgrid - x)}
+
+
+@register_op("roi_perspective_transform")
+def _roi_perspective_transform(ctx, op, ins):
+    """reference detection/roi_perspective_transform_op.cc: each quad roi
+    maps to a [transformed_h, transformed_w] patch via the closed-form
+    quad->rect homography (get_transform_matrix:110); out-of-quad samples
+    are 0.  Dense [R, 8] rois + RoisBatch vector (static-shape form)."""
+    x_in = first(ins, "X")
+    x = x_in.astype(jnp.float32)                     # [N, C, H, W]
+    rois = first(ins, "ROIs").astype(jnp.float32)    # [R, 8]
+    batch_idx = ins.get("RoisBatch")
+    batch_idx = (batch_idx[0].reshape(-1).astype(jnp.int32)
+                 if batch_idx else jnp.zeros((rois.shape[0],), jnp.int32))
+    TH = op.attr("transformed_height")
+    TW = op.attr("transformed_width")
+    scale = op.attr("spatial_scale", 1.0)
+    N, C, H, W = x.shape
+
+    def one(roi, bi):
+        rx = roi[0::2] * scale
+        ry = roi[1::2] * scale
+        x0, x1, x2, x3 = rx[0], rx[1], rx[2], rx[3]
+        y0, y1, y2, y3 = ry[0], ry[1], ry[2], ry[3]
+        len1 = jnp.sqrt((x0 - x1) ** 2 + (y0 - y1) ** 2)
+        len2 = jnp.sqrt((x1 - x2) ** 2 + (y1 - y2) ** 2)
+        len3 = jnp.sqrt((x2 - x3) ** 2 + (y2 - y3) ** 2)
+        len4 = jnp.sqrt((x3 - x0) ** 2 + (y3 - y0) ** 2)
+        est_h = (len2 + len4) / 2.0
+        est_w = (len1 + len3) / 2.0
+        nh = TH
+        nw = jnp.minimum(jnp.round(est_w * (nh - 1) / jnp.maximum(est_h, 1e-6)) + 1,
+                         TW)
+        dx1, dx2, dx3 = x1 - x2, x3 - x2, x0 - x1 + x2 - x3
+        dy1, dy2, dy3 = y1 - y2, y3 - y2, y0 - y1 + y2 - y3
+        den = dx1 * dy2 - dx2 * dy1
+        den = jnp.where(jnp.abs(den) < 1e-12, 1e-12, den)
+        m6 = (dx3 * dy2 - dx2 * dy3) / den / jnp.maximum(nw - 1, 1.0)
+        m7 = (dx1 * dy3 - dx3 * dy1) / den / jnp.maximum(nh - 1, 1.0)
+        m3 = (y1 - y0 + m6 * (nw - 1) * y1) / jnp.maximum(nw - 1, 1.0)
+        m4 = (y3 - y0 + m7 * (nh - 1) * y3) / jnp.maximum(nh - 1, 1.0)
+        m5 = y0
+        m0 = (x1 - x0 + m6 * (nw - 1) * x1) / jnp.maximum(nw - 1, 1.0)
+        m1 = (x3 - x0 + m7 * (nh - 1) * x3) / jnp.maximum(nh - 1, 1.0)
+        m2 = x0
+        ow = jnp.arange(TW, dtype=jnp.float32)[None, :]
+        oh = jnp.arange(TH, dtype=jnp.float32)[:, None]
+        denom = m6 * ow + m7 * oh + 1.0
+        in_w = (m0 * ow + m1 * oh + m2) / denom
+        in_h = (m3 * ow + m4 * oh + m5) / denom
+        # reference in_quad check: only output cells within the normalized
+        # patch extent sample; extrapolated columns/rows are zero
+        inside = ((in_w >= -0.5) & (in_w < W - 0.5)
+                  & (in_h >= -0.5) & (in_h < H - 0.5)
+                  & (ow < nw) & (oh < nh))
+        from ..ops.nn_ops import _bilinear_sample_grid
+
+        v = _bilinear_sample_grid(x[bi], in_h, in_w)  # [C, TH, TW]
+        return jnp.where(inside[None], v, 0.0)
+
+    out = jax.vmap(one)(rois, batch_idx)
+    return {"Out": out.astype(x.dtype)}

@@ -835,3 +835,45 @@ def _tree_conv(ctx, op, ins):
     out = jax.vmap(lambda n, e: tree_conv_math(n, e, w, max_depth))(
         nodes, edges)
     return {"Out": out.astype(nodes.dtype)}
+
+
+@register_op("similarity_focus")
+def _similarity_focus(ctx, op, ins):
+    """reference similarity_focus_op.h: for each selected index on `axis`,
+    greedily pick max-valued positions whose remaining two coordinate lines
+    are untagged (a greedy assignment over the plane), and set the focus
+    mask 1 across the whole axis at the picked positions."""
+    x_in = first(ins, "X")
+    x = x_in.astype(jnp.float32)  # [B, d1, d2, d3]
+    axis = op.attr("axis")
+    indexes = list(op.attr("indexes"))
+    if axis not in (1, 2, 3):
+        raise NotImplementedError("similarity_focus: axis must be 1, 2 or 3")
+    # canonicalize to axis=1
+    perm = {1: (0, 1, 2, 3), 2: (0, 2, 1, 3), 3: (0, 3, 1, 2)}[axis]
+    inv = {1: (0, 1, 2, 3), 2: (0, 2, 1, 3), 3: (0, 2, 3, 1)}[axis]
+    xc = jnp.transpose(x, perm)  # [B, A, P, Q]
+    B, A, P, Q = xc.shape
+    steps = min(P, Q)
+
+    def one(plane):  # [P, Q] -> mask [P, Q]
+        def body(_, state):
+            mask, tag_p, tag_q = state
+            avail = ~tag_p[:, None] & ~tag_q[None, :]
+            cand = jnp.where(avail, plane, -jnp.inf)
+            flat = jnp.argmax(cand)
+            p, q = flat // Q, flat % Q
+            mask = mask.at[p, q].set(1.0)
+            return mask, tag_p.at[p].set(True), tag_q.at[q].set(True)
+
+        m, _, _ = jax.lax.fori_loop(
+            0, steps, body,
+            (jnp.zeros((P, Q)), jnp.zeros((P,), bool), jnp.zeros((Q,), bool)))
+        return m
+
+    masks = [jax.vmap(one)(xc[:, idx]) for idx in indexes]
+    total = masks[0]
+    for m in masks[1:]:
+        total = jnp.maximum(total, m)
+    out = jnp.broadcast_to(total[:, None], (B, A, P, Q))
+    return {"Out": jnp.transpose(out, inv).astype(x_in.dtype)}

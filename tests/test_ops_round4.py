@@ -990,3 +990,100 @@ def test_tree_conv_dygraph_matches_static():
         w = np.asarray(tc.weight.value)
     expect = _np_tree_conv(nodes[0], edges[0], w, 2)
     np.testing.assert_allclose(dy[0], expect, rtol=1e-4, atol=1e-4)
+
+
+def test_polygon_box_transform_golden():
+    rng = np.random.RandomState(17)
+    x = rng.randn(1, 8, 3, 4).astype("f4")
+    main, startup = fluid.Program(), fluid.Program()
+    with program_guard(main, startup):
+        xv = fluid.layers.data("x", [8, 3, 4], dtype="float32")
+        out = fluid.layers.polygon_box_transform(xv)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    (got,) = exe.run(main, feed={"x": x}, fetch_list=[out], scope=scope)
+    got = np.asarray(got)
+    for g in range(8):
+        for h in range(3):
+            for w in range(4):
+                ref = (4 * w - x[0, g, h, w]) if g % 2 == 0 else (4 * h - x[0, g, h, w])
+                np.testing.assert_allclose(got[0, g, h, w], ref, rtol=1e-5)
+
+
+def test_roi_perspective_transform_axis_aligned_identity():
+    """an axis-aligned rect quad reduces the homography to plain bilinear
+    resampling of that rect."""
+    rng = np.random.RandomState(18)
+    x = rng.rand(1, 2, 8, 8).astype("f4")
+    # quad = rect (1,1)-(6,1)-(6,6)-(1,6), output 6x6 -> identity sampling
+    rois = np.array([[1, 1, 6, 1, 6, 6, 1, 6]], "f4")
+    main, startup = fluid.Program(), fluid.Program()
+    with program_guard(main, startup):
+        xv = fluid.layers.data("x", [2, 8, 8], dtype="float32")
+        rv = fluid.layers.data("r", [8], dtype="float32")
+        out = fluid.layers.roi_perspective_transform(xv, rv, 6, 6, 1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    (got,) = exe.run(main, feed={"x": x, "r": rois}, fetch_list=[out],
+                     scope=scope)
+    got = np.asarray(got)
+    np.testing.assert_allclose(got[0, :, :6, :6], x[0, :, 1:7, 1:7],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_similarity_focus_golden():
+    """numpy transcription of similarity_focus_op.h's greedy tagging."""
+    rng = np.random.RandomState(19)
+    x = rng.rand(2, 3, 4, 5).astype("f4")
+
+    def np_ref(x, indexes):
+        B, A, P, Q = x.shape
+        out = np.zeros_like(x)
+        for b in range(B):
+            total = np.zeros((P, Q))
+            for idx in indexes:
+                plane = x[b, idx]
+                order = np.argsort(-plane.reshape(-1))
+                tag_p = np.zeros(P, bool)
+                tag_q = np.zeros(Q, bool)
+                for f in order:
+                    p, q = f // Q, f % Q
+                    if tag_p[p] or tag_q[q]:
+                        continue
+                    tag_p[p] = tag_q[q] = True
+                    total[p, q] = 1.0
+            out[b, :, :, :] = total[None]
+        return out
+
+    main, startup = fluid.Program(), fluid.Program()
+    with program_guard(main, startup):
+        xv = fluid.layers.data("x", [3, 4, 5], dtype="float32")
+        out = fluid.layers.similarity_focus(xv, axis=1, indexes=[0, 2])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    (got,) = exe.run(main, feed={"x": x}, fetch_list=[out], scope=scope)
+    np.testing.assert_allclose(np.asarray(got), np_ref(x, [0, 2]), atol=1e-6)
+
+
+def test_roi_perspective_transform_masks_extrapolated_columns():
+    """narrow quad: columns beyond the normalized width are zero
+    (reference in_quad check)."""
+    x = np.ones((1, 1, 10, 10), "f4")
+    rois = np.array([[2, 2, 4, 2, 4, 8, 2, 8]], "f4")  # 2 wide, 6 tall
+    main, startup = fluid.Program(), fluid.Program()
+    with program_guard(main, startup):
+        xv = fluid.layers.data("x", [1, 10, 10], dtype="float32")
+        rv = fluid.layers.data("r", [8], dtype="float32")
+        out = fluid.layers.roi_perspective_transform(xv, rv, 7, 7, 1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    (got,) = exe.run(main, feed={"x": x, "r": rois}, fetch_list=[out],
+                     scope=scope)
+    got = np.asarray(got)[0, 0]
+    # nw = round(2 * 6 / 6) + 1 = 3: columns 0-2 sample, 3+ are zeroed
+    assert (got[:, :3] > 0).all()
+    assert (got[:, 3:] == 0).all()
