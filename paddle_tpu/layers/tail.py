@@ -807,3 +807,40 @@ def deformable_conv(input, offset, mask, num_filters, filter_size, stride=1,
     pre_act = helper.append_bias_op(pre_bias, bias_attr, [num_filters],
                                     dim_start=1)
     return pre_act
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1, position_sensitive=False,
+                           rois_batch=None, name=None):
+    """reference layers/nn.py:12250 deformable_roi_pooling over
+    deformable_psroi_pooling_op.h; dense [R, 4] rois + optional batch
+    vector (static-shape form)."""
+    helper = LayerHelper("deformable_psroi_pooling", name=name)
+    c_in = int(input.shape[1])
+    gh, gw = (group_size if isinstance(group_size, (list, tuple))
+              else (group_size, group_size))
+    # reference layers/nn.py: position-sensitive pooling divides channels
+    # by the POOLED grid (each bin owns its channel slice)
+    output_dim = (c_in // (pooled_height * pooled_width)
+                  if position_sensitive else c_in)
+    if part_size is None:
+        part_size = (pooled_height, pooled_width)
+    out = _out(helper, input.dtype)
+    cnt = _out(helper, "float32")
+    inputs = {"Input": [input.name], "ROIs": [rois.name]}
+    if not no_trans and trans is not None:
+        inputs["Trans"] = [trans.name]
+    if rois_batch is not None:
+        inputs["RoisBatch"] = [rois_batch.name]
+    helper.append_op(
+        "deformable_psroi_pooling", inputs=inputs,
+        outputs={"Output": [out.name], "TopCount": [cnt.name]},
+        attrs={"no_trans": no_trans, "spatial_scale": spatial_scale,
+               "output_dim": output_dim, "group_size": [gh, gw],
+               "pooled_height": pooled_height, "pooled_width": pooled_width,
+               "part_size": list(part_size),
+               "sample_per_part": sample_per_part, "trans_std": trans_std},
+    )
+    return out

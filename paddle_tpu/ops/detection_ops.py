@@ -1475,10 +1475,109 @@ def _roi_perspective_transform(ctx, op, ins):
         inside = ((in_w >= -0.5) & (in_w < W - 0.5)
                   & (in_h >= -0.5) & (in_h < H - 0.5)
                   & (ow < nw) & (oh < nh))
-        from ..ops.nn_ops import _bilinear_sample_grid
-
-        v = _bilinear_sample_grid(x[bi], in_h, in_w)  # [C, TH, TW]
+        # reference bilinear_interpolate clamps near-border coordinates to
+        # the border pixel (unlike the deformable-conv zero-attenuation)
+        wcl = jnp.clip(in_w, 0.0, W - 1.0)
+        hcl = jnp.clip(in_h, 0.0, H - 1.0)
+        yl = jnp.floor(hcl).astype(jnp.int32)
+        xl = jnp.floor(wcl).astype(jnp.int32)
+        yh = jnp.clip(yl + 1, 0, H - 1)
+        xh = jnp.clip(xl + 1, 0, W - 1)
+        fy = hcl - yl
+        fx = wcl - xl
+        img = x[bi]
+        v = ((img[:, yl, xl] * (1 - fx) + img[:, yl, xh] * fx) * (1 - fy)
+             + (img[:, yh, xl] * (1 - fx) + img[:, yh, xh] * fx) * fy)
         return jnp.where(inside[None], v, 0.0)
 
     out = jax.vmap(one)(rois, batch_idx)
     return {"Out": out.astype(x.dtype)}
+
+
+@register_op("deformable_psroi_pooling")
+def _deformable_psroi_pooling(ctx, op, ins):
+    """Deformable position-sensitive RoI pooling (reference
+    deformable_psroi_pooling_op.h): psroi bins whose start positions shift
+    by learned per-part offsets (Trans, scaled by trans_std), each bin
+    averaging sample_per_part^2 clamped bilinear samples; out-of-image
+    samples are dropped from the average."""
+    x_in = first(ins, "Input")
+    x = x_in.astype(jnp.float32)                     # [N, C, H, W]
+    rois = first(ins, "ROIs").astype(jnp.float32)    # [R, 4]
+    trans = (first(ins, "Trans").astype(jnp.float32)
+             if ins.get("Trans") else None)          # [R, 2*ncls, PH_p, PW_p]
+    batch_idx = ins.get("RoisBatch")
+    batch_idx = (batch_idx[0].reshape(-1).astype(jnp.int32)
+                 if batch_idx else jnp.zeros((rois.shape[0],), jnp.int32))
+    no_trans = op.attr("no_trans", False) or trans is None
+    scale = op.attr("spatial_scale", 1.0)
+    od = op.attr("output_dim")
+    gh_, gw_ = op.attr("group_size", [1, 1])
+    PH = op.attr("pooled_height", 1)
+    PW = op.attr("pooled_width", 1)
+    part_h, part_w = op.attr("part_size", [PH, PW])
+    S = op.attr("sample_per_part", 1)
+    trans_std = op.attr("trans_std", 0.1)
+    N, C, H, W = x.shape
+    ncls = 1 if no_trans else trans.shape[1] // 2
+    cec = od if no_trans else od // ncls  # channels per class
+
+    # static per-output-cell index tables
+    ph_i, pw_i = np.meshgrid(np.arange(PH), np.arange(PW), indexing="ij")
+    gh_i = np.clip((ph_i * gh_ // PH), 0, gh_ - 1)
+    gw_i = np.clip((pw_i * gw_ // PW), 0, gw_ - 1)
+    prt_h = np.floor(ph_i / PH * part_h).astype(np.int32)
+    prt_w = np.floor(pw_i / PW * part_w).astype(np.int32)
+    ct = np.arange(od)
+    c_idx = ((ct[:, None, None] * gh_ + gh_i[None]) * gw_
+             + gw_i[None])                        # [OD, PH, PW]
+    cls_id = (ct // cec)                          # [OD]
+
+    def one(roi, tr, bi):
+        img = x[bi]
+        x0 = jnp.round(roi[0]) * scale - 0.5
+        y0 = jnp.round(roi[1]) * scale - 0.5
+        x1 = (jnp.round(roi[2]) + 1.0) * scale - 0.5
+        y1 = (jnp.round(roi[3]) + 1.0) * scale - 0.5
+        rw = jnp.maximum(x1 - x0, 0.1)
+        rh = jnp.maximum(y1 - y0, 0.1)
+        bw, bh = rw / PW, rh / PH
+        sw, sh = bw / S, bh / S
+        if no_trans:
+            tx = jnp.zeros((od, PH, PW))
+            ty = jnp.zeros((od, PH, PW))
+        else:
+            tx = tr[2 * cls_id[:, None, None], prt_h[None], prt_w[None]] * trans_std
+            ty = tr[2 * cls_id[:, None, None] + 1, prt_h[None], prt_w[None]] * trans_std
+        wstart = pw_i[None] * bw + x0 + tx * rw   # [OD, PH, PW]
+        hstart = ph_i[None] * bh + y0 + ty * rh
+        ws = wstart[..., None, None] + np.arange(S)[None, None, None, None, :] * sw
+        hs = hstart[..., None, None] + np.arange(S)[None, None, None, :, None] * sh
+        valid = ((ws >= -0.5) & (ws <= W - 0.5) & (hs >= -0.5) & (hs <= H - 0.5))
+        wc = jnp.clip(ws, 0.0, W - 1.0)
+        hc = jnp.clip(hs, 0.0, H - 1.0)
+        xl = jnp.floor(wc).astype(jnp.int32)
+        yl = jnp.floor(hc).astype(jnp.int32)
+        xh = jnp.clip(xl + 1, 0, W - 1)
+        yh = jnp.clip(yl + 1, 0, H - 1)
+        fx = wc - xl
+        fy = hc - yl
+        cmap = jnp.asarray(c_idx)[..., None, None]
+        cmap = jnp.broadcast_to(cmap, ws.shape)
+        v00 = img[cmap, yl, xl]
+        v01 = img[cmap, yl, xh]
+        v10 = img[cmap, yh, xl]
+        v11 = img[cmap, yh, xh]
+        val = ((v00 * (1 - fx) + v01 * fx) * (1 - fy)
+               + (v10 * (1 - fx) + v11 * fx) * fy)
+        val = jnp.where(valid, val, 0.0)
+        cnt = jnp.sum(valid, axis=(-2, -1))
+        avg = jnp.where(cnt > 0, jnp.sum(val, axis=(-2, -1))
+                        / jnp.maximum(cnt, 1), 0.0)
+        return avg, cnt.astype(jnp.float32)
+
+    if no_trans:
+        out, counts = jax.vmap(lambda r, b: one(r, None, b))(rois, batch_idx)
+    else:
+        out, counts = jax.vmap(one)(rois, trans, batch_idx)
+    return {"Output": out.astype(x_in.dtype), "TopCount": counts}

@@ -1087,3 +1087,85 @@ def test_roi_perspective_transform_masks_extrapolated_columns():
     # nw = round(2 * 6 / 6) + 1 = 3: columns 0-2 sample, 3+ are zeroed
     assert (got[:, :3] > 0).all()
     assert (got[:, 3:] == 0).all()
+
+
+def test_deformable_roi_pooling_zero_trans_is_average():
+    """zero offsets + 1 sample per part: each bin averages its bilinear
+    sample at the bin start (numpy transcription of the reference loop)."""
+    rng = np.random.RandomState(20)
+    x = rng.rand(1, 4, 8, 8).astype("f4")
+    rois = np.array([[0, 0, 7, 7]], "f4")
+
+    def np_ref(x, roi, PH, PW, S, scale):
+        C, H, W = x.shape[1:]
+        x0 = round(roi[0]) * scale - 0.5
+        y0 = round(roi[1]) * scale - 0.5
+        x1 = (round(roi[2]) + 1) * scale - 0.5
+        y1 = (round(roi[3]) + 1) * scale - 0.5
+        rw, rh = max(x1 - x0, 0.1), max(y1 - y0, 0.1)
+        bw, bh = rw / PW, rh / PH
+        swb, shb = bw / S, bh / S
+        out = np.zeros((C, PH, PW))
+        for c in range(C):
+            for ph in range(PH):
+                for pw in range(PW):
+                    tot, n = 0.0, 0
+                    for ih in range(S):
+                        for iw in range(S):
+                            w = pw * bw + x0 + iw * swb
+                            h = ph * bh + y0 + ih * shb
+                            if w < -0.5 or w > W - 0.5 or h < -0.5 or h > H - 0.5:
+                                continue
+                            w = min(max(w, 0), W - 1)
+                            h = min(max(h, 0), H - 1)
+                            xl, yl = int(np.floor(w)), int(np.floor(h))
+                            xh, yh = min(xl + 1, W - 1), min(yl + 1, H - 1)
+                            fx, fy = w - xl, h - yl
+                            v = ((x[0, c, yl, xl] * (1 - fx) + x[0, c, yl, xh] * fx) * (1 - fy)
+                                 + (x[0, c, yh, xl] * (1 - fx) + x[0, c, yh, xh] * fx) * fy)
+                            tot += v
+                            n += 1
+                    out[c, ph, pw] = tot / n if n else 0.0
+        return out
+
+    main, startup = fluid.Program(), fluid.Program()
+    with program_guard(main, startup):
+        xv = fluid.layers.data("x", [4, 8, 8], dtype="float32")
+        rv = fluid.layers.data("r", [4], dtype="float32")
+        out = fluid.layers.deformable_roi_pooling(
+            xv, rv, None, no_trans=True, pooled_height=2, pooled_width=2,
+            sample_per_part=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    (got,) = exe.run(main, feed={"x": x, "r": rois}, fetch_list=[out],
+                     scope=scope)
+    np.testing.assert_allclose(np.asarray(got)[0],
+                               np_ref(x, rois[0], 2, 2, 2, 1.0),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_roi_pooling_trans_shifts_and_grads():
+    rng = np.random.RandomState(21)
+    x = rng.rand(1, 4, 8, 8).astype("f4")
+    rois = np.array([[0, 0, 7, 7]], "f4")
+    main, startup = fluid.Program(), fluid.Program()
+    with program_guard(main, startup):
+        xv = fluid.layers.data("x", [4, 8, 8], dtype="float32")
+        rv = fluid.layers.data("r", [4], dtype="float32")
+        tv = fluid.layers.data("t", [2, 2, 2], dtype="float32")
+        out = fluid.layers.deformable_roi_pooling(
+            xv, rv, tv, pooled_height=2, pooled_width=2, sample_per_part=2)
+        loss = fluid.layers.mean(out)
+        (g,) = fluid.calc_gradient(loss, [tv])
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    t0 = np.zeros((1, 2, 2, 2), "f4")
+    t1 = np.full((1, 2, 2, 2), 0.5, "f4")
+    (o0,) = exe.run(main, feed={"x": x, "r": rois, "t": t0},
+                    fetch_list=[out], scope=scope)
+    o1, gv = exe.run(main, feed={"x": x, "r": rois, "t": t1},
+                     fetch_list=[out, g], scope=scope)
+    assert not np.allclose(np.asarray(o0), np.asarray(o1))
+    assert np.isfinite(np.asarray(gv)).all()
