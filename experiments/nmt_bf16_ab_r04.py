@@ -1,0 +1,37 @@
+"""NMT f32 vs bf16-compute A/B (interleaved; the BERT precision fix
+applied to the ragged transformer bench)."""
+import sys
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu.models import nmt
+from tools.opbench import interleave
+
+
+def make(dtype):
+    main, startup, feeds, fetches = nmt.build_transformer_nmt(
+        src_vocab=8000, tgt_vocab=8000, d_model=512, n_layers=6, n_heads=8,
+        d_ff=2048, dropout=0.1, learning_rate=2.0, dtype=dtype)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    b = 32
+    ls = rng.randint(20, 64, size=b).tolist()
+    lt = rng.randint(20, 64, size=b).tolist()
+    batch = nmt.make_fake_nmt_batch(ls, lt, 8000, 8000)
+    exe.run(main, feed=batch, fetch_list=[fetches["loss"]], scope=scope)
+
+    def dispatch():
+        return exe.run(main, feed=batch, fetch_list=[fetches["loss"]],
+                       scope=scope, return_numpy=False)
+
+    return dispatch
+
+
+variants = {"f32": make("float32"), "bf16": make("bfloat16")}
+stats = interleave(variants, rounds=4, iters=4)
+for name, st in stats.items():
+    print(f"{name}: best {st['best_ms']:.1f} ms  ({32 / (st['best_ms'] / 1e3):.0f} seqs/s)  "
+          f"median {st['median_ms']:.1f}  spread {st['spread_pct']}%")

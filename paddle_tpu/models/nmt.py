@@ -67,6 +67,7 @@ def build_transformer_nmt(
     warmup_steps=400,
     with_optimizer=True,
     is_test=False,
+    dtype="float32",
 ):
     """Returns (main, startup, feeds, fetches).
 
@@ -83,6 +84,21 @@ def build_transformer_nmt(
 
         enc = _embed(src, src_vocab, d_model, "src", dropout, is_test)
         enc_bias = layers.attention_bias(enc, enc, causal=False)
+
+        def _to_compute(v):
+            # bf16 compute path (same recipe as build_bert): one cast on the
+            # activations; master weights stay f32 via per-op match_dtype
+            if dtype == "float32":
+                return v
+            lod = getattr(v, "_lod_ref", None)
+            out = layers.cast(v, dtype)
+            if lod is not None:
+                out._lod_ref = lod
+                out.lod_level = 1
+            return out
+
+        enc = _to_compute(enc)
+        enc_bias = _to_compute(enc_bias)
         for i in range(n_layers):
             p = f"enc{i}"
             enc = _add_norm(enc, _mha(enc, enc, enc_bias, d_model, n_heads,
@@ -93,6 +109,9 @@ def build_transformer_nmt(
         dec = _embed(tgt, tgt_vocab, d_model, "tgt", dropout, is_test)
         self_bias = layers.attention_bias(dec, dec, causal=True)
         cross_bias = layers.attention_bias(dec, enc, causal=False)
+        dec = _to_compute(dec)
+        self_bias = _to_compute(self_bias)
+        cross_bias = _to_compute(cross_bias)
         for i in range(n_layers):
             p = f"dec{i}"
             dec = _add_norm(dec, _mha(dec, dec, self_bias, d_model, n_heads,
@@ -104,6 +123,12 @@ def build_transformer_nmt(
 
         logits = layers.fc(dec, tgt_vocab, num_flatten_dims=2,
                            param_attr=_attr("proj.w"), bias_attr=_attr("proj.b"))
+        if dtype != "float32":
+            lod = getattr(logits, "_lod_ref", None)
+            logits = layers.cast(logits, "float32")
+            if lod is not None:
+                logits._lod_ref = lod
+                logits.lod_level = 1
 
         if label_smooth_eps:
             smooth = layers.label_smooth(layers.one_hot(lbl, tgt_vocab),
