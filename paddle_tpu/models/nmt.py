@@ -87,18 +87,15 @@ def build_transformer_nmt(
 
         def _to_compute(v):
             # bf16 compute path (same recipe as build_bert): one cast on the
-            # activations; master weights stay f32 via per-op match_dtype
+            # activations; master weights stay f32 via per-op match_dtype,
+            # and biases stay f32 (the add's match_dtype casts them in)
             if dtype == "float32":
                 return v
-            lod = getattr(v, "_lod_ref", None)
-            out = layers.cast(v, dtype)
-            if lod is not None:
-                out._lod_ref = lod
-                out.lod_level = 1
-            return out
+            from ..layers.nn import _keep_lod
+
+            return _keep_lod(v, layers.cast(v, dtype))
 
         enc = _to_compute(enc)
-        enc_bias = _to_compute(enc_bias)
         for i in range(n_layers):
             p = f"enc{i}"
             enc = _add_norm(enc, _mha(enc, enc, enc_bias, d_model, n_heads,
@@ -110,8 +107,6 @@ def build_transformer_nmt(
         self_bias = layers.attention_bias(dec, dec, causal=True)
         cross_bias = layers.attention_bias(dec, enc, causal=False)
         dec = _to_compute(dec)
-        self_bias = _to_compute(self_bias)
-        cross_bias = _to_compute(cross_bias)
         for i in range(n_layers):
             p = f"dec{i}"
             dec = _add_norm(dec, _mha(dec, dec, self_bias, d_model, n_heads,
@@ -124,11 +119,9 @@ def build_transformer_nmt(
         logits = layers.fc(dec, tgt_vocab, num_flatten_dims=2,
                            param_attr=_attr("proj.w"), bias_attr=_attr("proj.b"))
         if dtype != "float32":
-            lod = getattr(logits, "_lod_ref", None)
-            logits = layers.cast(logits, "float32")
-            if lod is not None:
-                logits._lod_ref = lod
-                logits.lod_level = 1
+            from ..layers.nn import _keep_lod
+
+            logits = _keep_lod(logits, layers.cast(logits, "float32"))
 
         if label_smooth_eps:
             smooth = layers.label_smooth(layers.one_hot(lbl, tgt_vocab),
