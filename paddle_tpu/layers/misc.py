@@ -603,3 +603,13 @@ def similarity_focus(input, axis, indexes, name=None):
                      outputs={"Out": [out.name]},
                      attrs={"axis": axis, "indexes": list(indexes)})
     return out
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """reference layers/nn.py hash over hash_op.h (XXH64 % hash_size)."""
+    helper = LayerHelper("hash", name=name)
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op("hash", inputs={"X": [input.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"mod_by": hash_size, "num_hash": num_hash})
+    return out

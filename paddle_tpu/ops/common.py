@@ -77,3 +77,34 @@ def flatten_lookup_ids(ids):
     strip it when present.  Shared by the lookup lowering and the sparse-grad
     assembler (core/lowering.py) so SelectedRows rows/values stay aligned."""
     return ids.reshape(ids.shape[:-1]) if ids.shape and ids.shape[-1] == 1 else ids
+
+
+def host_callback(ctx, fn, result_shape, *args):
+    """jax.pure_callback with a CLEAR failure on backends that cannot do
+    host send/recv (the axon TPU tunnel): host-side ops (py_func, hash,
+    detection_map, chunk_eval) are metric/data transforms — run their
+    program on CPUPlace there.  Real PJRT TPU runtimes support callbacks;
+    this is a tunnel limitation, not a design one."""
+    import jax
+
+    if _platform_lacks_callbacks(getattr(ctx, "platform", None)):
+        raise NotImplementedError(
+            "this op runs a host callback (jax.pure_callback), which the "
+            "axon TPU tunnel does not support; execute this program on "
+            "CPUPlace (metrics/data transforms are host-side work) or on a "
+            "PJRT runtime with send/recv callbacks")
+    return jax.pure_callback(fn, result_shape, *args)
+
+
+def _platform_lacks_callbacks(platform):
+    """The axon tunnel reports platform 'tpu' but rejects host send/recv;
+    it is identifiable by its platform_version string."""
+    if platform in (None, "cpu"):
+        return False
+    import jax
+
+    for d in jax.local_devices():
+        if d.platform == platform:
+            ver = getattr(d.client, "platform_version", "") or ""
+            return "axon" in ver
+    return False

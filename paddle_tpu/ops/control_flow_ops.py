@@ -243,5 +243,7 @@ def _py_func(ctx, op, ins):
         return tuple(np.asarray(o, dtype=rs.dtype)
                      for o, rs in zip(outs, result_shape))
 
-    outs = jax.pure_callback(host_fn, tuple(result_shape), *xs)
+    from .common import host_callback
+
+    outs = host_callback(ctx, host_fn, tuple(result_shape), *xs)
     return {"Out": list(outs)}

@@ -1034,8 +1034,10 @@ def _detection_map(ctx, op, ins):
                                  class_num, overlap_threshold, ap_type,
                                  background_label, evaluate_difficult)
 
-    out = jax.pure_callback(host, jax.ShapeDtypeStruct((), jnp.float32),
-                            det, gt, gt_lens)
+    from .common import host_callback
+
+    out = host_callback(ctx, host, jax.ShapeDtypeStruct((), jnp.float32),
+                        det, gt, gt_lens)
     return {"MAP": out.reshape(1)}
 
 

@@ -715,7 +715,9 @@ def _chunk_eval(ctx, op, ins):
 
     shapes = (jax.ShapeDtypeStruct((), jnp.float32),) * 3 + (
         jax.ShapeDtypeStruct((), jnp.int32),) * 3
-    p, r, f1, ni, nl, nc = jax.pure_callback(host, shapes, inf, lab, lens)
+    from .common import host_callback
+
+    p, r, f1, ni, nl, nc = host_callback(ctx, host, shapes, inf, lab, lens)
     return {"Precision": p.reshape(1), "Recall": r.reshape(1),
             "F1-Score": f1.reshape(1), "NumInferChunks": ni.reshape(1),
             "NumLabelChunks": nl.reshape(1), "NumCorrectChunks": nc.reshape(1)}
@@ -877,3 +879,96 @@ def _similarity_focus(ctx, op, ins):
         total = jnp.maximum(total, m)
     out = jnp.broadcast_to(total[:, None], (B, A, P, Q))
     return {"Out": jnp.transpose(out, inv).astype(x_in.dtype)}
+
+
+_XXP1 = np.uint64(0x9E3779B185EBCA87)
+_XXP2 = np.uint64(0xC2B2AE3D27D4EB4F)
+_XXP3 = np.uint64(0x165667B19E3779F9)
+_XXP4 = np.uint64(0x85EBCA77C2B2AE63)
+_XXP5 = np.uint64(0x27D4EB2F165667C5)
+
+
+def _rotl64(x, r):
+    r = np.uint64(r)
+    return (x << r) | (x >> (np.uint64(64) - r))
+
+
+def _xxh64(data: bytes, seed: int) -> int:
+    """XXH64 (the exact hash the reference hash_op links); numpy uint64
+    transcription of the specification, validated against the published
+    test vectors in tests."""
+    with np.errstate(over="ignore"):
+        seed = np.uint64(seed)
+        n = len(data)
+        i = 0
+        if n >= 32:
+            v = [seed + _XXP1 + _XXP2, seed + _XXP2, seed + np.uint64(0),
+                 seed - _XXP1]
+            while i + 32 <= n:
+                for k in range(4):
+                    lane = np.uint64(int.from_bytes(data[i + 8 * k:i + 8 * k + 8],
+                                                    "little"))
+                    v[k] = _rotl64(v[k] + lane * _XXP2, 31) * _XXP1
+                i += 32
+            acc = (_rotl64(v[0], 1) + _rotl64(v[1], 7) + _rotl64(v[2], 12)
+                   + _rotl64(v[3], 18))
+            for vk in v:
+                acc ^= _rotl64(vk * _XXP2, 31) * _XXP1
+                acc = acc * _XXP1 + _XXP4
+        else:
+            acc = seed + _XXP5
+        acc = acc + np.uint64(n)
+        while i + 8 <= n:
+            lane = np.uint64(int.from_bytes(data[i:i + 8], "little"))
+            acc ^= _rotl64(lane * _XXP2, 31) * _XXP1
+            acc = _rotl64(acc, 27) * _XXP1 + _XXP4
+            i += 8
+        if i + 4 <= n:
+            lane = np.uint64(int.from_bytes(data[i:i + 4], "little"))
+            acc ^= lane * _XXP1
+            acc = _rotl64(acc, 23) * _XXP2 + _XXP3
+            i += 4
+        while i < n:
+            acc ^= np.uint64(data[i]) * _XXP5
+            acc = _rotl64(acc, 11) * _XXP1
+            i += 1
+        acc ^= acc >> np.uint64(33)
+        acc *= _XXP2
+        acc ^= acc >> np.uint64(29)
+        acc *= _XXP3
+        acc ^= acc >> np.uint64(32)
+        return int(acc)
+
+
+@register_op("hash")
+def _hash(ctx, op, ins):
+    """reference hash_op.h: per input row, num_hash XXH64 digests (seed =
+    hash index) of the row's int32 bytes, mod mod_by.  The exact hash
+    function is the contract (embedding slots depend on it), so this runs
+    the spec-exact XXH64 in a host callback."""
+    x = first(ins, "X").astype(jnp.int32)
+    mod_by = op.attr("mod_by")
+    num_hash = op.attr("num_hash", 1)
+    rows = int(np.prod(x.shape[:-1]))
+    last = x.shape[-1]
+
+    try:  # the C library computes identical digests ~100x faster; the
+        # numpy transcription stays as the spec oracle and fallback
+        from xxhash import xxh64_intdigest as _fast_xxh64
+    except ImportError:
+        _fast_xxh64 = _xxh64
+
+    def host(xv):
+        flat = np.asarray(xv, np.int32).reshape(rows, last)
+        out = np.empty((rows, num_hash), np.int32)  # mod_by < 2^31 (x32 mode)
+        for r in range(rows):
+            b = flat[r].tobytes()
+            for j in range(num_hash):
+                out[r, j] = _fast_xxh64(b, j) % mod_by
+        return out
+
+    from .common import host_callback
+
+    out = host_callback(
+        ctx, host, jax.ShapeDtypeStruct((rows, num_hash), jnp.int32), x)
+    return {"Out": out.reshape(tuple(x.shape[:-1]) + (num_hash,))}

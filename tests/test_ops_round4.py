@@ -1169,3 +1169,34 @@ def test_deformable_roi_pooling_trans_shifts_and_grads():
                      fetch_list=[out, g], scope=scope)
     assert not np.allclose(np.asarray(o0), np.asarray(o1))
     assert np.isfinite(np.asarray(gv)).all()
+
+
+def test_xxh64_published_vectors():
+    from paddle_tpu.ops.misc_ops import _xxh64
+
+    assert _xxh64(b"", 0) == 0xEF46DB3751D8E999
+    assert _xxh64(b"a", 0) == 0xD24EC4F1A98C6E5B
+    assert _xxh64(b"abc", 0) == 0x44BC2CF5AD770999
+    # >= 32 bytes exercises the 4-lane path (published long-input vector)
+    assert _xxh64(b"Nobody inspects the spammish repetition", 0) == \
+        0xFBCEA83C8A378BF1
+
+
+def test_hash_op_matches_spec():
+    from paddle_tpu.ops.misc_ops import _xxh64
+
+    x = np.array([[1, 2], [3, 4], [1, 2]], "int32")
+    main, startup = fluid.Program(), fluid.Program()
+    with program_guard(main, startup):
+        xv = fluid.layers.data("x", [2], dtype="int32")
+        out = fluid.layers.hash(xv, hash_size=1000, num_hash=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    (got,) = exe.run(main, feed={"x": x}, fetch_list=[out], scope=scope)
+    got = np.asarray(got)
+    for r in range(3):
+        for j in range(3):
+            assert got[r, j] == _xxh64(x[r].tobytes(), j) % 1000
+    # identical rows hash identically; different rows differ somewhere
+    assert (got[0] == got[2]).all() and not (got[0] == got[1]).all()
