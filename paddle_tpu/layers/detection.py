@@ -675,3 +675,30 @@ def roi_perspective_transform(input, rois, transformed_height,
                "spatial_scale": spatial_scale},
     )
     return out
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution,
+                         gt_poly_lens=None, gt_lengths=None):
+    """Mask-RCNN mask targets (reference layers/detection.py
+    generate_mask_labels).  STATIC-SHAPE deviation: operates on the
+    generate_proposal_labels outputs; gt_segms is [N, G, P, 2] padded
+    polygons (one polygon per gt) + optional point/gt counts.  Returns
+    (mask_rois, roi_has_mask_int32, mask_int32)."""
+    helper = LayerHelper("generate_mask_labels")
+    masks = _out(helper, "int32")
+    has = _out(helper, "int32")
+    mask_rois = _out(helper, rois.dtype)
+    inputs = {"Rois": [rois.name], "LabelsInt32": [labels_int32.name],
+              "GtSegms": [gt_segms.name]}
+    if gt_poly_lens is not None:
+        inputs["GtPolyLens"] = [gt_poly_lens.name]
+    if gt_lengths is not None:
+        inputs["GtLod"] = [gt_lengths.name]
+    helper.append_op(
+        "generate_mask_labels", inputs=inputs,
+        outputs={"MaskInt32": [masks.name], "RoiHasMaskInt32": [has.name],
+                 "MaskRois": [mask_rois.name]},
+        attrs={"num_classes": num_classes, "resolution": resolution},
+    )
+    return mask_rois, has, masks

@@ -1583,3 +1583,92 @@ def _deformable_psroi_pooling(ctx, op, ins):
     else:
         out, counts = jax.vmap(one)(rois, trans, batch_idx)
     return {"Output": out.astype(x_in.dtype), "TopCount": counts}
+
+
+def _np_rasterize_poly(poly, x0, y0, x1, y1, res):
+    """Even-odd point-in-polygon over the res x res grid of the roi
+    (reference mask_util.cc Poly2MaskWrapper's role; polygons in image
+    coordinates)."""
+    xs = x0 + (np.arange(res) + 0.5) * (x1 - x0) / res
+    ys = y0 + (np.arange(res) + 0.5) * (y1 - y0) / res
+    gx, gy = np.meshgrid(xs, ys)
+    inside = np.zeros((res, res), bool)
+    n = len(poly)
+    j = n - 1
+    for i in range(n):
+        xi, yi = poly[i]
+        xj, yj = poly[j]
+        cond = ((yi > gy) != (yj > gy)) & (
+            gx < (xj - xi) * (gy - yi) / (yj - yi + 1e-12) + xi)
+        inside ^= cond
+        j = i
+    return inside.astype(np.int32)
+
+
+@register_op("generate_mask_labels")
+def _generate_mask_labels(ctx, op, ins):
+    """Mask-RCNN mask targets (reference
+    detection/generate_mask_labels_op.cc): for each sampled foreground roi,
+    rasterize its matched gt polygon (best bbox IoU) into the roi-cropped
+    resolution grid, expanded into the label's class block.
+
+    STATIC-SHAPE form over the generate_proposal_labels outputs: Rois
+    [N, R, 4], LabelsInt32 [N, R], GtSegms [N, G, P, 2] padded polygons
+    (+ GtPolyLens [N, G] point counts, GtLod gt counts).  Outputs
+    MaskInt32 [N, R, num_classes*res*res] and RoiHasMaskInt32 [N, R].
+    Host-side geometry -> runs under the host_callback contract (CPUPlace
+    on the axon tunnel, like detection_map)."""
+    rois = first(ins, "Rois").astype(jnp.float32)        # [N, R, 4]
+    labels = first(ins, "LabelsInt32").astype(jnp.int32)  # [N, R]
+    segms = first(ins, "GtSegms").astype(jnp.float32)    # [N, G, P, 2]
+    N, G = segms.shape[0], segms.shape[1]
+    poly_lens = (first(ins, "GtPolyLens").astype(jnp.int32)
+                 if ins.get("GtPolyLens")
+                 else jnp.full((N, G), segms.shape[2], jnp.int32))
+    gt_lens = (first(ins, "GtLod").astype(jnp.int32) if ins.get("GtLod")
+               else jnp.full((N,), G, jnp.int32))
+    C = op.attr("num_classes")
+    res = op.attr("resolution")
+    R = rois.shape[1]
+
+    def host(rois_v, labels_v, segms_v, plens_v, glens_v):
+        masks = np.zeros((N, R, C * res * res), np.int32)
+        has = np.zeros((N, R), np.int32)
+        for i in range(N):
+            polys = []
+            for g in range(int(glens_v[i])):
+                p = segms_v[i, g, :int(plens_v[i, g])]
+                if len(p) >= 3:
+                    polys.append(p)
+            if not polys:
+                continue
+            boxes = np.array([[p[:, 0].min(), p[:, 1].min(),
+                               p[:, 0].max(), p[:, 1].max()] for p in polys])
+            for r in range(R):
+                lab = int(labels_v[i, r])
+                if lab <= 0:
+                    continue
+                bx = rois_v[i, r]
+                ix = np.maximum(0, np.minimum(bx[2], boxes[:, 2])
+                                - np.maximum(bx[0], boxes[:, 0]))
+                iy = np.maximum(0, np.minimum(bx[3], boxes[:, 3])
+                                - np.maximum(bx[1], boxes[:, 1]))
+                inter = ix * iy
+                ua = ((bx[2] - bx[0]) * (bx[3] - bx[1])
+                      + (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+                      - inter)
+                best = int(np.argmax(np.where(ua > 0, inter / np.maximum(ua, 1e-12), 0)))
+                m = _np_rasterize_poly(polys[best], bx[0], bx[1], bx[2], bx[3],
+                                       res)
+                masks[i, r, lab * res * res:(lab + 1) * res * res] = m.reshape(-1)
+                has[i, r] = 1
+        return masks, has
+
+    from .common import host_callback
+
+    masks, has = host_callback(
+        ctx, host,
+        (jax.ShapeDtypeStruct((N, R, C * res * res), jnp.int32),
+         jax.ShapeDtypeStruct((N, R), jnp.int32)),
+        rois, labels, segms, poly_lens, gt_lens)
+    return {"MaskInt32": masks, "RoiHasMaskInt32": has, "MaskRois": rois}

@@ -777,3 +777,31 @@ def test_box_decoder_and_assign_golden():
     np.testing.assert_allclose(np.asarray(a_out)[0], [0, 0, 9, 9], atol=1e-4)
     np.testing.assert_allclose(np.asarray(d_out)[0].reshape(2, 4)[1],
                                [0, 0, 9, 9], atol=1e-4)
+
+
+def test_generate_mask_labels_square_polygon():
+    """a square polygon rasterizes to a filled block in the matched fg
+    roi's class slice."""
+    rois = np.array([[[0, 0, 8, 8], [20, 20, 28, 28]]], "f4")
+    labels = np.array([[2, 0]], "int32")  # roi 0 fg class 2, roi 1 bg
+    # polygon covering the left half of roi 0: x in [0, 4], y in [0, 8]
+    segms = np.array([[[[0, 0], [4, 0], [4, 8], [0, 8]]]], "f4")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        rv = fluid.layers.data("r", [2, 4], dtype="float32")
+        lv = fluid.layers.data("l", [2], dtype="int32")
+        sv = fluid.layers.data("s", [1, 4, 2], dtype="float32")
+        mask_rois, has, masks = fluid.layers.generate_mask_labels(
+            None, None, None, sv, rv, lv, num_classes=3, resolution=4)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    hv, mv = exe.run(main, feed={"r": rois, "l": labels, "s": segms},
+                     fetch_list=[has, masks], scope=scope)
+    hv, mv = np.asarray(hv), np.asarray(mv)
+    assert hv[0].tolist() == [1, 0]
+    m = mv[0, 0].reshape(3, 4, 4)
+    assert (m[0] == 0).all() and (m[1] == 0).all()  # only class 2 block
+    # left half of the roi (columns 0-1 at res 4) filled, right half empty
+    assert (m[2][:, :2] == 1).all() and (m[2][:, 2:] == 0).all()
