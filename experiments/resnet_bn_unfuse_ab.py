@@ -1,0 +1,92 @@
+"""Round-5 interleaved A/B: unfuse BN stats reductions from convolutions.
+
+The r5 profile (experiments/profile_model.py) showed conv fusions carrying
+BN-stat reduce epilogues running at 9-43 TF/s vs ~90-190 for clean convs —
+XLA's conv+reduce output fusion wrecks the MXU tiling.  Variants:
+
+  base        : round-4 lowering (two-pass stats, fused into convs)
+  barrier     : two-pass stats behind an optimization_barrier
+  single      : one fused E[x]/E[x^2] pass, no barrier
+  barrier1    : barrier + single fused stats pass  (expected winner)
+
+  python experiments/resnet_bn_unfuse_ab.py [rounds] [iters]
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def make_dispatch(unfuse, fused_pass, batch_size=256, K=4):
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+    from paddle_tpu.ops import nn_ops
+
+    nn_ops._BN_UNFUSE_CONV = unfuse
+    nn_ops._BN_STATS_FUSED_PASS = fused_pass
+    # the model is bf16, which now takes the fused pass by default — the
+    # baseline variants must explicitly restore the r4 two-pass lowering
+    nn_ops._BN_BF16_FUSED_DEFAULT = fused_pass
+    try:
+        main, startup, feeds, fetches = resnet.build(
+            dtype="bfloat16", class_dim=1000, learning_rate=0.1,
+            with_optimizer=True, stem="space_to_depth")
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        exe.run(startup, scope=scope)
+        rng = np.random.RandomState(0)
+        dev = fluid.TPUPlace(0).jax_device()
+        feed = {
+            "img": jax.device_put(
+                jnp.asarray(rng.rand(K, batch_size, 3, 224, 224), jnp.float32), dev),
+            "label": jax.device_put(
+                jnp.asarray(rng.randint(0, 1000, (K, batch_size, 1)), jnp.int32), dev),
+        }
+        loss_name = fetches["loss"].name
+
+        def dispatch():
+            return exe.run(main, feed=feed, fetch_list=[loss_name], scope=scope,
+                           steps=K, return_numpy=False)
+
+        # compile under the right toggles (lazy compile happens at first run)
+        out = dispatch()
+        loss = float(np.asarray(out[0]).reshape(-1)[-1])
+        assert np.isfinite(loss), loss
+        return dispatch
+    finally:
+        nn_ops._BN_UNFUSE_CONV = False
+        nn_ops._BN_STATS_FUSED_PASS = False
+        nn_ops._BN_BF16_FUSED_DEFAULT = True
+
+
+def main():
+    rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    from tools.opbench import interleave
+
+    K = 4
+    variants = {
+        "base": make_dispatch(False, False),
+        "barrier": make_dispatch(True, False),
+        "single": make_dispatch(False, True),
+        "barrier1": make_dispatch(True, True),
+    }
+    stats = interleave(variants, rounds=rounds, iters=iters, warmup=1)
+    for name, s in stats.items():
+        per_step = s["best_ms"] / K
+        print(f"{name:9s} best {per_step:7.2f} ms/step  "
+              f"({256/per_step*1e3:6.0f} imgs/s)  spread {s['spread_pct']}%  "
+              f"windows {[round(w/K,2) for w in s['windows_ms']]}")
+    print(json.dumps(stats))
+
+
+if __name__ == "__main__":
+    main()

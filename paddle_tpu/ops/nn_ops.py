@@ -38,6 +38,32 @@ _BN_SINGLE_PASS = False
 # step — consistently ~1% faster, standard numerics (docs/perf_r03.md).
 _BN_BF16_COMPUTE = True
 
+# Round-5 (docs/perf_r05.md): the ResNet-50 profile showed XLA fusing the BN
+# batch-stat reductions INTO the producing convolutions ("multiply_reduce_
+# fusion" convolution-fusion events at 9-43 TF/s vs 90-190 TF/s for clean
+# convs) — the reduce epilogue wrecks the conv's MXU tiling.  With
+# _BN_UNFUSE_CONV the training-mode lowering puts an optimization_barrier on
+# the activation so the conv materializes at full speed and the stats run as
+# a separate roofline-bandwidth reduce fusion (the barrier transposes to the
+# cotangent, unfusing the backward reductions from the dgrad convs too).
+_BN_UNFUSE_CONV = False
+
+# Single fused-pass stats: E[x]/E[x^2] as sibling reductions over the same
+# read of x (one HBM pass) instead of mean-then-centered two passes.  Unlike
+# the retired _BN_SINGLE_PASS pilot-mean variant there is no gather, so the
+# two sums fuse horizontally.  Cancellation in var = E[x^2]-mean^2 loses
+# ~2*log2(|mean|/std) mantissa bits of the f32 accumulator — fine for conv
+# activations (|mean|/std = O(1)), and for bf16 activations the input's own
+# 8-bit mantissa dominates any accumulator cancellation, so the bf16 path
+# takes the fused pass by default (interleaved A/B on the v5e: ResNet-50
+# step 103.9 vs 115.4 ms, a 10% step win — docs/perf_r05.md).  f32 stays
+# two-pass unless _BN_STATS_FUSED_PASS is toggled on (keeps OpTest goldens
+# vs the reference exact); _BN_BF16_FUSED_DEFAULT=False restores the r4
+# two-pass bf16 lowering (A/B baseline).  fp16 never takes the fused pass
+# implicitly — squaring in fp16 overflows at |x|>=256.
+_BN_STATS_FUSED_PASS = False
+_BN_BF16_FUSED_DEFAULT = True
+
 
 def enable_nhwc_lowering(on: bool = True):
     global _NHWC_LOWERING
@@ -226,10 +252,24 @@ def _batch_norm(ctx, op, ins):
     bshape = [1] * x.ndim
     bshape[ch_axis] = x.shape[ch_axis]
 
-    if is_test or op.attr("use_global_stats", False):
+    training = not (is_test or op.attr("use_global_stats", False))
+    if training and _BN_UNFUSE_CONV:
+        x = jax.lax.optimization_barrier(x)
+    # fp16 is excluded from the fused pass: jnp.square runs in x.dtype and
+    # fp16 overflows to inf at |x| >= 256; bf16 shares f32's exponent range.
+    fused_pass = _BN_STATS_FUSED_PASS or (
+        bf16_fast and x.dtype == jnp.bfloat16 and _BN_BF16_FUSED_DEFAULT)
+    if not training:
         mean, var = mean_in, var_in
         saved_mean, saved_var = mean_in, var_in
         mean_out, var_out = mean_in, var_in
+    elif fused_pass:
+        inv_n = 1.0 / float(np.prod([x.shape[i] for i in axes]))
+        s1 = jnp.sum(x, axis=axes, dtype=jnp.float32)
+        s2 = jnp.sum(jnp.square(x), axis=axes, dtype=jnp.float32)
+        mean = s1 * inv_n
+        var = jnp.maximum(s2 * inv_n - jnp.square(mean), 0.0)
+        mean_out = None
     elif _BN_SINGLE_PASS:
         # Single-sweep stats (one read of the activation instead of
         # jnp.var's mean-then-centered-pass two; measured ~10% off the
@@ -251,12 +291,14 @@ def _batch_norm(ctx, op, ins):
     else:
         mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
         if bf16_fast:
+            # fp16 route (and bf16 when the fused pass is disabled): centered
+            # variance keeps the squared magnitudes small pre-accumulation
             centered = x - mean.astype(x.dtype).reshape(bshape)
             var = jnp.mean(jnp.square(centered), axis=axes, dtype=jnp.float32)
         else:
             var = jnp.var(x, axis=axes)
         mean_out = None
-    if not (is_test or op.attr("use_global_stats", False)):
+    if training:
         # shared running-stats update for both training branches
         mean_out = momentum * mean_in + (1.0 - momentum) * mean
         var_out = momentum * var_in + (1.0 - momentum) * var
