@@ -4,7 +4,7 @@ ops by self time (parsed from the trace.json.gz the JAX profiler emits).
 Round-5 discovery: jax.profiler.trace WORKS over the axon tunnel (earlier
 rounds assumed only cost_analysis was available, which is broken).  This
 replaces the framework-variant decomposition (docs/perf_r03.md) with ground
-truth.
+truth.  Dispatch construction is shared with bench.py via tools/bench_kit.
 
   python experiments/profile_model.py resnet50
   python experiments/profile_model.py bert
@@ -15,6 +15,7 @@ import glob
 import gzip
 import json
 import os
+import re
 import sys
 import tempfile
 from collections import defaultdict
@@ -22,60 +23,6 @@ from collections import defaultdict
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
-
-
-def make_resnet_dispatch(batch_size=256, K=4):
-    import jax
-    import jax.numpy as jnp
-
-    import paddle_tpu as fluid
-    from paddle_tpu.models import resnet
-
-    main, startup, feeds, fetches = resnet.build(
-        dtype="bfloat16", class_dim=1000, learning_rate=0.1, with_optimizer=True,
-        stem="space_to_depth")
-    scope = fluid.Scope()
-    exe = fluid.Executor(fluid.TPUPlace(0))
-    exe.run(startup, scope=scope)
-    rng = np.random.RandomState(0)
-    dev = fluid.TPUPlace(0).jax_device()
-    feed = {
-        "img": jax.device_put(jnp.asarray(rng.rand(K, batch_size, 3, 224, 224), jnp.float32), dev),
-        "label": jax.device_put(jnp.asarray(
-            rng.randint(0, 1000, (K, batch_size, 1)), jnp.int32), dev),
-    }
-    loss_name = fetches["loss"].name
-
-    def dispatch():
-        return exe.run(main, feed=feed, fetch_list=[loss_name], scope=scope,
-                       steps=K, return_numpy=False)
-
-    return dispatch, K
-
-
-def make_bert_dispatch(batch_size=256, seq_len=128):
-    import jax
-    import jax.numpy as jnp
-
-    import paddle_tpu as fluid
-    from paddle_tpu.models import transformer
-
-    main, startup, feeds, fetches = transformer.build_bert(
-        vocab_size=30522, seq_len=seq_len, d_model=768, n_layers=12, n_heads=12,
-        d_ff=3072, dropout_prob=0.1, with_optimizer=True, dtype="bfloat16")
-    scope = fluid.Scope()
-    exe = fluid.Executor(fluid.TPUPlace(0))
-    exe.run(startup, scope=scope)
-    batch = transformer.make_fake_batch(batch_size, seq_len, 30522)
-    dev = fluid.TPUPlace(0).jax_device()
-    batch = {k: jax.device_put(jnp.asarray(v), dev) for k, v in batch.items()}
-    loss_name = fetches["loss"].name
-
-    def dispatch():
-        return exe.run(main, feed=batch, fetch_list=[loss_name], scope=scope,
-                       return_numpy=False)
-
-    return dispatch, 1
 
 
 def profile_dispatch(dispatch, n_iters=6, label="model"):
@@ -102,7 +49,6 @@ def summarize(trace_path, n_iters, steps_per_dispatch, top=40, merge_reps=True):
     with gzip.open(trace_path, "rt") as f:
         data = json.load(f)
     events = data.get("traceEvents", [])
-    # find device lanes: pids whose process name mentions TPU/device
     pid_names = {}
     tid_names = {}
     for e in events:
@@ -112,7 +58,7 @@ def summarize(trace_path, n_iters, steps_per_dispatch, top=40, merge_reps=True):
             tid_names[(e["pid"], e["tid"])] = e["args"].get("name", "")
     device_pids = {pid for pid, n in pid_names.items()
                    if "TPU" in n or "/device" in n.lower()}
-    if not device_pids:  # fall back: lanes named XLA Ops etc.
+    if not device_pids:
         device_pids = set(pid_names)
     agg = defaultdict(float)
     count = defaultdict(int)
@@ -121,7 +67,6 @@ def summarize(trace_path, n_iters, steps_per_dispatch, top=40, merge_reps=True):
         if e.get("ph") != "X" or e.get("pid") not in device_pids:
             continue
         lane = tid_names.get((e["pid"], e["tid"]), "")
-        # XLA op lanes carry per-HLO events; skip step/module summary lanes
         if "XLA Modules" in lane or "Steps" in lane:
             continue
         if "XLA Ops" not in lane and "TensorFlow Ops" not in lane and lane:
@@ -130,7 +75,6 @@ def summarize(trace_path, n_iters, steps_per_dispatch, top=40, merge_reps=True):
         dur = e.get("dur", 0) / 1e3  # us -> ms
         if merge_reps:
             # strip .N suffixes and fusion numbering so repeated layers merge
-            import re
             name = re.sub(r"\.\d+", "", name)
         agg[name] += dur
         count[name] += 1
@@ -145,12 +89,16 @@ def summarize(trace_path, n_iters, steps_per_dispatch, top=40, merge_reps=True):
 
 
 if __name__ == "__main__":
+    from tools.bench_kit import make_bert_dispatch, make_resnet_dispatch
+
     which = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
     n_iters = int(sys.argv[2]) if len(sys.argv) > 2 else 6
     if which == "resnet50":
-        dispatch, K = make_resnet_dispatch()
+        K = 4
+        dispatch, _ = make_resnet_dispatch(K=K)
     elif which == "bert":
-        dispatch, K = make_bert_dispatch()
+        K = 2
+        dispatch, _ = make_bert_dispatch(K=K)
     else:
         raise SystemExit(f"unknown model {which}")
     path = profile_dispatch(dispatch, n_iters=n_iters, label=which)

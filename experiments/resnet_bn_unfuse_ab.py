@@ -2,12 +2,13 @@
 
 The r5 profile (experiments/profile_model.py) showed conv fusions carrying
 BN-stat reduce epilogues running at 9-43 TF/s vs ~90-190 for clean convs —
-XLA's conv+reduce output fusion wrecks the MXU tiling.  Variants:
+but the step is bandwidth-bound, so what matters is total HBM bytes, not
+in-fusion MXU rate.  Variants (result: docs/perf_r05.md):
 
-  base        : round-4 lowering (two-pass stats, fused into convs)
-  barrier     : two-pass stats behind an optimization_barrier
-  single      : one fused E[x]/E[x^2] pass, no barrier
-  barrier1    : barrier + single fused stats pass  (expected winner)
+  base        : round-4 lowering (two-pass stats, fused into convs)   115.4 ms
+  barrier     : two-pass stats behind an optimization_barrier         130.8 ms
+  single      : one fused E[x]/E[x^2] pass, no barrier                103.9 ms  <- shipped
+  barrier1    : barrier + single fused stats pass                     120.9 ms
 
   python experiments/resnet_bn_unfuse_ab.py [rounds] [iters]
 """
@@ -19,52 +20,33 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np
+K = 4
 
 
-def make_dispatch(unfuse, fused_pass, batch_size=256, K=4):
-    import jax
-    import jax.numpy as jnp
-
-    import paddle_tpu as fluid
-    from paddle_tpu.models import resnet
+def make_dispatch(unfuse, fused_pass):
     from paddle_tpu.ops import nn_ops
+    from tools.bench_kit import make_resnet_dispatch
 
-    nn_ops._BN_UNFUSE_CONV = unfuse
-    nn_ops._BN_STATS_FUSED_PASS = fused_pass
-    # the model is bf16, which now takes the fused pass by default — the
-    # baseline variants must explicitly restore the r4 two-pass lowering
-    nn_ops._BN_BF16_FUSED_DEFAULT = fused_pass
-    try:
-        main, startup, feeds, fetches = resnet.build(
-            dtype="bfloat16", class_dim=1000, learning_rate=0.1,
-            with_optimizer=True, stem="space_to_depth")
-        scope = fluid.Scope()
-        exe = fluid.Executor(fluid.TPUPlace(0))
-        exe.run(startup, scope=scope)
-        rng = np.random.RandomState(0)
-        dev = fluid.TPUPlace(0).jax_device()
-        feed = {
-            "img": jax.device_put(
-                jnp.asarray(rng.rand(K, batch_size, 3, 224, 224), jnp.float32), dev),
-            "label": jax.device_put(
-                jnp.asarray(rng.randint(0, 1000, (K, batch_size, 1)), jnp.int32), dev),
-        }
-        loss_name = fetches["loss"].name
+    def with_flags(fn):
+        # the lowering flags participate in the executor compile-cache key,
+        # so they must hold BOTH at compile time and at every dispatch (a
+        # dispatch under different flags would recompile the default config
+        # and silently time the wrong variant)
+        saved = (nn_ops._BN_UNFUSE_CONV, nn_ops._BN_STATS_FUSED_PASS,
+                 nn_ops._BN_BF16_FUSED_DEFAULT)
+        # base/barrier variants must explicitly restore the r4 two-pass
+        # lowering (bf16 models take the fused pass by default)
+        nn_ops._BN_UNFUSE_CONV = unfuse
+        nn_ops._BN_STATS_FUSED_PASS = fused_pass
+        nn_ops._BN_BF16_FUSED_DEFAULT = fused_pass
+        try:
+            return fn()
+        finally:
+            (nn_ops._BN_UNFUSE_CONV, nn_ops._BN_STATS_FUSED_PASS,
+             nn_ops._BN_BF16_FUSED_DEFAULT) = saved
 
-        def dispatch():
-            return exe.run(main, feed=feed, fetch_list=[loss_name], scope=scope,
-                           steps=K, return_numpy=False)
-
-        # compile under the right toggles (lazy compile happens at first run)
-        out = dispatch()
-        loss = float(np.asarray(out[0]).reshape(-1)[-1])
-        assert np.isfinite(loss), loss
-        return dispatch
-    finally:
-        nn_ops._BN_UNFUSE_CONV = False
-        nn_ops._BN_STATS_FUSED_PASS = False
-        nn_ops._BN_BF16_FUSED_DEFAULT = True
+    inner, _ = with_flags(lambda: make_resnet_dispatch(K=K))
+    return lambda: with_flags(inner)
 
 
 def main():
@@ -72,7 +54,6 @@ def main():
     iters = int(sys.argv[2]) if len(sys.argv) > 2 else 4
     from tools.opbench import interleave
 
-    K = 4
     variants = {
         "base": make_dispatch(False, False),
         "barrier": make_dispatch(True, False),

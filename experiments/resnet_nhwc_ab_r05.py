@@ -5,6 +5,9 @@ BN lowering; VERDICT r4 asks the layout question to be closed on the current
 config.  NHWC requires the conv7 stem (s2d rearrangement is NCHW-only), so
 conv7 NCHW is included to separate stem effect from layout effect.
 
+Result (docs/perf_r05.md): NCHW+s2d 104.07, NCHW+conv7 105.00, NHWC+conv7
+104.35 ms/step — NHWC neutral for the third round; question closed.
+
   python experiments/resnet_nhwc_ab_r05.py [rounds] [iters]
 """
 from __future__ import annotations
@@ -14,51 +17,19 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np
-
-
-def make_dispatch(data_format, stem, batch_size=256, K=4):
-    import jax
-    import jax.numpy as jnp
-
-    import paddle_tpu as fluid
-    from paddle_tpu.models import resnet
-
-    main, startup, feeds, fetches = resnet.build(
-        dtype="bfloat16", class_dim=1000, learning_rate=0.1,
-        with_optimizer=True, data_format=data_format, stem=stem)
-    scope = fluid.Scope()
-    exe = fluid.Executor(fluid.TPUPlace(0))
-    exe.run(startup, scope=scope)
-    rng = np.random.RandomState(0)
-    dev = fluid.TPUPlace(0).jax_device()
-    shape = (K, batch_size, 3, 224, 224) if data_format == "NCHW" else (K, batch_size, 224, 224, 3)
-    feed = {
-        "img": jax.device_put(jnp.asarray(rng.rand(*shape), jnp.float32), dev),
-        "label": jax.device_put(
-            jnp.asarray(rng.randint(0, 1000, (K, batch_size, 1)), jnp.int32), dev),
-    }
-    loss_name = fetches["loss"].name
-
-    def dispatch():
-        return exe.run(main, feed=feed, fetch_list=[loss_name], scope=scope,
-                       steps=K, return_numpy=False)
-
-    out = dispatch()
-    assert np.isfinite(float(np.asarray(out[0]).reshape(-1)[-1]))
-    return dispatch
+K = 4
 
 
 def main():
     rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 3
     iters = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    from tools.bench_kit import make_resnet_dispatch
     from tools.opbench import interleave
 
-    K = 4
     variants = {
-        "nchw_s2d": make_dispatch("NCHW", "space_to_depth"),
-        "nchw_conv7": make_dispatch("NCHW", "conv7"),
-        "nhwc_conv7": make_dispatch("NHWC", "conv7"),
+        "nchw_s2d": make_resnet_dispatch(K=K, stem="space_to_depth")[0],
+        "nchw_conv7": make_resnet_dispatch(K=K, stem="conv7")[0],
+        "nhwc_conv7": make_resnet_dispatch(K=K, stem="conv7", data_format="NHWC")[0],
     }
     stats = interleave(variants, rounds=rounds, iters=iters, warmup=1)
     for name, s in stats.items():

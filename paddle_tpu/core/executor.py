@@ -108,7 +108,10 @@ def _lowering_flags():
     from ..ops import nn_ops
 
     return ("nhwc", nn_ops._NHWC_LOWERING, "bn1p", nn_ops._BN_SINGLE_PASS,
-            "bnbf16", nn_ops._BN_BF16_COMPUTE)
+            "bnbf16", nn_ops._BN_BF16_COMPUTE,
+            "bnfused", nn_ops._BN_STATS_FUSED_PASS,
+            "bnfdef", nn_ops._BN_BF16_FUSED_DEFAULT,
+            "bnbar", nn_ops._BN_UNFUSE_CONV)
 
 
 class _CompiledStep:

@@ -255,10 +255,12 @@ def _batch_norm(ctx, op, ins):
     training = not (is_test or op.attr("use_global_stats", False))
     if training and _BN_UNFUSE_CONV:
         x = jax.lax.optimization_barrier(x)
-    # fp16 is excluded from the fused pass: jnp.square runs in x.dtype and
-    # fp16 overflows to inf at |x| >= 256; bf16 shares f32's exponent range.
-    fused_pass = _BN_STATS_FUSED_PASS or (
+    # fp16 is excluded from the fused pass UNCONDITIONALLY (even under the
+    # explicit toggle): jnp.square runs in x.dtype and fp16 overflows to inf
+    # at |x| >= 256; bf16 shares f32's exponent range.
+    fused_pass = (_BN_STATS_FUSED_PASS or (
         bf16_fast and x.dtype == jnp.bfloat16 and _BN_BF16_FUSED_DEFAULT)
+    ) and x.dtype != jnp.float16
     if not training:
         mean, var = mean_in, var_in
         saved_mean, saved_var = mean_in, var_in
