@@ -180,6 +180,7 @@ def bench_bert(batch_size=256, seq_len=128, K=2, iters=4):
     return {"metric": "bert_base_train_seqs_per_sec_per_chip", "value": round(seqs, 2),
             "unit": "seqs/sec", "mfu_bf16_analytic": round(mfu, 4),
             "batch_size": batch_size, "seq_len": seq_len,
+            "config": "fused-attention (output-dropout substitution)",
             "steps_per_dispatch": K, "windows_ms": ws, "spread_pct": _spread(ws)}
 
 

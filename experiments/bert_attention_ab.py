@@ -1,0 +1,74 @@
+"""Round-5 interleaved A/B: BERT attention formulations.
+
+The r5 profile showed the unfused matmul attention burning ~100 ms of the
+275 ms BERT step: batched score/context matmuls at 13.6 TF/s
+(math_ops.py matmul), ~36 ms of head split/merge copies, 15.7 ms softmax,
+plus [B,H,L,L]-sized attention-prob dropout masks.  Variants:
+
+  unfused : r4 default (matmul/softmax/dropout ops)
+  fused   : fused_attention op on its jnp fallback — bf16 einsums with f32
+            accumulators + f32 softmax, prob-dropout replaced by
+            output-dropout (same substitution the ring path makes)
+  pallas  : fused_attention routed to the stock Pallas flash kernel
+            (_FLASH_MIN_SEQ dropped to 64)
+
+  python experiments/bert_attention_ab.py [rounds] [iters]
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+K = 2
+BS = 256
+
+
+def make_variant(use_fused, flash_min_seq=None):
+    from paddle_tpu.ops import nn_ops
+    from tools.bench_kit import make_bert_dispatch
+
+    def with_flags(fn):
+        saved = nn_ops._FLASH_MIN_SEQ
+        if flash_min_seq is not None:
+            nn_ops._FLASH_MIN_SEQ = flash_min_seq
+        try:
+            return fn()
+        finally:
+            nn_ops._FLASH_MIN_SEQ = saved
+
+    def build():
+        from tools.bench_kit import make_bert_dispatch
+
+        dispatch, _ = make_bert_dispatch(batch_size=BS, K=K,
+                                         use_fused_attention=use_fused)
+        return dispatch
+
+    inner = with_flags(build)
+    return lambda: with_flags(inner)
+
+
+def main():
+    # three resident BERT executors OOM the chip (1.3 GB optimizer state
+    # each + step activations), so variants run as PAIRWISE interleaves
+    rounds = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+    which = sys.argv[3] if len(sys.argv) > 3 else "fused"
+    from tools.opbench import interleave
+
+    specs = {"fused": (True, None), "pallas": (True, 64)}
+    use_fused, mseq = specs[which]
+    variants = {
+        "unfused": make_variant(False),
+        which: make_variant(use_fused, flash_min_seq=mseq),
+    }
+    stats = interleave(variants, rounds=rounds, iters=iters, warmup=1)
+    for name, s in stats.items():
+        per_step = s["best_ms"] / K
+        print(f"{name:8s} best {per_step:7.2f} ms/step  "
+              f"({BS/per_step*1e3:6.0f} seqs/s)  spread {s['spread_pct']}%")
+
+
+if __name__ == "__main__":
+    main()

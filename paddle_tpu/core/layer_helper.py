@@ -12,6 +12,23 @@ from .initializer import ConstantInitializer, XavierInitializer
 from .param_attr import ParamAttr
 from .program import default_main_program, default_startup_program
 
+# Mixed-precision master-weight policy (round-5 fix, docs/perf_r05.md):
+# trainable parameters requested in a low-precision float are CREATED as
+# float32 masters — every consuming op lowers through match_dtype, which
+# casts the master to the activation dtype inside the compiled step, so the
+# program still computes in bf16 on the MXU.  Without this the r4 bf16
+# models created bf16 params, whose bf16 Adam beta-pow accumulators rounded
+# 0.999 -> 1.0 and made lr_t = lr*sqrt(1-b2p)/(1-b1p) identically ZERO:
+# bf16+Adam params silently never trained.  Toggle for experiments only.
+_MASTER_WEIGHTS = True
+_LOW_PRECISION = ("bfloat16", "float16", "fp16", "bf16")
+
+
+def _master_dtype(dtype):
+    if _MASTER_WEIGHTS and str(dtype) in _LOW_PRECISION:
+        return "float32"
+    return dtype
+
 
 class LayerHelper:
     def __init__(self, layer_type: str, **kwargs):
@@ -47,6 +64,7 @@ class LayerHelper:
         if init is None:
             init = ConstantInitializer(0.0) if is_bias else XavierInitializer()
         shape = [int(s) for s in shape]
+        dtype = _master_dtype(dtype)
         # parameter lives in the main program; its init op lives in startup
         param = self.main_program.global_block().create_parameter(
             attr.name,

@@ -404,18 +404,37 @@ def _cross_entropy(ctx, op, ins):
 
 @register_op("softmax_with_cross_entropy")
 def _softmax_with_cross_entropy(ctx, op, ins):
+    """Fused logsumexp formulation: loss = lse(x) - x[label].
+
+    Never materializes the [N, V] log-prob tensor — at BERT's 30522 vocab
+    the old log_softmax path streamed ~20 GB/step of f32 logp/softmax
+    through HBM (docs/perf_r05.md profile: ~25 ms of a 261 ms step).  All
+    reductions accumulate in f32 even for bf16 logits; the max shift is
+    stop_gradient'd (pure numerical shift, the standard logsumexp trick),
+    so autodiff yields the exact softmax-minus-onehot gradient as one
+    fused pass over the logits."""
     logits = first(ins, "Logits")
     label = first(ins, "Label")
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    softmax = jnp.exp(logp)
+    m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+    shifted = (logits - m).astype(jnp.float32)
+    sumexp = jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True)
+    lse = jnp.log(sumexp) + m.astype(jnp.float32)
+    # Softmax slot: only consumers pay for it (DCE'd when unfetched)
+    softmax = (jnp.exp(shifted) / sumexp).astype(logits.dtype)
     if op.attr("soft_label", False):
-        loss = -jnp.sum(label * logp, axis=-1, keepdims=True)
+        # -sum(label * (x - lse)) = lse*sum(label) - sum(label*x)
+        wx = jnp.sum((label * logits).astype(jnp.float32), axis=-1, keepdims=True)
+        wsum = jnp.sum(label.astype(jnp.float32), axis=-1, keepdims=True)
+        loss = lse * wsum - wx
     else:
         # expand unless the label is already rank-matched with trailing dim 1
         # (shape test alone mis-handles a rank-1 label of batch size 1)
         idx = label if label.ndim == logits.ndim and label.shape[-1] == 1 else label[..., None]
-        picked = jnp.take_along_axis(logp, idx.astype(jnp.int32), axis=-1)
-        loss = -picked
+        iota = jax.lax.broadcasted_iota(jnp.int32, logits.shape, logits.ndim - 1)
+        onehot = iota == idx.astype(jnp.int32)
+        picked = jnp.sum(jnp.where(onehot, logits, 0).astype(jnp.float32),
+                         axis=-1, keepdims=True)
+        loss = lse - picked
         ignore = op.attr("ignore_index", -100)
         loss = jnp.where(idx == ignore, 0.0, loss)
     return {"Loss": loss, "Softmax": softmax}
@@ -527,23 +546,44 @@ def _fused_attention(ctx, op, ins):
     scale = op.attr("scale", None)
     if scale is None:
         scale = 1.0 / float(np.sqrt(q.shape[-1]))
-    if bias is not None and bias.shape[1] == 1 and q.shape[1] != 1:
-        bias = jnp.broadcast_to(bias, (bias.shape[0], q.shape[1]) + bias.shape[2:])
-
-    # Pallas pays off only once the score tile no longer fits XLA's own
-    # fusion sweet spot: a full-model interleaved A/B at seq 128 measured the
-    # kernel 35% SLOWER than XLA's fused unfused-attention (docs/perf_r04.md),
-    # so short sequences take the plain path even on TPU.
     min_seq = op.attr("flash_min_seq", _FLASH_MIN_SEQ)
     if ctx.platform == "tpu" and k.shape[2] >= min_seq:
+        # long-sequence streaming kernel (O(L) memory): the stock online-
+        # softmax flash implementation.  Only THIS kernel needs the bias
+        # pre-broadcast to per-head; fused_sdpa and the jnp path broadcast
+        # lazily (a materialized [B,H,L,L] bias is H x the HBM traffic).
         from jax.experimental.pallas.ops.tpu.flash_attention import flash_attention
 
-        ab = bias.astype(jnp.float32) if bias is not None else None
+        ab = bias
+        if ab is not None and ab.shape[1] == 1 and q.shape[1] != 1:
+            ab = jnp.broadcast_to(ab, (ab.shape[0], q.shape[1]) + ab.shape[2:])
+        ab = ab.astype(jnp.float32) if ab is not None else None
         out = flash_attention(q, k, v, ab=ab, causal=causal, sm_scale=scale)
         return {"Out": out.astype(q.dtype)}
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    if (ctx.platform == "tpu" and op.attr("use_pallas_sdpa", False)
+            and max(q.shape[2], k.shape[2]) <= 512):
+        # moderate-L fused kernel (ops/pallas_attention.py): whole-row
+        # softmax in VMEM, scores never reach HBM fwd or bwd.  OPT-IN only:
+        # the r5 full-model A/B measured it SLOWER than the mixed-precision
+        # jnp formulation below (BERT step 305 vs 275 ms; isolated
+        # microbench 10.9 vs 7.9 ms/layer-fwd) — at L<=512 XLA's own
+        # softmax/matmul fusion wins on this chip, extending r4's negative
+        # result for the stock streaming kernel (docs/perf_r05.md).
+        # bias is mask-derived in every caller, hence non-differentiable.
+        from .pallas_attention import fused_sdpa
+
+        b = jax.lax.stop_gradient(bias) if bias is not None else None
+        out = fused_sdpa(q, k, v, b, bool(causal), float(scale))
+        return {"Out": out.astype(q.dtype)}
+    # mixed-precision fallback (standard TPU attention numerics): the
+    # einsums keep their input dtype on the MXU and ACCUMULATE in f32 via
+    # preferred_element_type; softmax runs in f32; probs return to the
+    # activation dtype for the context matmul.  The previous revision cast
+    # q/k/v to f32 BEFORE the einsums, which ran the batched matmuls at the
+    # f32 MXU rate and doubled score-tensor HBM traffic — profiled at
+    # 13.6 TF/s on the BERT bench (docs/perf_r05.md).
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if bias is not None:
         s = s + bias.astype(jnp.float32)
     if causal:
@@ -551,7 +591,8 @@ def _fused_attention(ctx, op, ins):
         mask = jnp.tril(jnp.ones((Lq, Lk), bool), k=Lk - Lq)
         s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v,
+                     preferred_element_type=jnp.float32)
     return {"Out": out.astype(q.dtype)}
 
 

@@ -245,8 +245,13 @@ class AdamOptimizer(Optimizer):
         for p in parameters:
             self._add_accumulator("moment1", p)
             self._add_accumulator("moment2", p)
-            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1])
-            self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2, shape=[1])
+            # beta powers MUST be f32 regardless of param dtype: bf16 cannot
+            # represent 0.999 (rounds to 1.0), which zeroes the bias-corrected
+            # lr and silently freezes training (docs/perf_r05.md)
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1],
+                                  dtype="float32")
+            self._add_accumulator("beta2_pow_acc", p, fill_value=self._beta2, shape=[1],
+                                  dtype="float32")
 
     def _append_optimize_op(self, block, param_and_grad):
         p, g = param_and_grad
@@ -391,7 +396,8 @@ class AdamaxOptimizer(Optimizer):
         for p in parameters:
             self._add_accumulator("moment", p)
             self._add_accumulator("inf_norm", p)
-            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1])
+            self._add_accumulator("beta1_pow_acc", p, fill_value=self._beta1, shape=[1],
+                                  dtype="float32")
 
     def _append_optimize_op(self, block, param_and_grad):
         p, g = param_and_grad

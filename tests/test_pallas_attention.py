@@ -1,0 +1,71 @@
+"""fused_sdpa Pallas kernel goldens (interpret mode on the CPU mesh).
+
+Reference semantics: scaled-dot-product attention as in the unfused
+matmul/softmax stack (layers/nn.py multi-head attention) — the kernel must
+match the jnp fallback in ops/nn_ops.py _fused_attention bit-for-bit-ish in
+f32 (both compute f32 scores + f32 softmax).  Grads via the custom VJP's
+recompute backward kernel vs jax.grad of the reference.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas_attention import fused_sdpa
+
+
+def _ref(q, k, v, bias, causal, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        Lq, Lk = s.shape[-2], s.shape[-1]
+        s = jnp.where(jnp.tril(jnp.ones((Lq, Lk), bool), k=Lk - Lq), s, -1e30)
+    p = jax.nn.softmax(s, -1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+@pytest.mark.parametrize("bias_kind,causal", [
+    (None, False), ("bcast", False), ("per_head", True), (None, True),
+])
+def test_fused_sdpa_fwd_and_grad(bias_kind, causal):
+    B, H, L, dh = 2, 4, 16, 8
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, L, dh), jnp.float32)
+    k = jnp.asarray(rng.randn(B, H, L, dh), jnp.float32)
+    v = jnp.asarray(rng.randn(B, H, L, dh), jnp.float32)
+    bias = None
+    if bias_kind == "bcast":
+        bias = jnp.asarray(rng.randn(B, 1, L, L) * 2, jnp.float32)
+    elif bias_kind == "per_head":
+        bias = jnp.asarray(rng.randn(B, H, L, L) * 2, jnp.float32)
+    scale = 1.0 / np.sqrt(dh)
+
+    out = fused_sdpa(q, k, v, bias, causal, scale, True)
+    want = _ref(q, k, v, bias, causal, scale)
+    assert np.allclose(out, want, atol=1e-5), np.abs(out - want).max()
+
+    def f(q, k, v):
+        return jnp.sum(jnp.sin(fused_sdpa(q, k, v, bias, causal, scale, True)))
+
+    def g(q, k, v):
+        return jnp.sum(jnp.sin(_ref(q, k, v, bias, causal, scale)))
+
+    got = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+    ref = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(got, ref):
+        assert np.allclose(a, b, atol=1e-4), np.abs(a - b).max()
+
+
+def test_fused_sdpa_cross_attention_lengths():
+    # Lq != Lk (cross attention): kernel block specs carry distinct lengths
+    B, H, Lq, Lk, dh = 1, 2, 8, 24, 8
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(B, H, Lq, dh), jnp.float32)
+    k = jnp.asarray(rng.randn(B, H, Lk, dh), jnp.float32)
+    v = jnp.asarray(rng.randn(B, H, Lk, dh), jnp.float32)
+    out = fused_sdpa(q, k, v, None, False, 0.5, True)
+    want = _ref(q, k, v, None, False, 0.5)
+    assert np.allclose(out, want, atol=1e-5)
