@@ -940,35 +940,152 @@ def _xxh64(data: bytes, seed: int) -> int:
         return int(acc)
 
 
+# --- in-graph 64-bit arithmetic on (hi, lo) uint32 pairs -------------------
+# JAX runs x32 here, so XXH64 is built from vectorized uint32 ops.  Every
+# byte position is static (input rows have static shape), so the whole
+# digest unrolls at trace time into plain VPU arithmetic — no host
+# callback, runs on any backend including the axon TPU tunnel.
+
+def _u64c(v):
+    """python int -> ((hi, lo) uint32 scalar constants)."""
+    return (jnp.uint32((v >> 32) & 0xFFFFFFFF), jnp.uint32(v & 0xFFFFFFFF))
+
+
+def _add64(a, b):
+    lo = a[1] + b[1]
+    carry = (lo < b[1]).astype(jnp.uint32)
+    return (a[0] + b[0] + carry, lo)
+
+
+def _xor64(a, b):
+    return (a[0] ^ b[0], a[1] ^ b[1])
+
+
+def _shr64(a, r):
+    if r == 0:
+        return a
+    if r < 32:
+        return (a[0] >> r, (a[1] >> r) | (a[0] << (32 - r)))
+    if r == 32:
+        return (jnp.zeros_like(a[0]), a[0])
+    return (jnp.zeros_like(a[0]), a[0] >> (r - 32))
+
+
+def _shl64(a, r):
+    if r == 0:
+        return a
+    if r < 32:
+        return ((a[0] << r) | (a[1] >> (32 - r)), a[1] << r)
+    if r == 32:
+        return (a[1], jnp.zeros_like(a[1]))
+    return (a[1] << (r - 32), jnp.zeros_like(a[1]))
+
+
+def _rot64(a, r):
+    s, t = _shl64(a, r), _shr64(a, 64 - r)
+    return (s[0] | t[0], s[1] | t[1])
+
+
+def _mul32x32(a, b):
+    """uint32 x uint32 -> (hi, lo) full 64-bit product (16-bit split)."""
+    a0, a1 = a & 0xFFFF, a >> 16
+    b0, b1 = b & 0xFFFF, b >> 16
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+    mid = (p00 >> 16) + (p01 & 0xFFFF) + (p10 & 0xFFFF)
+    lo = (mid << 16) | (p00 & 0xFFFF)
+    hi = p11 + (p01 >> 16) + (p10 >> 16) + (mid >> 16)
+    return (hi, lo)
+
+
+def _mul64(a, b):
+    hi, lo = _mul32x32(a[1], b[1])
+    return (hi + a[1] * b[0] + a[0] * b[1], lo)
+
+
+def _mod64_u31(a, m):
+    """(hi, lo) mod m for m < 2^31: 64-step restoring division (static
+    unroll of cheap vector ops; remainder always fits uint32)."""
+    m = jnp.uint32(m)
+    r = jnp.zeros_like(a[0])
+    for word in (a[0], a[1]):
+        for bit in range(31, -1, -1):
+            r = (r << 1) | ((word >> bit) & jnp.uint32(1))
+            r = jnp.where(r >= m, r - m, r)
+    return r
+
+
+def _xxh64_jnp(words, seed):
+    """Vectorized XXH64 over rows of uint32 `words` [rows, last] (each word
+    = 4 little-endian bytes, matching int32 rows), python-int seed.
+    Returns (hi, lo) uint32 arrays [rows].  Mirrors _xxh64 (the numpy spec
+    oracle) with every loop unrolled over the static byte length."""
+    rows, last = words.shape
+    n = 4 * last
+    P1, P2, P3, P4, P5 = (_u64c(int(_XXP1)), _u64c(int(_XXP2)),
+                          _u64c(int(_XXP3)), _u64c(int(_XXP4)),
+                          _u64c(int(_XXP5)))
+
+    def bc(c64):
+        return (jnp.broadcast_to(c64[0], (rows,)), jnp.broadcast_to(c64[1], (rows,)))
+
+    def lane8(i):  # 8-byte lane starting at word index i: lo = words[i]
+        return (words[:, i + 1], words[:, i])
+
+    seed64 = _u64c(seed & 0xFFFFFFFFFFFFFFFF)
+    i = 0
+    if n >= 32:
+        v = [bc(_add64(_add64(seed64, P1), P2)), bc(_add64(seed64, P2)),
+             bc(seed64), bc(_add64(seed64, _u64c((-int(_XXP1)) & 0xFFFFFFFFFFFFFFFF)))]
+        while 4 * i + 32 <= n:
+            for k in range(4):
+                v[k] = _mul64(_rot64(_add64(v[k], _mul64(lane8(i + 2 * k), P2)), 31), P1)
+            i += 8
+        acc = _add64(_add64(_rot64(v[0], 1), _rot64(v[1], 7)),
+                     _add64(_rot64(v[2], 12), _rot64(v[3], 18)))
+        for vk in v:
+            acc = _xor64(acc, _mul64(_rot64(_mul64(vk, P2), 31), P1))
+            acc = _add64(_mul64(acc, P1), P4)
+    else:
+        acc = bc(_add64(seed64, P5))
+    acc = _add64(acc, bc(_u64c(n)))
+    while 4 * i + 8 <= n:
+        acc = _xor64(acc, _mul64(_rot64(_mul64(lane8(i), P2), 31), P1))
+        acc = _add64(_mul64(_rot64(acc, 27), P1), P4)
+        i += 2
+    if 4 * i + 4 <= n:
+        lane = (jnp.zeros_like(words[:, i]), words[:, i])
+        acc = _xor64(acc, _mul64(lane, P1))
+        acc = _add64(_mul64(_rot64(acc, 23), P2), P3)
+        i += 1
+    # n is always a multiple of 4 (int32 rows): the 1-byte tail never runs
+    acc = _xor64(acc, _shr64(acc, 33))
+    acc = _mul64(acc, P2)
+    acc = _xor64(acc, _shr64(acc, 29))
+    acc = _mul64(acc, P3)
+    acc = _xor64(acc, _shr64(acc, 32))
+    return acc
+
+
 @register_op("hash")
 def _hash(ctx, op, ins):
     """reference hash_op.h: per input row, num_hash XXH64 digests (seed =
     hash index) of the row's int32 bytes, mod mod_by.  The exact hash
-    function is the contract (embedding slots depend on it), so this runs
-    the spec-exact XXH64 in a host callback."""
+    function is the contract (embedding slots depend on it); the digest is
+    computed IN-GRAPH as vectorized uint32-pair arithmetic (no host
+    callback — VERDICT r4 #5: must run on the axon TPU), pinned against
+    the numpy spec oracle + published test vectors in tests."""
     x = first(ins, "X").astype(jnp.int32)
     mod_by = op.attr("mod_by")
     num_hash = op.attr("num_hash", 1)
     rows = int(np.prod(x.shape[:-1]))
     last = x.shape[-1]
-
-    try:  # the C library computes identical digests ~100x faster; the
-        # numpy transcription stays as the spec oracle and fallback
-        from xxhash import xxh64_intdigest as _fast_xxh64
-    except ImportError:
-        _fast_xxh64 = _xxh64
-
-    def host(xv):
-        flat = np.asarray(xv, np.int32).reshape(rows, last)
-        out = np.empty((rows, num_hash), np.int32)  # mod_by < 2^31 (x32 mode)
-        for r in range(rows):
-            b = flat[r].tobytes()
-            for j in range(num_hash):
-                out[r, j] = _fast_xxh64(b, j) % mod_by
-        return out
-
-    from .common import host_callback
-
-    out = host_callback(
-        ctx, host, jax.ShapeDtypeStruct((rows, num_hash), jnp.int32), x)
+    words = jax.lax.bitcast_convert_type(x.reshape(rows, last), jnp.uint32)
+    outs = []
+    for j in range(num_hash):
+        digest = _xxh64_jnp(words, j)
+        outs.append(_mod64_u31(digest, mod_by).astype(jnp.int32))
+    out = jnp.stack(outs, axis=-1)
     return {"Out": out.reshape(tuple(x.shape[:-1]) + (num_hash,))}

@@ -1,0 +1,149 @@
+"""Fetch-time host evaluation of sink ops on callback-less platforms
+(VERDICT r4 #5) + in-graph XXH64 goldens.
+
+Reference context: chunk_eval_op.cc / detection_map_op.cc / py_func_op.cc
+run in-process on the program's device.  On the axon tunnel (no host
+send/recv) the executor prunes these sink ops from the device program,
+fetches their inputs, and evaluates them on CPU — validated on the real
+chip during r5; these tests force the same code path on the CPU backend by
+patching the platform predicate.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import LoDTensor
+from paddle_tpu.core.program import program_guard
+from paddle_tpu.ops.misc_ops import _xxh64
+
+
+@pytest.fixture
+def forced_deferral(monkeypatch):
+    """Force the executor's platform probe to report callback-less (as the
+    axon device does) while the CPU host-eval lowering context stays
+    callback-capable — exercising the full split/fetch/host-eval path on
+    the CPU backend."""
+    from unittest.mock import patch as _patch
+
+    from paddle_tpu.core import executor as ex
+    from paddle_tpu.ops import common
+
+    orig = ex.Executor._split_host_eval
+
+    def patched(self, program, fetch_names, feed):
+        with _patch.object(common, "_platform_lacks_callbacks", lambda p: True):
+            return orig(self, program, fetch_names, feed)
+
+    monkeypatch.setattr(ex.Executor, "_split_host_eval", patched)
+
+
+def test_chunk_eval_defers_to_fetch_time(forced_deferral):
+    label = np.array([[0], [1], [4], [2], [3]], "int64")
+    pred = np.array([[0], [1], [4], [2], [4]], "int64")
+    main, startup = fluid.Program(), fluid.Program()
+    with program_guard(main, startup):
+        iv = fluid.layers.data("i", [1], dtype="int64", lod_level=1)
+        lv = fluid.layers.data("l", [1], dtype="int64", lod_level=1)
+        outs = fluid.layers.chunk_eval(iv, lv, "IOB", 2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    for _ in range(2):  # second run exercises the pruned-program cache
+        res = exe.run(main, feed={"i": LoDTensor([pred]), "l": LoDTensor([label])},
+                      fetch_list=list(outs), scope=scope)
+    p, r, f1, ni, nl, nc = [np.asarray(v).reshape(-1)[0] for v in res]
+    assert (ni, nl, nc) == (2, 2, 1)
+    np.testing.assert_allclose([p, r, f1], [0.5, 0.5, 0.5])
+
+
+def test_deferred_metric_with_device_compute_upstream(forced_deferral):
+    # the metric's input is PRODUCED by device ops (scale of the feed), so
+    # the executor must add the intermediate to the device fetch set; a
+    # second device-side fetch (mean) rides the same dispatch
+    det = np.array([[[1, 0.9, .1, .1, .2, .2],
+                     [2, 0.8, .3, .3, .4, .4],
+                     [-1, 0.0, 0, 0, 0, 0]]], "f4")
+    gt = np.array([[[1, .1, .1, .2, .2],
+                    [2, .3, .3, .4, .4]]], "f4")
+    main, startup = fluid.Program(), fluid.Program()
+    with program_guard(main, startup):
+        dv = fluid.layers.data("det", [3, 6], dtype="float32")
+        gv = fluid.layers.data("gt", [2, 5], dtype="float32")
+        dv2 = fluid.layers.scale(dv, scale=1.0)  # device-produced input
+        m = fluid.layers.detection_map(dv2, gv, class_num=3,
+                                       overlap_threshold=0.5,
+                                       ap_version="integral")
+        mean_det = fluid.layers.mean(dv2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    res = exe.run(main, feed={"det": det, "gt": gt},
+                  fetch_list=[mean_det, m], scope=scope)
+    assert np.isfinite(np.asarray(res[0]).reshape(-1)[0])
+    np.testing.assert_allclose(float(np.asarray(res[1]).reshape(-1)[0]), 1.0,
+                               atol=1e-6)
+
+
+def test_detection_map_defers(forced_deferral):
+    det = np.array([[[1, 0.9, .1, .1, .2, .2],
+                     [2, 0.8, .3, .3, .4, .4],
+                     [1, 0.7, .5, .5, .6, .6],
+                     [-1, 0.0, 0, 0, 0, 0]]], "f4")
+    gt = np.array([[[1, .1, .1, .2, .2],
+                    [2, .3, .3, .4, .4]]], "f4")
+    main, startup = fluid.Program(), fluid.Program()
+    with program_guard(main, startup):
+        dv = fluid.layers.data("det", [4, 6], dtype="float32")
+        gv = fluid.layers.data("gt", [2, 5], dtype="float32")
+        m = fluid.layers.detection_map(dv, gv, class_num=3,
+                                       overlap_threshold=0.5,
+                                       ap_version="integral")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    (mv,) = exe.run(main, feed={"det": det, "gt": gt}, fetch_list=[m], scope=scope)
+    np.testing.assert_allclose(float(np.asarray(mv).reshape(-1)[0]), 1.0, atol=1e-6)
+
+
+# --- in-graph XXH64 (runs on any backend; no callback) ---------------------
+
+@pytest.mark.parametrize("last,mod", [
+    (2, 1000),            # short input (n < 32)
+    (8, 2_000_000_011),   # exactly one 32-byte block, mod near 2^31
+    (11, 999_983),        # block + 8-byte lane + 4-byte tail
+    (9, 2**31 - 1),       # block + 4-byte tail, max mod
+])
+def test_hash_in_graph_matches_spec_oracle(last, mod):
+    rng = np.random.RandomState(last)
+    x = rng.randint(-2**31, 2**31, size=(5, last)).astype("int32")
+    main, startup = fluid.Program(), fluid.Program()
+    with program_guard(main, startup):
+        xv = fluid.layers.data("x", [last], dtype="int32")
+        out = fluid.layers.hash(xv, hash_size=mod, num_hash=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    (got,) = exe.run(main, feed={"x": x}, fetch_list=[out], scope=scope)
+    got = np.asarray(got)
+    for r in range(5):
+        for j in range(2):
+            assert got[r, j] == _xxh64(x[r].tobytes(), j) % mod
+
+
+def test_xxh64_published_vectors_via_jnp():
+    # XXH64 official test vectors (xxhash spec): empty-seed cases need
+    # byte granularity we don't feed, so pin 4- and 8-byte inputs against
+    # the numpy oracle which itself is pinned to published vectors in
+    # tests/test_ops_round4.py
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.misc_ops import _xxh64_jnp
+
+    for words_np, seed in [(np.array([[0x04030201]], np.int32), 0),
+                           (np.array([[0x04030201, 0x08070605]], np.int32), 7)]:
+        words = jax.lax.bitcast_convert_type(jnp.asarray(words_np), jnp.uint32)
+        hi, lo = _xxh64_jnp(words, seed)
+        got = (int(np.asarray(hi)[0]) << 32) | int(np.asarray(lo)[0])
+        want = _xxh64(words_np.tobytes(), seed)
+        assert got == want, (hex(got), hex(want))
