@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.registry import register_op
 from .common import bcast_y_to_x, first, match_dtype, normalize_axes
@@ -360,3 +361,54 @@ def _fake_dequantize_max_abs(ctx, op, ins):
     scale = first(ins, "Scale").reshape(())
     max_range = op.attr("max_range", 127.0)
     return {"Out": x * scale / max_range}
+
+
+# --- round-5 registry-audit fill-ins ---------------------------------------
+# reference: minus_op.cc, l1_norm_op.cc, squared_l2_norm_op.cc,
+# squared_l2_distance_op.cc, fill_op.cc, fill_zeros_like_op.cc (the *2
+# variant differs only in grad wiring, which autodiff subsumes)
+
+@register_op("minus")
+def _minus(ctx, op, ins):
+    x = first(ins, "X")
+    return {"Out": x - match_dtype(x, first(ins, "Y"))}
+
+
+@register_op("l1_norm")
+def _l1_norm(ctx, op, ins):
+    return {"Out": jnp.sum(jnp.abs(first(ins, "X"))).reshape(())}
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ctx, op, ins):
+    return {"Out": jnp.sum(jnp.square(first(ins, "X"))).reshape(())}
+
+
+@register_op("squared_l2_distance")
+def _squared_l2_distance(ctx, op, ins):
+    x = first(ins, "X")
+    y = match_dtype(x, first(ins, "Y"))
+    n = x.shape[0]
+    sub = x.reshape(n, -1) - y.reshape(y.shape[0], -1)  # y may broadcast [1,D]
+    return {"sub_result": sub,
+            "Out": jnp.sum(jnp.square(sub), axis=1, keepdims=True)}
+
+
+@register_op("fill")
+def _fill(ctx, op, ins):
+    from .common import canon_dtype, np_dtype
+
+    shape = tuple(op.attr("shape"))
+    dtype = canon_dtype(np_dtype(op.attr("dtype", "float32")))
+    vals = np.asarray(op.attr("value"), np.float32).reshape(shape)
+    return {"Out": jnp.asarray(vals.astype(dtype))}
+
+
+@register_op("fill_zeros_like2")
+def _fill_zeros_like2(ctx, op, ins):
+    x = first(ins, "X")
+    from .common import canon_dtype, np_dtype
+
+    dt = op.attr("dtype", None)
+    dtype = x.dtype if dt in (None, -1) else canon_dtype(np_dtype(dt))
+    return {"Out": jnp.zeros(x.shape, dtype)}

@@ -157,6 +157,7 @@ def conv2d_transpose_math(x, w, strides=(1, 1), pads=(0, 0), dilations=(1, 1),
 
 
 @register_op("conv2d_transpose")
+@register_op("depthwise_conv2d_transpose")
 def _conv2d_transpose(ctx, op, ins):
     x = first(ins, "Input")
     w = match_dtype(x, first(ins, "Filter"))  # fluid layout: (in, out, kh, kw)

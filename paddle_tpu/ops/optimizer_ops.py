@@ -444,3 +444,36 @@ def _dgc(ctx, op, ins):
         "UOut": jnp.where(active, u3, u),
         "VOut": jnp.where(active, v3, v),
     }
+
+
+@register_opt("proximal_gd")
+def _proximal_gd(ctx, op, ins):
+    """reference proximal_gd_op.h: prox = p - lr*g;
+    p' = sign(prox) * max(|prox| - lr*l1, 0) / (1 + lr*l2)."""
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    lr = _lr(ins)
+    l1 = op.attr("l1", 0.0)
+    l2 = op.attr("l2", 0.0)
+    prox = p - lr * g
+    p_new = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+             / (1.0 + lr * l2))
+    return {"ParamOut": p_new}
+
+
+@register_opt("proximal_adagrad")
+def _proximal_adagrad(ctx, op, ins):
+    """reference proximal_adagrad_op.h: moment += g^2; eff_lr =
+    lr/sqrt(moment); then the proximal_gd shrinkage at eff_lr."""
+    p = first(ins, "Param")
+    g = first(ins, "Grad")
+    m = first(ins, "Moment")
+    lr = _lr(ins)
+    l1 = op.attr("l1", 0.0)
+    l2 = op.attr("l2", 0.0)
+    m_new = m + jnp.square(g)
+    eff = lr / jnp.sqrt(m_new)
+    prox = p - eff * g
+    p_new = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - eff * l1, 0.0)
+             / (1.0 + eff * l2))
+    return {"ParamOut": p_new, "MomentOut": m_new}

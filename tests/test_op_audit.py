@@ -1,0 +1,101 @@
+"""CI gate: every reference operator is registered or recorded
+(tools/op_audit.py — the op-level analog of tests/test_api_audit.py).
+
+Also pins goldens for the round-5 registry fill-ins (reference:
+minus_op.cc, l1_norm_op.cc, squared_l2_norm_op.cc,
+squared_l2_distance_op.cc, fill_op.cc, proximal_gd_op.h,
+proximal_adagrad_op.h)."""
+import numpy as np
+
+from op_test import OpTest
+
+import tools.op_audit as op_audit
+
+
+def test_op_registry_audit_gate():
+    res = op_audit.audit()
+    assert res["ok"], {
+        "uncovered": res["uncovered"], "stale": res["stale_deviations"]}
+    # sanity floor so a broken extraction can't silently pass
+    assert res["ref_total"] >= 300
+    assert res["registered"] >= 240
+
+
+def _golden(op_type, inputs, outputs, attrs=None, **kw):
+    class T(OpTest):
+        def setUp(self):
+            self.op_type = op_type
+            self.inputs = inputs
+            self.outputs = outputs
+            self.attrs = attrs or {}
+
+    T().check_output(**kw)
+
+
+RNG = np.random.RandomState(5)
+
+
+def test_minus_golden():
+    x = RNG.rand(3, 4).astype("f4")
+    y = RNG.rand(3, 4).astype("f4")
+    _golden("minus", {"X": x, "Y": y}, {"Out": x - y})
+
+
+def test_l1_and_squared_l2_norm_golden():
+    x = (RNG.rand(4, 5).astype("f4") - 0.5)
+    _golden("l1_norm", {"X": x}, {"Out": np.abs(x).sum()}, atol=1e-5)
+    _golden("squared_l2_norm", {"X": x}, {"Out": (x * x).sum()}, atol=1e-5)
+
+
+def test_squared_l2_distance_golden():
+    x = RNG.rand(4, 3).astype("f4")
+    y = RNG.rand(4, 3).astype("f4")
+    sub = x - y
+    _golden("squared_l2_distance", {"X": x, "Y": y},
+            {"sub_result": sub, "Out": (sub * sub).sum(1, keepdims=True)},
+            atol=1e-5)
+    # broadcast Y [1, D]
+    y1 = RNG.rand(1, 3).astype("f4")
+    sub1 = x - y1
+    _golden("squared_l2_distance", {"X": x, "Y": y1},
+            {"sub_result": sub1, "Out": (sub1 * sub1).sum(1, keepdims=True)},
+            atol=1e-5)
+
+
+def test_fill_golden():
+    vals = [1.5, -2.0, 3.25, 0.0, 7.0, -1.0]
+    _golden("fill", {}, {"Out": np.asarray(vals, "f4").reshape(2, 3)},
+            {"shape": [2, 3], "value": vals, "dtype": "float32"})
+
+
+def test_fill_zeros_like2_golden():
+    x = RNG.rand(2, 3).astype("f4")
+    _golden("fill_zeros_like2", {"X": x}, {"Out": np.zeros_like(x)},
+            {"dtype": "float32"})
+
+
+def test_proximal_gd_golden():
+    p = RNG.rand(5).astype("f4")
+    g = (RNG.rand(5).astype("f4") - 0.5)
+    lr = np.array([0.1], "f4")
+    l1, l2 = 0.05, 0.1
+    prox = p - 0.1 * g
+    want = np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * l1, 0) / (1 + 0.1 * l2)
+    _golden("proximal_gd", {"Param": p, "Grad": g, "LearningRate": lr},
+            {"ParamOut": want.astype("f4")}, {"l1": l1, "l2": l2}, atol=1e-6)
+
+
+def test_proximal_adagrad_golden():
+    p = RNG.rand(5).astype("f4")
+    g = (RNG.rand(5).astype("f4") - 0.5)
+    m = RNG.rand(5).astype("f4") + 0.1
+    lr = np.array([0.1], "f4")
+    l1, l2 = 0.05, 0.1
+    m_new = m + g * g
+    eff = 0.1 / np.sqrt(m_new)
+    prox = p - eff * g
+    want = np.sign(prox) * np.maximum(np.abs(prox) - eff * l1, 0) / (1 + eff * l2)
+    _golden("proximal_adagrad",
+            {"Param": p, "Grad": g, "Moment": m, "LearningRate": lr},
+            {"ParamOut": want.astype("f4"), "MomentOut": m_new.astype("f4")},
+            {"l1": l1, "l2": l2}, atol=1e-6)
