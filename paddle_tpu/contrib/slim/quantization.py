@@ -116,3 +116,60 @@ def post_training_quantize(scope, program, weight_bits: int = 8):
             scope.set_var(wname, (q * scale / qmax).astype(w.dtype))
             scales[wname] = scale
     return scales
+
+
+def convert_quant_model(program, scope=None, weight_bits: int = 8):
+    """Freeze a QAT program for deployment (reference
+    QuantizationFreezePass + mkldnn_quantizer.cc role): strip the
+    fake-quant ops, remap every @QUANT input back to its source var, and —
+    when a scope is given — snap each quantized WEIGHT to its int8 grid so
+    the deployed float program computes exactly what int8 storage can
+    represent.  Returns {"weights": {name: scale_array}, "activations":
+    {name: bits}} — the scale manifest io.save_quantized_inference_model
+    persists for int8 on-disk storage."""
+    from ...core.program import Parameter
+
+    qmax = float(2 ** (weight_bits - 1) - 1)
+    block = program.global_block()
+    fake_types = ("fake_quantize_abs_max", "fake_channel_wise_quantize_abs_max")
+    remap = {}          # "@QUANT" name -> source name
+    weight_src = {}     # source weight name -> quant_axis (or None for tensor)
+    act_bits = {}       # activation source -> its fake-quant op's bit_length
+    for op in block.ops:
+        if op.type in fake_types:
+            src = op.inputs["X"][0]
+            remap[op.outputs["Out"][0]] = src
+            v = block._find_var_recursive(src)
+            if isinstance(v, Parameter):
+                weight_src[src] = (op.attrs.get("quant_axis", 0)
+                                   if op.type == "fake_channel_wise_quantize_abs_max"
+                                   else None)
+            else:
+                act_bits[src] = int(op.attrs.get("bit_length", 8))
+    if not remap:
+        return {"weights": {}, "activations": {}}
+    block.ops = [op for op in block.ops if op.type not in fake_types]
+    for op in block.ops:
+        for slot, names in op.inputs.items():
+            op.inputs[slot] = [remap.get(n, n) for n in names]
+    program._bump()
+
+    weight_scales = {}
+    if scope is not None:
+        for wname, qaxis in weight_src.items():
+            w = np.asarray(scope.find_var(wname))
+            if qaxis is None:
+                scale = np.asarray(np.max(np.abs(w)) or 1e-8, np.float32)
+            else:
+                red = tuple(i for i in range(w.ndim) if i != qaxis)
+                scale = np.maximum(np.abs(w).max(axis=red), 1e-8).astype(np.float32)
+                shp = [1] * w.ndim
+                shp[qaxis] = -1
+                scale = scale.reshape(shp)
+            q = np.clip(np.round(w / scale * qmax), -qmax - 1, qmax)
+            scope.set_var(wname, (q * scale / qmax).astype(w.dtype))
+            # quant_axis rides along explicitly — inferring it later from
+            # shape matching mis-resolves square weights
+            weight_scales[wname] = {"scale": np.squeeze(scale), "axis": qaxis}
+    return {"weights": weight_scales,
+            "activations": {n: act_bits[n] for n in sorted(act_bits)}}

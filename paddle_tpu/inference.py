@@ -1,15 +1,20 @@
 """Inference predictor: compile-and-serve of saved inference models.
 
-Reference: AnalysisPredictor (inference/api/analysis_predictor.h:46) —
-load a saved __model__ + params, run analysis passes, serve Run() calls,
-clone() per serving thread.
+Reference: AnalysisPredictor (inference/api/analysis_predictor.h:46) +
+AnalysisConfig (inference/api/paddle_analysis_config.h) + ZeroCopyTensor
+(inference/api/paddle_inference_api.h) — load a saved __model__ + params,
+run analysis passes, serve Run() calls, clone() per serving thread, and
+expose input/output buffers without feed/fetch copies.
 
 TPU-first: the "analysis passes" are XLA (whole-program fusion happens at
 compile, so the reference's fuse pass pipeline has no residue to apply);
 the predictor is a pruned Program + Scope + Executor with the compiled
 executable cached after the first call.  clone() shares the weights
 (read-only Scope) but gets its own Executor — the reference's
-clone-per-thread contract."""
+clone-per-thread contract.  Int8 models saved via
+io.save_quantized_inference_model load transparently (weights dequantize
+from their int8 grid at load; the served numerics ARE the int8-representable
+values)."""
 from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence
@@ -22,16 +27,99 @@ from .core.scope import Scope
 from . import io as _io
 
 
-class PredictConfig:
-    """reference AnalysisConfig (trimmed to what matters on TPU)."""
+class AnalysisConfig:
+    """reference paddle_analysis_config.h, mapped to what exists on TPU.
+
+    Knobs that are XLA's job are accepted-and-recorded no-ops so reference
+    configs port without edits; each says so in its docstring."""
 
     def __init__(self, model_dir: str, place: Optional[Place] = None):
         self.model_dir = model_dir
         self.place = place or TPUPlace(0)
+        self._ir_optim = True
+        self._memory_optim = True
+        self._int8 = True  # quantized models auto-detected at load
+        self._threads = 1
+
+    # -- device selection -------------------------------------------------
+    def enable_tpu(self, device_id: int = 0):
+        """reference enable_use_gpu analog."""
+        self.place = TPUPlace(device_id)
+        return self
+
+    def disable_tpu(self):
+        self.place = CPUPlace()
+        return self
+
+    # -- optimization switches (XLA-subsumed; recorded for parity) --------
+    def switch_ir_optim(self, on: bool = True):
+        """reference pass-pipeline switch: XLA always optimizes — recorded
+        only (a False here does not produce an unoptimized executable)."""
+        self._ir_optim = bool(on)
+        return self
+
+    def enable_memory_optim(self, on: bool = True):
+        """reference memory-reuse pass: PJRT buffer donation is always on
+        for inference (no state write-back); recorded only."""
+        self._memory_optim = bool(on)
+        return self
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        """reference MKL thread knob: XLA:CPU threading is process-global;
+        recorded only."""
+        self._threads = int(n)
+        return self
+
+    def enable_quantize(self, on: bool = True):
+        """int8 models are detected from __quant__.json automatically; this
+        records intent for config introspection."""
+        self._int8 = bool(on)
+        return self
+
+    def summary(self) -> dict:
+        return {"model_dir": self.model_dir, "place": type(self.place).__name__,
+                "ir_optim": self._ir_optim, "memory_optim": self._memory_optim,
+                "int8": self._int8, "threads": self._threads}
+
+
+# backward-compatible alias (round-4 surface)
+PredictConfig = AnalysisConfig
+
+
+class PredictorTensor:
+    """reference ZeroCopyTensor: a named input/output buffer handle.
+
+    copy_from_cpu stages a host array (or adopts a jax.Array as-is —
+    the true zero-copy path: a DataLoader or upstream model output already
+    on device is passed through untouched); copy_to_cpu materializes the
+    result to numpy once."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = arr
+        return self
+
+    def share_external_data(self, jax_array):
+        """Adopt a device-resident array without copying."""
+        self._value = jax_array
+        return self
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._value is None:
+            raise ValueError(f"output {self.name!r} not produced yet; "
+                             "call Predictor.run_zero_copy() first")
+        return np.asarray(self._value)
+
+    def value(self):
+        """The raw (possibly device-resident) array — no host copy."""
+        return self._value
 
 
 class Predictor:
-    def __init__(self, config: PredictConfig, _shared=None):
+    def __init__(self, config: AnalysisConfig, _shared=None):
         self.config = config
         if _shared is not None:  # clone path: share program + weights
             self.program, self.feed_names, self.fetch_names, self.scope = _shared
@@ -41,15 +129,50 @@ class Predictor:
             self.program, self.feed_names, self.fetch_names = _io.load_inference_model(
                 config.model_dir, exe, scope=self.scope)
         self.exe = Executor(config.place)
+        self._inputs = {n: PredictorTensor(n) for n in self.feed_names}
+        self._outputs = {n: PredictorTensor(n) for n in self.fetch_names}
 
+    # -- classic dict API --------------------------------------------------
     def run(self, feeds: Dict[str, np.ndarray],
-            fetch_names: Optional[Sequence[str]] = None) -> List[np.ndarray]:
+            fetch_names: Optional[Sequence[str]] = None,
+            return_numpy: bool = True) -> List[np.ndarray]:
         missing = set(self.feed_names) - set(feeds)
         if missing:
             raise KeyError(f"Predictor.run: missing feeds {sorted(missing)}")
         return self.exe.run(
             self.program, feed=dict(feeds),
-            fetch_list=list(fetch_names or self.fetch_names), scope=self.scope)
+            fetch_list=list(fetch_names or self.fetch_names), scope=self.scope,
+            return_numpy=return_numpy)
+
+    # -- zero-copy handle API (reference ZeroCopyRun contract) -------------
+    def get_input_names(self) -> List[str]:
+        return list(self.feed_names)
+
+    def get_output_names(self) -> List[str]:
+        return list(self.fetch_names)
+
+    def get_input_handle(self, name: str) -> PredictorTensor:
+        return self._inputs[name]
+
+    def get_output_handle(self, name: str) -> PredictorTensor:
+        return self._outputs[name]
+
+    def run_zero_copy(self):
+        """Execute from the staged input handles into the output handles.
+        Device-resident inputs pass straight to the executor (no host
+        round-trip); outputs stay device-resident until copy_to_cpu."""
+        feeds = {}
+        for n, h in self._inputs.items():
+            if h._value is None:
+                raise KeyError(f"input handle {n!r} has no data; call "
+                               "copy_from_cpu/share_external_data first")
+            feeds[n] = h._value
+        outs = self.exe.run(self.program, feed=feeds,
+                            fetch_list=list(self.fetch_names),
+                            scope=self.scope, return_numpy=False)
+        for n, v in zip(self.fetch_names, outs):
+            self._outputs[n]._value = v
+        return True
 
     def clone(self) -> "Predictor":
         """Serve from another thread: shared weights, private executor
@@ -58,6 +181,6 @@ class Predictor:
             self.program, self.feed_names, self.fetch_names, self.scope))
 
 
-def create_predictor(config: PredictConfig) -> Predictor:
+def create_predictor(config: AnalysisConfig) -> Predictor:
     """reference CreatePaddlePredictor."""
     return Predictor(config)

@@ -68,11 +68,27 @@ def load_vars(dirname: str, var_names: Optional[Sequence[str]] = None,
     with open(os.path.join(dirname, MANIFEST)) as f:
         manifest = json.load(f)
     want = set(var_names) if var_names is not None else None
+    qman = {}
+    qpath = os.path.join(dirname, QUANT_MANIFEST)
+    if os.path.exists(qpath):
+        with open(qpath) as f:
+            qman = json.load(f).get("weights", {})
     loaded = []
     for entry in manifest["vars"]:
         if want is not None and entry["name"] not in want:
             continue
         arr = np.load(os.path.join(dirname, entry["file"]))
+        if entry["name"] in qman and arr.dtype == np.int8:
+            # int8 storage -> dequantized floats (quantized inference model)
+            rec = qman[entry["name"]]
+            qmax = float(2 ** (rec["bits"] - 1) - 1)
+            scale = np.asarray(rec["scale"], np.float32)
+            shp = [1] * arr.ndim
+            axis = rec.get("axis")
+            if axis is not None:
+                shp[axis] = -1
+            arr = (arr.astype(np.float32) * scale.reshape(shp) / qmax).astype(
+                rec.get("dtype", "float32"))
         scope.set_var(entry["name"], arr)
         loaded.append(entry["name"])
     if want is not None:
@@ -322,3 +338,62 @@ def load_inference_model(dirname: str, executor, scope: Optional[Scope] = None):
     program = Program.from_dict(doc)
     load_vars(dirname, None, scope)
     return program, doc["feed_names"], doc["fetch_names"]
+
+
+# --- int8 quantized inference models ---------------------------------------
+
+QUANT_MANIFEST = "__quant__.json"
+
+
+def save_quantized_inference_model(
+    dirname: str,
+    feeded_var_names: Sequence[str],
+    target_vars: Sequence[Variable],
+    executor,
+    main_program: Optional[Program] = None,
+    scope: Optional[Scope] = None,
+    weight_bits: int = 8,
+):
+    """save_inference_model + int8 weight storage (reference:
+    inference/api/mkldnn_quantizer.cc role — produce a deployable quantized
+    model).  Works on a QAT-instrumented program (fake-quant ops are frozen
+    out via slim.convert_quant_model) or a plain float program (pure PTQ:
+    abs-max per-tensor weight scales).  Quantized params are stored as int8
+    on disk with their scales in __quant__.json; load_inference_model
+    dequantizes transparently, so the served program's numerics equal the
+    int8-representable weights exactly."""
+    from .contrib.slim.quantization import convert_quant_model
+    from .contrib.slim.quantization import post_training_quantize
+
+    program = main_program or default_main_program()
+    scope = scope or global_scope()
+    work = program.clone()
+    manifest = convert_quant_model(work, scope, weight_bits=weight_bits)
+    if not manifest["weights"]:
+        # plain float program: per-tensor PTQ (the slim pass, one copy)
+        manifest["weights"] = {
+            name: {"scale": np.float32(scale), "axis": None}
+            for name, scale in post_training_quantize(
+                scope, work, weight_bits=weight_bits).items()}
+    fetch = save_inference_model(dirname, feeded_var_names, target_vars,
+                                 executor, work, scope)
+    # overwrite the quantized params with int8 payloads + scale sidecar
+    qmax = float(2 ** (weight_bits - 1) - 1)
+    qrec = {}
+    for wname, rec in manifest["weights"].items():
+        w = np.asarray(scope.find_var(wname))
+        scale_arr = np.asarray(rec["scale"], np.float32)
+        axis = rec["axis"]
+        shp = [1] * w.ndim
+        if axis is not None:
+            shp[axis] = -1
+        q = np.clip(np.round(w / scale_arr.reshape(shp) * qmax),
+                    -qmax - 1, qmax).astype(np.int8)
+        fname = wname.replace("/", "%2F") + ".npy"
+        np.save(os.path.join(dirname, fname), q)
+        qrec[wname] = {"scale": scale_arr.tolist(), "axis": axis,
+                       "bits": weight_bits, "dtype": str(w.dtype)}
+    with open(os.path.join(dirname, QUANT_MANIFEST), "w") as f:
+        json.dump({"weights": qrec,
+                   "activations": manifest["activations"]}, f, indent=1)
+    return fetch

@@ -1,0 +1,26 @@
+"""ICI collective microbench harness validation on the virtual 8-dev mesh
+(BASELINE.md last row's harness — methodology ready for real multi-chip).
+"""
+import jax
+
+import tools.collective_bench as cb
+
+
+def test_collective_bench_all_kinds_run():
+    mesh = cb._mesh(8)
+    for kind in ("allreduce", "all_gather", "reduce_scatter", "ppermute"):
+        rec = cb.bench_collective(kind, 0.1, mesh, iters=1, chain=2)
+        assert rec["devices"] == 8
+        assert rec["time_us"] > 0
+        assert rec["achieved_gbps"] >= 0
+
+
+def test_collective_bench_algo_bytes_formulas():
+    # allreduce algorithmic bytes = 2(n-1)/n * payload; gather/scatter =
+    # (n-1)/n; ppermute = payload.  Pin via one synthetic record each.
+    mesh = cb._mesh(8)
+    r_ar = cb.bench_collective("allreduce", 0.1, mesh, iters=1, chain=2)
+    r_pp = cb.bench_collective("ppermute", 0.1, mesh, iters=1, chain=2)
+    # same payload: achieved_gbps ratio reflects the algo-bytes ratio up to
+    # timing noise; just assert both computed on the same payload size
+    assert abs(r_ar["payload_mb"] - r_pp["payload_mb"]) < 1e-6

@@ -1,0 +1,139 @@
+"""ICI collective micro-benchmark (BASELINE.md last row: achieved allreduce
+bandwidth vs roofline; reference shape: benchmark/fluid/fluid_benchmark.py
+multi-GPU modes measuring NCCL throughput).
+
+Sweeps psum / all_gather / reduce_scatter / ppermute over a jax.sharding
+Mesh across a range of payload sizes, timing K chained collectives per
+dispatch (one device sync at the end), and reports achieved algorithmic
+bandwidth per chip:
+
+  allreduce:      algo_bytes = 2 * (n-1)/n * payload   (ring)
+  all_gather:     algo_bytes = (n-1)/n * result
+  reduce_scatter: algo_bytes = (n-1)/n * payload
+  ppermute:       algo_bytes = payload                 (one hop)
+
+vs_roofline uses --ici-gbps (per-direction per-link; v5e ICI ~ 186 GB/s
+bidirectional over 2 links -> pass the datasheet number for the target
+topology).  On the 8-device virtual CPU mesh the absolute numbers are
+host-memcpy speeds — the point there is validating the harness end to end
+(tests/test_collective_bench.py + the dryrun), so the day multi-chip
+hardware exists this file is the measurement, not a TODO.
+
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python tools/collective_bench.py --sizes-mb 1,8 --iters 3
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _mesh(n=None):
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    n = n or len(devs)
+    return Mesh(np.array(devs[:n]), ("x",))
+
+
+def bench_collective(kind, size_mb, mesh, iters=4, chain=8, dtype="float32"):
+    """One (collective, size) point: per-chip payload `size_mb`, `chain`
+    dependent collectives per dispatch."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.devices.size
+    elems = int(size_mb * 1e6) // np.dtype(dtype).itemsize
+    elems -= elems % n  # reduce_scatter needs n | elems
+    x = jnp.ones((n, elems), dtype)
+    x = jax.device_put(x, NamedSharding(mesh, P("x", None)))
+
+    def body(v):
+        if kind == "allreduce":
+            return jax.lax.psum(v, "x") * (1.0 / n)  # keep values bounded
+        if kind == "all_gather":
+            g = jax.lax.all_gather(v, "x")           # [n, elems]
+            return g[0]                               # keep carry shape
+        if kind == "reduce_scatter":
+            g = jax.lax.psum_scatter(v, "x", tiled=True)
+            return jnp.tile(g, n)[:v.shape[0]]
+        if kind == "ppermute":
+            return jax.lax.ppermute(v, "x", [(i, (i + 1) % n) for i in range(n)])
+        raise ValueError(kind)
+
+    @jax.jit
+    @lambda f: jax.shard_map(f, mesh=mesh, in_specs=P("x", None),
+                             out_specs=P("x", None))
+    def step(v):
+        row = v[0]
+        for _ in range(chain):
+            row = body(row) + 1e-9  # data dependence between collectives
+        return row[None, :]
+
+    out = step(x)
+    np.asarray(jax.device_get(out[0, :1]))
+    best = 1e9
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = step(x)
+        np.asarray(jax.device_get(out[0, :1]))
+        best = min(best, (time.perf_counter() - t0) / chain)
+
+    payload = elems * np.dtype(dtype).itemsize
+    if kind == "allreduce":
+        algo = 2 * (n - 1) / n * payload
+    elif kind in ("all_gather", "reduce_scatter"):
+        algo = (n - 1) / n * payload
+    else:
+        algo = payload
+    return {"collective": kind, "payload_mb": round(payload / 1e6, 3),
+            "devices": n, "time_us": round(best * 1e6, 1),
+            "achieved_gbps": round(algo / best / 1e9, 3)}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--sizes-mb", default="0.25,1,4,16,64")
+    p.add_argument("--collectives",
+                   default="allreduce,all_gather,reduce_scatter,ppermute")
+    p.add_argument("--iters", type=int, default=4)
+    p.add_argument("--chain", type=int, default=8)
+    p.add_argument("--devices", type=int, default=None)
+    p.add_argument("--ici-gbps", type=float, default=None,
+                   help="per-chip ICI roofline for vs_roofline (e.g. 186 "
+                        "for v5e bidirectional)")
+    p.add_argument("--cpu-mesh", type=int, default=None, metavar="N",
+                   help="run on an N-device virtual CPU mesh (the axon site "
+                        "hook re-forces JAX_PLATFORMS=axon at interpreter "
+                        "start, so the env var alone does not stick)")
+    args = p.parse_args(argv)
+
+    if args.cpu_mesh:
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + f" --xla_force_host_platform_device_count={args.cpu_mesh}").strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    mesh = _mesh(args.devices)
+    for kind in args.collectives.split(","):
+        for size in args.sizes_mb.split(","):
+            rec = bench_collective(kind, float(size), mesh,
+                                   iters=args.iters, chain=args.chain)
+            if args.ici_gbps:
+                rec["vs_roofline"] = round(rec["achieved_gbps"] / args.ici_gbps, 4)
+            print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
