@@ -197,3 +197,327 @@ void rio_scanner_close(void* handle) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Multithreaded slot-batch queue (reference: framework/data_feed.cc
+// MultiSlotInMemoryDataFeed — C++ worker threads parse slot files so the
+// trainer never waits on the Python GIL).  Files hold _pack_arrays records
+// (see paddle_tpu/recordio.py): u32 nslots, then per slot {u32 dtype_len,
+// dtype str, u32 ndim, i64 shape[ndim], u64 raw_len, raw}.  The fast path
+// requires every sample to repeat the first record's per-slot dtype/shape
+// (dense slots — the CTR/train_from_dataset shape); a mismatch fails
+// loudly so ragged data falls back to the Python path.
+
+#include <atomic>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <thread>
+
+namespace {
+
+struct SlotLayout {
+  std::string dtype;
+  std::vector<int64_t> shape;  // per-sample
+  uint64_t raw_len = 0;
+};
+
+struct ParsedRec {
+  // offsets into `bytes` for each slot's raw payload
+  std::vector<uint8_t> bytes;
+  std::vector<size_t> slot_off;
+};
+
+struct SlotQueue {
+  std::vector<std::string> files;
+  std::vector<SlotLayout> layout;
+  size_t batch = 0;
+  bool drop_last = true;
+
+  std::mutex mu;
+  std::condition_variable cv_put, cv_get;
+  std::deque<ParsedRec> q;  // FIFO: preserves file order at n_threads=1
+  size_t cap = 8192;
+  bool done = false;
+  std::string error;
+  std::atomic<size_t> next_file{0};
+  int active_workers = 0;  // guarded by mu; signals end-of-stream at 0
+  std::vector<std::thread> workers;
+
+  ~SlotQueue() {
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      done = true;  // release any blocked producer
+      cv_put.notify_all();
+      cv_get.notify_all();
+    }
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+  }
+
+  bool parse_record(const uint8_t* p, uint32_t len, ParsedRec* out,
+                    std::string* err) {
+    size_t off = 0;
+    auto need = [&](size_t n) { return off + n <= len; };
+    if (!need(4)) { *err = "slotq: truncated record"; return false; }
+    uint32_t nslots;
+    memcpy(&nslots, p + off, 4); off += 4;
+    if (nslots != layout.size()) {
+      *err = "slotq: record slot count changed mid-stream";
+      return false;
+    }
+    out->bytes.assign(p, p + len);
+    out->slot_off.resize(nslots);
+    for (uint32_t s = 0; s < nslots; s++) {
+      if (!need(4)) { *err = "slotq: truncated dtype len"; return false; }
+      uint32_t dl; memcpy(&dl, p + off, 4); off += 4;
+      if (!need(dl)) { *err = "slotq: truncated dtype"; return false; }
+      std::string dt(reinterpret_cast<const char*>(p + off), dl); off += dl;
+      if (!need(4)) { *err = "slotq: truncated ndim"; return false; }
+      uint32_t nd; memcpy(&nd, p + off, 4); off += 4;
+      std::vector<int64_t> shape(nd);
+      if (!need(8 * nd)) { *err = "slotq: truncated shape"; return false; }
+      memcpy(shape.data(), p + off, 8 * nd); off += 8 * nd;
+      if (!need(8)) { *err = "slotq: truncated raw len"; return false; }
+      uint64_t rl; memcpy(&rl, p + off, 8); off += 8;
+      if (!need(rl)) { *err = "slotq: truncated payload"; return false; }
+      const SlotLayout& L = layout[s];
+      if (dt != L.dtype || shape != L.shape || rl != L.raw_len) {
+        *err = "slotq: sample shape/dtype differs from the first record "
+               "(ragged data — use the Python dataset path)";
+        return false;
+      }
+      out->slot_off[s] = off;
+      off += rl;
+    }
+    return true;
+  }
+
+  void worker() {
+    worker_loop();
+    std::unique_lock<std::mutex> lk(mu);
+    active_workers--;
+    cv_get.notify_all();
+  }
+
+  void worker_loop() {
+    for (;;) {
+      size_t idx = next_file.fetch_add(1);
+      if (idx >= files.size()) return;
+      Scanner sc;
+      sc.f = fopen(files[idx].c_str(), "rb");
+      if (!sc.f) {
+        std::unique_lock<std::mutex> lk(mu);
+        error = "slotq: cannot open " + files[idx];
+        done = true; cv_get.notify_all();
+        return;
+      }
+      fseek(sc.f, 0, SEEK_END); sc.file_size = ftell(sc.f); fseek(sc.f, 0, SEEK_SET);
+      for (;;) {
+        if (sc.remaining == 0 && !sc.load_chunk()) {
+          bool clean = g_error.empty();
+          if (!clean) {
+            std::unique_lock<std::mutex> lk(mu);
+            error = g_error;
+            done = true; cv_get.notify_all();
+          }
+          break;
+        }
+        if (sc.pos + 4 > sc.chunk.size()) {
+          std::unique_lock<std::mutex> lk(mu);
+          error = "slotq: record header past chunk end";
+          done = true; cv_get.notify_all();
+          fclose(sc.f);
+          return;
+        }
+        uint32_t rl;
+        memcpy(&rl, sc.chunk.data() + sc.pos, 4);
+        if (sc.pos + 4 + (uint64_t)rl > sc.chunk.size()) {
+          std::unique_lock<std::mutex> lk(mu);
+          error = "slotq: record length past chunk end";
+          done = true; cv_get.notify_all();
+          fclose(sc.f);
+          return;
+        }
+        const uint8_t* rec = sc.chunk.data() + sc.pos + 4;
+        sc.pos += 4 + rl;
+        sc.remaining--;
+        ParsedRec pr;
+        std::string err;
+        if (!parse_record(rec, rl, &pr, &err)) {
+          std::unique_lock<std::mutex> lk(mu);
+          error = err;
+          done = true; cv_get.notify_all();
+          fclose(sc.f);
+          return;
+        }
+        std::unique_lock<std::mutex> lk(mu);
+        cv_put.wait(lk, [&] { return q.size() < cap || done; });
+        if (done) { fclose(sc.f); return; }
+        q.push_back(std::move(pr));
+        cv_get.notify_one();
+      }
+      fclose(sc.f);
+    }
+  }
+};
+
+bool parse_layout(const uint8_t* p, uint32_t len,
+                  std::vector<SlotLayout>* out, std::string* err) {
+  size_t off = 0;
+  auto need = [&](size_t n) { return off + n <= len; };
+  uint32_t nslots;
+  if (!need(4)) { *err = "slotq: truncated record header"; return false; }
+  memcpy(&nslots, p + off, 4); off += 4;
+  if (nslots == 0 || nslots > 1024) {
+    *err = "slotq: implausible slot count (not a slot-record file)";
+    return false;
+  }
+  out->resize(nslots);
+  for (uint32_t s = 0; s < nslots; s++) {
+    SlotLayout& L = (*out)[s];
+    uint32_t dl;
+    if (!need(4)) { *err = "slotq: truncated dtype len"; return false; }
+    memcpy(&dl, p + off, 4); off += 4;
+    if (dl == 0 || dl > 16 || !need(dl)) {
+      *err = "slotq: implausible dtype (not a slot-record file)";
+      return false;
+    }
+    L.dtype.assign(reinterpret_cast<const char*>(p + off), dl); off += dl;
+    uint32_t nd;
+    if (!need(4)) { *err = "slotq: truncated ndim"; return false; }
+    memcpy(&nd, p + off, 4); off += 4;
+    if (nd > 8 || !need(8ull * nd)) {
+      *err = "slotq: implausible ndim"; return false;
+    }
+    L.shape.resize(nd);
+    memcpy(L.shape.data(), p + off, 8ull * nd); off += 8ull * nd;
+    if (!need(8)) { *err = "slotq: truncated raw len"; return false; }
+    memcpy(&L.raw_len, p + off, 8); off += 8;
+    if (!need(L.raw_len)) { *err = "slotq: truncated payload"; return false; }
+    // raw_len must equal prod(shape) * itemsize or the Python-side numpy
+    // buffers (sized from shape/dtype) would be overflowed by the memcpy
+    uint64_t elems = 1;
+    for (int64_t d : L.shape) {
+      if (d < 0) { *err = "slotq: negative dim"; return false; }
+      elems *= (uint64_t)d;
+    }
+    uint64_t item = 0;
+    for (char c : L.dtype)
+      if (c >= '0' && c <= '9') item = item * 10 + (c - '0');
+    if (item == 0 || item > 16 || elems * item != L.raw_len) {
+      *err = "slotq: raw_len inconsistent with shape*itemsize";
+      return false;
+    }
+    off += L.raw_len;
+  }
+  return true;
+}
+
+bool peek_layout(const std::string& path, std::vector<SlotLayout>* out) {
+  Scanner sc;
+  sc.f = fopen(path.c_str(), "rb");
+  if (!sc.f) { g_error = "slotq: cannot open " + path; return false; }
+  fseek(sc.f, 0, SEEK_END); sc.file_size = ftell(sc.f); fseek(sc.f, 0, SEEK_SET);
+  g_error.clear();
+  if (!sc.load_chunk() || sc.remaining == 0) {
+    if (g_error.empty()) g_error = "slotq: empty file " + path;
+    fclose(sc.f);
+    return false;
+  }
+  if (sc.pos + 4 > sc.chunk.size()) {
+    g_error = "slotq: record header past chunk end";
+    fclose(sc.f);
+    return false;
+  }
+  uint32_t rl;
+  memcpy(&rl, sc.chunk.data() + sc.pos, 4);
+  if (sc.pos + 4 + rl > sc.chunk.size()) {
+    g_error = "slotq: record length past chunk end";
+    fclose(sc.f);
+    return false;
+  }
+  std::string err;
+  bool ok = parse_layout(sc.chunk.data() + sc.pos + 4, rl, out, &err);
+  if (!ok) g_error = err;
+  fclose(sc.f);
+  return ok;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* slotq_open(const char** paths, int n_files, long long batch_size,
+                 int n_threads, int drop_last) {
+  g_error.clear();
+  auto* sq = new SlotQueue();
+  for (int i = 0; i < n_files; i++) sq->files.emplace_back(paths[i]);
+  sq->batch = static_cast<size_t>(batch_size);
+  sq->drop_last = drop_last != 0;
+  if (sq->files.empty() || !peek_layout(sq->files[0], &sq->layout)) {
+    if (g_error.empty()) g_error = "slotq: empty file list";
+    delete sq;
+    return nullptr;
+  }
+  int nt = n_threads > 0 ? n_threads : 1;
+  if (static_cast<size_t>(nt) > sq->files.size()) nt = (int)sq->files.size();
+  sq->active_workers = nt;
+  for (int i = 0; i < nt; i++)
+    sq->workers.emplace_back([sq] { sq->worker(); });
+  return sq;
+}
+
+int slotq_nslots(void* h) {
+  return (int)static_cast<SlotQueue*>(h)->layout.size();
+}
+
+int slotq_slot_info(void* h, int slot, char* dtype_buf, int cap,
+                    long long* shape_buf, int* ndim) {
+  auto* sq = static_cast<SlotQueue*>(h);
+  if (slot < 0 || slot >= (int)sq->layout.size()) return -1;
+  const SlotLayout& L = sq->layout[slot];
+  if ((int)L.dtype.size() + 1 > cap || (int)L.shape.size() > 8) return -1;
+  memcpy(dtype_buf, L.dtype.c_str(), L.dtype.size() + 1);
+  *ndim = (int)L.shape.size();
+  for (size_t i = 0; i < L.shape.size(); i++) shape_buf[i] = L.shape[i];
+  return 0;
+}
+
+// Fill caller-allocated per-slot buffers (each batch*raw_len bytes); returns
+// rows filled (may be < batch only at end with drop_last=0), 0 at end,
+// -1 on error (slotq_error).  Called WITHOUT the GIL (ctypes releases it):
+// the memcpy assembly overlaps Python-side device dispatch.
+long long slotq_next_batch(void* h, void** bufs) {
+  auto* sq = static_cast<SlotQueue*>(h);
+  std::vector<ParsedRec> local;
+  local.reserve(sq->batch);
+  {
+    std::unique_lock<std::mutex> lk(sq->mu);
+    while (local.size() < sq->batch) {
+      if (!sq->error.empty()) { g_error = sq->error; return -1; }
+      if (!sq->q.empty()) {
+        local.push_back(std::move(sq->q.front()));
+        sq->q.pop_front();
+        sq->cv_put.notify_one();
+        continue;
+      }
+      if (sq->active_workers == 0) break;  // drained and finished
+      sq->cv_get.wait(lk);
+    }
+  }
+  size_t rows = local.size();
+  if (rows == 0) return 0;
+  if (rows < sq->batch && sq->drop_last) return 0;
+  for (size_t s = 0; s < sq->layout.size(); s++) {
+    uint8_t* dst = static_cast<uint8_t*>(bufs[s]);
+    const uint64_t rl = sq->layout[s].raw_len;
+    for (size_t r = 0; r < rows; r++)
+      memcpy(dst + r * rl, local[r].bytes.data() + local[r].slot_off[s], rl);
+  }
+  return (long long)rows;
+}
+
+void slotq_close(void* h) { delete static_cast<SlotQueue*>(h); }
+
+}  // extern "C"
