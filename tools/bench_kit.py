@@ -120,3 +120,49 @@ def make_bert_dispatch(batch_size=256, seq_len=128, K=2, dtype="bfloat16",
     out = dispatch()
     assert np.isfinite(float(np.asarray(out[0]).reshape(-1)[-1]))
     return dispatch, loss_name
+
+
+def make_nmt_dispatch(K=8, b=32, T=64, dtype="float32"):
+    """Transformer-NMT ragged train-step closure: returns (dispatch, loss_name).
+
+    Pre-padded [K,b,T,1] id feeds + `@LOD` lengths companions — the executed
+    program is the same ragged program the LoDTensor path runs; only the
+    harness avoids per-step host dispatch."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu.lod import lod_var_name
+    from paddle_tpu.models import nmt
+
+    main, startup, feeds, fetches = nmt.build_transformer_nmt(
+        src_vocab=8000, tgt_vocab=8000, d_model=512, n_layers=6, n_heads=8,
+        d_ff=2048, dropout=0.1, learning_rate=2.0, dtype=dtype)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    dev = fluid.TPUPlace(0).jax_device()
+    feed = {}
+    lens = {}
+    for name in ("src_word", "trg_word", "lbl_word"):
+        side = "src" if name == "src_word" else "tgt"
+        if side not in lens:
+            lens[side] = rng.randint(20, T, size=(K, b)).astype("int32")
+        ids = rng.randint(1, 8000, size=(K, b, T, 1)).astype("int32")
+        # zero the padding region so the padded carrier matches what the
+        # LoDTensor expansion would produce
+        mask = np.arange(T)[None, None, :] < lens[side][..., None]
+        ids = ids * mask[..., None]
+        feed[name] = jax.device_put(jnp.asarray(ids), dev)
+        feed[lod_var_name(name)] = jax.device_put(jnp.asarray(lens[side]), dev)
+    loss_name = fetches["loss"].name
+
+    def dispatch():
+        return exe.run(main, feed=feed, fetch_list=[loss_name], scope=scope,
+                       steps=K, return_numpy=False)
+
+    out = dispatch()
+    assert np.isfinite(float(np.asarray(out[0]).reshape(-1)[-1]))
+    mean_tokens = float(lens["src"].mean() + lens["tgt"].mean())
+    return dispatch, loss_name, mean_tokens
