@@ -28,7 +28,9 @@ ROUND1_IMGS_PER_SEC = 2295.0  # BENCH_r01.json
 V5E_BF16_PEAK = 197e12
 
 
-def bench_resnet50(batch_size=256, K=4, iters=4):
+def bench_resnet50(batch_size=256, K=8, iters=4):
+    # K=8 interleaved-A/B'd vs K=4: 103.9 vs 106.2 ms/step (loop-state copy
+    # amortization, docs/perf_r05.md)
     dispatch, _ = make_resnet_dispatch(batch_size=batch_size, K=K)
     dt, out, ws = _timed_steps(dispatch, K=K, iters=iters, windows=3)
     lossN = float(np.asarray(out[0]).reshape(-1)[-1])
