@@ -28,19 +28,50 @@ ROUND1_IMGS_PER_SEC = 2295.0  # BENCH_r01.json
 V5E_BF16_PEAK = 197e12
 
 
+def _params_moved(dispatch, before, max_frozen_frac=0.25):
+    """Bench-level optimizer-liveness gate (the r5 bf16+Adam freeze shipped
+    two rounds of plausible-looking BERT numbers with ~96% of params frozen
+    while the f32 embeddings moved — loss finiteness cannot catch that).
+
+    A bounded frozen fraction is tolerated: in bf16 at symmetric init the
+    attention q/k score grads (p*(dp - rowsum)) cancel below bf16
+    resolution on real TPU hardware, so q/k legitimately sit still for the
+    first steps (~9% of BERT's params; they move once the value path
+    differentiates — measured r5, docs/perf_r05.md).  Returns
+    {"frozen": n, "total": n, "min_moved_delta": d} for the record."""
+    after = dispatch.probe_param()
+    frozen = []
+    min_moved = float("inf")
+    for name, b in before.items():
+        d = float(np.abs(after[name] - b).max())
+        if d == 0.0:
+            frozen.append(name)
+        else:
+            min_moved = min(min_moved, d)
+    assert len(frozen) <= max_frozen_frac * len(before), (
+        f"{len(frozen)}/{len(before)} params did not move during the bench "
+        f"(optimizer-freeze class bug): {sorted(frozen)[:5]}")
+    assert min_moved < float("inf"), "no param moved at all"
+    return {"frozen": len(frozen), "total": len(before),
+            "min_moved_delta": min_moved}
+
+
 def bench_resnet50(batch_size=256, K=8, iters=4):
     # K=8 interleaved-A/B'd vs K=4: 103.9 vs 106.2 ms/step (loop-state copy
     # amortization, docs/perf_r05.md)
     dispatch, _ = make_resnet_dispatch(batch_size=batch_size, K=K)
+    before = dispatch.probe_param()
     dt, out, ws = _timed_steps(dispatch, K=K, iters=iters, windows=3)
     lossN = float(np.asarray(out[0]).reshape(-1)[-1])
     assert np.isfinite(lossN), f"non-finite resnet loss {lossN}"
+    moved = _params_moved(dispatch, before)
     imgs = batch_size / dt
     mfu = imgs * 3 * 4.089e9 / V5E_BF16_PEAK
     print(f"resnet50: {dt*1e3:.1f} ms  {imgs:.0f} imgs/s  mfu {mfu:.3f}", file=sys.stderr)
     return {"metric": "resnet50_train_imgs_per_sec_per_chip", "value": round(imgs, 2),
             "unit": "imgs/sec", "mfu_bf16_analytic": round(mfu, 4),
             "batch_size": batch_size, "steps_per_dispatch": K,
+            "params_moved": moved,
             "windows_ms": ws, "spread_pct": _spread(ws)}
 
 
@@ -124,23 +155,28 @@ def bench_nmt(K=8, iters=3, b=32):
     from tools.bench_kit import make_nmt_dispatch
 
     dispatch, _, mean_tokens = make_nmt_dispatch(K=K, b=b)
+    before = dispatch.probe_param()
     dt, out, ws = _timed_steps(dispatch, K=K, iters=iters, windows=3)
     lv = float(np.asarray(out[0]).reshape(-1)[-1])
     assert np.isfinite(lv)
+    moved = _params_moved(dispatch, before)
     seqs = b / dt
     toks = mean_tokens * seqs
     print(f"nmt: {dt*1e3:.1f} ms  {seqs:.0f} seqs/s  loss {lv:.3f}", file=sys.stderr)
     return {"metric": "transformer_nmt_train_seqs_per_sec_per_chip",
             "value": round(seqs, 2), "unit": "seqs/sec", "batch_size": b,
             "config": "base-6L-512d ragged", "tokens_per_sec": round(toks, 1),
+            "params_moved": moved,
             "steps_per_dispatch": K, "windows_ms": ws, "spread_pct": _spread(ws)}
 
 
 def bench_bert(batch_size=256, seq_len=128, K=2, iters=4):
     dispatch, _ = make_bert_dispatch(batch_size=batch_size, seq_len=seq_len, K=K)
+    before = dispatch.probe_param()
     dt, out, ws = _timed_steps(dispatch, K=K, iters=iters, windows=2)
     lossN = float(np.asarray(out[0]).reshape(-1)[-1])
     assert np.isfinite(lossN)
+    moved = _params_moved(dispatch, before)
     seqs = batch_size / dt
     # analytic train FLOPs/seq for BERT-base @128: ~6 * 110e6 params * 128 tokens
     flops_per_seq = 6 * 110e6 * seq_len
@@ -150,6 +186,7 @@ def bench_bert(batch_size=256, seq_len=128, K=2, iters=4):
             "unit": "seqs/sec", "mfu_bf16_analytic": round(mfu, 4),
             "batch_size": batch_size, "seq_len": seq_len,
             "config": "fused-attention (output-dropout substitution)",
+            "params_moved": moved,
             "steps_per_dispatch": K, "windows_ms": ws, "spread_pct": _spread(ws)}
 
 
@@ -184,9 +221,15 @@ def bench_deepfm(batch_size=4096, K=16, iters=3):
         return exe.run(main, feed=feed, fetch_list=[fetches["loss"]], scope=scope,
                        steps=K, return_numpy=False)
 
+    from tools.bench_kit import attach_param_probe
+
+    attach_param_probe(dispatch, main, scope)
+    dispatch()  # compile before the probe so 'before' is post-init state
+    before = dispatch.probe_param()
     dt, out, ws = _timed_steps(dispatch, K=K, iters=iters, windows=3)
     lossN = float(np.asarray(out[0]).reshape(-1)[-1])
     assert np.isfinite(lossN)
+    moved = _params_moved(dispatch, before)
     sparse = sorted(lowering.LAST_TRACE_REPORT.get("sparse_grad_params", []))
     ex = batch_size / dt
     print(f"deepfm: {dt*1e3:.2f} ms  {ex:.0f} ex/s  sparse={sparse}", file=sys.stderr)
@@ -194,6 +237,7 @@ def bench_deepfm(batch_size=4096, K=16, iters=3):
             "value": round(ex, 2), "unit": "examples/sec",
             "batch_size": batch_size, "vocab": 200000,
             "sparse_grad_params": sparse, "steps_per_dispatch": K,
+            "params_moved": moved,
             "windows_ms": ws, "spread_pct": _spread(ws)}
 
 

@@ -715,13 +715,17 @@ def space_to_depth(x, blocksize, name=None):
     return out
 
 
-def fused_attention(q, k, v, bias=None, causal=False, scale=None, name=None):
+def fused_attention(q, k, v, bias=None, causal=False, scale=None,
+                    score_dtype=None, name=None):
     """Fused scaled-dot-product attention over (B, H, L, dh) tensors.
 
-    Lowers to the Pallas flash-attention TPU kernel (score matrix never
-    materialized in HBM, fwd + bwd); plain-math fallback off-TPU.  `bias`
-    is an additive pre-softmax mask, (B, 1|H, Lq, Lk).  `scale` defaults
-    to 1/sqrt(dh)."""
+    Long sequences lower to the streaming flash kernel (score matrix never
+    materialized in HBM, fwd + bwd); moderate lengths use the mixed-
+    precision XLA formulation.  `bias` is an additive pre-softmax mask,
+    (B, 1|H, Lq, Lk).  `scale` defaults to 1/sqrt(dh).
+    `score_dtype="bfloat16"` materializes the score tensor in bf16 (half
+    the attention HBM traffic; pre-softmax logits quantized to 8 mantissa
+    bits — softmax reductions stay f32)."""
     helper = LayerHelper("fused_attention", name=name)
     out = _out(helper, q.dtype, shape=q.shape)
     inputs = {"Q": [q.name], "K": [k.name], "V": [v.name]}
@@ -730,6 +734,14 @@ def fused_attention(q, k, v, bias=None, causal=False, scale=None, name=None):
     attrs = {"causal": causal}
     if scale is not None:
         attrs["scale"] = float(scale)
+    if score_dtype is not None:
+        sd = {"bf16": "bfloat16", "bfloat16": "bfloat16",
+              "float32": "float32", "fp32": "float32"}.get(str(score_dtype))
+        if sd is None:
+            raise ValueError(
+                f"fused_attention: score_dtype must be 'float32' or "
+                f"'bfloat16', got {score_dtype!r}")
+        attrs["score_dtype"] = sd
     helper.append_op("fused_attention", inputs=inputs, outputs={"Out": [out.name]}, attrs=attrs)
     return out
 

@@ -25,7 +25,7 @@ def _attr(name):
 
 def multi_head_attention(x, seq_len, d_model, n_heads, prefix, dropout_prob=0.1, is_test=False,
                          use_ring_attention=False, causal=False, kv=None, bias=None,
-                         use_fused_attention=False):
+                         use_fused_attention=False, score_dtype=None):
     """Self- or cross-attention over [b, T, d] (T may be dynamic: head
     split/merge uses fluid's 0-copy-dim reshape).  `kv` switches to
     cross-attention (keys/values from another sequence); `bias` is an
@@ -46,7 +46,8 @@ def multi_head_attention(x, seq_len, d_model, n_heads, prefix, dropout_prob=0.1,
         # Pallas flash kernel: scores never hit HBM.  Attention-prob dropout
         # can't run inside the fused kernel; the equivalent regularization
         # goes on the attention output (same substitution as the ring path).
-        ctx = layers.fused_attention(q, k, v, bias=bias, causal=causal)
+        ctx = layers.fused_attention(q, k, v, bias=bias, causal=causal,
+                                     score_dtype=score_dtype)
         if dropout_prob and not is_test:
             ctx = layers.dropout(ctx, dropout_prob, is_test=is_test,
                                  dropout_implementation="upscale_in_train")
@@ -74,10 +75,12 @@ def multi_head_attention(x, seq_len, d_model, n_heads, prefix, dropout_prob=0.1,
 
 
 def encoder_layer(x, seq_len, d_model, n_heads, d_ff, prefix, dropout_prob=0.1, is_test=False,
-                  use_ring_attention=False, causal=False, use_fused_attention=False):
+                  use_ring_attention=False, causal=False, use_fused_attention=False,
+                  score_dtype=None):
     attn_out = multi_head_attention(x, seq_len, d_model, n_heads, f"{prefix}.attn",
                                     dropout_prob, is_test, use_ring_attention, causal,
-                                    use_fused_attention=use_fused_attention)
+                                    use_fused_attention=use_fused_attention,
+                                    score_dtype=score_dtype)
     x = layers.layer_norm(layers.elementwise_add(x, attn_out), begin_norm_axis=2,
                           param_attr=_attr(f"{prefix}.ln1.w"), bias_attr=_attr(f"{prefix}.ln1.b"))
     ffn1 = layers.fc(x, d_ff, num_flatten_dims=2, act="gelu",
@@ -106,6 +109,7 @@ def build_bert(
     causal=False,
     use_fused_attention=False,
     dtype="float32",
+    attention_score_dtype=None,
 ):
     """BERT-base-style masked-LM pretraining program.
 
@@ -129,7 +133,8 @@ def build_bert(
         for i in range(n_layers):
             x = encoder_layer(x, seq_len, d_model, n_heads, d_ff, f"bert.l{i}",
                               dropout_prob, is_test, use_ring_attention, causal,
-                              use_fused_attention=use_fused_attention)
+                              use_fused_attention=use_fused_attention,
+                              score_dtype=attention_score_dtype)
         logits = layers.fc(x, vocab_size, num_flatten_dims=2,
                            param_attr=_attr("bert.lm_head.w"), bias_attr=_attr("bert.lm_head.b"))
         # bf16 logits feed the CE directly: softmax_with_cross_entropy does

@@ -583,6 +583,11 @@ def _fused_attention(ctx, op, ins):
     # q/k/v to f32 BEFORE the einsums, which ran the batched matmuls at the
     # f32 MXU rate and doubled score-tensor HBM traffic — profiled at
     # 13.6 TF/s on the BERT bench (docs/perf_r05.md).
+    #
+    # score_dtype="bfloat16" (opt-in) additionally materializes the
+    # [B,H,Lq,Lk] score tensor in bf16 — halves the dominant attention HBM
+    # traffic at a documented numerics cost (pre-softmax logits quantized
+    # to 8 mantissa bits; softmax max/sum still accumulate in f32).
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if bias is not None:
@@ -591,7 +596,13 @@ def _fused_attention(ctx, op, ins):
         Lq, Lk = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((Lq, Lk), bool), k=Lk - Lq)
         s = jnp.where(mask, s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
+    if op.attr("score_dtype", "float32") == "bfloat16":
+        s = s.astype(jnp.bfloat16)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        e = jnp.exp((s - m).astype(jnp.float32))
+        p = e / jnp.sum(e, axis=-1, keepdims=True)
+    else:
+        p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v,
                      preferred_element_type=jnp.float32)
     return {"Out": out.astype(q.dtype)}

@@ -47,6 +47,27 @@ def spread_pct(windows_ms):
                  / statistics.median(windows_ms) * 100, 1)
 
 
+
+
+def attach_param_probe(dispatch, main, scope):
+    """Attach `dispatch.probe_param()` returning {param: f8 snapshot} of
+    EVERY trainable param — the bench-level liveness gate.  All params (not
+    just the first) so a partial optimizer freeze — the r5 bf16+Adam bug
+    froze every encoder param while the f32 embeddings kept moving — cannot
+    pass by luck of program order."""
+    def _probe_param():
+        snap = {}
+        for p in main.all_parameters():
+            v = scope.find_var(p.name)
+            if v is not None:
+                snap[p.name] = np.asarray(v).astype("f8")
+        if not snap:
+            raise RuntimeError("no parameters in scope")
+        return snap
+
+    dispatch.probe_param = _probe_param
+    return dispatch
+
 def make_resnet_dispatch(batch_size=256, K=4, stem="space_to_depth",
                          data_format="NCHW", dtype="bfloat16"):
     """ResNet-50 train-step closure: returns (dispatch, loss_name)."""
@@ -81,6 +102,7 @@ def make_resnet_dispatch(batch_size=256, K=4, stem="space_to_depth",
     # fail fast on a broken model
     out = dispatch()
     assert np.isfinite(float(np.asarray(out[0]).reshape(-1)[-1]))
+    attach_param_probe(dispatch, main, scope)
     return dispatch, loss_name
 
 
@@ -119,6 +141,7 @@ def make_bert_dispatch(batch_size=256, seq_len=128, K=2, dtype="bfloat16",
 
     out = dispatch()
     assert np.isfinite(float(np.asarray(out[0]).reshape(-1)[-1]))
+    attach_param_probe(dispatch, main, scope)
     return dispatch, loss_name
 
 
@@ -164,5 +187,6 @@ def make_nmt_dispatch(K=8, b=32, T=64, dtype="float32"):
 
     out = dispatch()
     assert np.isfinite(float(np.asarray(out[0]).reshape(-1)[-1]))
+    attach_param_probe(dispatch, main, scope)
     mean_tokens = float(lens["src"].mean() + lens["tgt"].mean())
     return dispatch, loss_name, mean_tokens
