@@ -37,7 +37,13 @@ class Layer:
         init = attr.initializer or default_initializer
         if init is None:
             init = ConstantInitializer(0.0) if is_bias else XavierInitializer()
-        value = _materialize_init(init, shape, dtype or self._dtype)
+        # mixed-precision master-weight policy (same as the graph-mode
+        # LayerHelper): low-precision-float params are created as f32
+        # masters — dygraph ops cast per-use, optimizer state stays f32
+        # (bf16 Adam beta-pows round 0.999 -> 1.0 and freeze training)
+        from ..core.layer_helper import _master_dtype
+
+        value = _materialize_init(init, shape, _master_dtype(dtype or self._dtype))
         p = VarBase(value, stop_gradient=not attr.trainable, name=attr.name, persistable=True)
         return p
 

@@ -64,3 +64,12 @@ def test_beta_pow_accumulators_are_f32():
         assert v.dtype == np.float32, (n, v.dtype)
         # the fatal symptom: bf16(0.999) == 1.0 exactly
         assert 0.0 < float(v.reshape(-1)[0]) < 1.0
+
+
+def test_dygraph_params_are_f32_masters():
+    import paddle_tpu.dygraph as dg
+
+    with dg.guard():
+        fc = dg.nn.Linear(4, 4, dtype="bfloat16")
+        for p in fc.parameters():
+            assert str(np.asarray(p.numpy()).dtype) == "float32", p.name
