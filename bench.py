@@ -56,9 +56,11 @@ def _params_moved(dispatch, before, max_frozen_frac=0.25):
             "min_moved_delta": min_moved}
 
 
-def bench_resnet50(batch_size=256, K=8, iters=4):
-    # K=8 interleaved-A/B'd vs K=4: 103.9 vs 106.2 ms/step (loop-state copy
-    # amortization, docs/perf_r05.md)
+def bench_resnet50(batch_size=128, K=16, iters=4):
+    # bs128/K=16 interleaved-A/B'd vs bs256/K8 and bs64/K32: 2573 vs 2445
+    # vs 2351 imgs/s — the r4 "bs256 wins" result predates the single-pass
+    # BN stats; with less stats traffic the smaller batch's better
+    # cache/VMEM behavior wins (docs/perf_r05.md)
     dispatch, _ = make_resnet_dispatch(batch_size=batch_size, K=K)
     before = dispatch.probe_param()
     dt, out, ws = _timed_steps(dispatch, K=K, iters=iters, windows=3)
