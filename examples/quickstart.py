@@ -1,0 +1,87 @@
+"""paddle_tpu quickstart: the full user journey in one file.
+
+A user of the reference framework (PaddlePaddle Fluid) should recognize
+every step: build a Program with layers, run startup, train with an
+Executor, evaluate, save an inference model, quantize it to int8, and
+serve it through the AnalysisConfig/Predictor surface — except everything
+below compiles to single XLA programs and runs on a TPU (or the CPU
+backend when no chip is present).
+
+    python examples/quickstart.py          # uses the default device
+    JAX_PLATFORMS=cpu python examples/quickstart.py
+
+Multi-chip: wrap the program with fluid.CompiledProgram(mesh=...) — see
+__graft_entry__.dryrun_multichip for dp/tp/sp/ep/pp mesh examples.
+"""
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as fluid
+
+
+def main():
+    # ---- 1. build the training program (graph mode, fluid-style) -------
+    main_prog, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 7
+    with fluid.program_guard(main_prog, startup):
+        img = fluid.layers.data("img", [1, 28, 28], dtype="float32")
+        label = fluid.layers.data("label", [1], dtype="int64")
+        conv = fluid.layers.conv2d(img, num_filters=16, filter_size=3,
+                                   padding=1, act="relu")
+        pool = fluid.layers.pool2d(conv, pool_size=2, pool_stride=2)
+        flat = fluid.layers.reshape(pool, [-1, 16 * 14 * 14])
+        logits = fluid.layers.fc(flat, 10)
+        probs = fluid.layers.softmax(logits)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        acc = fluid.layers.accuracy(probs, label)
+        test_prog = main_prog.clone(for_test=True)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+
+    # ---- 2. train (whole program = ONE compiled XLA step) ---------------
+    place = fluid.TPUPlace(0)
+    exe = fluid.Executor(place)
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 10, (64,)).astype("int64")
+    images = (rng.rand(64, 1, 28, 28) * 0.4
+              + labels[:, None, None, None] * 0.06).astype("float32")
+    for step in range(30):
+        lv, av = exe.run(main_prog,
+                         feed={"img": images, "label": labels[:, None]},
+                         fetch_list=[loss, acc], scope=scope)
+        if step % 10 == 0:
+            print(f"step {step:3d}  loss {float(np.ravel(lv)[0]):.4f}  "
+                  f"acc {float(np.ravel(av)[0]):.2f}")
+
+    # ---- 3. evaluate with the test clone --------------------------------
+    (av,) = exe.run(test_prog, feed={"img": images, "label": labels[:, None]},
+                    fetch_list=[acc], scope=scope)
+    print(f"train-set accuracy after 30 steps: {float(np.ravel(av)[0]):.2f}")
+
+    # ---- 4. save a deployable int8 inference model ----------------------
+    outdir = os.path.join(tempfile.mkdtemp(prefix="quickstart_"), "model_int8")
+    fluid.io.save_quantized_inference_model(
+        outdir, ["img"], [probs], exe, main_prog, scope)
+    print("saved int8 inference model to", outdir)
+
+    # ---- 5. serve it (AnalysisConfig + zero-copy handles) ---------------
+    from paddle_tpu.inference import AnalysisConfig, create_predictor
+
+    pred = create_predictor(AnalysisConfig(outdir, place=place))
+    pred.get_input_handle("img").copy_from_cpu(images[:8])
+    pred.run_zero_copy()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    print("served predictions:", out.argmax(-1).tolist(),
+          " labels:", labels[:8].tolist())
+
+
+if __name__ == "__main__":
+    main()

@@ -147,3 +147,30 @@ def test_xxh64_published_vectors_via_jnp():
         got = (int(np.asarray(hi)[0]) << 32) | int(np.asarray(lo)[0])
         want = _xxh64(words_np.tobytes(), seed)
         assert got == want, (hex(got), hex(want))
+
+
+def test_py_func_sink_defers(forced_deferral):
+    # py_func as a pure sink (host-side metric transform): the executor
+    # must defer it to fetch time on the callback-less platform, feeding it
+    # a device-produced intermediate
+    main, startup = fluid.Program(), fluid.Program()
+    with program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.scale(x, scale=2.0)  # device compute upstream
+
+        def host_metric(arr):
+            return np.asarray(arr).sum(axis=1, keepdims=True).astype("f4")
+
+        out = main.current_block().create_var("pf_out", shape=(3, 1),
+                                              dtype="float32")
+        fluid.layers.py_func(host_metric, y, out)
+        dev_fetch = fluid.layers.mean(y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    xv = np.arange(12, dtype="f4").reshape(3, 4)
+    res = exe.run(main, feed={"x": xv}, fetch_list=[dev_fetch, out], scope=scope)
+    np.testing.assert_allclose(np.asarray(res[1]),
+                               (2 * xv).sum(1, keepdims=True), rtol=1e-6)
+    np.testing.assert_allclose(float(np.asarray(res[0]).reshape(-1)[0]),
+                               (2 * xv).mean(), rtol=1e-6)
