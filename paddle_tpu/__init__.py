@@ -58,7 +58,8 @@ from . import dygraph  # noqa: F401
 from . import dygraph_grad_clip  # noqa: F401
 from . import recordio_writer  # noqa: F401
 from . import metrics  # noqa: F401
-from . import profiler  # noqa: F401
+from . import monitor  # noqa: F401  (observability: spans/counters/exporters)
+from . import profiler  # noqa: F401  (compat facade over monitor)
 
 __version__ = "0.1.0"
 

@@ -13,12 +13,14 @@ donated to the executable each step, so parameter updates are in-place in HBM.
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..monitor import MONITOR as _MON
 from .dtypes import as_np_dtype
 from .lowering import LoweringContext, run_block_with_backward
 from .program import Program, Variable, default_main_program
@@ -129,6 +131,19 @@ class _CompiledStep:
         self.multiprocess = mesh is not None and any(
             d.process_index != jax.process_index() for d in mesh.devices.flat
         )
+        # AOT executable state: trace/lower and XLA-compile are split out of
+        # dispatch (jax.jit's .trace().lower().compile()) so the monitor can
+        # time each phase; re-built on state-aval change like jit's retrace.
+        # _exec_by_sig keeps previously built executables so programs whose
+        # state avals alternate don't recompile on every flip (the multi-
+        # entry cache jit provided); the signature is only computed on the
+        # miss path, never in steady state.
+        self.program_uuid = program._uuid[:8]
+        self._exec = None
+        self._exec_by_sig: Dict[tuple, object] = {}
+        self.last_lower_s = 0.0
+        self.last_compile_s = 0.0
+        self.last_recompiled = False
         feed_shapes = feed_shapes or {}
         block = program.global_block()
         ops = _runnable_ops(block)
@@ -373,6 +388,51 @@ class _CompiledStep:
             return jax.make_array_from_callback(host.shape, spec, lambda idx: host[idx])
         return jax.device_put(v, spec)
 
+    @staticmethod
+    def _state_sig(state_rw, state_ro):
+        return (
+            tuple((n, v.shape, str(v.dtype)) for n, v in sorted(state_rw.items())),
+            tuple((n, v.shape, str(v.dtype)) for n, v in sorted(state_ro.items())),
+        )
+
+    def _dispatch(self, state_rw, state_ro, feeds, key):
+        """Run the step through the AOT executable, building it on first
+        use (and after a state-aval change) with the block->jaxpr lowering
+        and the XLA compile timed as separate monitor spans."""
+        self.last_recompiled = False
+        if self._exec is not None:
+            try:
+                return self._exec(state_rw, state_ro, feeds, key)
+            except TypeError:
+                # state avals changed (dtype promotion, resharding): the
+                # aval check fires before execution, so donated buffers are
+                # untouched.  Try an executable built for this signature
+                # before recompiling (jit's multi-entry cache role).
+                cached = self._exec_by_sig.get(self._state_sig(state_rw, state_ro))
+                if cached is not None and cached is not self._exec:
+                    try:
+                        out = cached(state_rw, state_ro, feeds, key)
+                        self._exec = cached
+                        return out
+                    except TypeError:
+                        pass
+                self._exec = None
+        t0 = time.perf_counter()
+        lowered = self.jfn.trace(state_rw, state_ro, feeds, key).lower()
+        t1 = time.perf_counter()
+        self._exec = lowered.compile()
+        t2 = time.perf_counter()
+        self._exec_by_sig[self._state_sig(state_rw, state_ro)] = self._exec
+        if len(self._exec_by_sig) > 8:
+            self._exec_by_sig.pop(next(iter(self._exec_by_sig)))
+        self.last_lower_s = t1 - t0
+        self.last_compile_s = t2 - t1
+        self.last_recompiled = True
+        _MON.observe("executor.lower", self.last_lower_s, program=self.program_uuid)
+        _MON.observe("executor.compile", self.last_compile_s, program=self.program_uuid)
+        _MON.counter("executor.recompile").inc()
+        return self._exec(state_rw, state_ro, feeds, key)
+
     def __call__(self, scope: Scope, feeds: Dict[str, jnp.ndarray], key):
         if self.mesh is not None:
             # Reshard state committed elsewhere (e.g. by a single-device
@@ -385,7 +445,7 @@ class _CompiledStep:
                 key = self._place(key, self.key_spec)
         state_rw = {n: scope.find_var(n) for n in self.rw_names}
         state_ro = {n: scope.find_var(n) for n in self.ro_names}
-        fetches, new_state, new_key = self.jfn(state_rw, state_ro, feeds, key)
+        fetches, new_state, new_key = self._dispatch(state_rw, state_ro, feeds, key)
         for n, v in new_state.items():
             scope.set_var(n, v)
         return fetches, new_key
@@ -650,19 +710,23 @@ class Executor:
             _lowering_flags(),
         )
         compiled = self._cache.pop(cache_key, None)
+        cache_hit = compiled is not None
         if compiled is not None:
             self._cache[cache_key] = compiled  # re-insert: true LRU order
+            _MON.counter("executor.cache_hit").inc()
         else:
+            _MON.counter("executor.cache_miss").inc()
             mesh_platform = (
                 mesh.devices.flat[0].platform if mesh is not None else device.platform
             )
-            compiled = _CompiledStep(
-                program, list(jfeeds), fetch_names, scope,
-                mesh=mesh, batch_axis=batch_axis,
-                feed_shapes={n: v.shape for n, v in jfeeds.items()},
-                n_steps=steps, remat=remat, platform=mesh_platform,
-                local_sgd=bool(local_sgd_every),
-            )
+            with _MON.span("executor.build", program=program._uuid[:8]):
+                compiled = _CompiledStep(
+                    program, list(jfeeds), fetch_names, scope,
+                    mesh=mesh, batch_axis=batch_axis,
+                    feed_shapes={n: v.shape for n, v in jfeeds.items()},
+                    n_steps=steps, remat=remat, platform=mesh_platform,
+                    local_sgd=bool(local_sgd_every),
+                )
             self._cache[cache_key] = compiled
             from ..flags import flag as _flagv
 
@@ -697,36 +761,70 @@ class Executor:
                 for n, v in jfeeds.items()
             }
 
-        from .. import profiler as _prof
-
-        if _prof.is_profiler_enabled():
-            import time as _time
-
-            t0 = _time.perf_counter()
-            fetches, new_key = compiled(scope, jfeeds, key)
+        # one tail for both modes; mon_on guards only the timing hooks, so
+        # the disabled fast path stays branch-only (no blocking, no records)
+        # while the monitored per-phase breakdown cannot diverge from it.
+        # Monitored: execute is blocked to completion so device compute
+        # isn't attributed to the fetch copy; lower/compile are timed
+        # inside _dispatch when an executable is (re)built.
+        mon_on = _MON.enabled
+        if mon_on:
+            u8 = program._uuid[:8]
+            feed_bytes = int(sum(getattr(v, "nbytes", 0) for v in jfeeds.values()))
+            _MON.counter("executor.feed_bytes").inc(feed_bytes)
+            t_run0 = time.perf_counter()
+        fetches, new_key = compiled(scope, jfeeds, key)
+        if mon_on:
             jax.block_until_ready(fetches)
-            _prof.record_run(f"executor.run[{program._uuid[:8]}]", _time.perf_counter() - t0)
-        else:
-            fetches, new_key = compiled(scope, jfeeds, key)
+            t_disp = time.perf_counter() - t_run0
+            t_execute = t_disp - (compiled.last_lower_s + compiled.last_compile_s
+                                  if compiled.last_recompiled else 0.0)
+            _MON.observe("executor.execute", t_execute, program=u8)
         scope.set_var(RNG_STATE_VAR, new_key)
-
         if host_plan is not None:
-            fetches = self._finish_host_eval(host_plan, feed, fetches, scope)
+            with _MON.span("executor.host_eval"):
+                fetches = self._finish_host_eval(host_plan, feed, fetches, scope)
             fetch_names = host_plan["want"]
+        self._check_nan_inf(fetch_names, fetches)
+        if not mon_on:
+            if return_numpy:
+                return [np.asarray(f) for f in fetches]
+            return list(fetches)
+        t_f0 = time.perf_counter()
+        out = [np.asarray(f) for f in fetches] if return_numpy else list(fetches)
+        t_fetch = time.perf_counter() - t_f0
+        _MON.observe("executor.fetch", t_fetch, program=u8)
+        t_total = time.perf_counter() - t_run0
+        _MON.observe(f"executor.run[{u8}]", t_total)
+        _MON.record_step({
+            "program": u8,
+            "steps": steps,
+            "cache_hit": cache_hit,
+            "recompiled": compiled.last_recompiled,
+            "cache_hits_total": _MON.counter("executor.cache_hit").value,
+            "cache_misses_total": _MON.counter("executor.cache_miss").value,
+            "recompiles_total": _MON.counter("executor.recompile").value,
+            "t_lower_s": compiled.last_lower_s if compiled.last_recompiled else 0.0,
+            "t_compile_s": compiled.last_compile_s if compiled.last_recompiled else 0.0,
+            "t_execute_s": t_execute,
+            "t_fetch_s": t_fetch,
+            "t_total_s": t_total,
+            "feed_bytes": feed_bytes,
+        })
+        return out
 
+    @staticmethod
+    def _check_nan_inf(fetch_names, fetches):
         from ..flags import flag as _flag
 
-        if _flag("FLAGS_check_nan_inf"):
-            for name, val in zip(fetch_names, fetches):
-                arr = np.asarray(val)
-                if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
-                    raise RuntimeError(
-                        f"FLAGS_check_nan_inf: fetch {name!r} contains "
-                        f"NaN/Inf (reference CheckTensorNANOrInf)")
-
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return list(fetches)
+        if not _flag("FLAGS_check_nan_inf"):
+            return
+        for name, val in zip(fetch_names, fetches):
+            arr = np.asarray(val)
+            if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
+                raise RuntimeError(
+                    f"FLAGS_check_nan_inf: fetch {name!r} contains "
+                    f"NaN/Inf (reference CheckTensorNANOrInf)")
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,

@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
+from ..monitor import MONITOR as _MON
 from .program import Block, Operator
 from .registry import get_op_def
 
@@ -60,6 +61,9 @@ _STRUCTURAL_OPS = ("feed", "fetch", "backward")
 
 def run_ops(ctx: LoweringContext, ops: List[Operator], env: Dict[str, Any]) -> Dict[str, Any]:
     """Interpret `ops` over `env` (var name -> traced jax value), in order."""
+    # per-op lower counts run at TRACE time only (this loop is the trace),
+    # so the monitor's per-program op census costs nothing at execution
+    mon_on = _MON.enabled
     for op in ops:
         if op.type in _STRUCTURAL_OPS:
             raise RuntimeError(
@@ -67,6 +71,9 @@ def run_ops(ctx: LoweringContext, ops: List[Operator], env: Dict[str, Any]) -> D
                 "the executor must handle it"
             )
         lower_one(ctx, op, env)
+        if mon_on:
+            _MON.counter("lowering.ops_total").inc()
+            _MON.counter("lowering.op." + op.type).inc()
     return env
 
 

@@ -10,6 +10,7 @@ sharded == unsharded loss trajectories.
 """
 from __future__ import annotations
 
+from ..monitor import MONITOR as _MON
 from .layers import Layer
 
 
@@ -25,7 +26,11 @@ class ParallelEnv:
 
 
 def prepare_context():
-    return ParallelEnv()
+    env = ParallelEnv()
+    # per-device trace lane: merged Chrome traces get one row per rank
+    _MON.set_lane(env.local_rank, f"trainer{env.local_rank}")
+    _MON.gauge("parallel.nranks").set(env.nranks)
+    return env
 
 
 class DataParallel(Layer):
@@ -51,7 +56,17 @@ class DataParallel(Layer):
         gradients; under GSPMD eager the tape's gradient of a
         sharded-batch loss IS the global gradient — XLA inserted the
         cross-device reduction inside the backward math.  The mesh-parity
-        test (tests/test_dygraph.py) asserts sharded == unsharded losses."""
+        test (tests/test_dygraph.py) asserts sharded == unsharded losses.
+
+        The monitor still accounts the gradient volume the in-math
+        reduction moves per step (sum of param bytes), so the collective
+        budget stays visible even though no explicit collective runs."""
+        if _MON.enabled:
+            with _MON.span("collective.apply_grads"):
+                nbytes = sum(
+                    int(getattr(getattr(p, "value", p), "nbytes", 0))
+                    for p in self._layers.parameters())
+                _MON.counter("collective.grad_bytes").inc(nbytes)
         return
 
     def parameters(self, include_sublayers: bool = True):

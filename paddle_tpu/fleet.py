@@ -15,6 +15,10 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+import numpy as np
+
+from .monitor import MONITOR as _MON
+
 
 class UserDefinedRoleMaker:
     """reference role_maker.UserDefinedRoleMaker (collective flavor)."""
@@ -82,13 +86,19 @@ class Fleet:
         """Bootstrap the cross-process runtime when endpoints say so."""
         self._role = role_maker or PaddleCloudRoleMaker()
         eps = self._role.get_trainer_endpoints()
+        # each trainer gets its own monitor lane so merged Chrome traces
+        # (monitor.merge_chrome_traces) show one row per worker
+        _MON.set_lane(self._role.worker_index(),
+                      f"trainer{self._role.worker_index()}")
+        _MON.gauge("fleet.worker_num").set(self._role.worker_num())
         if len(eps) > 1:
             from .parallel import distributed as dist
 
-            dist.init_distributed(
-                trainer_id=self._role.worker_index(),
-                trainer_endpoints=eps,
-            )
+            with _MON.span("fleet.init", workers=len(eps)):
+                dist.init_distributed(
+                    trainer_id=self._role.worker_index(),
+                    trainer_endpoints=eps,
+                )
         return self
 
     def is_first_worker(self) -> bool:
@@ -161,9 +171,23 @@ class _DistributedOptimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        ops, pg = self._inner.minimize(loss, startup_program, parameter_list,
-                                       no_grad_set)
-        self.compiled_program = self._fleet.main_program(loss.block.program)
+        with _MON.span("fleet.minimize"):
+            ops, pg = self._inner.minimize(loss, startup_program,
+                                           parameter_list, no_grad_set)
+            self.compiled_program = self._fleet.main_program(loss.block.program)
+        # the per-round gradient allreduce GSPMD will insert moves
+        # sum(param bytes) over the dp axis; record the per-sync volume so
+        # bench tooling can compare measured step time against it
+        if _MON.enabled:
+            from .core.dtypes import as_np_dtype
+
+            nbytes = 0
+            for p in loss.block.program.all_parameters():
+                if not (p.shape and all(isinstance(d, int) and d > 0 for d in p.shape)):
+                    continue
+                dt = as_np_dtype(p.dtype)
+                nbytes += int(np.prod(p.shape)) * (np.dtype(dt).itemsize if dt else 4)
+            _MON.counter("collective.sync_bytes").inc(nbytes)
         return ops, pg
 
 

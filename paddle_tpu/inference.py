@@ -89,17 +89,18 @@ PredictConfig = AnalysisConfig
 class PredictorTensor:
     """reference ZeroCopyTensor: a named input/output buffer handle.
 
-    copy_from_cpu stages a host array (or adopts a jax.Array as-is —
-    the true zero-copy path: a DataLoader or upstream model output already
-    on device is passed through untouched); copy_to_cpu materializes the
-    result to numpy once."""
+    copy_from_cpu COPIES the host array (the reference contract: mutating
+    the source buffer afterwards must not change the staged feed);
+    share_external_data is the zero-copy alias path — a DataLoader or
+    upstream model output already on device is adopted untouched.
+    copy_to_cpu materializes the result to numpy once."""
 
     def __init__(self, name: str):
         self.name = name
         self._value = None
 
     def copy_from_cpu(self, arr):
-        self._value = arr
+        self._value = np.ascontiguousarray(np.array(arr, copy=True))
         return self
 
     def share_external_data(self, jax_array):

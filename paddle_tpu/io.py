@@ -368,32 +368,47 @@ def save_quantized_inference_model(
     program = main_program or default_main_program()
     scope = scope or global_scope()
     work = program.clone()
-    manifest = convert_quant_model(work, scope, weight_bits=weight_bits)
-    if not manifest["weights"]:
-        # plain float program: per-tensor PTQ (the slim pass, one copy)
-        manifest["weights"] = {
-            name: {"scale": np.float32(scale), "axis": None}
-            for name, scale in post_training_quantize(
-                scope, work, weight_bits=weight_bits).items()}
-    fetch = save_inference_model(dirname, feeded_var_names, target_vars,
-                                 executor, work, scope)
-    # overwrite the quantized params with int8 payloads + scale sidecar
-    qmax = float(2 ** (weight_bits - 1) - 1)
-    qrec = {}
-    for wname, rec in manifest["weights"].items():
-        w = np.asarray(scope.find_var(wname))
-        scale_arr = np.asarray(rec["scale"], np.float32)
-        axis = rec["axis"]
-        shp = [1] * w.ndim
-        if axis is not None:
-            shp[axis] = -1
-        q = np.clip(np.round(w / scale_arr.reshape(shp) * qmax),
-                    -qmax - 1, qmax).astype(np.int8)
-        fname = wname.replace("/", "%2F") + ".npy"
-        np.save(os.path.join(dirname, fname), q)
-        qrec[wname] = {"scale": scale_arr.tolist(), "axis": axis,
-                       "bits": weight_bits, "dtype": str(w.dtype)}
-    with open(os.path.join(dirname, QUANT_MANIFEST), "w") as f:
-        json.dump({"weights": qrec,
-                   "activations": manifest["activations"]}, f, indent=1)
-    return fetch
+    # the quant passes snap weights to the int8 grid via scope.set_var;
+    # snapshot the live values first and restore after saving, so "saving a
+    # quantized copy" does not silently degrade the in-memory float model
+    snapshot = {n: scope.find_var(n) for n in scope.local_var_names()}
+    try:
+        manifest = convert_quant_model(work, scope, weight_bits=weight_bits)
+        if not manifest["weights"]:
+            # plain float program: per-tensor PTQ (the slim pass, one copy)
+            manifest["weights"] = {
+                name: {"scale": np.float32(scale), "axis": None}
+                for name, scale in post_training_quantize(
+                    scope, work, weight_bits=weight_bits).items()}
+        fetch = save_inference_model(dirname, feeded_var_names, target_vars,
+                                     executor, work, scope)
+        # overwrite the quantized params with int8 payloads + scale sidecar
+        qmax = float(2 ** (weight_bits - 1) - 1)
+        qrec = {}
+        for wname, rec in manifest["weights"].items():
+            w = np.asarray(scope.find_var(wname))
+            scale_arr = np.asarray(rec["scale"], np.float32)
+            axis = rec["axis"]
+            shp = [1] * w.ndim
+            if axis is not None:
+                shp[axis] = -1
+            q = np.clip(np.round(w / scale_arr.reshape(shp) * qmax),
+                        -qmax - 1, qmax).astype(np.int8)
+            fname = wname.replace("/", "%2F") + ".npy"
+            np.save(os.path.join(dirname, fname), q)
+            qrec[wname] = {"scale": scale_arr.tolist(), "axis": axis,
+                           "bits": weight_bits, "dtype": str(w.dtype)}
+        with open(os.path.join(dirname, QUANT_MANIFEST), "w") as f:
+            json.dump({"weights": qrec,
+                       "activations": manifest["activations"]}, f, indent=1)
+        return fetch
+    finally:
+        # undo the in-place int8 snap: the live float model keeps serving
+        # its original weights (jax arrays are immutable, so the snapshot
+        # holds the pre-quantization values by reference).  Quantizing a
+        # parent-scope param through a child scope leaves a local SHADOW
+        # rather than touching the parent; those shadows are not in the
+        # snapshot and must be erased, not restored.
+        scope.erase(set(scope.local_var_names()) - set(snapshot))
+        for n, v in snapshot.items():
+            scope.set_var(n, v)

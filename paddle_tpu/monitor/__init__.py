@@ -1,0 +1,120 @@
+"""paddle_tpu.monitor — the framework-wide observability subsystem.
+
+Every layer reports into one process-global `Monitor`:
+
+    from paddle_tpu import monitor
+
+    monitor.enable()
+    with monitor.span("compile", program=uuid):      # nested, thread-safe
+        ...
+    monitor.counter("executor.cache_miss").inc()
+    monitor.gauge("reader.queue_depth").set(3)
+
+    print(monitor.export_prometheus())               # text exposition
+    monitor.export_json("snapshot.json")             # perf_report input
+    monitor.export_chrome_trace("trace.json")        # chrome://tracing
+    log = monitor.attach_logger(monitor.MonitorLogger("metrics.jsonl"))
+
+Disabled (the default) every entry point is a branch: `span()` returns a
+shared null singleton, `inc`/`set` are no-ops.  `paddle_tpu.profiler` is a
+compatibility facade over this module.
+
+Instrumented out of the box: `core/executor.py` (per-run step breakdown —
+lowering / compile / execute / fetch spans, cache-hit + recompile
+counters, steps/sec EMA), `core/lowering.py` (per-op lower counts),
+`reader.py` (queue depth / wait), `fleet.py` + `dygraph/parallel.py`
+(worker lanes, collective bytes), memstats gauges (live HBM bytes).
+See docs/observability.md.
+"""
+from __future__ import annotations
+
+from .core import Counter, Gauge, Monitor, NULL_SPAN, Span  # noqa: F401
+from . import exporters as _exp
+from .exporters import MonitorLogger, prometheus_text, summary_table  # noqa: F401
+from .memstats import register_memory_gauges
+
+MONITOR = Monitor()
+register_memory_gauges(MONITOR)
+
+
+def get_monitor() -> Monitor:
+    return MONITOR
+
+
+def enable():
+    return MONITOR.enable()
+
+
+def disable():
+    return MONITOR.disable()
+
+
+def is_enabled() -> bool:
+    return MONITOR.enabled
+
+
+def reset():
+    return MONITOR.reset()
+
+
+def span(name: str, **args):
+    return MONITOR.span(name, **args)
+
+
+def observe(name: str, seconds: float, **args):
+    return MONITOR.observe(name, seconds, **args)
+
+
+def counter(name: str) -> Counter:
+    return MONITOR.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return MONITOR.gauge(name)
+
+
+def record_step(record: dict):
+    return MONITOR.record_step(record)
+
+
+def step_records():
+    return MONITOR.step_records()
+
+
+def set_lane(lane: int, name=None):
+    return MONITOR.set_lane(lane, name)
+
+
+def attach_logger(logger):
+    if isinstance(logger, MonitorLogger):
+        logger.bind(MONITOR)
+    return MONITOR.attach_logger(logger)
+
+
+def detach_logger(logger):
+    return MONITOR.detach_logger(logger)
+
+
+def export_prometheus() -> str:
+    return prometheus_text(MONITOR)
+
+
+def export_json(path: str, include_steps: bool = True) -> str:
+    return _exp.export_json(MONITOR, path, include_steps)
+
+
+def json_snapshot(include_steps: bool = True) -> dict:
+    return _exp.json_snapshot(MONITOR, include_steps)
+
+
+def export_chrome_trace(path: str, pid=None, process_name=None) -> int:
+    return _exp.export_chrome_trace(MONITOR, pid=pid, path=path,
+                                    process_name=process_name)
+
+
+def merge_chrome_traces(named_paths, out_path: str) -> str:
+    return _exp.merge_chrome_traces(named_paths, out_path)
+
+
+def summary(sorted_key: str = "total") -> str:
+    return summary_table(MONITOR, sorted_key)

@@ -1,0 +1,299 @@
+"""Monitor core: span tracer + counter/gauge registry + step records.
+
+Reference lineage: the C++ profiler's RecordEvent/EventList
+(platform/profiler.cc) was a *profiling mode* — pay-when-on, nothing when
+off, nothing queryable in between.  This subsystem is the always-available
+replacement the perf rounds asked for (VERDICT r5): every layer of the
+framework reports spans and counters into one process-global `Monitor`,
+and exporters (exporters.py) render the same state as a Prometheus text
+page, a JSON snapshot, a Chrome trace, or an appended JSONL stream.
+
+Disabled-mode contract (the hot-path budget): `span()` is one attribute
+load + branch returning a shared singleton (no allocation), `Counter.inc`
+/ `Gauge.set` are one branch.  Tests pin this (tests/test_monitor.py).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+# Cap on buffered trace events / step records so an always-on monitor in a
+# long-running trainer cannot grow without bound (same role as the old
+# profiler's _EVENT_CAP).
+EVENT_CAP = 200_000
+STEP_CAP = 50_000
+
+
+class _NullSpan:
+    """Shared do-nothing span returned while the monitor is disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def annotate(self, **kw):
+        return self
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """Timed region.  Nesting is tracked per-thread: depth and a tid land
+    in the event buffer so the Chrome-trace exporter renders child spans
+    inside their parents."""
+
+    __slots__ = ("mon", "name", "args", "t0", "ts")
+
+    def __init__(self, mon: "Monitor", name: str, args: Optional[dict]):
+        self.mon = mon
+        self.name = name
+        self.args = args
+        self.t0 = 0.0
+        self.ts = 0.0
+
+    def annotate(self, **kw):
+        if self.args is None:
+            self.args = dict(kw)
+        else:
+            self.args.update(kw)
+        return self
+
+    def __enter__(self):
+        tls = self.mon._tls
+        tls.depth = getattr(tls, "depth", 0) + 1
+        self.ts = time.time()
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self.t0
+        tls = self.mon._tls
+        depth = getattr(tls, "depth", 1)
+        tls.depth = depth - 1
+        self.mon._record(self.name, self.ts, dur, depth - 1, self.args)
+        return False
+
+
+class Counter:
+    """Monotonic counter.  `inc` is one branch when disabled; enabled it
+    takes a per-counter lock — `value += n` alone is a LOAD/STORE pair a
+    GIL switch can split, losing increments under concurrent producers."""
+
+    __slots__ = ("mon", "name", "value", "_lock")
+
+    def __init__(self, mon: "Monitor", name: str):
+        self.mon = mon
+        self.name = name
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1):
+        if self.mon.enabled:
+            with self._lock:
+                self.value += n
+        return self
+
+
+class Gauge:
+    """Point-in-time value: either `set()` explicitly or `set_fn()` a
+    callable evaluated lazily at read/export time (how the HBM/live-array
+    gauges avoid walking `jax.live_arrays()` on the hot path)."""
+
+    __slots__ = ("mon", "name", "value", "fn")
+
+    def __init__(self, mon: "Monitor", name: str):
+        self.mon = mon
+        self.name = name
+        self.value = 0.0
+        self.fn: Optional[Callable[[], float]] = None
+
+    def set(self, v: float):
+        if self.mon.enabled:
+            self.value = v
+        return self
+
+    def set_fn(self, fn: Callable[[], float]):
+        self.fn = fn
+        return self
+
+    def read(self) -> float:
+        if self.fn is not None:
+            try:
+                return float(self.fn())
+            except Exception:
+                return float("nan")
+        return float(self.value)
+
+
+class Monitor:
+    """Process-global telemetry sink (one instance per process; see
+    monitor/__init__.py for the singleton + module-level API)."""
+
+    def __init__(self):
+        self.enabled = False
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        # span aggregates: name -> [calls, total_s, max_s, min_s]
+        self._agg: Dict[str, list] = {}
+        # raw events for trace export: (name, ts_s, dur_s, tid, depth, args)
+        self._events: List[tuple] = []
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._steps: List[dict] = []
+        self._loggers: List[Any] = []
+        # per-device/trainer lane for merged multi-process traces
+        self.lane = 0
+        self.lane_name = "paddle_tpu"
+        # steps/sec EMA state has its own lock: record_step also needs the
+        # registry lock, and nesting the two would invite deadlock
+        self._rate_lock = threading.Lock()
+        self._last_step_t: Optional[float] = None
+        self._steps_per_sec_ema = 0.0
+
+    # -- lifecycle ---------------------------------------------------------
+    def enable(self):
+        self.enabled = True
+        return self
+
+    def disable(self):
+        self.enabled = False
+        return self
+
+    def reset(self):
+        with self._lock:
+            self._agg.clear()
+            self._events.clear()
+            self._steps.clear()
+            for c in self._counters.values():
+                c.value = 0
+            for g in self._gauges.values():
+                if g.fn is None:
+                    g.value = 0.0
+            self._last_step_t = None
+            self._steps_per_sec_ema = 0.0
+        return self
+
+    def set_lane(self, lane: int, name: Optional[str] = None):
+        """Assign this process a trace lane (pid in Chrome-trace terms) so
+        merged multi-trainer traces show one lane per device/worker."""
+        self.lane = int(lane)
+        if name is not None:
+            self.lane_name = str(name)
+        return self
+
+    # -- spans -------------------------------------------------------------
+    def span(self, name: str, **args):
+        if not self.enabled:
+            return NULL_SPAN
+        return Span(self, name, args or None)
+
+    def observe(self, name: str, seconds: float, ts: Optional[float] = None,
+                **args):
+        """Record a completed duration without a context manager (the
+        profiler facade's record_run, and pre-measured phases)."""
+        if not self.enabled:
+            return
+        tls = self._tls
+        self._record(name, ts if ts is not None else time.time() - seconds,
+                     seconds, getattr(tls, "depth", 0), args or None)
+
+    def _record(self, name, ts, dur, depth, args):
+        tid = threading.get_ident() & 0xFFFF
+        with self._lock:
+            a = self._agg.get(name)
+            if a is None:
+                self._agg[name] = [1, dur, dur, dur]
+            else:
+                a[0] += 1
+                a[1] += dur
+                if dur > a[2]:
+                    a[2] = dur
+                if dur < a[3]:
+                    a[3] = dur
+            if len(self._events) < EVENT_CAP:
+                self._events.append((name, ts, dur, tid, depth, args))
+
+    def span_stats(self) -> Dict[str, dict]:
+        with self._lock:
+            return {n: {"calls": a[0], "total_s": a[1], "max_s": a[2],
+                        "min_s": a[3]}
+                    for n, a in self._agg.items()}
+
+    def events(self) -> List[tuple]:
+        with self._lock:
+            return list(self._events)
+
+    # -- counters / gauges -------------------------------------------------
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(name, Counter(self, name))
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(name, Gauge(self, name))
+        return g
+
+    def counter_values(self) -> Dict[str, int]:
+        return {n: c.value for n, c in sorted(self._counters.items())}
+
+    def gauge_values(self) -> Dict[str, float]:
+        return {n: g.read() for n, g in sorted(self._gauges.items())}
+
+    # -- step records ------------------------------------------------------
+    def record_step(self, record: dict):
+        """Append one per-`run()` record (executor step breakdown) and fan
+        it out to attached loggers.  Updates the steps/sec EMA gauge."""
+        if not self.enabled:
+            return
+        rate_gauge = self.gauge("executor.steps_per_sec_ema")
+        now = time.perf_counter()
+        with self._rate_lock:
+            if self._last_step_t is not None:
+                dt = now - self._last_step_t
+                if dt > 0:
+                    inst = 1.0 / dt
+                    ema = self._steps_per_sec_ema
+                    self._steps_per_sec_ema = inst if ema == 0.0 else 0.9 * ema + 0.1 * inst
+                    rate_gauge.set(self._steps_per_sec_ema)
+            self._last_step_t = now
+        steps_counter = self.counter("executor.steps")  # before _lock: counter() locks too
+        record = dict(record)
+        record.setdefault("kind", "step")
+        record.setdefault("ts", time.time())
+        record["step"] = steps_counter.value
+        with self._lock:
+            if len(self._steps) < STEP_CAP:
+                self._steps.append(record)
+        steps_counter.inc()
+        for lg in list(self._loggers):
+            try:
+                lg.on_step(record)
+            except Exception:
+                pass
+
+    def step_records(self) -> List[dict]:
+        with self._lock:
+            return list(self._steps)
+
+    # -- loggers -----------------------------------------------------------
+    def attach_logger(self, logger):
+        self._loggers.append(logger)
+        return logger
+
+    def detach_logger(self, logger):
+        if logger in self._loggers:
+            self._loggers.remove(logger)
+        close = getattr(logger, "close", None)
+        if callable(close):
+            close()

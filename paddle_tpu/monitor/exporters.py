@@ -1,0 +1,186 @@
+"""Machine-readable views of Monitor state.
+
+Four formats, one source of truth (monitor.core.Monitor):
+  * Prometheus text exposition — counters, gauges, span summaries;
+  * JSON snapshot — everything, for tools/perf_report.py render/diff;
+  * Chrome trace JSON — the tools/timeline.py role, with per-process
+    lanes and span nesting (tid/depth preserved);
+  * MonitorLogger — periodic JSONL appender bench tooling consumes
+    (tools/perf_report.py --check gates on it in CI).
+"""
+from __future__ import annotations
+
+import json
+import re
+import time
+from typing import Dict, Optional
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+PROM_PREFIX = "paddle_tpu_"
+
+
+def _prom_name(name: str) -> str:
+    return PROM_PREFIX + _NAME_RE.sub("_", name)
+
+
+def prometheus_text(mon) -> str:
+    """Prometheus text exposition format (one page per scrape)."""
+    lines = []
+    for name, v in mon.counter_values().items():
+        p = _prom_name(name)
+        lines.append(f"# TYPE {p} counter")
+        lines.append(f"{p} {v}")
+    for name, v in mon.gauge_values().items():
+        p = _prom_name(name)
+        lines.append(f"# TYPE {p} gauge")
+        lines.append(f"{p} {'NaN' if v != v else v}")
+    for name, s in sorted(mon.span_stats().items()):
+        p = _prom_name(name)
+        lines.append(f"# TYPE {p}_seconds summary")
+        lines.append(f"{p}_seconds_count {s['calls']}")
+        lines.append(f"{p}_seconds_sum {s['total_s']:.9f}")
+        # a summary family only admits _count/_sum/quantiles; max is its
+        # own gauge so strict OpenMetrics parsers accept the page
+        lines.append(f"# TYPE {p}_max_seconds gauge")
+        lines.append(f"{p}_max_seconds {s['max_s']:.9f}")
+    return "\n".join(lines) + "\n"
+
+
+def json_snapshot(mon, include_steps: bool = True) -> dict:
+    snap = {
+        "kind": "snapshot",
+        "ts": time.time(),
+        "lane": mon.lane,
+        "lane_name": mon.lane_name,
+        "counters": mon.counter_values(),
+        "gauges": mon.gauge_values(),
+        "spans": mon.span_stats(),
+    }
+    if include_steps:
+        snap["steps"] = mon.step_records()
+    return snap
+
+
+def export_json(mon, path: str, include_steps: bool = True) -> str:
+    with open(path, "w") as f:
+        json.dump(json_snapshot(mon, include_steps), f, indent=1)
+    return path
+
+
+def chrome_trace_events(mon, pid: Optional[int] = None,
+                        process_name: Optional[str] = None) -> list:
+    pid = mon.lane if pid is None else pid
+    events = [{"name": "process_name", "ph": "M", "pid": pid,
+               "args": {"name": process_name or mon.lane_name}}]
+    for name, ts, dur, tid, depth, args in mon.events():
+        ev = {"name": name, "ph": "X", "pid": pid, "tid": tid,
+              "ts": ts * 1e6, "dur": dur * 1e6, "cat": "span"}
+        if args:
+            ev["args"] = {k: str(v) for k, v in args.items()}
+        events.append(ev)
+    return events
+
+
+def export_chrome_trace(mon, path: str, pid: Optional[int] = None,
+                        process_name: Optional[str] = None) -> int:
+    """Write buffered span events as Chrome trace JSON; returns the number
+    of span events written (metadata rows excluded), matching the old
+    profiler.export_chrome_trace contract."""
+    events = chrome_trace_events(mon, pid, process_name)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return len(events) - 1
+
+
+def merge_chrome_traces(named_paths, out_path: str) -> str:
+    """Merge several processes' traces into one timeline, one pid lane per
+    input (the reference tool's `trainer1=f1,ps=f2` mode)."""
+    merged = []
+    items = (list(named_paths.items()) if isinstance(named_paths, dict)
+             else list(enumerate(named_paths)))
+    for pid, (name, p) in enumerate(items):
+        with open(p) as f:
+            doc = json.load(f)
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid
+            merged.append(ev)
+        merged.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": str(name)}})
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": merged}, f)
+    return out_path
+
+
+def summary_table(mon, sorted_key: str = "total") -> str:
+    """The aggregate span table the old profiler printed from EventList."""
+    stats = mon.span_stats()
+    keyfn = {
+        "total": lambda kv: -kv[1]["total_s"],
+        "calls": lambda kv: -kv[1]["calls"],
+        "max": lambda kv: -kv[1]["max_s"],
+        "min": lambda kv: kv[1]["min_s"],
+        "ave": lambda kv: -(kv[1]["total_s"] / max(kv[1]["calls"], 1)),
+    }.get(sorted_key, lambda kv: -kv[1]["total_s"])
+    lines = [
+        f"{'Event':<40} {'Calls':>8} {'Total(ms)':>12} {'Avg(ms)':>10} {'Max(ms)':>10} {'Min(ms)':>10}"
+    ]
+    for tag, r in sorted(stats.items(), key=keyfn):
+        avg = r["total_s"] / max(r["calls"], 1)
+        lines.append(
+            f"{tag:<40} {r['calls']:>8} {r['total_s']*1e3:>12.3f} {avg*1e3:>10.3f} "
+            f"{r['max_s']*1e3:>10.3f} {r['min_s']*1e3:>10.3f}"
+        )
+    return "\n".join(lines)
+
+
+class MonitorLogger:
+    """Appends JSONL records for bench tooling: every `every`-th step
+    record as it happens, plus full snapshots on demand.
+
+        logger = monitor.attach_logger(MonitorLogger("metrics.jsonl"))
+        ... train ...
+        logger.write_snapshot()   # final counter/gauge state
+        monitor.detach_logger(logger)
+    """
+
+    def __init__(self, path: str, every: int = 1):
+        self.path = path
+        self.every = max(int(every), 1)
+        self._n = 0
+        self._mon = None  # set by Monitor.attach_logger callers via bind
+        self._fh = None   # persistent append handle: one write+flush per
+        # record instead of open/close syscalls on every training step
+
+    def bind(self, mon):
+        self._mon = mon
+        return self
+
+    def _file(self):
+        if self._fh is None or self._fh.closed:
+            self._fh = open(self.path, "a")
+        return self._fh
+
+    def close(self):
+        if self._fh is not None and not self._fh.closed:
+            self._fh.close()
+
+    def on_step(self, record: dict):
+        self._n += 1
+        if self._n % self.every:
+            return
+        f = self._file()
+        f.write(json.dumps(record, default=str) + "\n")
+        f.flush()
+
+    def write_snapshot(self, mon=None):
+        mon = mon or self._mon
+        if mon is None:
+            from . import MONITOR
+
+            mon = MONITOR
+        f = self._file()
+        f.write(json.dumps(json_snapshot(mon, include_steps=False),
+                           default=str) + "\n")
+        f.flush()
+        return self.path

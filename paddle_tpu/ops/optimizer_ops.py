@@ -463,8 +463,9 @@ def _proximal_gd(ctx, op, ins):
 
 @register_opt("proximal_adagrad")
 def _proximal_adagrad(ctx, op, ins):
-    """reference proximal_adagrad_op.h: moment += g^2; eff_lr =
-    lr/sqrt(moment); then the proximal_gd shrinkage at eff_lr."""
+    """reference proximal_adagrad_op.h: moment += g^2; only the gradient
+    step is scaled by 1/sqrt(moment) — the l1 threshold and the (1+lr*l2)
+    denominator use the RAW lr, not the effective one."""
     p = first(ins, "Param")
     g = first(ins, "Grad")
     m = first(ins, "Moment")
@@ -472,8 +473,7 @@ def _proximal_adagrad(ctx, op, ins):
     l1 = op.attr("l1", 0.0)
     l2 = op.attr("l2", 0.0)
     m_new = m + jnp.square(g)
-    eff = lr / jnp.sqrt(m_new)
-    prox = p - eff * g
-    p_new = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - eff * l1, 0.0)
-             / (1.0 + eff * l2))
+    prox = p - (lr / jnp.sqrt(m_new)) * g
+    p_new = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+             / (1.0 + lr * l2))
     return {"ParamOut": p_new, "MomentOut": m_new}

@@ -1,12 +1,17 @@
-"""Profiler (reference: python/paddle/fluid/profiler.py + platform/profiler
-RecordEvent/DeviceTracer, SURVEY.md §5.1).
+"""Profiler facade (reference: python/paddle/fluid/profiler.py + platform/
+profiler RecordEvent/DeviceTracer, SURVEY.md §5.1).
 
-Two layers, mirroring the reference:
-  * host-side per-run records: the executor reports (program, wall time,
-    cache hit) per `run()`; `stop_profiler` prints the aggregate table the
-    reference printed from EventList;
-  * device-side: `jax.profiler` traces (xprof) exported to a directory —
-    Chrome/perfetto-compatible, the role tools/timeline.py played.
+This module is now a thin compatibility layer over `paddle_tpu.monitor`,
+the framework-wide observability subsystem: start/stop toggle the monitor,
+the aggregate table renders the monitor's span stats, and trace export
+goes through the monitor's Chrome-trace exporter.  New code should use
+`paddle_tpu.monitor` directly (spans, counters, gauges, Prometheus/JSON
+exporters, JSONL logging — see docs/observability.md); this surface keeps
+reference-era scripts and the round-5 bench tooling working unchanged.
+
+Device-side (xprof) tracing is unchanged: pass `trace_dir` and the jax
+profiler writes Chrome/perfetto-compatible traces, the role
+tools/timeline.py played.
 """
 from __future__ import annotations
 
@@ -17,45 +22,44 @@ from typing import Optional
 
 import jax
 
-_records = defaultdict(lambda: {"calls": 0, "total_s": 0.0, "max_s": 0.0, "min_s": float("inf")})
-_events: list = []  # (name, ts_us, dur_us) for Chrome-trace export
-_enabled = False
+from .monitor import MONITOR as _MON
+
 _trace_dir: Optional[str] = None
+_owns_enable = False  # did start_profiler() turn the monitor on?
 
 
 def is_profiler_enabled() -> bool:
-    return _enabled
+    return _MON.enabled
 
 
 def record_run(tag: str, seconds: float):
-    if not _enabled:
-        return
-    r = _records[tag]
-    r["calls"] += 1
-    r["total_s"] += seconds
-    r["max_s"] = max(r["max_s"], seconds)
-    r["min_s"] = min(r["min_s"], seconds)
+    _MON.observe(tag, seconds)
 
 
 def reset_profiler():
-    _records.clear()
-    _events.clear()
+    _MON.reset()
 
 
 def start_profiler(state: str = "All", tracer_option: Optional[str] = None,
                    trace_dir: Optional[str] = None):
     """state: CPU | GPU | All (kept for parity; device tracing needs
     trace_dir)."""
-    global _enabled, _trace_dir
-    _enabled = True
+    global _trace_dir, _owns_enable
+    _owns_enable = not _MON.enabled
+    _MON.enable()
     _trace_dir = trace_dir
     if trace_dir is not None:
         jax.profiler.start_trace(trace_dir)
 
 
 def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None):
-    global _enabled, _trace_dir
-    _enabled = False
+    global _trace_dir, _owns_enable
+    # only turn telemetry off if this facade turned it on: a profiler
+    # section inside an always-on monitor.enable() run must not kill the
+    # user's step records / counters on exit
+    if _owns_enable:
+        _MON.disable()
+    _owns_enable = False
     if _trace_dir is not None:
         jax.profiler.stop_trace()
         _trace_dir = None
@@ -69,23 +73,9 @@ def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None)
 
 
 def summary(sorted_key: str = "total") -> str:
-    keyfn = {
-        "total": lambda kv: -kv[1]["total_s"],
-        "calls": lambda kv: -kv[1]["calls"],
-        "max": lambda kv: -kv[1]["max_s"],
-        "min": lambda kv: kv[1]["min_s"],
-        "ave": lambda kv: -(kv[1]["total_s"] / max(kv[1]["calls"], 1)),
-    }.get(sorted_key, lambda kv: -kv[1]["total_s"])
-    lines = [
-        f"{'Event':<40} {'Calls':>8} {'Total(ms)':>12} {'Avg(ms)':>10} {'Max(ms)':>10} {'Min(ms)':>10}"
-    ]
-    for tag, r in sorted(_records.items(), key=keyfn):
-        avg = r["total_s"] / max(r["calls"], 1)
-        lines.append(
-            f"{tag:<40} {r['calls']:>8} {r['total_s']*1e3:>12.3f} {avg*1e3:>10.3f} "
-            f"{r['max_s']*1e3:>10.3f} {(0 if r['min_s']==float('inf') else r['min_s'])*1e3:>10.3f}"
-        )
-    return "\n".join(lines)
+    from .monitor.exporters import summary_table
+
+    return summary_table(_MON, sorted_key)
 
 
 @contextlib.contextmanager
@@ -101,12 +91,12 @@ def profiler(state: str = "All", sorted_key: str = "total", profile_path: Option
 
 # --- per-op attribution + Chrome-trace export (tools/timeline.py role) ------
 
-_EVENT_CAP = 200_000
-
 
 def record_event(name: str, ts: float, seconds: float):
-    if _enabled and len(_events) < _EVENT_CAP:
-        _events.append((name, ts * 1e6, seconds * 1e6))
+    # `ts` is ignored: callers historically passed perf_counter() values,
+    # which would land ~50 years away from the monitor's epoch-based span
+    # timestamps in one Chrome trace.  observe() stamps epoch time itself.
+    _MON.observe(name, seconds)
 
 
 def profile_program(program, feed, fetch_list=None, scope=None, place=None,
@@ -164,45 +154,21 @@ def profile_program(program, feed, fetch_list=None, scope=None, place=None,
 def export_chrome_trace(path: str, pid: int = 0, process_name: str = "paddle_tpu"):
     """Write recorded events as Chrome trace JSON (chrome://tracing /
     perfetto), the format tools/timeline.py emitted."""
-    import json
+    from .monitor.exporters import export_chrome_trace as _export
 
-    events = [{"name": "process_name", "ph": "M", "pid": pid,
-               "args": {"name": process_name}}]
-    for name, ts, dur in _events:
-        events.append({"name": name, "ph": "X", "pid": pid, "tid": 0,
-                       "ts": ts, "dur": dur, "cat": "op"})
-    with open(path, "w") as f:
-        json.dump({"traceEvents": events}, f)
-    return len(_events)
+    return _export(_MON, path, pid=pid, process_name=process_name)
 
 
 def merge_chrome_traces(named_paths, out_path):
     """Merge several processes' traces into one timeline (the reference
     tool's `trainer1=f1,ps=f2` multi-process mode): each input gets its own
     pid lane."""
-    import json
+    from .monitor.exporters import merge_chrome_traces as _merge
 
-    merged = []
-    for pid, (name, p) in enumerate(named_paths.items()
-                                    if isinstance(named_paths, dict)
-                                    else enumerate(named_paths)):
-        with open(p) as f:
-            doc = json.load(f)
-        for ev in doc.get("traceEvents", []):
-            ev = dict(ev)
-            ev["pid"] = pid
-            merged.append(ev)
-        merged.append({"name": "process_name", "ph": "M", "pid": pid,
-                       "args": {"name": str(name)}})
-    with open(out_path, "w") as f:
-        json.dump({"traceEvents": merged}, f)
-    return out_path
+    return _merge(named_paths, out_path)
 
 
-import contextlib as _contextlib
-
-
-@_contextlib.contextmanager
+@contextlib.contextmanager
 def cuda_profiler(output_file, output_mode=None, config=None):
     """reference profiler.cuda_profiler (nvprof hooks): accepted no-op on
     TPU — use profiler() / FLAGS_xla_dump_to for traces."""

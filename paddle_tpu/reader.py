@@ -15,12 +15,15 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Sequence
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from .monitor import MONITOR as _MON
 
 
 # --- reader decorators (reference: python/paddle/reader/decorator.py) ------
@@ -271,6 +274,7 @@ class DataLoader:
                     if not isinstance(item, dict):
                         item = {v.name: a for v, a in zip(self.feed_vars, item)}
                     placed = {}
+                    nbytes = 0
                     for n, a in item.items():
                         a = np.asarray(a)
                         want = name_dtypes.get(n)
@@ -280,7 +284,9 @@ class DataLoader:
                             a = a.astype(np.int32)
                         elif a.dtype == np.float64:
                             a = a.astype(np.float32)
+                        nbytes += a.nbytes
                         placed[n] = self._place(a)
+                    _MON.counter("reader.bytes_staged").inc(nbytes)
                     if not _put(placed):
                         return
             except BaseException as e:  # propagate to the consumer thread
@@ -292,11 +298,23 @@ class DataLoader:
         t.start()
         try:
             while True:
-                item = q.get()
+                # checked per batch (not latched): enabling the monitor
+                # mid-run starts producing wait spans from the live iterator
+                if _MON.enabled:
+                    # consumer-side starvation: time blocked on the queue —
+                    # a deep total here means the input pipeline, not the
+                    # device step, is the bottleneck
+                    _MON.gauge("reader.queue_depth").set(q.qsize())
+                    t0 = time.perf_counter()
+                    item = q.get()
+                    _MON.observe("reader.wait", time.perf_counter() - t0)
+                else:
+                    item = q.get()
                 if item is END:
                     return
                 if isinstance(item, tuple) and len(item) == 2 and item[0] == "__error__":
                     raise RuntimeError("DataLoader generator raised") from item[1]
+                _MON.counter("reader.batches").inc()
                 yield item
         finally:
             # consumer exited (break/exception/GC): release the producer

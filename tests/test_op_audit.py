@@ -91,10 +91,11 @@ def test_proximal_adagrad_golden():
     m = RNG.rand(5).astype("f4") + 0.1
     lr = np.array([0.1], "f4")
     l1, l2 = 0.05, 0.1
+    # reference proximal_adagrad_op.h: raw lr in the shrinkage; only the
+    # gradient step is scaled by 1/sqrt(m_new)
     m_new = m + g * g
-    eff = 0.1 / np.sqrt(m_new)
-    prox = p - eff * g
-    want = np.sign(prox) * np.maximum(np.abs(prox) - eff * l1, 0) / (1 + eff * l2)
+    prox = p - (0.1 / np.sqrt(m_new)) * g
+    want = np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * l1, 0) / (1 + 0.1 * l2)
     _golden("proximal_adagrad",
             {"Param": p, "Grad": g, "Moment": m, "LearningRate": lr},
             {"ParamOut": want.astype("f4"), "MomentOut": m_new.astype("f4")},

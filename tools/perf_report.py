@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Render / diff / CI-gate monitor output (paddle_tpu.monitor).
+
+    python tools/perf_report.py snapshot.json
+        Render span, counter, gauge, and step-breakdown tables from a
+        monitor.export_json() snapshot.
+
+    python tools/perf_report.py --diff before.json after.json
+        Per-span total/avg deltas and counter deltas between two snapshots
+        (the A/B view the perf rounds kept rebuilding by hand).
+
+    python tools/perf_report.py --check metrics.jsonl [--steady-after N]
+        CI/bench gate: assert the JSONL metrics file (MonitorLogger output)
+        exists, contains step records, and that the recompile count stayed
+        FLAT across steady-state steps (index >= N, default 2).  A rising
+        recompile count in steady state is the compile-cache-thrash
+        signature behind NMT-style run-to-run variance (BENCH r5: 26.3%
+        spread); exit 1 names the offending steps.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _load_snapshot(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def _fmt_table(rows, headers):
+    widths = [max(len(str(r[i])) for r in rows + [headers])
+              for i in range(len(headers))]
+    out = ["  ".join(str(h).ljust(w) for h, w in zip(headers, widths))]
+    for r in rows:
+        out.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+def render(path: str) -> str:
+    snap = _load_snapshot(path)
+    parts = [f"# monitor snapshot  lane={snap.get('lane_name', '?')}  "
+             f"ts={snap.get('ts', 0):.3f}"]
+
+    spans = snap.get("spans", {})
+    if spans:
+        rows = [(n, s["calls"], f"{s['total_s']*1e3:.3f}",
+                 f"{s['total_s']/max(s['calls'],1)*1e3:.3f}",
+                 f"{s['max_s']*1e3:.3f}")
+                for n, s in sorted(spans.items(),
+                                   key=lambda kv: -kv[1]["total_s"])]
+        parts.append("\n## spans\n" + _fmt_table(
+            rows, ["name", "calls", "total_ms", "avg_ms", "max_ms"]))
+
+    counters = snap.get("counters", {})
+    if counters:
+        rows = [(n, v) for n, v in counters.items()]
+        parts.append("\n## counters\n" + _fmt_table(rows, ["name", "value"]))
+
+    gauges = snap.get("gauges", {})
+    if gauges:
+        rows = [(n, v) for n, v in gauges.items()]
+        parts.append("\n## gauges\n" + _fmt_table(rows, ["name", "value"]))
+
+    steps = snap.get("steps", [])
+    if steps:
+        phases = ("t_lower_s", "t_compile_s", "t_execute_s", "t_fetch_s",
+                  "t_total_s")
+        rows = []
+        for ph in phases:
+            vals = [s.get(ph, 0.0) for s in steps]
+            rows.append((ph[2:-2], f"{sum(vals)*1e3:.3f}",
+                         f"{sum(vals)/len(vals)*1e3:.3f}",
+                         f"{max(vals)*1e3:.3f}"))
+        parts.append(f"\n## step breakdown ({len(steps)} steps)\n"
+                     + _fmt_table(rows, ["phase", "total_ms", "avg_ms",
+                                         "max_ms"]))
+        hits = sum(1 for s in steps if s.get("cache_hit"))
+        rec = sum(1 for s in steps if s.get("recompiled"))
+        parts.append(f"cache hits {hits}/{len(steps)}, recompiles {rec}")
+    return "\n".join(parts)
+
+
+def diff(path_a: str, path_b: str) -> str:
+    a, b = _load_snapshot(path_a), _load_snapshot(path_b)
+    parts = [f"# monitor diff  A={path_a}  B={path_b}"]
+    sa, sb = a.get("spans", {}), b.get("spans", {})
+    rows = []
+    for n in sorted(set(sa) | set(sb)):
+        ta = sa.get(n, {}).get("total_s", 0.0)
+        tb = sb.get(n, {}).get("total_s", 0.0)
+        ca = sa.get(n, {}).get("calls", 0)
+        cb = sb.get(n, {}).get("calls", 0)
+        aa = ta / max(ca, 1)
+        ab = tb / max(cb, 1)
+        pct = (ab - aa) / aa * 100 if aa else float("inf") if ab else 0.0
+        rows.append((n, f"{aa*1e3:.3f}", f"{ab*1e3:.3f}", f"{pct:+.1f}%"))
+    if rows:
+        parts.append("\n## span avg_ms A -> B\n"
+                     + _fmt_table(rows, ["name", "A", "B", "delta"]))
+    ca, cb = a.get("counters", {}), b.get("counters", {})
+    rows = [(n, ca.get(n, 0), cb.get(n, 0), cb.get(n, 0) - ca.get(n, 0))
+            for n in sorted(set(ca) | set(cb))
+            if ca.get(n, 0) != cb.get(n, 0)]
+    if rows:
+        parts.append("\n## counter deltas\n"
+                     + _fmt_table(rows, ["name", "A", "B", "delta"]))
+    return "\n".join(parts)
+
+
+def check(path: str, steady_after: int = 2) -> int:
+    """Return 0 when the metrics file is healthy, 1 otherwise (printed
+    diagnosis either way).  Made for CI/bench scripts:
+
+        python tools/perf_report.py --check metrics.jsonl || exit 1
+    """
+    try:
+        with open(path) as f:
+            lines = [json.loads(ln) for ln in f if ln.strip()]
+    except FileNotFoundError:
+        print(f"perf_report --check: {path} does not exist "
+              f"(was a MonitorLogger attached?)")
+        return 1
+    except json.JSONDecodeError as e:
+        print(f"perf_report --check: {path} is not valid JSONL: {e}")
+        return 1
+    steps = [r for r in lines if r.get("kind") == "step"]
+    if not steps:
+        print(f"perf_report --check: {path} contains no step records "
+              f"({len(lines)} lines)")
+        return 1
+    steady = steps[steady_after:]
+    if not steady:
+        print(f"perf_report --check: only {len(steps)} steps, fewer than "
+              f"--steady-after={steady_after}; nothing to gate — OK")
+        return 0
+    base = steady[0].get("recompiles_total", 0)
+    bad = [(i + steady_after, s.get("recompiles_total", 0))
+           for i, s in enumerate(steady)
+           if s.get("recompiles_total", 0) != base]
+    if bad:
+        print(f"perf_report --check: recompile count moved in steady state "
+              f"(started at {base}): steps {bad[:10]} — the executor is "
+              f"re-tracing; check feed shape/dtype churn and "
+              f"_lowering_flags toggles")
+        return 1
+    print(f"perf_report --check: OK — {len(steps)} steps, recompile count "
+          f"flat at {base} across {len(steady)} steady-state steps")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", help="snapshot.json (render mode)")
+    ap.add_argument("--diff", nargs=2, metavar=("A", "B"),
+                    help="diff two snapshots")
+    ap.add_argument("--check", metavar="METRICS_JSONL",
+                    help="CI gate over a MonitorLogger JSONL file")
+    ap.add_argument("--steady-after", type=int, default=2,
+                    help="steps to skip before the recompile-flat gate "
+                         "(default 2: startup + first real step)")
+    args = ap.parse_args(argv)
+    if args.check:
+        return check(args.check, args.steady_after)
+    if args.diff:
+        print(diff(*args.diff))
+        return 0
+    if not args.paths:
+        ap.print_help()
+        return 2
+    for p in args.paths:
+        print(render(p))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
