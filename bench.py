@@ -4,7 +4,10 @@ benchmark/fluid/fluid_benchmark.py — one driver, many models).
 Default invocation prints ONE JSON line: the flagship ResNet-50 metric with
 every other model's result embedded under extra.models.  `--per-model`
 prints one JSON line per model instead (mnist parity gate, resnet50,
-transformer NMT ragged path, BERT-base, DeepFM CTR).
+transformer NMT ragged path, BERT-base, DeepFM CTR).  `--pipeline` runs
+the serial-vs-overlapped loop A/B (paddle_tpu.pipeline.train_loop +
+Executor.run_async) and prints its own JSON line with both rates and
+host-blocked fractions.
 
 vs_baseline: the reference published no numbers (BASELINE.md), so the
 absolute series is tracked across rounds; vs_baseline = this round's
@@ -18,6 +21,7 @@ from __future__ import annotations
 
 import json
 import sys
+import time as _time
 
 import numpy as np
 
@@ -243,8 +247,98 @@ def bench_deepfm(batch_size=4096, K=16, iters=3):
             "windows_ms": ws, "spread_pct": _spread(ws)}
 
 
+def bench_pipeline(batch_size=128, steps=24, max_inflight=4, log_period=8,
+                   n_distinct_batches=4):
+    """Serial `exe.run` loop vs `pipeline.train_loop` A/B over identical
+    DataLoader-staged ResNet-50 batches (the ISSUE-2 overlap win).
+
+    Both arms pull device-resident feeds from the same DataLoader config
+    (H2D in the producer thread), so the A/B isolates the dispatch/fetch
+    overlap: the serial arm resolves every step's fetch before dispatching
+    the next, the pipelined arm keeps `max_inflight` steps in flight and
+    resolves only every `log_period`-th.  Reports both rates plus each
+    arm's host-blocked fraction — the pipelined one must sit strictly
+    below the serial one (and does, or this bench is the regression
+    alarm)."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor, pipeline
+    from paddle_tpu.models import resnet
+
+    main_p, startup, feeds, fetches = resnet.build(
+        dtype="bfloat16", class_dim=1000, learning_rate=0.1,
+        with_optimizer=True, stem="space_to_depth")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    exe.run(startup, scope=scope)
+    loss_name = fetches["loss"].name
+    dev = fluid.TPUPlace(0).jax_device()
+    rng = np.random.RandomState(0)
+    batches = [
+        {"img": rng.rand(batch_size, 3, 224, 224).astype("float32"),
+         "label": rng.randint(0, 1000, (batch_size, 1)).astype("int64")}
+        for _ in range(n_distinct_batches)
+    ]
+
+    def make_loader():
+        def gen():
+            for i in range(steps):
+                yield batches[i % n_distinct_batches]
+
+        return fluid.DataLoader.from_generator(
+            [feeds["img"], feeds["label"]], capacity=max_inflight + 2,
+            device=dev).set_batch_generator(gen)
+
+    # warmup/compile outside both timing windows (same executable serves
+    # both arms: same program, feed signature, and scope)
+    exe.run(main_p, feed=batches[0], fetch_list=[loss_name], scope=scope)
+
+    monitor.reset()
+    monitor.enable()
+    t0 = _time.perf_counter()
+    last = None
+    for feed in make_loader():
+        (last,) = exe.run(main_p, feed=feed, fetch_list=[loss_name],
+                          scope=scope)
+    serial_wall = _time.perf_counter() - t0
+    spans = monitor.get_monitor().span_stats()
+    serial_blocked = (spans.get("executor.execute", {}).get("total_s", 0.0)
+                      + spans.get("executor.fetch", {}).get("total_s", 0.0))
+    serial_frac = serial_blocked / serial_wall if serial_wall else 0.0
+    assert np.isfinite(float(np.asarray(last).reshape(-1)[0]))
+
+    monitor.reset()
+    stats = pipeline.train_loop(exe, main_p, make_loader(), [loss_name],
+                                scope=scope, max_inflight=max_inflight,
+                                log_period=log_period)
+    monitor.disable()
+    for _, vals in stats.logged:
+        assert np.isfinite(float(np.asarray(vals[0]).reshape(-1)[0]))
+
+    serial_imgs = steps * batch_size / serial_wall
+    piped_imgs = steps * batch_size / stats.wall_s
+    print(f"pipeline: serial {serial_imgs:.0f} imgs/s (host-blocked "
+          f"{serial_frac:.3f})  pipelined {piped_imgs:.0f} imgs/s "
+          f"(host-blocked {stats.host_blocked_frac:.3f})", file=sys.stderr)
+    return {"metric": "resnet50_pipeline_overlap",
+            "value": round(piped_imgs, 2), "unit": "imgs/sec",
+            "serial_imgs_per_sec": round(serial_imgs, 2),
+            "pipelined_imgs_per_sec": round(piped_imgs, 2),
+            "speedup": round(piped_imgs / serial_imgs, 4) if serial_imgs else 0.0,
+            "host_blocked_frac_serial": round(serial_frac, 4),
+            "host_blocked_frac_pipelined": round(stats.host_blocked_frac, 4),
+            "overlap_confirmed": bool(stats.host_blocked_frac < serial_frac),
+            "batch_size": batch_size, "steps": steps,
+            "max_inflight": max_inflight, "log_period": log_period}
+
+
 def main():
     per_model = "--per-model" in sys.argv
+    if "--pipeline" in sys.argv:
+        print(json.dumps(bench_pipeline()))
+        return
     only = None
     for a in sys.argv[1:]:
         if not a.startswith("-"):

@@ -60,6 +60,9 @@ from . import recordio_writer  # noqa: F401
 from . import metrics  # noqa: F401
 from . import monitor  # noqa: F401  (observability: spans/counters/exporters)
 from . import profiler  # noqa: F401  (compat facade over monitor)
+from . import pipeline  # noqa: F401  (overlapped train_loop driver)
+from .pipeline import train_loop  # noqa: F401
+from .core.executor import FetchHandle  # noqa: F401
 
 __version__ = "0.1.0"
 

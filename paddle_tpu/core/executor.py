@@ -451,6 +451,145 @@ class _CompiledStep:
         return fetches, new_key
 
 
+class _PendingFetches:
+    """Shared state behind the FetchHandles of one `run_async` dispatch.
+
+    Holds the still-in-flight output `jax.Array`s (plus the new RNG key, so
+    `wait()` exerts backpressure even for fetch-less programs), the deferred
+    host-eval plan, and the deferred NaN/Inf check.  Resolution happens at
+    most once; an error raised during resolution is sticky so every handle
+    of the dispatch reports the same failure."""
+
+    __slots__ = ("fetch_names", "fetches", "key", "host_plan", "feed",
+                 "scope", "program_u8", "_np", "_exc", "_done")
+
+    def __init__(self, fetch_names, fetches, key, host_plan, feed, scope,
+                 program_u8):
+        self.fetch_names = list(fetch_names)
+        self.fetches = list(fetches)
+        self.key = key
+        self.host_plan = host_plan
+        self.feed = feed
+        self.scope = scope
+        self.program_u8 = program_u8
+        self._np = None
+        self._exc = None
+        self._done = False
+
+    @property
+    def want_names(self):
+        return self.host_plan["want"] if self.host_plan is not None else self.fetch_names
+
+    def wait(self):
+        """Block until the dispatched step has executed on the device —
+        no device->host copy, no host eval.  The bounded-depth knob:
+        train_loop calls this on non-logging steps."""
+        jax.block_until_ready(self.fetches)
+        if self.key is not None:
+            jax.block_until_ready(self.key)
+
+    def ready(self) -> bool:
+        """Non-blocking readiness probe (best effort: falls back to True
+        when the array type predates `is_ready`)."""
+        outs = self.fetches if self.fetches else ([self.key] if self.key is not None else [])
+        for a in outs:
+            probe = getattr(a, "is_ready", None)
+            if callable(probe) and not probe():
+                return False
+        return True
+
+    def resolve(self):
+        """Materialize fetches to numpy (first call only), finishing the
+        deferred host-eval pass and the NaN/Inf check.  In-flight errors —
+        a poisoned value caught by FLAGS_check_nan_inf, an XLA runtime
+        failure surfacing at the blocking copy — raise HERE, not at
+        dispatch; the scope already holds the step's output buffers, so a
+        resolution failure does not corrupt persistent state."""
+        if self._done:
+            if self._exc is not None:
+                raise self._exc
+            return self._np
+        mon_on = _MON.enabled
+        if mon_on:
+            t0 = time.perf_counter()
+        try:
+            if self.host_plan is not None:
+                with _MON.span("executor.host_eval"):
+                    vals = Executor._finish_host_eval(
+                        self.host_plan, self.feed, self.fetches, self.scope)
+                names = self.host_plan["want"]
+            else:
+                vals, names = self.fetches, self.fetch_names
+            Executor._check_nan_inf(names, vals)
+            self._np = [np.asarray(v) for v in vals]
+        except BaseException as e:
+            self._exc = e
+            raise
+        finally:
+            # resolution is one-shot either way: drop the device buffers,
+            # the staged feed, and the key so retained handles don't pin a
+            # whole batch (+ outputs) in memory past their numpy copies
+            self._done = True
+            self.fetches = []
+            self.feed = None
+            self.host_plan = None
+            self.key = None
+        if mon_on:
+            _MON.observe("executor.fetch", time.perf_counter() - t0,
+                         program=self.program_u8)
+        return self._np
+
+
+class FetchHandle:
+    """Lazy fetch result from `Executor.run_async`.
+
+    Wraps one output of a still-in-flight dispatch: JAX's async dispatch
+    keeps the device busy while Python runs ahead, and the device->host
+    copy (plus deferred host-eval / NaN check) happens only on first
+    access — `numpy()`, `np.asarray(handle)`, or `float(handle)`."""
+
+    __slots__ = ("_pending", "_idx", "name")
+
+    def __init__(self, pending: _PendingFetches, idx: int, name: str):
+        self._pending = pending
+        self._idx = idx
+        self.name = name
+
+    def numpy(self) -> np.ndarray:
+        return self._pending.resolve()[self._idx]
+
+    def wait(self):
+        """Block until device execution finished, WITHOUT copying to host."""
+        self._pending.wait()
+        return self
+
+    # jax-style alias so generic `jax.block_until_ready`-ish call sites work
+    def block_until_ready(self):
+        return self.wait()
+
+    def is_ready(self) -> bool:
+        return self._pending._done or self._pending.ready()
+
+    @property
+    def has_deferred_host_work(self) -> bool:
+        """True when skipping resolution would skip SIDE EFFECTS, not just
+        the host copy: deferred host-eval ops write metric accumulators
+        back to the scope.  train_loop resolves such steps even when it
+        wouldn't log them."""
+        return self._pending.host_plan is not None
+
+    def __array__(self, dtype=None):
+        arr = self.numpy()
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __float__(self):
+        return float(np.asarray(self.numpy()).reshape(-1)[0])
+
+    def __repr__(self):
+        state = "resolved" if self._pending._done else "in-flight"
+        return f"FetchHandle({self.name!r}, {state})"
+
+
 class Executor:
     """Reference: executor.py:292.  `run` signature kept source-compatible."""
 
@@ -581,6 +720,41 @@ class Executor:
         feed must carry a leading [steps] axis and fetches come back stacked
         [steps, ...].  Amortizes host/tunnel dispatch overhead the way the
         reference's dataset trainers amortize the Python boundary."""
+        return self._run_impl(program, feed, fetch_list, scope, return_numpy,
+                              steps, async_mode=False)
+
+    def run_async(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, np.ndarray]] = None,
+        fetch_list: Optional[Sequence[Union[str, Variable]]] = None,
+        scope: Optional[Scope] = None,
+        use_program_cache: bool = True,
+        steps: int = 1,
+    ) -> List["FetchHandle"]:
+        """`run`, minus the blocking tail: returns one `FetchHandle` per
+        fetch as soon as the step is ENQUEUED on the device.  The scope is
+        updated immediately with the step's (in-flight) output buffers and
+        the advanced RNG key, so the next `run`/`run_async` over the same
+        scope chains correctly — values, optimizer accumulators, and RNG
+        advance exactly as under the synchronous path.  Device->host
+        copies, deferred host-eval ops, and the FLAGS_check_nan_inf guard
+        all run at handle resolution (`handle.numpy()`); an in-flight
+        error therefore surfaces on resolution, not dispatch.  See
+        paddle_tpu/pipeline.py:train_loop for the bounded-depth driver."""
+        return self._run_impl(program, feed, fetch_list, scope, True,
+                              steps, async_mode=True)
+
+    def _run_impl(
+        self,
+        program: Optional[Program],
+        feed: Optional[Dict[str, np.ndarray]],
+        fetch_list: Optional[Sequence[Union[str, Variable]]],
+        scope: Optional[Scope],
+        return_numpy: bool,
+        steps: int,
+        async_mode: bool,
+    ):
         program = program if program is not None else default_main_program()
         mesh = None
         batch_axis = "dp"
@@ -775,12 +949,40 @@ class Executor:
             t_run0 = time.perf_counter()
         fetches, new_key = compiled(scope, jfeeds, key)
         if mon_on:
+            # dispatch = enqueue-only cost (what run_async pays on the
+            # critical path); execute additionally blocks to completion so
+            # device compute isn't attributed to the fetch copy.
+            build_s = (compiled.last_lower_s + compiled.last_compile_s
+                       if compiled.last_recompiled else 0.0)
+            t_dispatch = time.perf_counter() - t_run0 - build_s
+            _MON.observe("executor.dispatch", t_dispatch, program=u8)
+        scope.set_var(RNG_STATE_VAR, new_key)
+        if async_mode:
+            pending = _PendingFetches(fetch_names, fetches, new_key,
+                                      host_plan, feed, scope,
+                                      program._uuid[:8])
+            if mon_on:
+                _MON.record_step({
+                    "program": u8,
+                    "steps": steps,
+                    "async": True,
+                    "cache_hit": cache_hit,
+                    "recompiled": compiled.last_recompiled,
+                    "cache_hits_total": _MON.counter("executor.cache_hit").value,
+                    "cache_misses_total": _MON.counter("executor.cache_miss").value,
+                    "recompiles_total": _MON.counter("executor.recompile").value,
+                    "t_lower_s": compiled.last_lower_s if compiled.last_recompiled else 0.0,
+                    "t_compile_s": compiled.last_compile_s if compiled.last_recompiled else 0.0,
+                    "t_dispatch_s": t_dispatch,
+                    "feed_bytes": feed_bytes,
+                })
+            return [FetchHandle(pending, i, n)
+                    for i, n in enumerate(pending.want_names)]
+        if mon_on:
             jax.block_until_ready(fetches)
             t_disp = time.perf_counter() - t_run0
-            t_execute = t_disp - (compiled.last_lower_s + compiled.last_compile_s
-                                  if compiled.last_recompiled else 0.0)
+            t_execute = t_disp - build_s
             _MON.observe("executor.execute", t_execute, program=u8)
-        scope.set_var(RNG_STATE_VAR, new_key)
         if host_plan is not None:
             with _MON.span("executor.host_eval"):
                 fetches = self._finish_host_eval(host_plan, feed, fetches, scope)
@@ -806,6 +1008,7 @@ class Executor:
             "recompiles_total": _MON.counter("executor.recompile").value,
             "t_lower_s": compiled.last_lower_s if compiled.last_recompiled else 0.0,
             "t_compile_s": compiled.last_compile_s if compiled.last_recompiled else 0.0,
+            "t_dispatch_s": t_dispatch,
             "t_execute_s": t_execute,
             "t_fetch_s": t_fetch,
             "t_total_s": t_total,

@@ -54,6 +54,8 @@ def set_flags(flags: Dict[str, Any]):
         ent["value"] = _coerce(ent["type"], v)
         if k == "FLAGS_xla_dump_to":
             apply_xla_dump()
+        elif k == "FLAGS_compile_cache_dir":
+            apply_compile_cache()
 
 
 def get_flags(names) -> Dict[str, Any]:
@@ -90,6 +92,12 @@ DEFINE_string("FLAGS_xla_dump_to", "",
 DEFINE_int("FLAGS_executor_cache_capacity", 128,
            "LRU capacity of the executor's compiled-program cache "
            "(reference use_program_cache)")
+DEFINE_string("FLAGS_compile_cache_dir", "",
+              "directory for XLA's persistent compilation cache: cold-start "
+              "executor.compile cost (seconds per program signature, re-paid "
+              "every process) is paid once per machine — the second process "
+              "running the same program loads the compiled executable from "
+              "disk.  Set before the first compile (env var or set_flags)")
 DEFINE_bool("FLAGS_cudnn_deterministic", True,
             "accepted no-op: XLA TPU lowerings are deterministic by default")
 DEFINE_float("FLAGS_fraction_of_gpu_memory_to_use", 1.0,
@@ -111,5 +119,22 @@ def apply_xla_dump():
         ).strip()
 
 
+def apply_compile_cache():
+    """Wire FLAGS_compile_cache_dir into jax's persistent compilation
+    cache.  The min-compile-time floor drops to 0 so every program
+    signature is cached — the framework compiles few, large programs, so
+    the cache stays small and the cold-start win applies to all of them.
+    Effective for programs compiled after the flag is set."""
+    d = flag("FLAGS_compile_cache_dir")
+    if not d:
+        return
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", d)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
 init_from_env()
 apply_xla_dump()
+apply_compile_cache()

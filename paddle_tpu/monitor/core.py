@@ -253,29 +253,35 @@ class Monitor:
     # -- step records ------------------------------------------------------
     def record_step(self, record: dict):
         """Append one per-`run()` record (executor step breakdown) and fan
-        it out to attached loggers.  Updates the steps/sec EMA gauge."""
+        it out to attached loggers.  Only `kind="step"` records (the
+        executor's own) advance the executor.steps counter and steps/sec
+        EMA — auxiliary kinds (pipeline_step, ...) describe the SAME
+        training step from another layer and must not double-count it."""
         if not self.enabled:
             return
-        rate_gauge = self.gauge("executor.steps_per_sec_ema")
-        now = time.perf_counter()
-        with self._rate_lock:
-            if self._last_step_t is not None:
-                dt = now - self._last_step_t
-                if dt > 0:
-                    inst = 1.0 / dt
-                    ema = self._steps_per_sec_ema
-                    self._steps_per_sec_ema = inst if ema == 0.0 else 0.9 * ema + 0.1 * inst
-                    rate_gauge.set(self._steps_per_sec_ema)
-            self._last_step_t = now
-        steps_counter = self.counter("executor.steps")  # before _lock: counter() locks too
         record = dict(record)
         record.setdefault("kind", "step")
         record.setdefault("ts", time.time())
+        is_exec_step = record["kind"] == "step"
+        steps_counter = self.counter("executor.steps")  # before _lock: counter() locks too
+        if is_exec_step:
+            rate_gauge = self.gauge("executor.steps_per_sec_ema")
+            now = time.perf_counter()
+            with self._rate_lock:
+                if self._last_step_t is not None:
+                    dt = now - self._last_step_t
+                    if dt > 0:
+                        inst = 1.0 / dt
+                        ema = self._steps_per_sec_ema
+                        self._steps_per_sec_ema = inst if ema == 0.0 else 0.9 * ema + 0.1 * inst
+                        rate_gauge.set(self._steps_per_sec_ema)
+                self._last_step_t = now
         record["step"] = steps_counter.value
         with self._lock:
             if len(self._steps) < STEP_CAP:
                 self._steps.append(record)
-        steps_counter.inc()
+        if is_exec_step:
+            steps_counter.inc()
         for lg in list(self._loggers):
             try:
                 lg.on_step(record)

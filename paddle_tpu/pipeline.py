@@ -1,0 +1,162 @@
+"""Overlapped training driver: feed staging, device compute, and fetch
+run concurrently, K steps deep.
+
+Reference counterparts: `operators/reader/buffered_reader.cc` (the async
+`cudaMemcpyAsync` double-buffer) hid H2D latency, and the
+ParallelExecutor's dependency-driven op scheduling overlapped compute
+with transfer.  The TPU-native equivalent composes three existing
+pieces:
+
+  * `DataLoader` stages batches onto the device in its producer thread
+    (H2D off the critical path, `capacity` batches deep);
+  * `Executor.run_async` enqueues a step and returns lazy `FetchHandle`s
+    immediately — JAX's async dispatch keeps the device busy while
+    Python prepares and dispatches the NEXT step;
+  * `train_loop` below bounds how many dispatched-but-unresolved steps
+    may be in flight (donated-buffer pressure on HBM grows with depth)
+    and only materializes fetches on logging steps — non-logging steps
+    `wait()` for execution without paying the device->host copy.
+
+Monitor integration: `pipeline.inflight` gauge, `pipeline.host_blocked`
+span (time the host spent waiting on the device — the overlap-win
+metric), and one `kind="pipeline_step"` record per drained step that
+`tools/perf_report.py` turns into a host-blocked fraction (and can gate
+on via `--check --max-host-blocked-frac`).
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .monitor import MONITOR as _MON
+
+
+@dataclass
+class PipelineStats:
+    """What `train_loop` hands back: per-logged-step fetch values plus the
+    overlap accounting bench.py / perf tooling report."""
+
+    steps: int = 0
+    logged: List[Tuple[int, List[np.ndarray]]] = field(default_factory=list)
+    wall_s: float = 0.0
+    host_blocked_s: float = 0.0
+    max_inflight_seen: int = 0
+
+    @property
+    def host_blocked_frac(self) -> float:
+        """Fraction of wall time the host spent blocked on the device
+        (resolving or waiting on handles).  A serial exe.run loop sits
+        near 1.0 whenever the device step dominates; the pipelined loop's
+        win is exactly how far below that it lands."""
+        return self.host_blocked_s / self.wall_s if self.wall_s > 0 else 0.0
+
+
+def train_loop(
+    exe,
+    program,
+    loader: Iterable,
+    fetch_list: Sequence,
+    scope=None,
+    max_inflight: int = 2,
+    log_period: int = 1,
+    on_logged: Optional[Callable[[int, List[np.ndarray]], Any]] = None,
+    max_steps: Optional[int] = None,
+) -> PipelineStats:
+    """Drive a training program over `loader` with up to `max_inflight`
+    steps dispatched ahead of resolution.
+
+        loader = fluid.DataLoader.from_generator([x, y], capacity=4) \\
+                      .set_batch_generator(gen)
+        stats = train_loop(exe, main, loader, [loss], scope=scope,
+                           max_inflight=3, log_period=10)
+
+    `loader` yields feed dicts (a `DataLoader` places them on device in
+    its producer thread; plain numpy dicts also work).  Step N+1 is
+    dispatched BEFORE step N's handles resolve; state write-back and RNG
+    threading stay correct because the scope holds each step's output
+    buffers, not the handles.  Every `log_period`-th step (step 0, then
+    log_period, ...) is resolved to numpy and collected in
+    `stats.logged` (or passed to `on_logged(step, values)`); other steps
+    only `wait()` for device completion, skipping the host copy
+    entirely.  `max_inflight` bounds donated-buffer pressure so deep
+    pipelines cannot OOM HBM.
+
+    Note the skip trade-off: the FLAGS_check_nan_inf guard runs at
+    resolution, so non-logged steps are not NaN-checked (steps with
+    deferred host-eval side effects are always resolved; a NaN in the
+    params still surfaces at the next logged step's loss)."""
+    if not fetch_list:
+        raise ValueError("train_loop needs a non-empty fetch_list (the "
+                         "handles are also the pipeline's backpressure)")
+    if max_inflight < 1:
+        raise ValueError(f"max_inflight must be >= 1, got {max_inflight}")
+    if log_period < 1:
+        raise ValueError(f"log_period must be >= 1, got {log_period}")
+
+    stats = PipelineStats()
+    inflight: deque = deque()  # (step index, [FetchHandle, ...])
+    gauge = _MON.gauge("pipeline.inflight")
+    t_wall0 = time.perf_counter()
+    last_drain_t = t_wall0
+
+    def drain_one():
+        nonlocal last_drain_t
+        step_i, handles = inflight.popleft()
+        gauge.set(len(inflight))
+        want_log = step_i % log_period == 0
+        # deferred host-eval ops (callback-less platforms) update scope
+        # accumulators at resolution — those steps must resolve even when
+        # they aren't logged, or the metric silently misses updates
+        must_resolve = want_log or handles[0].has_deferred_host_work
+        t_b0 = time.perf_counter()
+        with _MON.span("pipeline.host_blocked", step=step_i, logged=want_log):
+            if must_resolve:
+                vals = [h.numpy() for h in handles]
+            else:
+                handles[0].wait()  # all handles share one pending dispatch
+        now = time.perf_counter()
+        stats.host_blocked_s += now - t_b0
+        if _MON.enabled:
+            _MON.record_step({
+                "kind": "pipeline_step",
+                "pipeline_step": step_i,
+                "t_host_blocked_s": now - t_b0,
+                "t_step_wall_s": now - last_drain_t,
+                "inflight": len(inflight),
+                "logged": want_log,
+            })
+        last_drain_t = now
+        if want_log:
+            if on_logged is not None:
+                on_logged(step_i, vals)
+            else:
+                stats.logged.append((step_i, vals))
+
+    it = iter(loader)
+    try:
+        while max_steps is None or stats.steps < max_steps:
+            # bound checked BEFORE pulling: a shared/resumable loader must
+            # not lose a batch the loop will never dispatch
+            try:
+                feed = next(it)
+            except StopIteration:
+                break
+            while len(inflight) >= max_inflight:
+                drain_one()
+            handles = exe.run_async(program, feed=feed,
+                                    fetch_list=fetch_list, scope=scope)
+            inflight.append((stats.steps, handles))
+            stats.steps += 1
+            stats.max_inflight_seen = max(stats.max_inflight_seen,
+                                          len(inflight))
+            gauge.set(len(inflight))
+        while inflight:
+            drain_one()
+    finally:
+        gauge.set(0)
+    stats.wall_s = time.perf_counter() - t_wall0
+    return stats

@@ -28,18 +28,33 @@ from .monitor import MONITOR as _MON
 
 # --- reader decorators (reference: python/paddle/reader/decorator.py) ------
 
-def shuffle(reader: Callable, buf_size: int):
+def shuffle(reader: Callable, buf_size: int, seed: Optional[int] = None):
+    """Buffered shuffle.  `seed` makes the order deterministic; when omitted
+    the program-level `random_seed` (reference: Program.random_seed, the
+    knob every seeded test already sets) is honored before falling back to
+    an unseeded RNG.  A private `random.Random` instance either way, so
+    shuffling never perturbs the global `random` module's stream."""
+
     def reader_():
         import random
 
+        s = seed
+        if s is None:
+            try:
+                from .core.program import default_main_program
+
+                s = default_main_program().random_seed
+            except Exception:
+                s = None
+        rng = random.Random(s) if s is not None else random.Random()
         buf = []
         for item in reader():
             buf.append(item)
             if len(buf) >= buf_size:
-                random.shuffle(buf)
+                rng.shuffle(buf)
                 yield from buf
                 buf = []
-        random.shuffle(buf)
+        rng.shuffle(buf)
         yield from buf
 
     return reader_
@@ -235,9 +250,18 @@ class DataLoader:
         self._gen = batches
         return self
 
-    def _place(self, arr):
+    def _place(self, name, arr):
+        """Stage one feed on device.  `sharding` is either a single
+        Sharding applied to every feed or a dict name->Sharding; a feed
+        missing from the dict falls back to `device` placement (labels
+        replicate while images batch-shard, etc.)."""
         if self.sharding is not None:
-            return jax.device_put(arr, self.sharding)
+            if isinstance(self.sharding, dict):
+                spec = self.sharding.get(name)
+                if spec is not None:
+                    return jax.device_put(arr, spec)
+            else:
+                return jax.device_put(arr, self.sharding)
         if self.device is not None:
             return jax.device_put(arr, self.device)
         return jax.device_put(arr)
@@ -285,7 +309,7 @@ class DataLoader:
                         elif a.dtype == np.float64:
                             a = a.astype(np.float32)
                         nbytes += a.nbytes
-                        placed[n] = self._place(a)
+                        placed[n] = self._place(n, a)
                     _MON.counter("reader.bytes_staged").inc(nbytes)
                     if not _put(placed):
                         return
@@ -313,7 +337,11 @@ class DataLoader:
                 if item is END:
                     return
                 if isinstance(item, tuple) and len(item) == 2 and item[0] == "__error__":
-                    raise RuntimeError("DataLoader generator raised") from item[1]
+                    # re-raise the producer's exception AS ITSELF: the
+                    # instance still carries the generator frame's
+                    # traceback, so user data bugs point at user code, not
+                    # at a bare RuntimeError from this loop
+                    raise item[1]
                 _MON.counter("reader.batches").inc()
                 yield item
         finally:

@@ -1,0 +1,209 @@
+"""Async dispatch pipeline (ISSUE 2): `Executor.run_async` lazy fetch
+handles must be value-equivalent to synchronous `run`, surface in-flight
+errors on resolution, and `pipeline.train_loop` must drive an overlapped
+loop whose logged fetches match the serial loop's.  CPU-only, fast —
+runs in tier-1."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+from paddle_tpu.core.scope import RNG_STATE_VAR
+
+
+def _build_sgd_program(seed=11):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h = fluid.layers.fc(x, 8, act="relu")
+        h = fluid.layers.dropout(h, dropout_prob=0.3)  # exercises RNG threading
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(h, 1), y))
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+    startup.random_seed = seed
+    main.random_seed = seed
+    return main, startup, loss
+
+
+def _feed_seq(n, batch=8):
+    rng = np.random.RandomState(0)
+    out = []
+    for _ in range(n):
+        xv = rng.rand(batch, 4).astype("f4")
+        out.append({"x": xv, "y": xv.sum(1, keepdims=True)})
+    return out
+
+
+def _run_serial(feeds, loss, main, startup):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    losses = [exe.run(main, feed=f, fetch_list=[loss], scope=scope)[0]
+              for f in feeds]
+    return losses, scope
+
+
+def test_run_async_matches_sync():
+    """Handles resolve to the same values as a synchronous run over the
+    same feed sequence; params, optimizer accumulators, and the RNG key
+    advance identically (the scope chains output buffers, not handles)."""
+    main, startup, loss = _build_sgd_program()
+    feeds = _feed_seq(6)
+    sync_losses, sync_scope = _run_serial(feeds, loss, main, startup)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    handle_seq = [exe.run_async(main, feed=f, fetch_list=[loss], scope=scope)
+                  for f in feeds]  # all 6 steps dispatched before ANY resolve
+    async_losses = [hs[0].numpy() for hs in handle_seq]
+
+    for a, s in zip(async_losses, sync_losses):
+        np.testing.assert_array_equal(a, s)
+    for name in sync_scope.local_var_names():
+        if name == RNG_STATE_VAR:
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(scope.find_var(name)),
+            np.asarray(sync_scope.find_var(name)),
+            err_msg=f"state var {name} diverged under async dispatch")
+    np.testing.assert_array_equal(
+        np.asarray(scope.find_var(RNG_STATE_VAR)),
+        np.asarray(sync_scope.find_var(RNG_STATE_VAR)))
+
+
+def test_run_async_interleaves_with_sync_run():
+    """A sync run issued after async dispatches sees their state updates."""
+    main, startup, loss = _build_sgd_program()
+    feeds = _feed_seq(4)
+    sync_losses, _ = _run_serial(feeds, loss, main, startup)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    for f in feeds[:3]:
+        exe.run_async(main, feed=f, fetch_list=[loss], scope=scope)
+    (last,) = exe.run(main, feed=feeds[3], fetch_list=[loss], scope=scope)
+    np.testing.assert_array_equal(last, sync_losses[3])
+
+
+def test_fetch_handle_api():
+    x = fluid.layers.data("x", [3], dtype="float32")
+    y = fluid.layers.scale(x, scale=3.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    (h,) = exe.run_async(feed={"x": np.ones((2, 3), "f4")}, fetch_list=[y])
+    assert h.name == y.name
+    h.wait()  # no host copy, just completion
+    assert h.is_ready()
+    np.testing.assert_allclose(np.asarray(h), np.full((2, 3), 3.0))
+    np.testing.assert_allclose(h.numpy(), np.full((2, 3), 3.0))
+    assert "resolved" in repr(h)
+
+
+def test_run_async_nan_surfaces_on_resolution():
+    """An in-flight NaN (FLAGS_check_nan_inf) raises at handle resolution,
+    not dispatch, and every handle of the dispatch reports the same
+    sticky error; the scope stays usable for subsequent runs."""
+    x = fluid.layers.data("x", [2], dtype="float32")
+    y = fluid.layers.scale(x, scale=2.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        bad = np.array([[1.0, np.nan]], dtype="f4")
+        (h,) = exe.run_async(feed={"x": bad}, fetch_list=[y], scope=scope)
+        with pytest.raises(RuntimeError, match="NaN/Inf"):
+            h.numpy()
+        with pytest.raises(RuntimeError, match="NaN/Inf"):
+            np.asarray(h)  # sticky: second access sees the same failure
+        # scope not corrupted: a clean follow-up run works
+        (ok,) = exe.run(feed={"x": np.ones((1, 2), "f4")}, fetch_list=[y],
+                        scope=scope)
+        np.testing.assert_allclose(ok, [[2.0, 2.0]])
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_train_loop_matches_serial_and_records_metrics():
+    """CPU-only pipeline smoke test (tier-1): logged steps of the
+    overlapped loop equal the serial loop's values; the monitor carries
+    pipeline.inflight / host_blocked / pipeline_step records."""
+    main, startup, loss = _build_sgd_program()
+    feeds = _feed_seq(10)
+    sync_losses, _ = _run_serial(feeds, loss, main, startup)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    monitor.reset()
+    monitor.enable()
+    try:
+        stats = fluid.train_loop(exe, main, iter(feeds), [loss], scope=scope,
+                                 max_inflight=3, log_period=3)
+    finally:
+        monitor.disable()
+    assert stats.steps == 10
+    assert [s for s, _ in stats.logged] == [0, 3, 6, 9]
+    for step_i, vals in stats.logged:
+        np.testing.assert_array_equal(vals[0], sync_losses[step_i])
+    assert 1 <= stats.max_inflight_seen <= 3
+    assert stats.wall_s > 0 and stats.host_blocked_s >= 0
+
+    records = [r for r in monitor.step_records()
+               if r.get("kind") == "pipeline_step"]
+    assert len(records) == 10
+    assert sum(1 for r in records if r["logged"]) == 4
+    spans = monitor.get_monitor().span_stats()
+    assert "pipeline.host_blocked" in spans
+    assert "executor.dispatch" in spans
+    assert monitor.gauge("pipeline.inflight").read() == 0  # drained
+    # pipeline_step records describe the SAME steps the executor already
+    # counted: executor.steps must not double-count them
+    assert monitor.counter("executor.steps").value == 10
+
+
+def test_train_loop_on_logged_callback_and_max_steps():
+    main, startup, loss = _build_sgd_program()
+    feeds = _feed_seq(8)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    seen = []
+    stats = fluid.train_loop(exe, main, iter(feeds), [loss], scope=scope,
+                             max_inflight=2, log_period=2,
+                             on_logged=lambda s, v: seen.append(s),
+                             max_steps=5)
+    assert stats.steps == 5
+    assert seen == [0, 2, 4]
+    assert stats.logged == []  # callback consumed them
+
+
+def test_train_loop_rejects_empty_fetch_list():
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(ValueError, match="fetch_list"):
+        fluid.train_loop(exe, fluid.Program(), iter([]), [])
+
+
+def test_perf_report_host_blocked_gate(tmp_path):
+    """tools/perf_report.py --check gates on the pipeline's steady-state
+    host-blocked fraction from MonitorLogger output."""
+    import json
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent.parent))
+    from tools.perf_report import check
+
+    path = tmp_path / "metrics.jsonl"
+    rows = [{"kind": "step", "recompiles_total": 1} for _ in range(6)]
+    rows += [{"kind": "pipeline_step", "pipeline_step": i,
+              "t_host_blocked_s": 0.02, "t_step_wall_s": 0.1,
+              "inflight": 2, "logged": i % 2 == 0} for i in range(6)]
+    path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    assert check(str(path), max_host_blocked_frac=0.5) == 0
+    assert check(str(path), max_host_blocked_frac=0.1) == 1  # frac = 0.2
+    # threshold given but no pipeline records -> explicit failure
+    bare = tmp_path / "bare.jsonl"
+    bare.write_text("\n".join(json.dumps(r) for r in rows[:6]) + "\n")
+    assert check(str(bare), max_host_blocked_frac=0.5) == 1
+    assert check(str(bare)) == 0

