@@ -7,7 +7,9 @@ prints one JSON line per model instead (mnist parity gate, resnet50,
 transformer NMT ragged path, BERT-base, DeepFM CTR).  `--pipeline` runs
 the serial-vs-overlapped loop A/B (paddle_tpu.pipeline.train_loop +
 Executor.run_async) and prints its own JSON line with both rates and
-host-blocked fractions.
+host-blocked fractions.  `--chaos` runs the resilient loop under a fixed
+injected fault schedule (paddle_tpu.faults) and reports throughput plus
+the recovery ledger — the robustness overhead as a number.
 
 vs_baseline: the reference published no numbers (BASELINE.md), so the
 absolute series is tracked across rounds; vs_baseline = this round's
@@ -334,10 +336,91 @@ def bench_pipeline(batch_size=128, steps=24, max_inflight=4, log_period=8,
             "max_inflight": max_inflight, "log_period": log_period}
 
 
+def bench_chaos(steps=48, batch_size=256, max_inflight=3,
+                fault_spec="bad_batch@5;nan@13;device@21:UNAVAILABLE;"
+                           "device@29:RESOURCE_EXHAUSTED"):
+    """Throughput under a fixed fault schedule: the same seeded MLP run
+    twice through `resilient_train_loop` — once clean, once with the
+    fault injector delivering one of each recoverable class — reporting
+    both rates, the recovery ledger, and the end-state parity check that
+    the chaos run's params match what the surviving batches should
+    produce.  The resilience overhead (snapshots + per-step resolution
+    under skip_step) is the metric: it is the price of not dying."""
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor
+    from tools.perf_report import retry_fraction
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data("x", [64], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h = fluid.layers.fc(x, 256, act="relu")
+        h = fluid.layers.fc(h, 256, act="relu")
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(h, 1), y))
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    startup.random_seed = main_p.random_seed = 7
+    rng = np.random.RandomState(0)
+    feeds = []
+    for _ in range(steps):
+        xv = rng.rand(batch_size, 64).astype("f4")
+        feeds.append({"x": xv, "y": xv.sum(1, keepdims=True)})
+
+    def run(injector, nan_mode):
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        t0 = _time.perf_counter()
+        stats = fluid.resilient_train_loop(
+            exe, main_p, lambda: list(feeds), [loss], scope=scope,
+            injector=injector, nan_mode=nan_mode,
+            policy=fluid.RetryPolicy(backoff_base_s=0.0),
+            max_inflight=max_inflight, log_period=8)
+        return stats, _time.perf_counter() - t0
+
+    run(None, "raise")  # warmup/compile outside both timing windows
+    monitor.enable()
+    clean_stats, clean_wall = run(None, "raise")
+    monitor.reset()  # recovery_frac must count the chaos run's steps only
+    chaos_stats, chaos_wall = run(fluid.FaultInjector(fault_spec),
+                                  "skip_step")
+    frac = retry_fraction(monitor.step_records())
+    monitor.disable()
+    clean_sps = clean_stats.steps / clean_wall
+    chaos_sps = chaos_stats.steps / chaos_wall
+    # expected committed steps: each bad batch and each skip_step'd NaN
+    # drops exactly one batch from the schedule; retries drop none
+    from paddle_tpu.faults import parse_fault_spec
+
+    dropped = sum(1 for f in parse_fault_spec(fault_spec)
+                  if f.kind in ("bad_batch", "nan"))
+    survived = bool(chaos_stats.steps == steps - dropped)
+    print(f"chaos: clean {clean_sps:.1f} steps/s, faulted {chaos_sps:.1f} "
+          f"steps/s (skipped {chaos_stats.skipped_batches} batches, "
+          f"{chaos_stats.skipped_steps} steps, {chaos_stats.retries} "
+          f"retries)", file=sys.stderr)
+    return {"metric": "chaos_train_steps_per_sec", "value": round(chaos_sps, 2),
+            "unit": "steps/sec", "clean_steps_per_sec": round(clean_sps, 2),
+            "chaos_overhead": round(1.0 - chaos_sps / clean_sps, 4)
+            if clean_sps else 0.0,
+            "fault_spec": fault_spec, "steps": chaos_stats.steps,
+            "survived": survived,
+            "skipped_batches": chaos_stats.skipped_batches,
+            "skipped_steps": chaos_stats.skipped_steps,
+            "retries": chaos_stats.retries,
+            "degraded_inflight": chaos_stats.degraded_inflight,
+            "final_max_inflight": chaos_stats.final_max_inflight,
+            "recovery_frac": round(frac, 4),
+            "batch_size": batch_size, "max_inflight": max_inflight}
+
+
 def main():
     per_model = "--per-model" in sys.argv
     if "--pipeline" in sys.argv:
         print(json.dumps(bench_pipeline()))
+        return
+    if "--chaos" in sys.argv:
+        print(json.dumps(bench_chaos()))
         return
     only = None
     for a in sys.argv[1:]:

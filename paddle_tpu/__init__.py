@@ -63,6 +63,12 @@ from . import profiler  # noqa: F401  (compat facade over monitor)
 from . import pipeline  # noqa: F401  (overlapped train_loop driver)
 from .pipeline import train_loop  # noqa: F401
 from .core.executor import FetchHandle  # noqa: F401
+from . import errors  # noqa: F401  (failure taxonomy: classify + classes)
+from . import faults  # noqa: F401  (deterministic fault injection)
+from . import resilience  # noqa: F401  (fault-tolerant train loop)
+from .faults import FaultInjector  # noqa: F401
+from .resilience import (RetryPolicy, ResilienceStats,  # noqa: F401
+                         resilient_train_loop)
 
 __version__ = "0.1.0"
 

@@ -5,16 +5,28 @@ save/load ops — no preemption handling.  TPU pods get preempted, so this
 is parity-plus: periodic sharded snapshots with atomic directory commit,
 keep-last-N rotation, a SIGTERM hook that flushes one final snapshot
 before the process dies, and `latest()`/`restore()` for resume.
+
+Hardening (ISSUE 3): `save` is guarded against signal re-entrancy (a
+SIGTERM arriving mid-save defers the flush until the in-progress save
+commits, instead of re-entering on the half-written .tmp dir), `restore`
+walks backwards past corrupt checkpoints to the newest valid one, and the
+scope's RNG key (`core.scope.RNG_STATE_VAR`) rides along in every
+snapshot so a resumed run replays the exact random stream — the property
+the resilience layer's rollback/resume parity tests pin.
 """
 from __future__ import annotations
 
+import logging
 import os
 import shutil
 import signal
-import time
 from typing import Optional
 
 from . import io as _io
+from .core.scope import RNG_STATE_VAR
+from .monitor import MONITOR as _MON
+
+log = logging.getLogger("paddle_tpu.checkpoint")
 
 
 class CheckpointManager:
@@ -28,28 +40,57 @@ class CheckpointManager:
         self.mesh = mesh
         self._step = 0
         self._prev_handlers = {}
+        self._saving = False
+        self._deferred_signal = None
         os.makedirs(root, exist_ok=True)
 
     # -- saving ------------------------------------------------------------
     def _dir(self, step: int) -> str:
         return os.path.join(self.root, f"ckpt-{step:010d}")
 
+    def _var_names(self, scope):
+        """Persistables plus the RNG key when the scope holds one, so a
+        restore rewinds the random stream too (None -> io's default when
+        no program is attached)."""
+        if self.program is None:
+            return None
+        names = [v.name for v in _io._persistables(self.program)]
+        if scope is not None and scope.find_var(RNG_STATE_VAR) is not None:
+            names.append(RNG_STATE_VAR)
+        return names
+
     def save(self, step: Optional[int] = None):
         """Atomic snapshot: write to a temp dir, rename into place (a
         preempted half-written save can never be mistaken for a valid
-        checkpoint), then rotate old ones."""
+        checkpoint), then rotate old ones.  Not interrupted by its own
+        preemption hook: a SIGTERM landing mid-save is deferred until this
+        save commits (re-entering would trash the .tmp dir under the
+        first writer)."""
         step = self._step if step is None else step
         final = self._dir(step)
         tmp = final + ".tmp"
-        if os.path.exists(tmp):
-            shutil.rmtree(tmp)
-        _io.save_sharded(tmp, scope=self.scope, program=self.program)
-        with open(os.path.join(tmp, "STEP"), "w") as f:
-            f.write(str(step))
-        if os.path.exists(final):
-            shutil.rmtree(final)
-        os.rename(tmp, final)
-        self._rotate()
+        self._saving = True
+        try:
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            with _MON.span("checkpoint.save", step=step):
+                _io.save_sharded(tmp, var_names=self._var_names(self.scope),
+                                 scope=self.scope, program=self.program)
+                with open(os.path.join(tmp, "STEP"), "w") as f:
+                    f.write(str(step))
+                if os.path.exists(final):
+                    shutil.rmtree(final)
+                os.rename(tmp, final)
+            self._rotate()
+            _MON.counter("checkpoint.saves").inc()
+        finally:
+            self._saving = False
+            deferred = self._deferred_signal
+            self._deferred_signal = None
+            if deferred is not None:
+                # replay the preemption notice whether or not this save
+                # committed — a failed save must not swallow a SIGTERM
+                self._on_preempt(*deferred)
         return final
 
     def _rotate(self):
@@ -65,15 +106,48 @@ class CheckpointManager:
         c = self.checkpoints()
         return os.path.join(self.root, c[-1]) if c else None
 
-    def restore(self, scope=None, mesh=None) -> Optional[int]:
-        """Load the newest snapshot; returns its step (None if none)."""
-        d = self.latest()
-        if d is None:
-            return None
-        _io.load_sharded(d, scope=scope or self.scope, mesh=mesh or self.mesh)
-        with open(os.path.join(d, "STEP")) as f:
-            self._step = int(f.read())
-        return self._step
+    def restore(self, scope=None, mesh=None,
+                max_step: Optional[int] = None) -> Optional[int]:
+        """Load the newest loadable snapshot; returns its step (None if
+        none exist).  A corrupt newest checkpoint (missing STEP,
+        unreadable shard, truncated manifest) is logged and skipped — the
+        walk continues backwards to the previous valid one instead of
+        killing the resume (exactly the moment a half-dead pod needs it
+        least).  Only raises when checkpoints exist but none load.
+
+        `max_step` bounds the walk: the resilience layer's rollback must
+        not restore a checkpoint taken AFTER the failing step (its state
+        already contains the poison it is rolling back from)."""
+        ckpts = self.checkpoints()
+        errors = []
+        for name in reversed(ckpts):
+            d = os.path.join(self.root, name)
+            try:
+                with open(os.path.join(d, "STEP")) as f:
+                    step = int(f.read())
+                if max_step is not None and step > max_step:
+                    continue
+                with _MON.span("checkpoint.restore", step=step):
+                    _io.load_sharded(d, scope=scope or self.scope,
+                                     mesh=mesh or self.mesh)
+            except Exception as e:
+                errors.append((name, e))
+                _MON.counter("checkpoint.restore_skipped").inc()
+                log.warning("checkpoint %s is unreadable (%s: %s); falling "
+                            "back to the previous one", d, type(e).__name__, e)
+                continue
+            self._step = step
+            if errors:
+                log.warning("restored %s after skipping %d corrupt "
+                            "checkpoint(s): %s", d, len(errors),
+                            [n for n, _ in errors])
+            return step
+        if errors:
+            raise RuntimeError(
+                f"no loadable checkpoint under {self.root}: all "
+                f"{len(errors)} candidates failed "
+                f"({[(n, str(e)) for n, e in errors]})")
+        return None
 
     # -- step-driven + preemption hooks ------------------------------------
     def step(self, n: int = 1):
@@ -83,17 +157,31 @@ class CheckpointManager:
             self.save()
         return self._step
 
-    def install_preemption_handler(self, signals=(signal.SIGTERM,)):
-        """On SIGTERM (the preemption notice), flush one final snapshot and
-        re-raise the previous handler's behavior."""
-        def handler(signum, frame):
+    def _on_preempt(self, signum, frame):
+        try:
             self.save()
+        finally:
+            # chain the previous handler's behavior even when the flush
+            # fails: the process was told to die, and eating the signal
+            # because the disk was full would leave it a zombie
             prev = self._prev_handlers.get(signum)
             if callable(prev):
                 prev(signum, frame)
             elif prev == signal.SIG_DFL:
                 signal.signal(signum, signal.SIG_DFL)
                 os.kill(os.getpid(), signum)
+
+    def install_preemption_handler(self, signals=(signal.SIGTERM,)):
+        """On SIGTERM (the preemption notice), flush one final snapshot and
+        re-raise the previous handler's behavior.  A notice that lands
+        while `save()` is mid-flight is deferred until that save commits
+        (then flushed and chained as usual) — the handler never re-enters
+        a half-written snapshot."""
+        def handler(signum, frame):
+            if self._saving:
+                self._deferred_signal = (signum, frame)
+                return
+            self._on_preempt(signum, frame)
 
         for sig in signals:
             self._prev_handlers[sig] = signal.getsignal(sig)

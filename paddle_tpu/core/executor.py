@@ -523,8 +523,19 @@ class _PendingFetches:
             Executor._check_nan_inf(names, vals)
             self._np = [np.asarray(v) for v in vals]
         except BaseException as e:
-            self._exc = e
-            raise
+            # route the in-flight failure through the taxonomy
+            # (paddle_tpu/errors.py): an XLA RESOURCE_EXHAUSTED /
+            # UNAVAILABLE surfacing at the blocking copy becomes a
+            # TransientDeviceError the resilient loop can retry; anything
+            # unmapped stays itself.  The classified error is the sticky
+            # one — every handle of the dispatch reports the same failure.
+            from ..errors import classify
+
+            ce = classify(e)
+            self._exc = ce
+            if ce is e:
+                raise
+            raise ce from e
         finally:
             # resolution is one-shot either way: drop the device buffers,
             # the staged feed, and the key so retained handles don't pin a
@@ -916,7 +927,13 @@ class Executor:
             for n in compiled.state_in_names:
                 v = scope.find_var(n)
                 if not isinstance(v, jax.Array):
-                    scope.set_var(n, jax.device_put(jnp.asarray(v), device))
+                    # owned copy, NOT device_put: on CPU, device_put can
+                    # alias the numpy buffer zero-copy, and rw state is
+                    # DONATED — XLA reusing/freeing memory the caller
+                    # (checkpoint snapshot, resilience restore) still
+                    # references corrupts it in place
+                    with jax.default_device(device):
+                        scope.set_var(n, jnp.array(v, copy=True))
         elif compiled.multiprocess:
             # Cross-process mesh: every process contributes its LOCAL slice
             # of batch-sharded feeds (reference: per-trainer data shards in
@@ -1018,6 +1035,7 @@ class Executor:
 
     @staticmethod
     def _check_nan_inf(fetch_names, fetches):
+        from ..errors import NumericError
         from ..flags import flag as _flag
 
         if not _flag("FLAGS_check_nan_inf"):
@@ -1025,7 +1043,9 @@ class Executor:
         for name, val in zip(fetch_names, fetches):
             arr = np.asarray(val)
             if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
-                raise RuntimeError(
+                # NumericError subclasses RuntimeError, so legacy callers
+                # catching the guard's historical type keep working
+                raise NumericError(
                     f"FLAGS_check_nan_inf: fetch {name!r} contains "
                     f"NaN/Inf (reference CheckTensorNANOrInf)")
 

@@ -1,0 +1,184 @@
+"""Failure taxonomy for fault-tolerant training.
+
+Long accelerator runs do not die from clean exits: they die from bad
+records, numeric blow-ups, transient XLA/runtime failures, and pod
+preemptions.  The reference runtime surfaced all of these as whatever
+exception the failing layer happened to raise; nothing downstream could
+tell "skip this batch" from "the program is miscompiled".  This module is
+the shared vocabulary the resilience layer (paddle_tpu/resilience.py)
+routes on:
+
+    DataError             a batch the input pipeline could not produce or
+                          parse — skippable within a budget
+    NumericError          the FLAGS_check_nan_inf guard tripped (NaN/Inf
+                          in a fetched value) — skippable / rollbackable
+    TransientDeviceError  runtime failure the next attempt may not see
+                          (XLA RESOURCE_EXHAUSTED / UNAVAILABLE / ...) —
+                          retryable with backoff
+    PreemptionError       the pod is going away — flush a checkpoint and
+                          exit resumable
+    FatalError            everything else — never retried
+
+Every class subclasses RuntimeError so legacy call sites catching
+RuntimeError (the NaN guard's historical type) keep working.
+
+`classify(exc)` maps an arbitrary exception onto this taxonomy, reading
+context breadcrumbs (`attach_context`) that the executor's sticky
+resolution errors, the pipeline's drain path, and the loader's producer
+thread leave on exceptions they forward.
+"""
+from __future__ import annotations
+
+__all__ = ["TrainingError", "DataError", "NumericError",
+           "TransientDeviceError", "PreemptionError", "FatalError",
+           "classify", "attach_context", "get_context"]
+
+from typing import Optional
+
+
+class TrainingError(RuntimeError):
+    """Base of the failure taxonomy.  Carries structured context — which
+    train step / raw batch / layer the failure belongs to — so recovery
+    can rewind to exactly the right point."""
+
+    def __init__(self, message: str, *, step: Optional[int] = None,
+                 batch_index: Optional[int] = None,
+                 phase: Optional[str] = None):
+        super().__init__(message)
+        self.step = step
+        self.batch_index = batch_index
+        self.phase = phase
+
+    def __str__(self):
+        base = super().__str__()
+        ctx = []
+        if self.step is not None:
+            ctx.append(f"step={self.step}")
+        if self.batch_index is not None:
+            ctx.append(f"batch={self.batch_index}")
+        if self.phase:
+            ctx.append(f"phase={self.phase}")
+        return f"{base} [{', '.join(ctx)}]" if ctx else base
+
+
+class DataError(TrainingError):
+    """The input pipeline failed to produce a batch (parse error, corrupt
+    record, injected bad batch).  Dropping the batch is sound; the
+    resilient loop does so within `RetryPolicy.max_bad_batches`."""
+
+
+class NumericError(TrainingError):
+    """NaN/Inf reached a fetched value (the FLAGS_check_nan_inf guard).
+    Since the step that produced it already wrote its (poisoned) update
+    into the scope, recovery needs state restore, not just retry — see
+    `resilient_train_loop`'s `nan_mode`."""
+
+
+class TransientDeviceError(TrainingError):
+    """Device/runtime failure a later attempt may not reproduce: XLA
+    RESOURCE_EXHAUSTED (HBM pressure), UNAVAILABLE / ABORTED (tunnel or
+    runtime hiccup), DEADLINE_EXCEEDED.  `resource_exhausted` marks the
+    OOM flavor so the resilient loop can also shed in-flight depth."""
+
+    def __init__(self, message: str, *, code: Optional[str] = None,
+                 resource_exhausted: bool = False, **kw):
+        super().__init__(message, **kw)
+        self.code = code
+        self.resource_exhausted = bool(resource_exhausted
+                                       or code == "RESOURCE_EXHAUSTED")
+
+
+class PreemptionError(TrainingError):
+    """The process received its preemption notice (SIGTERM on TPU pods).
+    Not an error to retry: flush a checkpoint, report where to resume."""
+
+
+class FatalError(TrainingError):
+    """Anything `classify` cannot place in a recoverable class: program
+    bugs, INVALID_ARGUMENT compiles, user-code exceptions.  The resilient
+    loop re-raises these untouched."""
+
+
+# XLA status codes whose failures are worth retrying.  INVALID_ARGUMENT /
+# INTERNAL / UNIMPLEMENTED are deliberately absent: those reproduce.
+_TRANSIENT_CODES = ("RESOURCE_EXHAUSTED", "UNAVAILABLE", "ABORTED",
+                    "DEADLINE_EXCEEDED", "CANCELLED")
+
+
+def attach_context(exc: BaseException, *, step: Optional[int] = None,
+                   batch_index: Optional[int] = None,
+                   phase: Optional[str] = None) -> BaseException:
+    """Leave step/batch/phase breadcrumbs on an exception without changing
+    its type (sticky errors must keep raising as themselves — pinned by
+    the loader's propagate-as-itself contract).  First writer wins per
+    key, so the layer closest to the failure names it."""
+    try:
+        ctx = exc.__dict__.setdefault("_pt_ctx", {})
+    except AttributeError:  # exceptions with __slots__ / C extensions
+        return exc
+    for k, v in (("step", step), ("batch_index", batch_index),
+                 ("phase", phase)):
+        if v is not None and ctx.get(k) is None:
+            ctx[k] = v
+    if isinstance(exc, TrainingError):
+        for k in ("step", "batch_index", "phase"):
+            if getattr(exc, k, None) is None and ctx.get(k) is not None:
+                setattr(exc, k, ctx[k])
+    return exc
+
+
+def get_context(exc: BaseException) -> dict:
+    """The breadcrumbs `attach_context` left (empty dict if none)."""
+    ctx = dict(getattr(exc, "_pt_ctx", None) or {})
+    if isinstance(exc, TrainingError):
+        for k in ("step", "batch_index", "phase"):
+            if ctx.get(k) is None and getattr(exc, k, None) is not None:
+                ctx[k] = getattr(exc, k)
+    return ctx
+
+
+def classify(exc: BaseException, wrap_unknown: bool = False) -> BaseException:
+    """Map an exception onto the taxonomy.
+
+    Returns the exception itself when it is already a `TrainingError` or
+    when no specific class applies (so sticky errors keep their original
+    type unless a mapping genuinely adds information).  With
+    `wrap_unknown=True` unmapped exceptions come back wrapped in
+    `FatalError` instead.  Mapped exceptions carry the original as
+    `__cause__` and inherit any attached step/batch context."""
+    if isinstance(exc, TrainingError):
+        return exc
+    ctx = get_context(exc)
+    kw = {"step": ctx.get("step"), "batch_index": ctx.get("batch_index"),
+          "phase": ctx.get("phase")}
+
+    def _wrap(cls, **extra):
+        e = cls(f"{type(exc).__name__}: {exc}", **kw, **extra)
+        e.__cause__ = exc
+        return e
+
+    # KeyboardInterrupt / SystemExit are control flow, never classified.
+    if not isinstance(exc, Exception):
+        return exc
+    msg = str(exc)
+    # XLA runtime failures (jaxlib XlaRuntimeError subclasses RuntimeError
+    # and spells its status code into the message) plus anything else that
+    # carries a status-code-shaped message.  Checked BEFORE the loader
+    # breadcrumb: an XLA RESOURCE_EXHAUSTED raised while the producer
+    # thread stages a batch is an HBM problem, not skippable data.
+    if isinstance(exc, (RuntimeError, OSError)):
+        for code in _TRANSIENT_CODES:
+            if code in msg:
+                kw.pop("phase", None)
+                return _wrap(TransientDeviceError, code=code, phase="device")
+    # Producer-thread breadcrumb: the loader marks exceptions raised while
+    # producing a batch, whatever their type (user generator bugs raise as
+    # themselves but recovery treats them as data failures).
+    if ctx.get("phase") == "loader":
+        return _wrap(DataError)
+    # The NaN/Inf guard's historical RuntimeError message.
+    if isinstance(exc, (RuntimeError, FloatingPointError)) and "NaN/Inf" in msg:
+        return _wrap(NumericError)
+    if wrap_unknown:
+        return _wrap(FatalError)
+    return exc

@@ -1,0 +1,172 @@
+"""Deterministic fault injection: the chaos harness that proves the
+resilience layer actually survives what it claims to survive.
+
+Large-scale-training lore says every recovery path you have not tested is
+broken; this module makes the four failure classes of errors.py
+reproducible on CPU in tier-1 tests.  A `FaultInjector` is driven by a
+schedule string (`FLAGS_fault_spec` or the constructor), every entry
+fires exactly once, and nothing here depends on wall time or real
+hardware — the same spec injects the same faults at the same points on
+every run.
+
+Spec grammar (entries separated by ';', whitespace ignored):
+
+    bad_batch@B           raw loader batch B raises DataError when pulled
+    nan@S                 the feed of train step S gets a planted NaN, so
+                          the real computation produces NaN and the
+                          FLAGS_check_nan_inf guard trips at resolution
+    device@S[:CODE]       dispatch of train step S raises
+                          TransientDeviceError (CODE defaults to
+                          UNAVAILABLE; RESOURCE_EXHAUSTED exercises the
+                          max_inflight degradation path)
+    preempt@S             dispatch of train step S delivers SIGTERM to
+                          this process (the real preemption notice, so
+                          the loop's deferred-flush handler is what gets
+                          tested)
+
+    e.g.  FLAGS_fault_spec="bad_batch@2;nan@5;device@7:RESOURCE_EXHAUSTED;preempt@11"
+
+`seed` only feeds the poison-value RNG; firing points are exact indices.
+The hooks (`on_batch`, `on_feed`, `on_dispatch`) are called by
+`resilient_train_loop`'s feed path and dispatch callback; they are cheap
+no-ops once every entry has fired.
+"""
+from __future__ import annotations
+
+__all__ = ["Fault", "FaultInjector", "parse_fault_spec"]
+
+import os
+import random
+import signal
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .errors import DataError, TransientDeviceError
+from .monitor import MONITOR as _MON
+
+_KINDS = ("bad_batch", "nan", "device", "preempt")
+
+
+@dataclass
+class Fault:
+    kind: str
+    at: int
+    arg: Optional[str] = None
+    fired: bool = False
+
+    def __str__(self):
+        s = f"{self.kind}@{self.at}"
+        return f"{s}:{self.arg}" if self.arg else s
+
+
+def parse_fault_spec(spec: str) -> List[Fault]:
+    faults = []
+    for raw in (spec or "").split(";"):
+        entry = raw.strip()
+        if not entry:
+            continue
+        kind, sep, rest = entry.partition("@")
+        kind = kind.strip()
+        if not sep or kind not in _KINDS:
+            raise ValueError(
+                f"fault spec entry {entry!r}: want kind@N[:arg] with kind in "
+                f"{_KINDS} (full spec {spec!r})")
+        at_s, _, arg = rest.partition(":")
+        try:
+            at = int(at_s)
+        except ValueError:
+            raise ValueError(f"fault spec entry {entry!r}: {at_s!r} is not "
+                             f"an integer index")
+        faults.append(Fault(kind, at, arg.strip() or None))
+    return faults
+
+
+class FaultInjector:
+    """Seeded, schedule-driven fault source.  One instance = one schedule;
+    construct fresh (or `reset()`) per run."""
+
+    def __init__(self, spec: str = "", seed: int = 0):
+        self.spec = spec
+        self.seed = seed
+        self.faults = parse_fault_spec(spec)
+        self._rng = random.Random(seed)
+
+    @staticmethod
+    def from_flags() -> Optional["FaultInjector"]:
+        """Build the injector `FLAGS_fault_spec` asks for (None when the
+        flag is empty — the production default)."""
+        from .flags import flag
+
+        spec = flag("FLAGS_fault_spec")
+        return FaultInjector(spec) if spec else None
+
+    def reset(self):
+        for f in self.faults:
+            f.fired = False
+        self._rng = random.Random(self.seed)
+        return self
+
+    def pending(self) -> List[Fault]:
+        return [f for f in self.faults if not f.fired]
+
+    def fired(self) -> List[Fault]:
+        return [f for f in self.faults if f.fired]
+
+    def summary(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.faults:
+            if f.fired:
+                out[f.kind] = out.get(f.kind, 0) + 1
+        return out
+
+    # -- hooks -------------------------------------------------------------
+    def _take(self, kind: str, at: int) -> Optional[Fault]:
+        for f in self.faults:
+            if f.kind == kind and f.at == at and not f.fired:
+                f.fired = True
+                _MON.counter(f"faults.{kind}").inc()
+                return f
+        return None
+
+    def on_batch(self, batch_index: int, feed):
+        """Called with every raw batch pulled from the loader; raises
+        DataError for a scheduled bad batch (simulating a record the
+        parser rejects)."""
+        if self._take("bad_batch", batch_index) is not None:
+            raise DataError(f"injected bad batch {batch_index}",
+                            batch_index=batch_index, phase="loader")
+        return feed
+
+    def on_feed(self, step: int, feed: dict) -> dict:
+        """Called with the feed about to become train step `step`; plants
+        a NaN in the first floating-point array so the NaN reaches the
+        loss through the real computation (not a mocked check)."""
+        if self._take("nan", step) is None:
+            return feed
+        feed = dict(feed)
+        for name in sorted(feed):
+            a = np.asarray(feed[name])
+            if np.issubdtype(a.dtype, np.floating) and a.size:
+                a = a.copy()
+                a.flat[self._rng.randrange(a.size)] = np.nan
+                feed[name] = a
+                break
+        else:
+            raise ValueError(f"nan@{step}: feed has no floating-point array "
+                             f"to poison (names: {sorted(feed)})")
+        return feed
+
+    def on_dispatch(self, step: int):
+        """Called just before train step `step` is dispatched; raises the
+        scheduled transient device error, or delivers a real SIGTERM (the
+        preemption notice) to this process."""
+        f = self._take("device", step)
+        if f is not None:
+            code = f.arg or "UNAVAILABLE"
+            raise TransientDeviceError(
+                f"injected device failure ({code}) at dispatch {step}",
+                code=code, step=step, phase="device")
+        if self._take("preempt", step) is not None:
+            os.kill(os.getpid(), signal.SIGTERM)

@@ -98,6 +98,13 @@ DEFINE_string("FLAGS_compile_cache_dir", "",
               "every process) is paid once per machine — the second process "
               "running the same program loads the compiled executable from "
               "disk.  Set before the first compile (env var or set_flags)")
+DEFINE_string("FLAGS_fault_spec", "",
+              "deterministic fault-injection schedule for chaos testing the "
+              "resilience layer (paddle_tpu/faults.py), e.g. "
+              "'bad_batch@2;nan@5;device@7:RESOURCE_EXHAUSTED;preempt@11'. "
+              "Each resilient_train_loop call builds one injector from the "
+              "spec; every entry fires exactly once per injector (so once "
+              "per call).  Empty (default) injects nothing")
 DEFINE_bool("FLAGS_cudnn_deterministic", True,
             "accepted no-op: XLA TPU lowerings are deterministic by default")
 DEFINE_float("FLAGS_fraction_of_gpu_memory_to_use", 1.0,

@@ -28,10 +28,11 @@ from __future__ import annotations
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Callable, Iterable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import errors as _errors
 from .monitor import MONITOR as _MON
 
 
@@ -65,6 +66,9 @@ def train_loop(
     log_period: int = 1,
     on_logged: Optional[Callable[[int, List[np.ndarray]], Any]] = None,
     max_steps: Optional[int] = None,
+    step_offset: int = 0,
+    on_dispatch: Optional[Callable[[int, Dict], Any]] = None,
+    resolve_all: bool = False,
 ) -> PipelineStats:
     """Drive a training program over `loader` with up to `max_inflight`
     steps dispatched ahead of resolution.
@@ -88,7 +92,20 @@ def train_loop(
     Note the skip trade-off: the FLAGS_check_nan_inf guard runs at
     resolution, so non-logged steps are not NaN-checked (steps with
     deferred host-eval side effects are always resolved; a NaN in the
-    params still surfaces at the next logged step's loss)."""
+    params still surfaces at the next logged step's loss).  Passing
+    `resolve_all=True` closes that window — every step pays the host
+    copy + guard, which is what the resilience layer's NaN modes need to
+    attribute a NaN to the exact step that produced it.
+
+    Resilience hooks: `step_offset` shifts step numbering (logging phase,
+    records, error context) so a restarted segment keeps GLOBAL step
+    indices; `on_dispatch(step, feed)` runs just before each dispatch
+    (snapshot/checkpoint/fault-injection point — an exception it raises
+    aborts the loop like any other).  Whenever the loop exits abnormally,
+    still-in-flight steps are waited on and discarded before the error
+    propagates, so abandoned handles never keep device buffers pinned;
+    errors raised while draining carry their step index
+    (`errors.get_context`)."""
     if not fetch_list:
         raise ValueError("train_loop needs a non-empty fetch_list (the "
                          "handles are also the pipeline's backpressure)")
@@ -111,13 +128,18 @@ def train_loop(
         # deferred host-eval ops (callback-less platforms) update scope
         # accumulators at resolution — those steps must resolve even when
         # they aren't logged, or the metric silently misses updates
-        must_resolve = want_log or handles[0].has_deferred_host_work
+        must_resolve = want_log or resolve_all or handles[0].has_deferred_host_work
         t_b0 = time.perf_counter()
         with _MON.span("pipeline.host_blocked", step=step_i, logged=want_log):
-            if must_resolve:
-                vals = [h.numpy() for h in handles]
-            else:
-                handles[0].wait()  # all handles share one pending dispatch
+            try:
+                if must_resolve:
+                    vals = [h.numpy() for h in handles]
+                else:
+                    handles[0].wait()  # all handles share one pending dispatch
+            except BaseException as e:
+                # a resolution failure (sticky NaN guard, XLA runtime
+                # error) belongs to THIS step; recovery rewinds to it
+                raise _errors.attach_context(e, step=step_i)
         now = time.perf_counter()
         stats.host_blocked_s += now - t_b0
         if _MON.enabled:
@@ -147,9 +169,17 @@ def train_loop(
                 break
             while len(inflight) >= max_inflight:
                 drain_one()
-            handles = exe.run_async(program, feed=feed,
-                                    fetch_list=fetch_list, scope=scope)
-            inflight.append((stats.steps, handles))
+            step_i = step_offset + stats.steps
+            if on_dispatch is not None:
+                on_dispatch(step_i, feed)
+            try:
+                handles = exe.run_async(program, feed=feed,
+                                        fetch_list=fetch_list, scope=scope)
+            except BaseException as e:
+                # a synchronous dispatch failure (compile/enqueue path)
+                # belongs to this step, same as a resolution failure
+                raise _errors.attach_context(e, step=step_i)
+            inflight.append((step_i, handles))
             stats.steps += 1
             stats.max_inflight_seen = max(stats.max_inflight_seen,
                                           len(inflight))
@@ -157,6 +187,17 @@ def train_loop(
         while inflight:
             drain_one()
     finally:
+        # abnormal exit: the remaining in-flight handles would otherwise
+        # be abandoned still pinning device buffers (donated inputs + a
+        # whole batch each).  wait() for execution and discard — values
+        # already landed in the scope at dispatch; resolution errors here
+        # are secondary to the one propagating.
+        while inflight:
+            _, handles = inflight.popleft()
+            try:
+                handles[0].wait()
+            except Exception:
+                pass
         gauge.set(0)
     stats.wall_s = time.perf_counter() - t_wall0
     return stats

@@ -91,18 +91,31 @@ def map_readers(func, *readers):
 
 
 def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
-    """Parallel map over a reader via worker threads (decorator.py xmap)."""
+    """Parallel map over a reader via worker threads (decorator.py xmap).
+
+    A mapper (or source-reader) exception must not strand the consumer: a
+    worker that died without posting its END sentinel used to leave the
+    consumer blocked on `out_q.get()` forever.  Workers now post the
+    exception itself (tagged with the sample index and a loader-phase
+    breadcrumb for errors.classify), and the consumer re-raises it."""
 
     def reader_():
         in_q: "queue.Queue" = queue.Queue(buffer_size)
         out_q: "queue.Queue" = queue.Queue(buffer_size)
         END = object()
+        ERR = object()
 
         def feed():
-            for i, sample in enumerate(reader()):
-                in_q.put((i, sample))
-            for _ in range(process_num):
-                in_q.put(END)
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.put((i, sample))
+            except BaseException as e:
+                from .errors import attach_context
+
+                out_q.put((ERR, attach_context(e, phase="loader")))
+            finally:
+                for _ in range(process_num):
+                    in_q.put(END)
 
         def work():
             while True:
@@ -111,7 +124,15 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                     out_q.put(END)
                     return
                 i, sample = s
-                out_q.put((i, mapper(sample)))
+                try:
+                    out_q.put((i, mapper(sample)))
+                except BaseException as e:
+                    from .errors import attach_context
+
+                    out_q.put((ERR, attach_context(e, batch_index=i,
+                                                   phase="loader")))
+                    out_q.put(END)  # this worker is done; keep END count right
+                    return
 
         threading.Thread(target=feed, daemon=True).start()
         workers = [threading.Thread(target=work, daemon=True) for _ in range(process_num)]
@@ -124,6 +145,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 if item is END:
                     done += 1
                     continue
+                if item[0] is ERR:
+                    raise item[1]
                 yield item[1]
             return
         pending = {}
@@ -133,6 +156,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
             if item is END:
                 done += 1
                 continue
+            if item[0] is ERR:
+                raise item[1]
             pending[item[0]] = item[1]
             while next_idx in pending:
                 yield pending.pop(next_idx)
@@ -291,6 +316,7 @@ class DataLoader:
             return False
 
         def produce():
+            produced = 0
             try:
                 for item in self._gen():
                     if stop.is_set():
@@ -313,8 +339,16 @@ class DataLoader:
                     _MON.counter("reader.bytes_staged").inc(nbytes)
                     if not _put(placed):
                         return
+                    produced += 1
             except BaseException as e:  # propagate to the consumer thread
-                _put(("__error__", e))
+                # still raised AS ITSELF in the consumer (original type +
+                # traceback, pinned by test_reader); the breadcrumb routes
+                # it through errors.classify as a DataError so the
+                # resilient loop knows it is a skippable data failure
+                from .errors import attach_context
+
+                _put(("__error__", attach_context(e, batch_index=produced,
+                                                  phase="loader")))
             finally:
                 _put(END)
 

@@ -1,0 +1,609 @@
+"""Fault-tolerant training: RetryPolicy + resilient_train_loop.
+
+Composes the pieces earlier PRs built — `CheckpointManager` (atomic
+snapshots, deferred SIGTERM flush), `Executor.run_async` (sticky
+in-flight errors), `pipeline.train_loop` (bounded overlap), the monitor —
+into one loop that survives the four real failure classes of
+`paddle_tpu/errors.py`:
+
+    DataError             drop the batch and pull the next, within
+                          `RetryPolicy.max_bad_batches`
+    NumericError          `nan_mode`: "raise" (default), "skip_step"
+                          (undo the poisoned update, drop that batch,
+                          continue), or "rollback" (restore the last
+                          checkpoint at or before the failure and replay)
+    TransientDeviceError  seeded-jitter exponential backoff + retry the
+                          same step; RESOURCE_EXHAUSTED additionally
+                          halves the in-flight depth (HBM pressure is the
+                          usual cause)
+    PreemptionError       flush one checkpoint with resume info and
+                          return gracefully (`stats.preempted`)
+    anything else         re-raised untouched
+
+Correctness under async dispatch: `run_async` writes a step's (still in
+flight) output buffers into the scope at DISPATCH time, so by the time a
+failure surfaces at resolution of step K, steps K+1..K+m already ran on
+poisoned state.  Recovery therefore restores state captured at the
+dispatch boundary of step K — either a host snapshot taken by the
+`on_dispatch` hook (skip_step / device retry; a bounded window of
+`max_inflight + 2` is retained) or a checkpoint (rollback / resume) — and
+re-feeds the affected batches from a bounded replay window, or from a
+rebuilt loader when the caller passed a factory.
+
+The robustness tax is explicit: NaN modes force per-step resolution
+(`resolve_all`) and state snapshots block on the previous step, trading
+overlap for recoverability.  `nan_mode="raise"` keeps the overlapped
+fast path (snapshots still serialize dispatch when device retries are
+enabled; pass `snapshot_state=False` to opt out of those too).
+
+Step numbering is GLOBAL across recoveries: a step index names one
+committed optimizer step, so a skip_step run's params are bit-identical
+to a fault-free run over the surviving batches, and a rollback/resume
+run's params are bit-identical to an uninterrupted run (the RNG key rides
+in snapshots and checkpoints).
+
+Monitor surface: `resilience.skipped_batches / skipped_steps / retries /
+rollbacks / degraded_inflight / preemptions` counters, `resilience.
+snapshot / recover / backoff` spans, one `kind="resilience_event"` record
+per recovery action (rendered and CI-gated by `tools/perf_report.py
+--check --max-retry-frac`).
+"""
+from __future__ import annotations
+
+__all__ = ["RetryPolicy", "ResilienceStats", "resilient_train_loop",
+           "RESUME_FILE"]
+
+import json
+import logging
+import os
+import random
+import signal as _signal
+import threading
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass, field as _field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import errors as _errors
+from . import pipeline as _pipeline
+from .errors import (DataError, NumericError, PreemptionError,
+                     TrainingError, TransientDeviceError)
+from .monitor import MONITOR as _MON
+
+RESUME_FILE = "RESUME.json"
+
+_log = logging.getLogger("paddle_tpu.resilience")
+
+
+@dataclass
+class RetryPolicy:
+    """Per-class recovery budgets + seeded backoff.  Budgets are totals
+    for one `resilient_train_loop` call; exhausting one re-raises the
+    classified error.  Backoff is exponential with deterministic jitter
+    (seeded, so chaos tests replay identical schedules)."""
+
+    max_bad_batches: int = 8
+    max_skipped_steps: int = 4
+    max_rollbacks: int = 2
+    max_device_retries: int = 3
+    backoff_base_s: float = 0.05
+    backoff_factor: float = 2.0
+    backoff_jitter: float = 0.1
+    seed: int = 0
+
+    def backoff_s(self, attempt: int) -> float:
+        """Delay before retry number `attempt` (0-based)."""
+        base = self.backoff_base_s * (self.backoff_factor ** attempt)
+        if base <= 0:
+            return 0.0
+        r = random.Random(self.seed * 1_000_003 + attempt)
+        return base * (1.0 + self.backoff_jitter * (2.0 * r.random() - 1.0))
+
+
+@dataclass
+class ResilienceStats:
+    """What `resilient_train_loop` hands back: the PipelineStats-style
+    aggregates plus the recovery ledger."""
+
+    steps: int = 0
+    logged: List[Tuple[int, List[np.ndarray]]] = _field(default_factory=list)
+    wall_s: float = 0.0
+    preempted: bool = False
+    resume_step: Optional[int] = None
+    checkpoint_dir: Optional[str] = None
+    skipped_batches: int = 0
+    skipped_steps: int = 0
+    retries: int = 0
+    rollbacks: int = 0
+    degraded_inflight: int = 0
+    final_max_inflight: int = 0
+    segments: int = 0
+
+
+def _snapshot_scope(scope) -> Dict[str, Any]:
+    """Host copy of every scope-local var (params, accumulators, RNG key).
+    np.asarray blocks until in-flight values land, so a snapshot taken at
+    a dispatch boundary is exactly `state after the steps dispatched so
+    far` — the only consistent cut an async pipeline has."""
+    snap = {}
+    for name in scope.local_var_names():
+        v = scope.find_var(name)
+        try:
+            snap[name] = np.asarray(v).copy()
+        except Exception:
+            snap[name] = v  # non-array odds and ends: keep the reference
+    return snap
+
+
+def _restore_scope(scope, snap: Dict[str, Any]):
+    for name, v in snap.items():
+        # hand the scope a PRIVATE copy: on CPU, jax.device_put can alias
+        # a numpy buffer zero-copy, and the executor donates state buffers
+        # to XLA — donating memory the snapshot (or the caller's ref run)
+        # still references corrupts it in place
+        scope.set_var(name, v.copy() if isinstance(v, np.ndarray) else v)
+
+
+def _event(action: str, cls: str, step=None, batch=None, **extra):
+    if not _MON.enabled:
+        return
+    rec = {"kind": "resilience_event", "action": action, "class": cls}
+    if step is not None:
+        rec["at_step"] = step
+    if batch is not None:
+        rec["at_batch"] = batch
+    rec.update(extra)
+    _MON.record_step(rec)
+
+
+def resilient_train_loop(
+    exe,
+    program,
+    loader,
+    fetch_list: Sequence,
+    scope=None,
+    *,
+    policy: Optional[RetryPolicy] = None,
+    nan_mode: str = "raise",
+    checkpoint_manager=None,
+    resume: bool = False,
+    injector=None,
+    max_inflight: int = 2,
+    log_period: int = 1,
+    on_logged: Optional[Callable[[int, List[np.ndarray]], Any]] = None,
+    max_steps: Optional[int] = None,
+    snapshot_state: bool = True,
+) -> ResilienceStats:
+    """Drive `pipeline.train_loop` under a supervision loop that survives
+    classified failures.
+
+        cm = fluid.CheckpointManager(root, program=main, scope=scope,
+                                     save_every_steps=50)
+        stats = fluid.resilient_train_loop(
+            exe, main, lambda: make_loader(), [loss], scope=scope,
+            policy=fluid.RetryPolicy(max_device_retries=3),
+            nan_mode="skip_step", checkpoint_manager=cm)
+        if stats.preempted:
+            ...exit; the next process passes resume=True and continues...
+
+    `loader` is an iterable of feed dicts or (preferred) a zero-arg
+    callable returning a fresh one.  The callable form is REQUIRED for
+    `nan_mode="rollback"` and for `resume=True` — both must rewind the
+    data stream further back than the bounded replay window reaches — and
+    the stream must be deterministic (same batches in the same order each
+    call; seeded shuffles qualify).
+
+    `checkpoint_manager` enables rollback, periodic dispatch-boundary
+    saves (every `cm.save_every_steps` steps; each checkpoint includes the
+    RNG key and a RESUME.json recording the data-stream position), and
+    the preemption flush.  `resume=True` restores the newest valid
+    checkpoint into `scope` and fast-forwards the loader before training.
+
+    `injector` (paddle_tpu/faults.py) threads a deterministic fault
+    schedule through the loop; defaults to `FaultInjector.from_flags()`
+    so `FLAGS_fault_spec=...` chaos-tests any entry point that reaches
+    this loop.  SIGTERM (real or injected) is latched by a handler and
+    honored at the next dispatch boundary: in-flight steps drain, one
+    checkpoint flushes, and the loop returns with `stats.preempted=True`
+    and `stats.resume_step`."""
+    policy = policy or RetryPolicy()
+    if nan_mode not in ("raise", "skip_step", "rollback"):
+        raise ValueError(f"nan_mode must be raise | skip_step | rollback, "
+                         f"got {nan_mode!r}")
+    factory = loader if callable(loader) else None
+    cm = checkpoint_manager
+    if nan_mode == "rollback" and cm is None:
+        raise ValueError("nan_mode='rollback' needs a checkpoint_manager")
+    if nan_mode == "rollback" and factory is None:
+        raise ValueError("nan_mode='rollback' needs `loader` to be a "
+                         "zero-arg factory (the replay must rewind the "
+                         "data stream past the in-flight window)")
+    if resume and (cm is None or factory is None):
+        raise ValueError("resume=True needs a checkpoint_manager and a "
+                         "loader factory")
+    if nan_mode == "skip_step" and not snapshot_state:
+        raise ValueError("nan_mode='skip_step' undoes the poisoned update "
+                         "from a dispatch-boundary snapshot; it cannot run "
+                         "with snapshot_state=False (use nan_mode='rollback' "
+                         "with a checkpoint_manager, or 'raise')")
+    if injector is None:
+        from .faults import FaultInjector
+
+        injector = FaultInjector.from_flags()
+    if scope is None:
+        from .core.scope import global_scope
+
+        scope = global_scope()
+    if cm is not None and cm.scope is None:
+        cm.scope = scope
+
+    stats = ResilienceStats()
+    eff_inflight = max_inflight
+    window = max_inflight + 2
+    snapshots_on = snapshot_state and (
+        nan_mode == "skip_step" or policy.max_device_retries > 0)
+    resolve_all = nan_mode != "raise"
+
+    # ---- data cursor: one pass + bounded replay --------------------------
+    it_box: Dict[str, Any] = {"it": None}
+    consumed = 0                     # raw batches pulled from the source
+    replay: "OrderedDict[int, dict]" = OrderedDict()    # batch idx -> feed
+    pending: deque = deque()         # (batch idx, feed) queued for re-feed
+    skipped_raw: set = set()         # raw batch indices dropped as bad
+    stream = {"suspect": False}      # a producer-side error likely killed it
+    step_batch: Dict[int, int] = {}  # global step -> raw batch idx it used
+    snaps: "OrderedDict[int, dict]" = OrderedDict()     # step -> state snap
+    start_step = 0                   # global step the next segment starts at
+    preempt = {"hit": False}
+
+    def _fresh_iter():
+        return iter(factory() if factory is not None else loader)
+
+    def _pull_raw():
+        nonlocal consumed
+        bi = consumed
+        try:
+            feed = next(it_box["it"])
+        except StopIteration:
+            raise
+        except BaseException as e:
+            raise _errors.attach_context(e, batch_index=bi)
+        consumed += 1
+        if injector is not None:
+            injector.on_batch(bi, feed)  # may raise DataError
+        return bi, feed
+
+    def _next_good_batch():
+        """Pull until a batch survives, spending the bad-batch budget."""
+        while True:
+            try:
+                out = _pull_raw()
+                stream["suspect"] = False  # it survived: not dead after all
+                return out
+            except StopIteration:
+                raise
+            except BaseException as e:
+                ce = _errors.classify(e)
+                if not isinstance(ce, DataError):
+                    raise
+                if stats.skipped_batches >= policy.max_bad_batches:
+                    # budget exhausted: terminal — surface the DataError
+                    if ce is e:
+                        raise
+                    raise ce from e
+                stats.skipped_batches += 1
+                if ce.batch_index is not None and ce.batch_index < consumed:
+                    skipped_raw.add(ce.batch_index)
+                else:
+                    # the pull itself failed (producer thread / generator
+                    # frame) — most iterators are dead after raising, so
+                    # the next pull's StopIteration may be an early end,
+                    # not a real end of data
+                    stream["suspect"] = True
+                _MON.counter("resilience.skipped_batches").inc()
+                _event("skip_batch", "DataError", batch=ce.batch_index)
+
+    def _segment_feeds(seg_start: int):
+        """Feeds for one train_loop attempt: replayed batches first, then
+        fresh pulls; records the step->batch mapping and applies the NaN
+        injection for the step each feed is about to become."""
+        step = seg_start
+        while True:
+            if pending:
+                bi, feed = pending.popleft()
+            else:
+                try:
+                    bi, feed = _next_good_batch()
+                except StopIteration:
+                    if stream["suspect"]:
+                        # skipped a producer-side failure and the stream
+                        # ended right after: almost certainly the iterator
+                        # died mid-run, not a genuine end of data — say so
+                        # instead of "completing" short silently
+                        _log.warning(
+                            "resilience: data stream ended at batch %d "
+                            "immediately after a producer-side error was "
+                            "skipped — the iterator likely died mid-run; "
+                            "the run is ending early, not at end-of-data",
+                            consumed)
+                        _MON.counter("resilience.stream_died").inc()
+                        _event("stream_died", "DataError", batch=consumed)
+                    return
+            replay[bi] = feed
+            while len(replay) > window:
+                replay.popitem(last=False)
+            step_batch[step] = bi
+            if len(step_batch) > 8 * window:
+                # only entries near the in-flight window are read at
+                # recovery (rollback/resume fall back to RESUME.json);
+                # prune so a long run doesn't leak one entry per step
+                for s in [s for s in step_batch if s < step - 2 * window]:
+                    del step_batch[s]
+            if injector is not None:
+                feed = injector.on_feed(step, feed)
+            yield feed
+            step += 1
+
+    def _flush_checkpoint(step: int) -> str:
+        """Dispatch-boundary save: scope == state after `step` steps (the
+        save's host copies block on anything still in flight).  RESUME.json
+        records where the data stream stands so resume can fast-forward."""
+        cm._step = step
+        d = cm.save(step=step)
+        with open(os.path.join(d, RESUME_FILE), "w") as f:
+            json.dump({"step": step,
+                       "next_batch": step_batch.get(step, consumed),
+                       "skipped_batches": stats.skipped_batches}, f)
+        return d
+
+    def _on_dispatch(step: int, feed):
+        time.sleep(0)  # let a just-delivered SIGTERM reach the handler
+        if preempt["hit"]:
+            raise PreemptionError("preemption notice received",
+                                  step=step, phase="dispatch")
+        if injector is not None:
+            injector.on_dispatch(step)  # may raise / deliver SIGTERM
+            time.sleep(0)
+            if preempt["hit"]:
+                raise PreemptionError("preemption notice received",
+                                      step=step, phase="dispatch")
+        if (cm is not None and cm.save_every_steps and step > 0
+                and step % cm.save_every_steps == 0 and cm._step != step):
+            _flush_checkpoint(step)
+        if snapshots_on:
+            with _MON.span("resilience.snapshot", step=step):
+                snaps[step] = _snapshot_scope(scope)
+            while len(snaps) > window:
+                snaps.popitem(last=False)
+
+    def _queue_replay_from(batch_idx: int):
+        """Re-feed raw batches [batch_idx, consumed) from the replay
+        window (they belong to steps that are being redone).  Batches the
+        loader already dropped as bad leave holes in the range — those
+        stay dropped; only a batch that is neither replayable nor known
+        to be skipped means the window failed to cover the in-flight
+        depth."""
+        pending.clear()
+        missing = [bi for bi in range(batch_idx, consumed)
+                   if bi not in replay and bi not in skipped_raw]
+        if missing:
+            raise RuntimeError(
+                f"resilience: replay window lost batches {missing} "
+                f"(window={window}); the window must cover the in-flight "
+                f"depth — this is a bug")
+        for bi in range(batch_idx, consumed):
+            if bi in replay:
+                pending.append((bi, replay[bi]))
+
+    def _rewind_source_to(batch_idx: int):
+        """Rebuild the loader from the factory and fast-forward so the
+        next raw pull is `batch_idx` (rollback/resume reach further back
+        than the replay window)."""
+        nonlocal consumed
+        if factory is None:
+            raise RuntimeError(
+                "resilience: recovery needs to rewind the data stream to "
+                f"batch {batch_idx}, but `loader` is a bare iterable — "
+                "pass a zero-arg factory")
+        pending.clear()
+        replay.clear()
+        it_box["it"] = _fresh_iter()
+        consumed = 0
+        while consumed < batch_idx:
+            try:
+                next(it_box["it"])
+            except StopIteration:
+                raise RuntimeError(
+                    f"resilience: loader exhausted at batch {consumed} while "
+                    f"fast-forwarding to {batch_idx} — the factory must "
+                    f"replay the same deterministic stream")
+            consumed += 1
+
+    def _reraise(ce, orig):
+        if ce is orig:
+            raise ce
+        raise ce from orig
+
+    def _recover(e: BaseException) -> str:
+        """Route one classified failure; returns "continue" (another
+        segment) or "preempted" (graceful exit), re-raises otherwise."""
+        nonlocal eff_inflight, start_step
+        ce = _errors.classify(e)
+        if isinstance(ce, PreemptionError):
+            step = ce.step if ce.step is not None else start_step
+            stats.preempted = True
+            stats.resume_step = step
+            _MON.counter("resilience.preemptions").inc()
+            if cm is not None:
+                with _MON.span("resilience.recover", action="preempt_flush"):
+                    stats.checkpoint_dir = _flush_checkpoint(step)
+            _event("preempt_flush", "PreemptionError", step=step,
+                   checkpoint=stats.checkpoint_dir)
+            start_step = step
+            return "preempted"
+        if not isinstance(ce, TrainingError) or isinstance(ce, DataError):
+            # unmapped exceptions and FatalError are never retried;
+            # a DataError escaping the feed path means budget exhausted
+            _reraise(ce, e)
+        step = ce.step if ce.step is not None else \
+            _errors.get_context(e).get("step")
+        if isinstance(ce, NumericError):
+            if nan_mode == "raise" or step is None:
+                _reraise(ce, e)
+            if nan_mode == "skip_step":
+                if stats.skipped_steps >= policy.max_skipped_steps:
+                    _reraise(ce, e)
+                snap = snaps.get(step)
+                if snap is None:
+                    _reraise(ce, e)
+                with _MON.span("resilience.recover", action="skip_step",
+                               step=step):
+                    _restore_scope(scope, snap)
+                    _queue_replay_from(step_batch[step] + 1)
+                snaps.clear()
+                stats.skipped_steps += 1
+                _MON.counter("resilience.skipped_steps").inc()
+                _event("skip_step", "NumericError", step=step,
+                       batch=step_batch.get(step))
+                start_step = step
+                return "continue"
+            # rollback
+            if stats.rollbacks >= policy.max_rollbacks:
+                _reraise(ce, e)
+            with _MON.span("resilience.recover", action="rollback",
+                           step=step):
+                restored = cm.restore(scope=scope, max_step=step)
+                if restored is None:
+                    _reraise(ce, e)  # nothing at or before the failure
+                bi = step_batch.get(restored)
+                if bi is None:  # checkpoint predates this process: sidecar
+                    try:
+                        with open(os.path.join(cm._dir(restored),
+                                               RESUME_FILE)) as f:
+                            bi = int(json.load(f).get("next_batch", restored))
+                    except OSError:
+                        bi = restored + stats.skipped_batches
+                _rewind_source_to(bi)
+            snaps.clear()
+            stats.rollbacks += 1
+            _MON.counter("resilience.rollbacks").inc()
+            _event("rollback", "NumericError", step=step,
+                   restored_step=restored)
+            start_step = restored
+            return "continue"
+        if isinstance(ce, TransientDeviceError):
+            if stats.retries >= policy.max_device_retries or step is None:
+                _reraise(ce, e)
+            delay = policy.backoff_s(stats.retries)
+            if delay > 0:
+                with _MON.span("resilience.backoff", attempt=stats.retries):
+                    time.sleep(delay)
+            if ce.resource_exhausted and eff_inflight > 1:
+                eff_inflight = max(1, eff_inflight // 2)
+                stats.degraded_inflight += 1
+                _MON.counter("resilience.degraded_inflight").inc()
+                _MON.gauge("resilience.max_inflight").set(eff_inflight)
+                _event("degrade_inflight", "TransientDeviceError", step=step,
+                       max_inflight=eff_inflight)
+            with _MON.span("resilience.recover", action="retry", step=step):
+                snap = snaps.get(step)
+                if snap is not None:
+                    # resolution-time failure: later steps already ran on
+                    # this state; rewind to the dispatch boundary of `step`
+                    _restore_scope(scope, snap)
+                _queue_replay_from(step_batch[step])  # retry the same batch
+            snaps.clear()
+            stats.retries += 1
+            _MON.counter("resilience.retries").inc()
+            _event("retry", "TransientDeviceError", step=step,
+                   code=ce.code)
+            start_step = step
+            return "continue"
+        _reraise(ce, e)
+
+    # ---- SIGTERM latch ---------------------------------------------------
+    prev_handler = None
+    installed = False
+    if threading.current_thread() is threading.main_thread():
+        prev_handler = _signal.getsignal(_signal.SIGTERM)
+        _signal.signal(_signal.SIGTERM, lambda s, f: preempt.update(hit=True))
+        installed = True
+
+    nan_check_prev = None
+    if resolve_all:
+        # can't skip/rollback a NaN the guard never sees: force the guard
+        # on (and per-step resolution) for the duration
+        from .flags import get_flags, set_flags
+
+        nan_check_prev = get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]
+        set_flags({"FLAGS_check_nan_inf": True})
+
+    t0 = time.perf_counter()
+    try:
+        if resume:
+            restored = cm.restore(scope=scope)
+            if restored is not None:
+                start_step = restored
+                info = {}
+                try:
+                    # from the RESTORED checkpoint's dir, not latest():
+                    # restore may have walked past a corrupt newer one
+                    # whose sidecar would misalign the data stream
+                    with open(os.path.join(cm._dir(restored),
+                                           RESUME_FILE)) as f:
+                        info = json.load(f)
+                except OSError:
+                    pass
+                stats.skipped_batches = int(info.get("skipped_batches", 0))
+                _rewind_source_to(int(info.get("next_batch", restored)))
+                _event("resume", "PreemptionError", step=restored)
+            else:
+                it_box["it"] = _fresh_iter()
+        else:
+            it_box["it"] = _fresh_iter()
+
+        while True:
+            stats.segments += 1
+            seg_start = start_step
+            remaining = None if max_steps is None else max_steps - seg_start
+            if remaining is not None and remaining <= 0:
+                break
+            collect = (on_logged if on_logged is not None
+                       else lambda s, v: stats.logged.append((s, v)))
+            try:
+                seg = _pipeline.train_loop(
+                    exe, program, _segment_feeds(seg_start), fetch_list,
+                    scope=scope, max_inflight=eff_inflight,
+                    log_period=log_period, on_logged=collect,
+                    max_steps=remaining, step_offset=seg_start,
+                    on_dispatch=_on_dispatch, resolve_all=resolve_all)
+            except BaseException as e:
+                if _recover(e) == "preempted":
+                    break
+                continue
+            start_step = seg_start + seg.steps
+            # a SIGTERM that landed after the last dispatch (tail drain,
+            # loader exhausted) was latched but never hit a dispatch
+            # boundary — honor it here or the notice is silently dropped
+            if preempt["hit"]:
+                stats.preempted = True
+                stats.resume_step = start_step
+                _MON.counter("resilience.preemptions").inc()
+                if cm is not None:
+                    stats.checkpoint_dir = _flush_checkpoint(start_step)
+                _event("preempt_flush", "PreemptionError", step=start_step,
+                       checkpoint=stats.checkpoint_dir)
+            break
+        stats.steps = start_step
+        stats.final_max_inflight = eff_inflight
+        return stats
+    finally:
+        stats.wall_s = time.perf_counter() - t0
+        if installed:
+            _signal.signal(_signal.SIGTERM, prev_handler)
+        if nan_check_prev is not None:
+            from .flags import set_flags
+
+            set_flags({"FLAGS_check_nan_inf": nan_check_prev})

@@ -207,3 +207,70 @@ def test_perf_report_host_blocked_gate(tmp_path):
     bare.write_text("\n".join(json.dumps(r) for r in rows[:6]) + "\n")
     assert check(str(bare), max_host_blocked_frac=0.5) == 1
     assert check(str(bare)) == 0
+
+
+def test_train_loop_drains_inflight_on_error():
+    """If a drain raises mid-loop, the remaining in-flight handles must be
+    waited on and discarded — not abandoned pinning device buffers — and
+    the error must carry the failing step's index (ISSUE 3 satellite)."""
+    from paddle_tpu.errors import NumericError, get_context
+
+    main, startup, loss = _build_sgd_program()
+    feeds = _feed_seq(8)
+    feeds[2]["x"] = np.full_like(feeds[2]["x"], np.nan)  # poison step 2
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    monitor.reset()
+    monitor.enable()
+    try:
+        with pytest.raises(NumericError, match="NaN/Inf") as ei:
+            fluid.train_loop(exe, main, iter(feeds), [loss], scope=scope,
+                             max_inflight=3, log_period=1)
+    finally:
+        monitor.disable()
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+    assert get_context(ei.value)["step"] == 2
+    # nothing left in flight: the finally drained the abandoned handles
+    assert monitor.gauge("pipeline.inflight").read() == 0
+    # the executor/scope stay usable after the abort (params carry the
+    # poison — recovery is the resilience layer's job — but runs succeed)
+    (ok,) = exe.run(main, feed=_feed_seq(1)[0], fetch_list=[loss], scope=scope)
+    assert ok.shape == (1,)
+
+
+def test_train_loop_step_offset_and_dispatch_hook():
+    """step_offset shifts logging phase and indices to GLOBAL numbering
+    (what resilient segments rely on); on_dispatch fires before each
+    dispatch with the feed."""
+    main, startup, loss = _build_sgd_program()
+    feeds = _feed_seq(6)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    seen = []
+    stats = fluid.train_loop(exe, main, iter(feeds), [loss], scope=scope,
+                             max_inflight=2, log_period=4, step_offset=10,
+                             on_dispatch=lambda s, f: seen.append(s))
+    assert stats.steps == 6
+    assert seen == [10, 11, 12, 13, 14, 15]
+    assert [s for s, _ in stats.logged] == [12]  # global 12 % 4 == 0
+
+
+def test_dispatch_time_error_carries_step_context():
+    """An exception raised synchronously inside run_async (compile/enqueue
+    path) must carry the step index, same as resolution failures — the
+    resilience layer's retry attribution depends on it."""
+    from paddle_tpu.errors import get_context
+
+    main, startup, loss = _build_sgd_program()
+    feeds = _feed_seq(4)
+    feeds[2] = {"x": feeds[2]["x"][:, :2], "y": feeds[2]["y"]}  # bad shape
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    with pytest.raises(Exception) as ei:
+        fluid.train_loop(exe, main, iter(feeds), [loss], scope=scope,
+                         max_inflight=2)
+    assert get_context(ei.value)["step"] == 2

@@ -77,3 +77,113 @@ def test_half_written_save_is_ignored(tmp_path):
     cm.save(step=1)
     os.makedirs(str(tmp_path / "ckpt-0000000002.tmp"))  # crashed mid-save
     assert cm.latest().endswith("ckpt-0000000001")
+
+
+def test_save_not_reentrant_under_sigterm(tmp_path, monkeypatch):
+    """A preemption notice landing mid-save() must not re-enter save on
+    the half-written .tmp dir: the flush is deferred until the current
+    save commits, then runs (ISSUE 3 satellite)."""
+    from paddle_tpu import io as _io
+
+    main, startup, loss = _model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    cm = CheckpointManager(str(tmp_path), program=main, scope=scope)
+    cm._step = 7
+    depth = {"n": 0, "max": 0, "signals": 0}
+    real_save = _io.save_sharded
+
+    def save_with_signal(*a, **kw):
+        depth["n"] += 1
+        depth["max"] = max(depth["max"], depth["n"])
+        if depth["signals"] == 0:
+            depth["signals"] += 1
+            os.kill(os.getpid(), signal.SIGUSR1)  # preemption mid-save
+        try:
+            return real_save(*a, **kw)
+        finally:
+            depth["n"] -= 1
+
+    monkeypatch.setattr(_io, "save_sharded", save_with_signal)
+    hits = []
+    old = signal.signal(signal.SIGUSR1, lambda *a: hits.append(a))
+    try:
+        cm.install_preemption_handler(signals=(signal.SIGUSR1,))
+        cm.save()
+    finally:
+        cm.uninstall_preemption_handler()
+        signal.signal(signal.SIGUSR1, old)
+    # never re-entered; the deferred flush ran as a SECOND, serial save
+    # and chained the previous handler (the re-raise contract)
+    assert depth["max"] == 1 and depth["signals"] == 1
+    assert hits
+    assert cm.checkpoints() == ["ckpt-0000000007"]
+    assert not any(d.endswith(".tmp") for d in os.listdir(str(tmp_path)))
+    # the committed checkpoint restores fine
+    scope2 = fluid.Scope()
+    exe.run(startup, scope=scope2)
+    assert CheckpointManager(str(tmp_path), program=main,
+                             scope=scope2).restore(scope=scope2) == 7
+
+
+def test_restore_walks_past_corrupt_newest(tmp_path, caplog):
+    """A corrupt newest checkpoint (missing STEP, unreadable shard) must
+    not kill the resume: restore falls back to the previous valid one and
+    logs what it skipped (ISSUE 3 satellite)."""
+    import logging
+
+    main, startup, loss = _model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    cm = CheckpointManager(str(tmp_path), program=main, scope=scope)
+    cm.save(step=1)
+    good = {v.name: np.asarray(scope.find_var(v.name)).copy()
+            for v in main.all_parameters()}
+    exe.run(main, feed={"x": np.ones((4, 4), "f4"), "y": np.ones((4, 1), "f4")},
+            fetch_list=[loss], scope=scope)
+    cm.save(step=2)
+    cm.save(step=3)
+    os.remove(os.path.join(str(tmp_path), "ckpt-0000000003", "STEP"))
+    manifest = os.path.join(str(tmp_path), "ckpt-0000000002",
+                            "__sharded_manifest__.json")
+    with open(manifest, "w") as f:
+        f.write("{ truncated")
+
+    scope2 = fluid.Scope()
+    exe.run(startup, scope=scope2)
+    cm2 = CheckpointManager(str(tmp_path), program=main, scope=scope2)
+    with caplog.at_level(logging.WARNING, logger="paddle_tpu.checkpoint"):
+        step = cm2.restore(scope=scope2)
+    assert step == 1
+    assert "falling back" in caplog.text
+    for n, v in good.items():
+        np.testing.assert_array_equal(np.asarray(scope2.find_var(n)), v)
+
+    # every candidate corrupt -> explicit error, not a silent None
+    os.remove(os.path.join(str(tmp_path), "ckpt-0000000001", "STEP"))
+    import pytest
+    with pytest.raises(RuntimeError, match="no loadable checkpoint"):
+        cm2.restore(scope=scope2)
+    # max_step bounds the walk (rollback must not grab a later snapshot)
+    assert CheckpointManager(str(tmp_path / "empty")).restore() is None
+
+
+def test_checkpoint_carries_rng_state(tmp_path):
+    """The scope's RNG key rides along in snapshots, so a restored run
+    replays the exact random stream (rollback/resume determinism)."""
+    from paddle_tpu.core.scope import RNG_STATE_VAR
+
+    main, startup, loss = _model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    exe.run(main, feed={"x": np.ones((4, 4), "f4"), "y": np.ones((4, 1), "f4")},
+            fetch_list=[loss], scope=scope)
+    key = np.asarray(scope.find_var(RNG_STATE_VAR)).copy()
+    cm = CheckpointManager(str(tmp_path), program=main, scope=scope)
+    cm.save(step=5)
+    scope2 = fluid.Scope()
+    cm.restore(scope=scope2)
+    np.testing.assert_array_equal(np.asarray(scope2.find_var(RNG_STATE_VAR)), key)

@@ -129,3 +129,46 @@ def test_datafeeder_shapes():
     feed = feeder.feed(samples)
     assert feed["img"].shape == (2, 3, 8, 8) and feed["img"].dtype == np.float32
     assert feed["label"].shape == (2, 1) and feed["label"].dtype == np.int64
+
+
+def test_xmap_mapper_exception_reraised_not_hung():
+    """A mapper exception used to kill the worker thread without posting
+    END, leaving the consumer blocked on out_q.get() forever; it must be
+    re-raised in the consumer instead (ISSUE 3 satellite)."""
+    import pytest
+
+    def r():
+        yield from range(8)
+
+    def bad_mapper(v):
+        if v == 3:
+            raise ValueError(f"cannot map sample {v}")
+        return v * 2
+
+    for order in (False, True):
+        x = rd.xmap_readers(bad_mapper, r, 2, 4, order=order)
+        with pytest.raises(ValueError, match="cannot map sample 3"):
+            list(x())
+
+    # the breadcrumb routes it through the taxonomy as a data failure
+    from paddle_tpu.errors import DataError, classify
+
+    x = rd.xmap_readers(bad_mapper, r, 2, 4)
+    try:
+        list(x())
+    except ValueError as e:
+        ce = classify(e)
+        assert isinstance(ce, DataError) and ce.batch_index == 3
+
+
+def test_xmap_source_reader_exception_reraised():
+    """The feeder thread dying (source reader bug) must surface too."""
+    import pytest
+
+    def bad_reader():
+        yield 1
+        raise OSError("source went away")
+
+    x = rd.xmap_readers(lambda v: v, bad_reader, 2, 4)
+    with pytest.raises(OSError, match="source went away"):
+        list(x())

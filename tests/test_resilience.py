@@ -1,0 +1,565 @@
+"""Chaos suite (ISSUE 3): every fault class the resilience layer claims
+to survive — bad batch, NaN, transient device error, preemption — is
+injected deterministically (paddle_tpu/faults.py) and must be survived
+per its configured policy, with monitor counters asserting exactly how
+many recoveries happened and end-state parity pinned bit-for-bit.
+CPU-only, deterministic — runs in tier-1."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+from paddle_tpu.checkpoint_manager import CheckpointManager
+from paddle_tpu.errors import (DataError, NumericError, PreemptionError,
+                               TransientDeviceError, attach_context, classify)
+from paddle_tpu.faults import FaultInjector, parse_fault_spec
+
+# backoff-free policy: chaos tests must not sleep
+FAST = dict(backoff_base_s=0.0)
+
+
+def _build(seed=11):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h = fluid.layers.fc(x, 8, act="relu")
+        h = fluid.layers.dropout(h, dropout_prob=0.3)  # exercises RNG rewind
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(h, 1), y))
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+    startup.random_seed = seed
+    main.random_seed = seed
+    return main, startup, loss
+
+
+def _feeds(n, batch=8):
+    rng = np.random.RandomState(0)
+    out = []
+    for _ in range(n):
+        xv = rng.rand(batch, 4).astype("f4")
+        out.append({"x": xv, "y": xv.sum(1, keepdims=True)})
+    return out
+
+
+def _run_resilient(main, startup, loss, feeds, **kw):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    stats = fluid.resilient_train_loop(exe, main, lambda: list(feeds),
+                                       [loss], scope=scope, **kw)
+    return stats, scope
+
+
+def _params(scope):
+    return {n: np.asarray(scope.find_var(n)).copy()
+            for n in scope.local_var_names()}
+
+
+def _assert_state_equal(scope, ref, msg=""):
+    for n, v in ref.items():
+        np.testing.assert_array_equal(
+            np.asarray(scope.find_var(n)), v,
+            err_msg=f"{msg}: state var {n} diverged")
+
+
+# --- taxonomy ---------------------------------------------------------------
+
+def test_classify_taxonomy():
+    assert isinstance(classify(NumericError("x")), NumericError)
+    nan = classify(RuntimeError("fetch 'loss' contains NaN/Inf"))
+    assert isinstance(nan, NumericError)
+    assert isinstance(nan, RuntimeError)  # legacy catch sites keep working
+    dev = classify(RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+    assert isinstance(dev, TransientDeviceError) and dev.resource_exhausted
+    assert classify(RuntimeError("UNAVAILABLE: socket closed")).code == "UNAVAILABLE"
+    # unmapped exceptions pass through untouched (sticky errors keep type)
+    boring = ValueError("user bug")
+    assert classify(boring) is boring
+    # ... unless routed via the loader breadcrumb
+    marked = attach_context(ValueError("bad row"), batch_index=7, phase="loader")
+    ce = classify(marked)
+    assert isinstance(ce, DataError) and ce.batch_index == 7
+    assert ce.__cause__ is marked
+    # wrap_unknown promotes leftovers to FatalError
+    from paddle_tpu.errors import FatalError
+    assert isinstance(classify(ValueError("x"), wrap_unknown=True), FatalError)
+    # control-flow exceptions are never classified
+    ki = KeyboardInterrupt()
+    assert classify(ki, wrap_unknown=True) is ki
+
+
+def test_fault_spec_grammar():
+    faults = parse_fault_spec(
+        " bad_batch@2; nan@5 ;device@7:RESOURCE_EXHAUSTED;preempt@9;")
+    assert [(f.kind, f.at, f.arg) for f in faults] == [
+        ("bad_batch", 2, None), ("nan", 5, None),
+        ("device", 7, "RESOURCE_EXHAUSTED"), ("preempt", 9, None)]
+    with pytest.raises(ValueError, match="kind@N"):
+        parse_fault_spec("explode@3")
+    with pytest.raises(ValueError, match="not an integer"):
+        parse_fault_spec("nan@soon")
+    inj = FaultInjector("bad_batch@1")
+    with pytest.raises(DataError):
+        inj.on_batch(1, {})
+    assert inj.on_batch(1, {}) == {}  # fires exactly once
+    assert inj.summary() == {"bad_batch": 1}
+
+
+def test_injector_from_flags():
+    fluid.set_flags({"FLAGS_fault_spec": "nan@3"})
+    try:
+        inj = FaultInjector.from_flags()
+        assert [f.kind for f in inj.pending()] == ["nan"]
+    finally:
+        fluid.set_flags({"FLAGS_fault_spec": ""})
+    assert FaultInjector.from_flags() is None
+
+
+# --- fault class: bad batch -------------------------------------------------
+
+def test_bad_batches_skipped_with_parity():
+    main, startup, loss = _build()
+    feeds = _feeds(10)
+    monitor.reset()
+    monitor.enable()
+    try:
+        stats, scope = _run_resilient(
+            main, startup, loss, feeds, max_inflight=3,
+            injector=FaultInjector("bad_batch@2;bad_batch@6"),
+            policy=fluid.RetryPolicy(max_bad_batches=2, **FAST))
+    finally:
+        monitor.disable()
+    assert stats.steps == 8 and stats.skipped_batches == 2
+    assert monitor.counter("resilience.skipped_batches").value == 2
+    assert monitor.counter("faults.bad_batch").value == 2
+    # params identical to a fault-free run over the surviving batches
+    surviving = [f for i, f in enumerate(feeds) if i not in (2, 6)]
+    _, ref_scope = _run_resilient(main, startup, loss, surviving,
+                                  max_inflight=3)
+    _assert_state_equal(scope, _params(ref_scope), "bad-batch skip")
+
+
+def test_bad_batch_budget_exhausted_raises():
+    main, startup, loss = _build()
+    with pytest.raises(DataError, match="injected bad batch"):
+        _run_resilient(main, startup, loss, _feeds(8), max_inflight=2,
+                       injector=FaultInjector("bad_batch@1;bad_batch@3"),
+                       policy=fluid.RetryPolicy(max_bad_batches=1, **FAST))
+
+
+# --- fault class: NaN -------------------------------------------------------
+
+def test_nan_mode_raise_surfaces_numeric_error():
+    main, startup, loss = _build()
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(NumericError, match="NaN/Inf"):
+            _run_resilient(main, startup, loss, _feeds(8), max_inflight=2,
+                           injector=FaultInjector("nan@3"))
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_nan_skip_step_parity():
+    """The poisoned step's update is undone (state snapshot + RNG rewind),
+    its batch dropped, and the run ends bit-identical to a fault-free run
+    over the surviving batches — the ISSUE acceptance criterion."""
+    main, startup, loss = _build()
+    feeds = _feeds(10)
+    monitor.reset()
+    monitor.enable()
+    try:
+        stats, scope = _run_resilient(
+            main, startup, loss, feeds, max_inflight=3,
+            injector=FaultInjector("nan@4"), nan_mode="skip_step",
+            policy=fluid.RetryPolicy(**FAST))
+    finally:
+        monitor.disable()
+    assert stats.steps == 9 and stats.skipped_steps == 1
+    assert stats.segments == 2
+    assert monitor.counter("resilience.skipped_steps").value == 1
+    events = [r for r in monitor.step_records()
+              if r.get("kind") == "resilience_event"]
+    assert [e["action"] for e in events] == ["skip_step"]
+    assert events[0]["at_step"] == 4
+    surviving = [f for i, f in enumerate(feeds) if i != 4]
+    _, ref_scope = _run_resilient(main, startup, loss, surviving,
+                                  max_inflight=3)
+    _assert_state_equal(scope, _params(ref_scope), "nan skip_step")
+    # the guard flag was force-enabled for the run, then restored
+    assert fluid.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] is False
+
+
+def test_nan_skip_step_budget_exhausted():
+    main, startup, loss = _build()
+    with pytest.raises(NumericError):
+        _run_resilient(main, startup, loss, _feeds(10), max_inflight=2,
+                       injector=FaultInjector("nan@1;nan@5"),
+                       nan_mode="skip_step",
+                       policy=fluid.RetryPolicy(max_skipped_steps=1, **FAST))
+
+
+def test_nan_rollback_replays_to_full_parity(tmp_path):
+    """Rollback restores the newest checkpoint at/before the failing step
+    (never a later, already-poisoned one), rewinds the data stream via
+    the factory, and — since the injected NaN fires once — the replay is
+    clean: final params match an uninterrupted fault-free run."""
+    main, startup, loss = _build()
+    feeds = _feeds(12)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    cm = CheckpointManager(str(tmp_path), program=main, scope=scope,
+                           save_every_steps=3)
+    monitor.reset()
+    monitor.enable()
+    try:
+        stats = fluid.resilient_train_loop(
+            exe, main, lambda: list(feeds), [loss], scope=scope,
+            injector=FaultInjector("nan@7"), nan_mode="rollback",
+            checkpoint_manager=cm, policy=fluid.RetryPolicy(**FAST),
+            max_inflight=3)
+    finally:
+        monitor.disable()
+    assert stats.steps == 12 and stats.rollbacks == 1
+    assert monitor.counter("resilience.rollbacks").value == 1
+    _, ref_scope = _run_resilient(main, startup, loss, feeds, max_inflight=3)
+    _assert_state_equal(scope, _params(ref_scope), "nan rollback")
+
+
+def test_rollback_requires_factory_and_manager():
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(ValueError, match="checkpoint_manager"):
+        fluid.resilient_train_loop(exe, main, iter(_feeds(2)), [loss],
+                                   nan_mode="rollback")
+    with pytest.raises(ValueError, match="factory"):
+        fluid.resilient_train_loop(
+            exe, main, iter(_feeds(2)), [loss], nan_mode="rollback",
+            checkpoint_manager=CheckpointManager("/tmp/_unused_cm"))
+
+
+# --- fault class: transient device error ------------------------------------
+
+def test_transient_device_error_retried_with_parity():
+    main, startup, loss = _build()
+    feeds = _feeds(10)
+    monitor.reset()
+    monitor.enable()
+    try:
+        stats, scope = _run_resilient(
+            main, startup, loss, feeds, max_inflight=3,
+            injector=FaultInjector("device@5:UNAVAILABLE"),
+            policy=fluid.RetryPolicy(**FAST))
+    finally:
+        monitor.disable()
+    assert stats.steps == 10 and stats.retries == 1
+    assert stats.degraded_inflight == 0  # UNAVAILABLE does not shed depth
+    assert monitor.counter("resilience.retries").value == 1
+    _, ref_scope = _run_resilient(main, startup, loss, feeds, max_inflight=3)
+    _assert_state_equal(scope, _params(ref_scope), "device retry")
+
+
+def test_oom_degrades_inflight_depth():
+    main, startup, loss = _build()
+    feeds = _feeds(10)
+    monitor.reset()
+    monitor.enable()
+    try:
+        stats, scope = _run_resilient(
+            main, startup, loss, feeds, max_inflight=4,
+            injector=FaultInjector("device@3:RESOURCE_EXHAUSTED"),
+            policy=fluid.RetryPolicy(**FAST))
+    finally:
+        monitor.disable()
+    assert stats.steps == 10 and stats.retries == 1
+    assert stats.degraded_inflight == 1 and stats.final_max_inflight == 2
+    assert monitor.counter("resilience.degraded_inflight").value == 1
+    assert monitor.gauge("resilience.max_inflight").read() == 2
+    _, ref_scope = _run_resilient(main, startup, loss, feeds, max_inflight=4)
+    _assert_state_equal(scope, _params(ref_scope), "OOM degrade")
+
+
+def test_device_retry_budget_exhausted():
+    main, startup, loss = _build()
+    with pytest.raises(TransientDeviceError):
+        _run_resilient(main, startup, loss, _feeds(8), max_inflight=2,
+                       injector=FaultInjector("device@1;device@3"),
+                       policy=fluid.RetryPolicy(max_device_retries=1, **FAST))
+
+
+def test_backoff_is_seeded_and_exponential():
+    p = fluid.RetryPolicy(backoff_base_s=0.1, backoff_factor=2.0,
+                          backoff_jitter=0.5, seed=3)
+    a = [p.backoff_s(i) for i in range(3)]
+    b = [p.backoff_s(i) for i in range(3)]
+    assert a == b  # deterministic
+    assert a[1] > a[0] and a[2] > a[1]  # grows despite jitter at these sizes
+    for i, v in enumerate(a):
+        assert abs(v - 0.1 * 2 ** i) <= 0.5 * 0.1 * 2 ** i + 1e-12
+    assert fluid.RetryPolicy(backoff_base_s=0.0).backoff_s(5) == 0.0
+
+
+# --- fault class: preemption ------------------------------------------------
+
+def test_preemption_flush_and_resume_bit_identical(tmp_path):
+    """The satellite acceptance test: a seeded run interrupted by injected
+    SIGTERM flushes a snapshot (with RNG key + data position), and a
+    fresh-process resume reaches bit-identical params to an uninterrupted
+    run at the same step count."""
+    main, startup, loss = _build()
+    feeds = _feeds(12)
+    # reference: uninterrupted
+    _, ref_scope = _run_resilient(main, startup, loss, feeds, max_inflight=3)
+    ref = _params(ref_scope)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    cm = CheckpointManager(str(tmp_path), program=main, scope=scope)
+    monitor.reset()
+    monitor.enable()
+    try:
+        stats = fluid.resilient_train_loop(
+            exe, main, lambda: list(feeds), [loss], scope=scope,
+            injector=FaultInjector("preempt@5"), checkpoint_manager=cm,
+            max_inflight=3)
+    finally:
+        monitor.disable()
+    assert stats.preempted and stats.resume_step == 5
+    assert stats.steps == 5
+    assert monitor.counter("resilience.preemptions").value == 1
+    assert stats.checkpoint_dir and os.path.isdir(stats.checkpoint_dir)
+    with open(os.path.join(stats.checkpoint_dir, "RESUME.json")) as f:
+        info = json.load(f)
+    assert info["step"] == 5 and info["next_batch"] == 5
+
+    # "new process": fresh scope + executor, restore and continue
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    scope2 = fluid.Scope()
+    exe2.run(startup, scope=scope2)
+    cm2 = CheckpointManager(str(tmp_path), program=main, scope=scope2)
+    stats2 = fluid.resilient_train_loop(
+        exe2, main, lambda: list(feeds), [loss], scope=scope2,
+        checkpoint_manager=cm2, resume=True, max_inflight=3)
+    assert stats2.steps == 12 and not stats2.preempted
+    _assert_state_equal(scope2, ref, "preemption resume")
+
+
+# --- the whole menagerie at once --------------------------------------------
+
+def test_chaos_all_fault_classes_survived():
+    """One run, one of each recoverable fault class, exact counter
+    assertions, and end-state parity vs the fault-free run over the
+    surviving batches (the ISSUE 3 acceptance criterion)."""
+    main, startup, loss = _build()
+    feeds = _feeds(14)
+    spec = "bad_batch@2;nan@6;device@9:UNAVAILABLE;device@11:RESOURCE_EXHAUSTED"
+    monitor.reset()
+    monitor.enable()
+    try:
+        stats, scope = _run_resilient(
+            main, startup, loss, feeds, max_inflight=3,
+            injector=FaultInjector(spec), nan_mode="skip_step",
+            policy=fluid.RetryPolicy(**FAST))
+    finally:
+        monitor.disable()
+    # 14 batches - 1 bad batch - 1 skipped NaN step = 12 committed steps
+    assert stats.steps == 12
+    assert stats.skipped_batches == 1
+    assert stats.skipped_steps == 1
+    assert stats.retries == 2
+    assert stats.degraded_inflight == 1 and stats.final_max_inflight == 1
+    assert not stats.preempted
+    c = monitor.get_monitor().counter_values()
+    assert c["resilience.skipped_batches"] == 1
+    assert c["resilience.skipped_steps"] == 1
+    assert c["resilience.retries"] == 2
+    assert c["resilience.degraded_inflight"] == 1
+    assert c["faults.bad_batch"] == 1 and c["faults.nan"] == 1
+    assert c["faults.device"] == 2
+    actions = [r["action"] for r in monitor.step_records()
+               if r.get("kind") == "resilience_event"]
+    assert sorted(actions) == ["degrade_inflight", "retry", "retry",
+                               "skip_batch", "skip_step"]
+    # parity: fault-free run over surviving batches (raw batch 2 dropped
+    # by the loader; step 6 — which consumed raw batch 7 after the bad
+    # batch shifted the mapping — dropped with its NaN)
+    surviving = [f for i, f in enumerate(feeds) if i not in (2, 7)]
+    _, ref_scope = _run_resilient(main, startup, loss, surviving,
+                                  max_inflight=3)
+    _assert_state_equal(scope, _params(ref_scope), "chaos")
+
+
+def test_resilient_loop_logged_steps_use_global_indices():
+    main, startup, loss = _build()
+    feeds = _feeds(9)
+    seen = []
+    stats, _ = _run_resilient(
+        main, startup, loss, feeds, max_inflight=2, log_period=3,
+        injector=FaultInjector("nan@4"), nan_mode="skip_step",
+        policy=fluid.RetryPolicy(**FAST),
+        on_logged=lambda s, v: seen.append(s))
+    # 8 committed steps; global numbering survives the recovery restart
+    assert stats.steps == 8
+    assert seen == [0, 3, 6]
+
+
+def test_perf_report_retry_frac_gate(tmp_path):
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent.parent))
+    from tools.perf_report import check, retry_fraction
+
+    rows = [{"kind": "step", "recompiles_total": 0} for _ in range(10)]
+    rows += [{"kind": "resilience_event", "action": "retry",
+              "class": "TransientDeviceError", "at_step": 4},
+             {"kind": "resilience_event", "action": "skip_batch",
+              "class": "DataError", "at_batch": 2},
+             {"kind": "resilience_event", "action": "degrade_inflight",
+              "class": "TransientDeviceError", "at_step": 4}]
+    assert retry_fraction(rows) == pytest.approx(0.2)  # degrade not counted
+    path = tmp_path / "metrics.jsonl"
+    path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    assert check(str(path), max_retry_frac=0.3) == 0
+    assert check(str(path), max_retry_frac=0.1) == 1
+    # healthy run with zero events passes
+    bare = tmp_path / "bare.jsonl"
+    bare.write_text("\n".join(json.dumps(r) for r in rows[:10]) + "\n")
+    assert check(str(bare), max_retry_frac=0.0) == 0
+
+
+def test_bad_batch_inside_inflight_window_of_nan():
+    """Regression: a bad batch consumed inside the in-flight window of a
+    later-failing step leaves a hole in the replay range; recovery must
+    re-feed around the hole, not abort."""
+    main, startup, loss = _build()
+    feeds = _feeds(10)
+    stats, scope = _run_resilient(
+        main, startup, loss, feeds, max_inflight=3,
+        injector=FaultInjector("nan@4;bad_batch@6"), nan_mode="skip_step",
+        policy=fluid.RetryPolicy(**FAST))
+    # 10 batches - 1 bad - 1 nan-skipped = 8 committed steps
+    assert stats.steps == 8
+    assert stats.skipped_batches == 1 and stats.skipped_steps == 1
+    surviving = [f for i, f in enumerate(feeds) if i not in (4, 6)]
+    _, ref_scope = _run_resilient(main, startup, loss, surviving,
+                                  max_inflight=3)
+    _assert_state_equal(scope, _params(ref_scope), "hole in replay window")
+
+
+def test_skip_step_rejects_snapshot_state_false():
+    main, startup, loss = _build()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises(ValueError, match="snapshot_state"):
+        fluid.resilient_train_loop(exe, main, iter([]), [loss],
+                                   nan_mode="skip_step",
+                                   snapshot_state=False)
+
+
+def test_sigterm_after_last_dispatch_still_flushes(tmp_path):
+    """Regression: a preemption notice landing after the final dispatch
+    (tail drain) must still flush a checkpoint and report preempted, not
+    be silently dropped with the loop 'completing'."""
+    import signal
+
+    main, startup, loss = _build()
+    feeds = _feeds(6)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    cm = CheckpointManager(str(tmp_path), program=main, scope=scope)
+
+    def logged(s, v):
+        if s == 5:  # resolution of the last step: all dispatches done
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    stats = fluid.resilient_train_loop(
+        exe, main, lambda: list(feeds), [loss], scope=scope,
+        checkpoint_manager=cm, max_inflight=2, on_logged=logged)
+    assert stats.steps == 6
+    assert stats.preempted and stats.resume_step == 6
+    assert stats.checkpoint_dir and os.path.isdir(stats.checkpoint_dir)
+
+
+def test_resume_ignores_corrupt_newer_checkpoint_sidecar(tmp_path):
+    """Regression: resume must read RESUME.json from the checkpoint that
+    actually restored, not from a corrupt newer one restore walked past —
+    a stale sidecar would misalign the data stream with the state."""
+    main, startup, loss = _build()
+    feeds = _feeds(12)
+    _, ref_scope = _run_resilient(main, startup, loss, feeds, max_inflight=3)
+    ref = _params(ref_scope)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    cm = CheckpointManager(str(tmp_path), program=main, scope=scope)
+    stats = fluid.resilient_train_loop(
+        exe, main, lambda: list(feeds), [loss], scope=scope,
+        injector=FaultInjector("preempt@5"), checkpoint_manager=cm,
+        max_inflight=3)
+    assert stats.preempted
+    # plant a corrupt "newer" checkpoint whose sidecar points way ahead
+    fake = tmp_path / "ckpt-0000000009"
+    os.makedirs(str(fake))
+    (fake / "RESUME.json").write_text(
+        json.dumps({"step": 9, "next_batch": 9, "skipped_batches": 0}))
+    # no STEP / manifest -> restore() walks past it to ckpt-5
+
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    scope2 = fluid.Scope()
+    exe2.run(startup, scope=scope2)
+    cm2 = CheckpointManager(str(tmp_path), program=main, scope=scope2)
+    stats2 = fluid.resilient_train_loop(
+        exe2, main, lambda: list(feeds), [loss], scope=scope2,
+        checkpoint_manager=cm2, resume=True, max_inflight=3)
+    assert stats2.steps == 12
+    _assert_state_equal(scope2, ref, "resume past corrupt sidecar")
+
+
+def test_classify_prefers_transient_code_over_loader_phase():
+    """An XLA RESOURCE_EXHAUSTED raised in the producer thread is an HBM
+    problem, not skippable data — the code match outranks the breadcrumb."""
+    e = attach_context(RuntimeError("RESOURCE_EXHAUSTED: while staging"),
+                       batch_index=3, phase="loader")
+    ce = classify(e)
+    assert isinstance(ce, TransientDeviceError) and ce.resource_exhausted
+
+
+def test_dead_stream_after_producer_error_is_flagged(caplog):
+    """A generator that raises mid-run ends the stream; the run must flag
+    the early end instead of 'completing' silently."""
+    import logging
+
+    main, startup, loss = _build()
+    feeds = _feeds(8)
+
+    def dying_feeds():
+        # a DataLoader/xmap producer marks its exceptions with the loader
+        # breadcrumb before re-raising; simulate that contract directly
+        for i, f in enumerate(feeds):
+            if i == 5:
+                raise attach_context(ValueError("generator bug at batch 5"),
+                                     phase="loader")
+            yield f
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    monitor.reset()
+    monitor.enable()
+    try:
+        with caplog.at_level(logging.WARNING, logger="paddle_tpu.resilience"):
+            stats = fluid.resilient_train_loop(
+                exe, main, lambda: dying_feeds(), [loss], scope=scope,
+                max_inflight=2, policy=fluid.RetryPolicy(**FAST))
+    finally:
+        monitor.disable()
+    assert stats.steps == 5 and stats.skipped_batches == 1
+    assert monitor.counter("resilience.stream_died").value == 1
+    assert "ended early" in caplog.text or "died mid-run" in caplog.text

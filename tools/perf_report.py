@@ -22,6 +22,13 @@
         fraction (from paddle_tpu.pipeline.train_loop's pipeline_step
         records): above the threshold, the host is back to waiting on the
         device — an overlap regression.
+
+    python tools/perf_report.py --check metrics.jsonl --max-retry-frac 0.1
+        Additionally gate recovery events per executed step (skip-batch /
+        skip-step / retry / rollback resilience_event records from
+        paddle_tpu.resilience.resilient_train_loop): a healthy run sits
+        near 0; above the threshold the run is burning its budget
+        re-doing work (flaky data source, NaN-prone config, sick device).
 """
 from __future__ import annotations
 
@@ -104,7 +111,33 @@ def render(path: str) -> str:
             f"-> fraction {frac:.3f}\n"
             f"inflight depth avg {sum(depths)/len(depths):.2f} "
             f"max {max(depths)}")
+
+    revents = [s for s in records if s.get("kind") == "resilience_event"]
+    if revents:
+        rows = [(r.get("action", "?"), r.get("class", "?"),
+                 r.get("at_step", r.get("at_batch", "")),
+                 r.get("code", r.get("restored_step",
+                                     r.get("max_inflight", ""))))
+                for r in revents]
+        frac = retry_fraction(records)
+        parts.append(f"\n## resilience ({len(revents)} events, "
+                     f"recovery fraction {frac:.3f})\n"
+                     + _fmt_table(rows, ["action", "class", "at", "detail"]))
     return "\n".join(parts)
+
+
+RECOVERY_ACTIONS = ("skip_batch", "skip_step", "retry", "rollback")
+
+
+def retry_fraction(records):
+    """Recovery events per executed step — the resilience-health number a
+    chaos bench / CI run gates on.  A fraction creeping up means the run
+    is spending its life re-doing work (flaky data, NaN-prone config,
+    sick device) even if it technically still converges."""
+    steps = sum(1 for r in records if r.get("kind", "step") == "step")
+    rec = sum(1 for r in records if r.get("kind") == "resilience_event"
+              and r.get("action") in RECOVERY_ACTIONS)
+    return rec / steps if steps else 0.0
 
 
 def host_blocked_fraction(pipeline_steps):
@@ -145,7 +178,8 @@ def diff(path_a: str, path_b: str) -> str:
 
 
 def check(path: str, steady_after: int = 2,
-          max_host_blocked_frac: float = None) -> int:
+          max_host_blocked_frac: float = None,
+          max_retry_frac: float = None) -> int:
     """Return 0 when the metrics file is healthy, 1 otherwise (printed
     diagnosis either way).  Made for CI/bench scripts:
 
@@ -210,6 +244,21 @@ def check(path: str, steady_after: int = 2,
                 print(f"perf_report --check: host-blocked fraction "
                       f"{frac:.3f} <= {max_host_blocked_frac} across "
                       f"{len(steady_p)} steady-state pipeline steps")
+    if max_retry_frac is not None:
+        frac = retry_fraction(lines)
+        if frac > max_retry_frac:
+            n_ev = sum(1 for r in lines
+                       if r.get("kind") == "resilience_event"
+                       and r.get("action") in RECOVERY_ACTIONS)
+            failures.append(
+                f"recovery fraction {frac:.3f} ({n_ev} skip/retry/rollback "
+                f"events over {len(steps)} steps) exceeds the "
+                f"--max-retry-frac={max_retry_frac} gate — the run is "
+                f"spending its budget re-doing work; check the data "
+                f"source, NaN guard hits, and device health")
+        else:
+            print(f"perf_report --check: recovery fraction {frac:.3f} <= "
+                  f"{max_retry_frac}")
     if failures:
         for f_ in failures:
             print(f"perf_report --check: {f_}")
@@ -234,10 +283,15 @@ def main(argv=None):
                     help="additionally gate the pipeline's steady-state "
                          "host-blocked fraction (pipeline_step records from "
                          "paddle_tpu.pipeline.train_loop) at <= FRAC")
+    ap.add_argument("--max-retry-frac", type=float, default=None,
+                    metavar="FRAC",
+                    help="additionally gate recovery events per step "
+                         "(resilience_event records from paddle_tpu."
+                         "resilience.resilient_train_loop) at <= FRAC")
     args = ap.parse_args(argv)
     if args.check:
         return check(args.check, args.steady_after,
-                     args.max_host_blocked_frac)
+                     args.max_host_blocked_frac, args.max_retry_frac)
     if args.diff:
         print(diff(*args.diff))
         return 0

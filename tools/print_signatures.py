@@ -29,6 +29,9 @@ MODULES = [
     "paddle_tpu.metrics",
     "paddle_tpu.profiler",
     "paddle_tpu.flags",
+    "paddle_tpu.errors",
+    "paddle_tpu.faults",
+    "paddle_tpu.resilience",
 ]
 
 
@@ -45,7 +48,10 @@ def collect():
     lines = []
     for modname in MODULES:
         mod = importlib.import_module(modname)
-        for name in sorted(dir(mod)):
+        # a module that declares __all__ freezes exactly that surface;
+        # otherwise every public attribute (imports included) counts
+        names = getattr(mod, "__all__", None)
+        for name in sorted(names) if names is not None else sorted(dir(mod)):
             if name.startswith("_"):
                 continue
             obj = getattr(mod, name)
