@@ -414,13 +414,92 @@ def bench_chaos(steps=48, batch_size=256, max_inflight=3,
             "batch_size": batch_size, "max_inflight": max_inflight}
 
 
+def bench_chaos_dist(fault_spec, steps=12, n_procs=2, save_every=3,
+                     max_restarts=2):
+    """Multi-worker chaos benchmark: the same 2-worker sync-SGD gang run
+    uninterrupted and under a distributed fault schedule
+    (kill_worker@S:RANK / stall_worker@S:RANK:SECS), both through
+    `paddle_tpu.launch.run_gang` + the resilient gang worker.  Reports
+    both gang rates, the restart ledger, and the end-state parity check —
+    gang-restart overhead (detection + rollback + relaunch + replay) as a
+    number, the multi-worker analogue of the single-process chaos bench
+    above."""
+    import os
+    import tempfile
+
+    from paddle_tpu.launch import run_gang
+
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tests", "dist_worker_resilient.py")
+    env = {"RUN_STEPS": str(steps), "SAVE_EVERY": str(save_every),
+           "FLAGS_dist_heartbeat_interval_s": "0.25",
+           "FLAGS_dist_heartbeat_miss_factor": "12",
+           "FLAGS_dist_watchdog_timeout_s": "60"}
+
+    def one(spec, restarts):
+        root = tempfile.mkdtemp(prefix="pt-chaos-dist-")
+        e = dict(env)
+        if spec:
+            e["FLAGS_fault_spec"] = spec
+        t0 = _time.perf_counter()
+        res = run_gang([sys.executable, worker], n_procs,
+                       checkpoint_root=root, extra_env=e,
+                       max_restarts=restarts, timeout=540)
+        wall = _time.perf_counter() - t0
+        shas = []
+        for code, out, err in res.workers:
+            for line in (out or "").splitlines():
+                if line.startswith("RESULT "):
+                    shas.append(json.loads(line[len("RESULT "):])["params_sha"])
+        return res, wall, shas
+
+    clean_res, clean_wall, clean_shas = one(None, 0)
+    assert clean_res.ok, "clean gang run failed; chaos numbers meaningless"
+    chaos_res, chaos_wall, chaos_shas = one(fault_spec, max_restarts)
+    parity = bool(chaos_res.ok and clean_shas and chaos_shas
+                  and len(set(clean_shas + chaos_shas)) == 1)
+    clean_sps = steps / clean_wall
+    chaos_sps = steps / chaos_wall if chaos_res.ok else 0.0
+    print(f"chaos-dist: clean {clean_sps:.2f} steps/s, faulted "
+          f"{chaos_sps:.2f} steps/s ({chaos_res.restarts} gang restart(s), "
+          f"parity={parity})", file=sys.stderr)
+    return {"metric": "chaos_dist_train_steps_per_sec",
+            "value": round(chaos_sps, 3), "unit": "steps/sec",
+            "clean_steps_per_sec": round(clean_sps, 3),
+            "gang_restart_overhead": round(1.0 - chaos_sps / clean_sps, 4)
+            if clean_sps and chaos_sps else None,
+            "fault_spec": fault_spec, "n_procs": n_procs, "steps": steps,
+            "survived": bool(chaos_res.ok),
+            "gang_restarts": chaos_res.restarts,
+            "incarnations": chaos_res.incarnations,
+            "worker_deaths": [d for i in chaos_res.incidents
+                              for d in i.get("dead", [])],
+            "bit_parity_vs_clean": parity}
+
+
+_DIST_FAULT_KINDS = ("kill_worker", "stall_worker")
+
+
 def main():
     per_model = "--per-model" in sys.argv
+    fault_spec = None
+    for i, a in enumerate(sys.argv):
+        if a == "--fault-spec" and i + 1 < len(sys.argv):
+            fault_spec = sys.argv[i + 1]
+        elif a.startswith("--fault-spec="):
+            fault_spec = a.split("=", 1)[1]
     if "--pipeline" in sys.argv:
         print(json.dumps(bench_pipeline()))
         return
     if "--chaos" in sys.argv:
-        print(json.dumps(bench_chaos()))
+        # distributed entries route to the multi-worker gang bench; plain
+        # specs keep the single-process resilient-loop bench
+        if fault_spec and any(k in fault_spec for k in _DIST_FAULT_KINDS):
+            print(json.dumps(bench_chaos_dist(fault_spec)))
+        elif fault_spec:
+            print(json.dumps(bench_chaos(fault_spec=fault_spec)))
+        else:
+            print(json.dumps(bench_chaos()))
         return
     only = None
     for a in sys.argv[1:]:

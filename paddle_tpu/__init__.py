@@ -69,6 +69,10 @@ from . import resilience  # noqa: F401  (fault-tolerant train loop)
 from .faults import FaultInjector  # noqa: F401
 from .resilience import (RetryPolicy, ResilienceStats,  # noqa: F401
                          resilient_train_loop)
+from . import dist_resilience  # noqa: F401  (heartbeats + collective watchdog)
+# paddle_tpu.launch (the gang launcher) is deliberately NOT imported here:
+# `python -m paddle_tpu.launch` would re-execute an already-imported module
+# (runpy RuntimeWarning); import it explicitly where needed.
 
 __version__ = "0.1.0"
 

@@ -13,6 +13,17 @@ walks backwards past corrupt checkpoints to the newest valid one, and the
 scope's RNG key (`core.scope.RNG_STATE_VAR`) rides along in every
 snapshot so a resumed run replays the exact random stream — the property
 the resilience layer's rollback/resume parity tests pin.
+
+Coordinated multi-worker commit (ISSUE 4): with `world_size > 1` every
+rank writes its shards into the SAME pending directory, publishes a
+`SHARD_DONE.p<rank>` marker, and only rank 0 — after observing every
+rank's marker within `commit_timeout_s` (heartbeat-aware: a dead peer
+raises PeerFailureError instead of waiting out the clock) — writes the
+`COMMITTED` marker and renames the directory into place.  `restore`
+refuses any distributed checkpoint without `COMMITTED`, so a worker that
+crashed after its own shard landed can never leave a mixed-step
+directory that a restarted gang would happily load: either every rank's
+step N state is there, or the walk falls back to step N-k.
 """
 from __future__ import annotations
 
@@ -20,6 +31,7 @@ import logging
 import os
 import shutil
 import signal
+import time
 from typing import Optional
 
 from . import io as _io
@@ -28,16 +40,24 @@ from .monitor import MONITOR as _MON
 
 log = logging.getLogger("paddle_tpu.checkpoint")
 
+COMMITTED_MARKER = "COMMITTED"
+DIST_MARKER = "DIST"
+
 
 class CheckpointManager:
     def __init__(self, root: str, program=None, scope=None, keep: int = 3,
-                 save_every_steps: int = 0, mesh=None):
+                 save_every_steps: int = 0, mesh=None,
+                 rank: int = 0, world_size: int = 1,
+                 commit_timeout_s: float = 60.0):
         self.root = root
         self.program = program
         self.scope = scope
         self.keep = keep
         self.save_every_steps = save_every_steps
         self.mesh = mesh
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.commit_timeout_s = commit_timeout_s
         self._step = 0
         self._prev_handlers = {}
         self._saving = False
@@ -65,23 +85,36 @@ class CheckpointManager:
         checkpoint), then rotate old ones.  Not interrupted by its own
         preemption hook: a SIGTERM landing mid-save is deferred until this
         save commits (re-entering would trash the .tmp dir under the
-        first writer)."""
+        first writer).
+
+        With `world_size > 1` the temp dir is SHARED: every rank writes
+        its shards plus a `SHARD_DONE.p<rank>` marker, and rank 0 alone —
+        after observing every marker — writes `COMMITTED` and performs
+        the rename.  A gang member crashing anywhere in that window
+        leaves an uncommitted `.tmp` dir that `restore` never considers,
+        so no restarted worker can resume from a step its peers don't
+        have."""
         step = self._step if step is None else step
         final = self._dir(step)
         tmp = final + ".tmp"
         self._saving = True
         try:
-            if os.path.exists(tmp):
-                shutil.rmtree(tmp)
-            with _MON.span("checkpoint.save", step=step):
-                _io.save_sharded(tmp, var_names=self._var_names(self.scope),
-                                 scope=self.scope, program=self.program)
-                with open(os.path.join(tmp, "STEP"), "w") as f:
-                    f.write(str(step))
-                if os.path.exists(final):
-                    shutil.rmtree(final)
-                os.rename(tmp, final)
-            self._rotate()
+            with _MON.span("checkpoint.save", step=step, rank=self.rank):
+                if self.world_size > 1:
+                    self._save_coordinated(tmp, final, step)
+                else:
+                    if os.path.exists(tmp):
+                        shutil.rmtree(tmp)
+                    _io.save_sharded(tmp, var_names=self._var_names(self.scope),
+                                     scope=self.scope, program=self.program)
+                    with open(os.path.join(tmp, "STEP"), "w") as f:
+                        f.write(str(step))
+                    with open(os.path.join(tmp, COMMITTED_MARKER), "w") as f:
+                        f.write(str(step))
+                    if os.path.exists(final):
+                        shutil.rmtree(final)
+                    os.rename(tmp, final)
+                    self._rotate()
             _MON.counter("checkpoint.saves").inc()
         finally:
             self._saving = False
@@ -92,6 +125,75 @@ class CheckpointManager:
                 # committed — a failed save must not swallow a SIGTERM
                 self._on_preempt(*deferred)
         return final
+
+    def _save_coordinated(self, tmp: str, final: str, step: int):
+        # NO rmtree of a pre-existing tmp here: peers may already be
+        # writing into it (the launcher clears stale .tmp debris between
+        # gang incarnations instead)
+        os.makedirs(tmp, exist_ok=True)
+        _io.save_sharded(tmp, var_names=self._var_names(self.scope),
+                         scope=self.scope, program=self.program,
+                         process_index=self.rank)
+        with open(os.path.join(tmp, DIST_MARKER), "w") as f:
+            f.write(str(self.world_size))
+        done = os.path.join(tmp, f"SHARD_DONE.p{self.rank}")
+        with open(done + ".tmp", "w") as f:
+            f.write(str(step))
+        os.replace(done + ".tmp", done)  # marker lands whole or not at all
+        if self.rank != 0:
+            # commit is rank 0's job; peers proceed — the checkpoint only
+            # matters at restart, and an uncommitted one is invisible there
+            return
+        self._wait_for_shards(tmp, step)
+        with open(os.path.join(tmp, "STEP"), "w") as f:
+            f.write(str(step))
+        with open(os.path.join(tmp, COMMITTED_MARKER), "w") as f:
+            f.write(str(step))
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+        _MON.counter("checkpoint.commits").inc()
+        self._rotate()
+
+    def _wait_for_shards(self, tmp: str, step: int):
+        """Rank 0's bounded rendezvous: every rank's SHARD_DONE marker for
+        THIS step, or a classified raise.  Heartbeat-aware — a peer that
+        died mid-save surfaces as PeerFailureError immediately instead of
+        burning the whole commit timeout."""
+        from .dist_resilience import active_heartbeat
+        from .errors import CollectiveTimeoutError, PeerFailureError
+
+        deadline = time.monotonic() + self.commit_timeout_s
+        while True:
+            missing = []
+            for r in range(self.world_size):
+                marker = os.path.join(tmp, f"SHARD_DONE.p{r}")
+                try:
+                    with open(marker) as f:
+                        ok = int(f.read().strip() or -1) == step
+                except (OSError, ValueError):
+                    ok = False
+                if not ok:  # absent, unreadable, or a stale ghost's step
+                    missing.append(r)
+            if not missing:
+                return
+            hb = active_heartbeat()
+            if hb is not None:
+                dead = [r for r in hb.dead_peers() if r in missing]
+                if dead:
+                    raise PeerFailureError(
+                        f"checkpoint step {step}: peer(s) {dead} died "
+                        f"before publishing their shard markers — "
+                        f"abandoning the uncommitted checkpoint",
+                        rank=self.rank, peers=dead,
+                        collective="checkpoint.commit", step=step)
+            if time.monotonic() > deadline:
+                raise CollectiveTimeoutError(
+                    f"checkpoint step {step}: rank(s) {missing} did not "
+                    f"publish shard markers within {self.commit_timeout_s}s",
+                    rank=self.rank, peers=missing,
+                    collective="checkpoint.commit", step=step)
+            time.sleep(0.05)
 
     def _rotate(self):
         ckpts = self.checkpoints()
@@ -122,6 +224,17 @@ class CheckpointManager:
         errors = []
         for name in reversed(ckpts):
             d = os.path.join(self.root, name)
+            # a distributed checkpoint without its rank-0 COMMITTED marker
+            # is a mixed-step landmine: some ranks' shards are step N,
+            # others never arrived.  Skip it outright — the walk continues
+            # to the newest checkpoint every rank actually has.
+            if (os.path.exists(os.path.join(d, DIST_MARKER))
+                    and not os.path.exists(os.path.join(d, COMMITTED_MARKER))):
+                _MON.counter("checkpoint.uncommitted_skipped").inc()
+                log.warning("checkpoint %s is uncommitted (distributed save "
+                            "missing its COMMITTED marker); falling back to "
+                            "the previous one", d)
+                continue
             try:
                 with open(os.path.join(d, "STEP")) as f:
                     step = int(f.read())

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..dist_resilience import guard_blocking as _guard_blocking
 from ..monitor import MONITOR as _MON
 from .dtypes import as_np_dtype
 from .lowering import LoweringContext, run_block_with_backward
@@ -483,10 +484,15 @@ class _PendingFetches:
     def wait(self):
         """Block until the dispatched step has executed on the device —
         no device->host copy, no host eval.  The bounded-depth knob:
-        train_loop calls this on non-logging steps."""
-        jax.block_until_ready(self.fetches)
-        if self.key is not None:
-            jax.block_until_ready(self.key)
+        train_loop calls this on non-logging steps.  Routed through the
+        collective watchdog: on a cross-process mesh this wait sits inside
+        the step's allreduce, which never completes once a peer is dead."""
+        def _block():
+            jax.block_until_ready(self.fetches)
+            if self.key is not None:
+                jax.block_until_ready(self.key)
+
+        _guard_blocking(_block, what="executor.wait")
 
     def ready(self) -> bool:
         """Non-blocking readiness probe (best effort: falls back to True
@@ -520,8 +526,15 @@ class _PendingFetches:
                 names = self.host_plan["want"]
             else:
                 vals, names = self.fetches, self.fetch_names
-            Executor._check_nan_inf(names, vals)
-            self._np = [np.asarray(v) for v in vals]
+            # the device->host copy (the NaN guard's np.asarray included)
+            # is where an in-flight collective's block manifests;
+            # watchdog-guarded so a dead peer raises (classified below)
+            # instead of hanging the resolver
+            def _materialize():
+                Executor._check_nan_inf(names, vals)
+                return [np.asarray(v) for v in vals]
+
+            self._np = _guard_blocking(_materialize, what="executor.resolve")
         except BaseException as e:
             # route the in-flight failure through the taxonomy
             # (paddle_tpu/errors.py): an XLA RESOURCE_EXHAUSTED /
@@ -964,7 +977,13 @@ class Executor:
             feed_bytes = int(sum(getattr(v, "nbytes", 0) for v in jfeeds.values()))
             _MON.counter("executor.feed_bytes").inc(feed_bytes)
             t_run0 = time.perf_counter()
-        fetches, new_key = compiled(scope, jfeeds, key)
+        # dispatch is watchdog-guarded: on backends whose dispatch blocks
+        # (CPU/gloo cross-process collectives), a dead peer wedges the
+        # enqueue itself — the guard turns that into PeerFailureError.
+        # With the health layer off (every single-process run) this is a
+        # direct call behind one None-check.
+        fetches, new_key = _guard_blocking(
+            lambda: compiled(scope, jfeeds, key), what="executor.dispatch")
         if mon_on:
             # dispatch = enqueue-only cost (what run_async pays on the
             # critical path); execute additionally blocks to completion so
@@ -996,7 +1015,8 @@ class Executor:
             return [FetchHandle(pending, i, n)
                     for i, n in enumerate(pending.want_names)]
         if mon_on:
-            jax.block_until_ready(fetches)
+            _guard_blocking(lambda: jax.block_until_ready(fetches),
+                            what="executor.execute")
             t_disp = time.perf_counter() - t_run0
             t_execute = t_disp - build_s
             _MON.observe("executor.execute", t_execute, program=u8)
@@ -1004,13 +1024,17 @@ class Executor:
             with _MON.span("executor.host_eval"):
                 fetches = self._finish_host_eval(host_plan, feed, fetches, scope)
             fetch_names = host_plan["want"]
-        self._check_nan_inf(fetch_names, fetches)
+        def _fetch_out():
+            # the NaN guard's np.asarray is itself the blocking copy, so
+            # it lives inside the watchdog guard with the fetch
+            self._check_nan_inf(fetch_names, fetches)
+            return ([np.asarray(f) for f in fetches] if return_numpy
+                    else list(fetches))
+
         if not mon_on:
-            if return_numpy:
-                return [np.asarray(f) for f in fetches]
-            return list(fetches)
+            return _guard_blocking(_fetch_out, what="executor.fetch")
         t_f0 = time.perf_counter()
-        out = [np.asarray(f) for f in fetches] if return_numpy else list(fetches)
+        out = _guard_blocking(_fetch_out, what="executor.fetch")
         t_fetch = time.perf_counter() - t_f0
         _MON.observe("executor.fetch", t_fetch, program=u8)
         t_total = time.perf_counter() - t_run0

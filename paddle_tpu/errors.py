@@ -19,6 +19,18 @@ routes on:
                           exit resumable
     FatalError            everything else — never retried
 
+and, for the multi-worker tier (paddle_tpu/dist_resilience.py):
+
+    DistributedError      base of the gang-level failures below — one
+                          worker cannot fix these alone; the gang-restart
+                          driver (paddle_tpu/launch.py) owns recovery
+    PeerFailureError      a peer worker stopped heartbeating (crashed,
+                          SIGKILLed, wedged) while this worker was inside
+                          or about to enter a collective
+    CollectiveTimeoutError a collective/barrier blew its armed deadline
+                          with every peer still heartbeating (deadlocked
+                          collective, pathological straggler)
+
 Every class subclasses RuntimeError so legacy call sites catching
 RuntimeError (the NaN guard's historical type) keep working.
 
@@ -31,6 +43,7 @@ from __future__ import annotations
 
 __all__ = ["TrainingError", "DataError", "NumericError",
            "TransientDeviceError", "PreemptionError", "FatalError",
+           "DistributedError", "PeerFailureError", "CollectiveTimeoutError",
            "classify", "attach_context", "get_context"]
 
 from typing import Optional
@@ -97,6 +110,47 @@ class FatalError(TrainingError):
     """Anything `classify` cannot place in a recoverable class: program
     bugs, INVALID_ARGUMENT compiles, user-code exceptions.  The resilient
     loop re-raises these untouched."""
+
+
+class DistributedError(TrainingError):
+    """Base of the gang-level failures.  A single worker cannot recover
+    from these (every peer is wedged in the same collective); the point of
+    raising instead of hanging is to die LOUDLY and classified, so the
+    gang-restart driver (paddle_tpu/launch.py) can kill the stragglers and
+    relaunch from the last coordinated checkpoint.  Carries the local rank
+    and, where known, the set of implicated peers."""
+
+    def __init__(self, message: str, *, rank: Optional[int] = None,
+                 peers=None, collective: Optional[str] = None, **kw):
+        super().__init__(message, **kw)
+        self.rank = rank
+        self.peers = list(peers) if peers is not None else []
+        self.collective = collective
+
+    def __str__(self):
+        base = super().__str__()
+        ctx = []
+        if self.rank is not None:
+            ctx.append(f"rank={self.rank}")
+        if self.peers:
+            ctx.append(f"peers={self.peers}")
+        if self.collective:
+            ctx.append(f"collective={self.collective}")
+        return f"{base} [{', '.join(ctx)}]" if ctx else base
+
+
+class PeerFailureError(DistributedError):
+    """A peer worker stopped heartbeating — crashed, OOM-killed, or wedged
+    past the liveness deadline.  The next (or current) collective with that
+    peer can never complete; the watchdog raises this instead of letting
+    the process hang inside it.  `peers` lists the dead ranks."""
+
+
+class CollectiveTimeoutError(DistributedError):
+    """A collective/barrier exceeded its armed deadline while every peer
+    still heartbeats: a deadlocked collective, divergent program order, or
+    a straggler past the watchdog budget.  Thread stacks were dumped at
+    raise time (`dist_resilience.dump_stacks`)."""
 
 
 # XLA status codes whose failures are worth retrying.  INVALID_ARGUMENT /

@@ -24,7 +24,31 @@ Spec grammar (entries separated by ';', whitespace ignored):
                           the loop's deferred-flush handler is what gets
                           tested)
 
+distributed entries (ISSUE 4) target a specific worker RANK; every
+worker of a gang parses the same spec and an entry fires only in the
+process whose rank matches (`PADDLE_TRAINER_ID`, or the `rank` ctor
+arg), so one spec string drives a whole deterministic multi-worker
+chaos schedule:
+
+    kill_worker@S:RANK        worker RANK dies with SIGKILL at dispatch
+                              of train step S — no cleanup, no tombstone:
+                              the hard death peers must detect by
+                              heartbeat staleness
+    stall_worker@S:RANK:SECS  worker RANK sleeps SECS at dispatch of
+                              step S (the straggler that trips the
+                              collective watchdog when SECS exceeds its
+                              deadline)
+
+ranked entries fire once per GANG, not once per process: a gang restart
+replays the failed step, so without cross-incarnation memory the same
+kill would fire forever.  When `PADDLE_FAULT_STATE_DIR` names a shared
+directory (paddle_tpu.launch exports one per run_gang call), a ranked
+entry drops a `fired-...` marker there at its firing point — written
+BEFORE the SIGKILL lands — and every later incarnation treats marked
+entries as already spent.
+
     e.g.  FLAGS_fault_spec="bad_batch@2;nan@5;device@7:RESOURCE_EXHAUSTED;preempt@11"
+          FLAGS_fault_spec="kill_worker@3:1;stall_worker@6:0:0.2"
 
 `seed` only feeds the poison-value RNG; firing points are exact indices.
 The hooks (`on_batch`, `on_feed`, `on_dispatch`) are called by
@@ -38,6 +62,8 @@ __all__ = ["Fault", "FaultInjector", "parse_fault_spec"]
 import os
 import random
 import signal
+import sys
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
@@ -46,7 +72,10 @@ import numpy as np
 from .errors import DataError, TransientDeviceError
 from .monitor import MONITOR as _MON
 
-_KINDS = ("bad_batch", "nan", "device", "preempt")
+_KINDS = ("bad_batch", "nan", "device", "preempt",
+          "kill_worker", "stall_worker")
+# entries that only fire in the worker whose rank matches their arg
+_RANKED_KINDS = ("kill_worker", "stall_worker")
 
 
 @dataclass
@@ -59,6 +88,18 @@ class Fault:
     def __str__(self):
         s = f"{self.kind}@{self.at}"
         return f"{s}:{self.arg}" if self.arg else s
+
+    @property
+    def target_rank(self) -> Optional[int]:
+        """Worker rank a ranked entry targets (None for per-process kinds)."""
+        if self.kind not in _RANKED_KINDS or not self.arg:
+            return None
+        return int(self.arg.split(":", 1)[0])
+
+    @property
+    def stall_s(self) -> float:
+        assert self.kind == "stall_worker"
+        return float(self.arg.split(":", 1)[1])
 
 
 def parse_fault_spec(spec: str) -> List[Fault]:
@@ -79,7 +120,24 @@ def parse_fault_spec(spec: str) -> List[Fault]:
         except ValueError:
             raise ValueError(f"fault spec entry {entry!r}: {at_s!r} is not "
                              f"an integer index")
-        faults.append(Fault(kind, at, arg.strip() or None))
+        arg = arg.strip() or None
+        f = Fault(kind, at, arg)
+        if kind == "kill_worker":
+            if arg is None or not arg.isdigit():
+                raise ValueError(f"fault spec entry {entry!r}: want "
+                                 f"kill_worker@STEP:RANK")
+        elif kind == "stall_worker":
+            parts = (arg or "").split(":")
+            ok = len(parts) == 2 and parts[0].isdigit()
+            if ok:
+                try:
+                    float(parts[1])
+                except ValueError:
+                    ok = False
+            if not ok:
+                raise ValueError(f"fault spec entry {entry!r}: want "
+                                 f"stall_worker@STEP:RANK:SECONDS")
+        faults.append(f)
     return faults
 
 
@@ -87,11 +145,19 @@ class FaultInjector:
     """Seeded, schedule-driven fault source.  One instance = one schedule;
     construct fresh (or `reset()`) per run."""
 
-    def __init__(self, spec: str = "", seed: int = 0):
+    def __init__(self, spec: str = "", seed: int = 0,
+                 rank: Optional[int] = None):
         self.spec = spec
         self.seed = seed
         self.faults = parse_fault_spec(spec)
         self._rng = random.Random(seed)
+        # ranked entries (kill_worker/stall_worker) fire only in the worker
+        # whose rank matches; default from the PADDLE_* trainer contract so
+        # one FLAGS_fault_spec string drives a whole gang deterministically
+        self.rank = rank if rank is not None else int(
+            os.environ.get("PADDLE_TRAINER_ID", "0"))
+        # once-per-gang ledger for ranked entries (survives gang restarts)
+        self.state_dir = os.environ.get("PADDLE_FAULT_STATE_DIR")
 
     @staticmethod
     def from_flags() -> Optional["FaultInjector"]:
@@ -122,9 +188,29 @@ class FaultInjector:
         return out
 
     # -- hooks -------------------------------------------------------------
+    def _ranked_marker(self, f: Fault) -> Optional[str]:
+        if self.state_dir is None or f.kind not in _RANKED_KINDS:
+            return None
+        return os.path.join(self.state_dir, f"fired-{f.kind}@{f.at}-{f.arg}")
+
     def _take(self, kind: str, at: int) -> Optional[Fault]:
         for f in self.faults:
             if f.kind == kind and f.at == at and not f.fired:
+                if (f.kind in _RANKED_KINDS
+                        and f.target_rank != self.rank):
+                    continue  # another worker's fault: stays pending here
+                marker = self._ranked_marker(f)
+                if marker is not None:
+                    if os.path.exists(marker):
+                        # spent in an earlier gang incarnation: the restart
+                        # replays this step, the fault must not replay too
+                        f.fired = True
+                        continue
+                    os.makedirs(self.state_dir, exist_ok=True)
+                    with open(marker, "w") as fh:
+                        fh.write(str(os.getpid()))
+                        fh.flush()
+                        os.fsync(fh.fileno())  # must hit disk before SIGKILL
                 f.fired = True
                 _MON.counter(f"faults.{kind}").inc()
                 return f
@@ -160,8 +246,10 @@ class FaultInjector:
 
     def on_dispatch(self, step: int):
         """Called just before train step `step` is dispatched; raises the
-        scheduled transient device error, or delivers a real SIGTERM (the
-        preemption notice) to this process."""
+        scheduled transient device error, delivers a real SIGTERM (the
+        preemption notice), hard-kills this worker (SIGKILL — no cleanup,
+        no tombstone: peers must detect the death by heartbeat staleness),
+        or stalls it to fake a straggler."""
         f = self._take("device", step)
         if f is not None:
             code = f.arg or "UNAVAILABLE"
@@ -170,3 +258,12 @@ class FaultInjector:
                 code=code, step=step, phase="device")
         if self._take("preempt", step) is not None:
             os.kill(os.getpid(), signal.SIGTERM)
+        f = self._take("kill_worker", step)
+        if f is not None:
+            print(f"faults: kill_worker@{step}:{self.rank} firing (SIGKILL)",
+                  file=sys.stderr, flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+        f = self._take("stall_worker", step)
+        if f is not None:
+            _MON.counter("faults.stall_seconds").inc(int(f.stall_s))
+            time.sleep(f.stall_s)

@@ -97,7 +97,10 @@ DEFINE_string("FLAGS_compile_cache_dir", "",
               "executor.compile cost (seconds per program signature, re-paid "
               "every process) is paid once per machine — the second process "
               "running the same program loads the compiled executable from "
-              "disk.  Set before the first compile (env var or set_flags)")
+              "disk.  Set before the first compile (env var or set_flags). "
+              "Single-process only: init_distributed force-disables it for "
+              "multi-process runs (cached cross-process executables corrupt "
+              "the heap on the current backend)")
 DEFINE_string("FLAGS_fault_spec", "",
               "deterministic fault-injection schedule for chaos testing the "
               "resilience layer (paddle_tpu/faults.py), e.g. "
@@ -105,6 +108,28 @@ DEFINE_string("FLAGS_fault_spec", "",
               "Each resilient_train_loop call builds one injector from the "
               "spec; every entry fires exactly once per injector (so once "
               "per call).  Empty (default) injects nothing")
+DEFINE_float("FLAGS_dist_heartbeat_interval_s", 0.5,
+             "seconds between liveness beats each worker publishes to its "
+             "peers (paddle_tpu/dist_resilience.py).  The transport rides "
+             "the PADDLE_TRAINER_* endpoint contract: UDP to every peer "
+             "endpoint, or files under PADDLE_HEARTBEAT_DIR when set "
+             "(what paddle_tpu.launch uses on localhost)")
+DEFINE_float("FLAGS_dist_heartbeat_miss_factor", 10.0,
+             "a peer is declared dead after interval_s * miss_factor "
+             "seconds without an observed beat; the collective watchdog "
+             "then raises PeerFailureError instead of letting the next "
+             "collective hang forever.  Keep the product in whole seconds: "
+             "a beat thread can starve behind GIL-heavy import/compile "
+             "phases, and a too-tight deadline reads starvation as death")
+DEFINE_float("FLAGS_dist_watchdog_timeout_s", 120.0,
+             "deadline armed around every collective/blocking device wait "
+             "when the distributed health layer is active; on expiry all "
+             "thread stacks are dumped and CollectiveTimeoutError raised")
+DEFINE_float("FLAGS_dist_bootstrap_timeout_s", 120.0,
+             "deadline on jax.distributed.initialize (the gen_nccl_id "
+             "role): a gang whose worker never dials in raises "
+             "CollectiveTimeoutError instead of blocking the others at "
+             "startup")
 DEFINE_bool("FLAGS_cudnn_deterministic", True,
             "accepted no-op: XLA TPU lowerings are deterministic by default")
 DEFINE_float("FLAGS_fraction_of_gpu_memory_to_use", 1.0,

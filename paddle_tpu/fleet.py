@@ -83,7 +83,15 @@ class Fleet:
 
     # -- lifecycle ---------------------------------------------------------
     def init(self, role_maker=None):
-        """Bootstrap the cross-process runtime when endpoints say so."""
+        """Bootstrap the cross-process runtime when endpoints say so.
+
+        Multi-worker gangs also get the distributed health layer (ISSUE
+        4): the heartbeat starts BEFORE the coordination-service
+        bootstrap — a peer that dies while everyone else is still dialing
+        in must already be detectable — and the collective watchdog it
+        arms guards every blocking executor wait from then on
+        (core/executor.py routes them through
+        dist_resilience.guard_blocking)."""
         self._role = role_maker or PaddleCloudRoleMaker()
         eps = self._role.get_trainer_endpoints()
         # each trainer gets its own monitor lane so merged Chrome traces
@@ -92,14 +100,67 @@ class Fleet:
                       f"trainer{self._role.worker_index()}")
         _MON.gauge("fleet.worker_num").set(self._role.worker_num())
         if len(eps) > 1:
+            from . import dist_resilience as _dres
             from .parallel import distributed as dist
 
+            self._watchdog = _dres.init_health(
+                rank=self._role.worker_index(),
+                world=self._role.worker_num(), endpoints=eps)
             with _MON.span("fleet.init", workers=len(eps)):
                 dist.init_distributed(
                     trainer_id=self._role.worker_index(),
                     trainer_endpoints=eps,
                 )
+                # Establish the cross-process collective context NOW, while
+                # every worker sits at the same point (right after the
+                # bootstrap, before model build/compile skews them apart):
+                # gloo's context handshake carries its own short internal
+                # deadline, and deferring it to the first training
+                # collective makes compile-time skew look like a collective
+                # failure.  A straggler surfaces here instead, classified,
+                # under the bootstrap deadline.
+                from .flags import flag as _flag
+
+                self._watchdog.run(
+                    self._collective_warmup, what="fleet.init.barrier",
+                    timeout_s=float(_flag("FLAGS_dist_bootstrap_timeout_s")))
         return self
+
+    @staticmethod
+    def _collective_warmup():
+        import jax
+
+        try:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("paddle_tpu.fleet.init")
+        except ImportError:
+            # fallback must still span PROCESSES (a local-only psum would
+            # leave the cross-process context unestablished): a global-mesh
+            # sum over one element per global device
+            import numpy as np
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from .parallel.distributed import global_mesh
+
+            mesh = global_mesh()
+            x = jax.make_array_from_process_local_data(
+                NamedSharding(mesh, PartitionSpec("dp")),
+                np.ones((jax.local_device_count(), 1), "f4"))
+            out = jax.jit(lambda a: a.sum(),
+                          out_shardings=NamedSharding(mesh, PartitionSpec()))(x)
+            jax.block_until_ready(out)
+
+    @property
+    def watchdog(self):
+        """The gang's CollectiveWatchdog (None for single-worker runs)."""
+        return getattr(self, "_watchdog", None)
+
+    @property
+    def heartbeat(self):
+        from .dist_resilience import active_heartbeat
+
+        return active_heartbeat() if getattr(self, "_watchdog", None) else None
 
     def is_first_worker(self) -> bool:
         return self._role is None or self._role.is_first_worker()

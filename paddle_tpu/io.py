@@ -145,7 +145,8 @@ def _loaded_view(mm, stored_as):
 
 
 def save_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
-                 scope: Optional[Scope] = None, program: Optional[Program] = None):
+                 scope: Optional[Scope] = None, program: Optional[Program] = None,
+                 process_index: Optional[int] = None):
     """Sharded checkpoint (SURVEY §5.4: TensorStore-style per-shard save;
     reference precedent: sliced pserver save, io.py:292
     _save_distributed_persistables).  Each variable writes only its unique
@@ -160,7 +161,11 @@ def save_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
         program = program or default_main_program()
         var_names = [v.name for v in _persistables(program)]
     os.makedirs(dirname, exist_ok=True)
-    proc = jax.process_index()
+    # process_index override: the CheckpointManager's coordinated-commit
+    # protocol names shard files by TRAINER rank so in-process tests (and
+    # any caller that is not a real jax process) can exercise the
+    # multi-writer layout; real gangs leave it None -> jax.process_index()
+    proc = jax.process_index() if process_index is None else int(process_index)
     entries = []
     for name in var_names:
         v = scope.find_var(name)

@@ -1,0 +1,425 @@
+"""Gang launcher with restart: the `paddle.distributed.launch` role,
+grown a fault-tolerance story.
+
+Promoted from tests/dist_harness.py (which now wraps this module): one
+copy of the port allocation, the `PADDLE_TRAINER_*` env contract, and
+worker spawning — plus what the test harness never had:
+
+  * **leak-free spawning** — `Gang` is a context manager that always
+    kills and reaps every worker on the way out (bounded per-worker join,
+    SIGTERM then SIGKILL), so a failed spawn or a raising test body never
+    strands live subprocesses;
+  * **TOCTOU-free ports** — `allocate_port_block(n)` binds all `n`
+    consecutive ports simultaneously before releasing them, retrying on
+    `EADDRINUSE` with a fresh base instead of assuming `port+i` is free;
+  * **gang restart** — `run_gang` supervises the workers, and when one
+    dies (SIGKILL, classified resilience exit, crash) it kills the
+    stragglers, clears uncommitted checkpoint debris, and relaunches the
+    whole gang on a fresh port block with `PADDLE_RESTART_NUM` bumped —
+    workers resume from the last *coordinated* checkpoint
+    (`CheckpointManager` rank-0 COMMITTED marker) with `step_offset`
+    continuity, so the restarted run's params are bit-identical to an
+    uninterrupted one.
+
+CLI (the reference `python -m paddle.distributed.launch` shape):
+
+    python -m paddle_tpu.launch --nproc 2 --max-restarts 3 \
+        [--devices-per-proc 1] [--metrics gang.jsonl] worker.py [args...]
+
+Monitor surface: the launcher process emits `dist.gang_restarts` /
+`dist.worker_deaths` counters and one `kind="dist_event"` record per
+incident (`action="gang_restart"` / `"worker_death"` / `"gang_failed"`),
+written to `--metrics` as JSONL — the file `tools/perf_report.py --check
+--max-gang-restarts` gates in CI.
+"""
+from __future__ import annotations
+
+__all__ = ["allocate_port_block", "worker_env", "Gang", "GangResult",
+           "run_gang", "main"]
+
+import argparse
+import errno
+import os
+import random
+import shutil
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from .monitor import MONITOR as _MON
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# dist_resilience's classified exits (peer failure / watchdog timeout);
+# labels the `classified` field of incident records.  Restart policy is
+# deliberately broader — ANY death restarts, because unclassified exits
+# include real restartable cases (a raw SIGKILL, a bootstrap lost to
+# machine load) and the once-per-gang fault ledger / max_restarts budget
+# bound the damage of relaunching a deterministic crasher.
+_CLASSIFIED_EXITS = (43, 44)  # EXIT_PEER_FAILURE, EXIT_COLLECTIVE_TIMEOUT
+
+
+def allocate_port_block(n: int, tries: int = 64,
+                        low: int = 20000, high: int = 50000) -> int:
+    """Base port of `n` CONSECUTIVE free TCP ports, verified by binding
+    all of them simultaneously (close-then-reuse races shrink to the
+    spawn window instead of `n` independent guesses).  The old
+    `free_port() + i` scheme was a TOCTOU lottery: any daemon grabbing
+    `port+i` between close and worker bind wedged the whole bootstrap
+    with EADDRINUSE."""
+    rng = random.Random(os.getpid() * 7919 + int(time.time() * 1e3) % 65536)
+    last_err: Optional[OSError] = None
+    for _ in range(tries):
+        base = rng.randrange(low, high - n)
+        socks = []
+        try:
+            for i in range(n):
+                s = socket.socket()
+                socks.append(s)
+                s.bind(("127.0.0.1", base + i))
+            return base
+        except OSError as e:
+            if e.errno not in (errno.EADDRINUSE, errno.EACCES):
+                raise
+            last_err = e
+        finally:
+            for s in socks:
+                s.close()
+    raise OSError(
+        f"allocate_port_block: no free block of {n} consecutive ports in "
+        f"[{low}, {high}) after {tries} tries (last: {last_err})")
+
+
+def worker_env(rank: int, endpoints: Sequence[str],
+               devices_per_proc: int = 1,
+               extra: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """Env for one worker under the PADDLE_TRAINER_* contract, on the CPU
+    virtual mesh (tests / localhost gangs).  The axon tunnel shim
+    monkeypatches jax.distributed for its loopback relay, so workers get a
+    clean PYTHONPATH rooted at the repo."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = REPO_ROOT
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devices_per_proc}"
+    env["PADDLE_TRAINER_ID"] = str(rank)
+    env["PADDLE_TRAINER_ENDPOINTS"] = ",".join(endpoints)
+    env["PADDLE_CURRENT_ENDPOINT"] = endpoints[rank]
+    env.update(extra or {})
+    return env
+
+
+class Gang:
+    """Spawn-and-always-reap context manager around one gang incarnation.
+
+        with Gang([sys.executable, worker_py], n_procs=2) as gang:
+            results = gang.communicate(timeout=600)
+
+    On exit — success, failure, or mid-spawn exception — every live
+    worker is killed (SIGTERM, then SIGKILL after `grace_s`) and reaped
+    with a bounded join, so no orphan ever sits blocked inside
+    jax.distributed.initialize holding its port."""
+
+    def __init__(self, argv: Sequence[str], n_procs: int,
+                 devices_per_proc: int = 1,
+                 extra_env: Optional[Dict[str, str]] = None,
+                 per_rank_env: Optional[Dict[int, Dict[str, str]]] = None,
+                 grace_s: float = 3.0):
+        self.argv = list(argv)
+        self.n_procs = n_procs
+        self.devices_per_proc = devices_per_proc
+        self.extra_env = dict(extra_env or {})
+        self.per_rank_env = {r: dict(e) for r, e in (per_rank_env or {}).items()}
+        self.grace_s = grace_s
+        self.procs: List[subprocess.Popen] = []
+        self._files: List[tuple] = []  # (stdout, stderr) spool per worker
+        self.base_port: Optional[int] = None
+        self.endpoints: List[str] = []
+
+    def __enter__(self) -> "Gang":
+        import tempfile
+
+        self.base_port = allocate_port_block(self.n_procs)
+        self.endpoints = [f"127.0.0.1:{self.base_port + i}"
+                          for i in range(self.n_procs)]
+        try:
+            for rank in range(self.n_procs):
+                extra = dict(self.extra_env)
+                extra.update(self.per_rank_env.get(rank, {}))
+                env = worker_env(rank, self.endpoints,
+                                 self.devices_per_proc, extra)
+                # worker output goes to spooled temp FILES, not pipes: a
+                # pipe fills at ~64KB and a worker chatty past that (per-
+                # step logs, repeated stack dumps) would block in write()
+                # while the unsuspecting supervisor reads it as "alive"
+                out_f = tempfile.TemporaryFile(mode="w+t")
+                err_f = tempfile.TemporaryFile(mode="w+t")
+                self._files.append((out_f, err_f))
+                self.procs.append(subprocess.Popen(
+                    self.argv, stdout=out_f, stderr=err_f, env=env,
+                    text=True))
+        except BaseException:
+            self._reap()
+            raise
+        return self
+
+    def __exit__(self, *exc):
+        self._reap()
+        return False
+
+    def _reap(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + self.grace_s
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.0, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        for p in self.procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=self.grace_s)
+                except subprocess.TimeoutExpired:
+                    pass  # unkillable (D-state); nothing more a user can do
+        for of, ef in self._files:
+            for f in (of, ef):
+                try:
+                    f.close()
+                except OSError:
+                    pass
+        self._files = []
+
+    def communicate(self, timeout: float = 600):
+        """Wait for every worker and read its spooled output; returns
+        [(returncode, stdout, stderr)].  Re-callable: the spools are
+        seeked, not drained."""
+        out = []
+        for p, (of, ef) in zip(self.procs, self._files):
+            try:
+                p.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                try:
+                    p.wait(timeout=self.grace_s)
+                except subprocess.TimeoutExpired:
+                    pass
+            o = e = ""
+            for f, slot in ((of, "o"), (ef, "e")):
+                try:
+                    f.seek(0)
+                    text = f.read()
+                except (OSError, ValueError):
+                    text = ""
+                if slot == "o":
+                    o = text
+                else:
+                    e = text
+            out.append((p.returncode, o, e))
+        return out
+
+    def wait_any_death_or_exit(self, poll_s: float = 0.1,
+                               timeout: float = 600):
+        """Block until every worker exited cleanly, or any worker died
+        (non-zero / signaled) — whichever first.  Returns (ok, ranks_done)
+        where ok=False names a failed incarnation."""
+        t0 = time.monotonic()
+        while True:
+            codes = [p.poll() for p in self.procs]
+            if any(c not in (None, 0) for c in codes):
+                return False, codes
+            if all(c == 0 for c in codes):
+                return True, codes
+            if time.monotonic() - t0 > timeout:
+                raise TimeoutError(
+                    f"gang did not finish within {timeout}s (exit codes so "
+                    f"far: {codes}) — watchdogs should have fired long ago")
+            time.sleep(poll_s)
+
+
+@dataclass
+class GangResult:
+    """What `run_gang` hands back."""
+
+    ok: bool = False
+    restarts: int = 0
+    incarnations: int = 0
+    # last incarnation's per-rank (returncode, stdout, stderr)
+    workers: List[tuple] = field(default_factory=list)
+    # one dict per death the supervisor observed across all incarnations
+    incidents: List[dict] = field(default_factory=list)
+
+
+def _clear_uncommitted(checkpoint_root: str):
+    """Drop half-written checkpoint debris (.tmp dirs, stale shard/commit
+    markers from the dead incarnation) so the restarted gang's saves can
+    never rendezvous with a ghost's markers."""
+    if not checkpoint_root or not os.path.isdir(checkpoint_root):
+        return
+    for name in os.listdir(checkpoint_root):
+        if name.endswith(".tmp"):
+            shutil.rmtree(os.path.join(checkpoint_root, name),
+                          ignore_errors=True)
+
+
+def run_gang(argv: Sequence[str], n_procs: int, *,
+             devices_per_proc: int = 1,
+             extra_env: Optional[Dict[str, str]] = None,
+             max_restarts: int = 2,
+             checkpoint_root: Optional[str] = None,
+             heartbeat_dir: Optional[str] = None,
+             timeout: float = 600,
+             grace_s: float = 3.0,
+             peer_grace_s: float = 15.0,
+             log: bool = True) -> GangResult:
+    """Supervise `n_procs` copies of `argv` with gang-restart semantics.
+
+    Each incarnation gets a fresh port block and a fresh heartbeat
+    directory (a dead incarnation's beats must not fake liveness into the
+    next), plus `PADDLE_RESTART_NUM=<k>` so workers know they are a
+    resume.  When any worker dies, every straggler is killed and reaped
+    (they are wedged or about to classify-exit anyway), uncommitted
+    checkpoint debris is cleared, and the gang relaunches — workers
+    restore the last COMMITTED coordinated checkpoint and continue with
+    global step numbering.  After `max_restarts` exhausted the last
+    incarnation's outputs come back with ok=False."""
+    result = GangResult()
+    base_env = dict(extra_env or {})
+    if checkpoint_root:
+        base_env["PADDLE_CHECKPOINT_ROOT"] = checkpoint_root
+    # once-per-gang fault ledger: ranked FLAGS_fault_spec entries
+    # (kill_worker/stall_worker) record their firing here so a restarted
+    # incarnation replaying the same step does not replay the fault
+    if "PADDLE_FAULT_STATE_DIR" not in base_env:
+        import tempfile
+
+        base_env["PADDLE_FAULT_STATE_DIR"] = (
+            os.path.join(checkpoint_root, "fault-state") if checkpoint_root
+            else tempfile.mkdtemp(prefix="pt-fault-state-"))
+    os.makedirs(base_env["PADDLE_FAULT_STATE_DIR"], exist_ok=True)
+    for incarnation in range(max_restarts + 1):
+        result.incarnations = incarnation + 1
+        env = dict(base_env)
+        env["PADDLE_RESTART_NUM"] = str(incarnation)
+        hb = heartbeat_dir or (checkpoint_root and
+                               os.path.join(checkpoint_root, "hb"))
+        if hb:
+            inc_dir = os.path.join(hb, f"i{incarnation}")
+            shutil.rmtree(inc_dir, ignore_errors=True)
+            env["PADDLE_HEARTBEAT_DIR"] = inc_dir
+        with Gang(argv, n_procs, devices_per_proc=devices_per_proc,
+                  extra_env=env, grace_s=grace_s) as gang:
+            try:
+                ok, codes = gang.wait_any_death_or_exit(timeout=timeout)
+            except TimeoutError:
+                ok, codes = False, [p.poll() for p in gang.procs]
+            if not ok:
+                # survivors are raising classified errors right now (their
+                # watchdogs see the dead peer); give them one bounded
+                # window to exit 43/44 on their own — the exit codes are
+                # the incident record — before the reaper kills the rest
+                deadline = time.monotonic() + peer_grace_s
+                while (time.monotonic() < deadline
+                       and any(p.poll() is None for p in gang.procs)):
+                    time.sleep(0.05)
+                codes = [p.poll() for p in gang.procs]
+            result.workers = gang.communicate(timeout=grace_s)
+        if ok:
+            result.ok = True
+            return result
+        dead = [(r, c) for r, c in enumerate(codes) if c not in (None, 0)]
+        incident = {
+            "kind": "dist_event", "action": "worker_death",
+            "incarnation": incarnation,
+            "dead": [{"rank": r, "returncode": c,
+                      "classified": c in _CLASSIFIED_EXITS,
+                      "signaled": (c is not None and c < 0)}
+                     for r, c in dead],
+            # per-worker stderr tails: the only forensic record of an
+            # incarnation that is about to be replaced
+            "stderr_tails": {r: (result.workers[r][2] or "")[-2000:]
+                             for r in range(len(result.workers))},
+        }
+        result.incidents.append(incident)
+        _MON.counter("dist.worker_deaths").inc(max(len(dead), 1))
+        _MON.record_step(incident)
+        if log:
+            for r, c in dead:
+                err = result.workers[r][2] if r < len(result.workers) else ""
+                print(f"paddle_tpu.launch: worker {r} died "
+                      f"(returncode {c}) in incarnation {incarnation}:\n"
+                      f"{(err or '')[-2000:]}", file=sys.stderr, flush=True)
+        if incarnation == max_restarts:
+            break
+        _clear_uncommitted(checkpoint_root or "")
+        result.restarts += 1
+        _MON.counter("dist.gang_restarts").inc()
+        _MON.record_step({"kind": "dist_event", "action": "gang_restart",
+                          "incarnation": incarnation + 1,
+                          "after_death_of": [r for r, _ in dead]})
+        if log:
+            print(f"paddle_tpu.launch: gang restart "
+                  f"{result.restarts}/{max_restarts} — relaunching "
+                  f"{n_procs} workers from the last coordinated checkpoint",
+                  file=sys.stderr, flush=True)
+    _MON.record_step({"kind": "dist_event", "action": "gang_failed",
+                      "restarts": result.restarts})
+    return result
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.launch",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--nproc", type=int, default=2,
+                    help="workers in the gang (PADDLE_TRAINERS_NUM role)")
+    ap.add_argument("--devices-per-proc", type=int, default=1)
+    ap.add_argument("--max-restarts", type=int, default=2)
+    ap.add_argument("--checkpoint-root", default=None,
+                    help="coordinated-checkpoint directory (also exported "
+                         "as PADDLE_CHECKPOINT_ROOT to workers)")
+    ap.add_argument("--timeout", type=float, default=600)
+    ap.add_argument("--metrics", default=None,
+                    help="JSONL file for the launcher's dist_event records "
+                         "+ final counter snapshot (perf_report --check "
+                         "--max-gang-restarts input)")
+    ap.add_argument("script", help="worker script")
+    ap.add_argument("args", nargs=argparse.REMAINDER)
+    ns = ap.parse_args(argv)
+
+    logger = None
+    if ns.metrics:
+        from . import monitor as _monitor
+        from .monitor import MonitorLogger
+
+        _monitor.enable()
+        logger = _monitor.get_monitor().attach_logger(MonitorLogger(ns.metrics))
+    res = run_gang([sys.executable, ns.script, *ns.args], ns.nproc,
+                   devices_per_proc=ns.devices_per_proc,
+                   max_restarts=ns.max_restarts,
+                   checkpoint_root=ns.checkpoint_root,
+                   timeout=ns.timeout)
+    for rank, (code, out, err) in enumerate(res.workers):
+        sys.stdout.write(out or "")
+        if code != 0:
+            sys.stderr.write(f"-- worker {rank} (exit {code}) stderr tail --\n"
+                             f"{(err or '')[-2000:]}\n")
+    if logger is not None:
+        logger.write_snapshot()
+        from . import monitor as _monitor
+
+        _monitor.get_monitor().detach_logger(logger)
+    print(f"paddle_tpu.launch: {'ok' if res.ok else 'FAILED'} after "
+          f"{res.incarnations} incarnation(s), {res.restarts} restart(s)",
+          file=sys.stderr)
+    return 0 if res.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,11 +70,45 @@ def init_distributed(trainer_id: Optional[int] = None,
             jax.config.update("jax_cpu_collectives_implementation", "gloo")
         except Exception:
             pass
-    jax.distributed.initialize(
-        coordinator_address=trainer_endpoints[0],
-        num_processes=len(trainer_endpoints),
-        process_id=trainer_id,
-    )
+    # The persistent compilation cache (FLAGS_compile_cache_dir) corrupts
+    # the heap when a cross-process executable round-trips through it on
+    # this jaxlib (observed deterministically: malloc corruption / SIGSEGV
+    # in gang workers at the first cached multi-process compile).  The
+    # cold-start win is a single-process feature; force it off before the
+    # runtime goes multi-process.
+    if jax.config.jax_compilation_cache_dir:
+        import logging
+
+        logging.getLogger("paddle_tpu.distributed").warning(
+            "init_distributed: disabling the persistent compilation cache "
+            "(%s) for this multi-process run — cached cross-process "
+            "executables are unsafe on this backend",
+            jax.config.jax_compilation_cache_dir)
+        jax.config.update("jax_compilation_cache_dir", None)
+
+    # The bootstrap is the first gang-wide rendezvous, so it is also the
+    # first place a dead/never-started worker wedges everyone else.  Run
+    # it under a bounded deadline (FLAGS_dist_bootstrap_timeout_s) on a
+    # worker thread: expiry raises a classified CollectiveTimeoutError in
+    # this frame instead of blocking forever (the jax-level
+    # initialization_timeout is kept slightly wider as a backstop for the
+    # abandoned thread).
+    from ..dist_resilience import CollectiveWatchdog, active_heartbeat
+    from ..flags import flag as _flag
+
+    boot_timeout = float(_flag("FLAGS_dist_bootstrap_timeout_s"))
+
+    def _boot():
+        jax.distributed.initialize(
+            coordinator_address=trainer_endpoints[0],
+            num_processes=len(trainer_endpoints),
+            process_id=trainer_id,
+            initialization_timeout=max(int(boot_timeout) + 10, 15),
+        )
+
+    CollectiveWatchdog(heartbeat=active_heartbeat(),
+                       timeout_s=boot_timeout, rank=trainer_id).run(
+        _boot, what="jax.distributed.initialize")
     _initialized = True
 
 

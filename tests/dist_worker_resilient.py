@@ -1,0 +1,166 @@
+"""Gang worker for the distributed chaos suite (ISSUE 4).
+
+Trains RUN_STEPS sync-SGD steps under the FULL distributed-resilience
+stack: `fleet.init()` (heartbeat + collective watchdog + bounded
+coordination bootstrap), coordinated checkpoints every SAVE_EVERY steps
+(rank-0 COMMITTED marker), deterministic fault injection from
+FLAGS_fault_spec (kill_worker / stall_worker fire by rank), and
+classified exits (43 = peer failure, 44 = collective timeout) so the
+gang launcher can tell a resilience death from a crash.
+
+Restart contract: `paddle_tpu.launch run_gang` re-execs this script with
+PADDLE_RESTART_NUM > 0; the worker then restores the newest COMMITTED
+checkpoint and continues from its step with GLOBAL step numbering.  The
+batch for step S is derived from a per-step seeded RNG, so any
+restore-and-replay consumes exactly the batches an uninterrupted run
+would — the bit-parity property tests/test_dist_chaos.py pins via the
+end-state params digest printed on the RESULT line.
+"""
+import json
+import os
+import sys
+
+# must be set before jax import
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_xla_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        _xla_flags + " --xla_force_host_platform_device_count=1").strip()
+
+import hashlib  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+
+def build_model():
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 90
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [16], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="int64")
+        h = fluid.layers.fc(x, 32, act="relu")
+        logits = fluid.layers.fc(h, 10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+    return main, startup, loss
+
+
+def step_batch(step: int, batch: int = 32):
+    """The global batch of train step `step`, derived from the step index
+    alone — identical whether the step runs in the first incarnation or a
+    post-restart replay (the restart-parity property)."""
+    rng = np.random.RandomState(1234 + step)
+    xg = rng.rand(batch, 16).astype("f4")
+    yg = rng.randint(0, 10, size=(batch, 1)).astype("int64")
+    return xg, yg
+
+
+def params_digest(scope) -> str:
+    h = hashlib.sha256()
+    for name in sorted(scope.local_var_names()):
+        try:
+            a = np.asarray(scope.find_var(name))
+        except Exception:
+            continue
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def main():
+    import paddle_tpu as fluid
+    from paddle_tpu import dist_resilience as dres
+    from paddle_tpu import monitor
+    from paddle_tpu.errors import DistributedError
+    from paddle_tpu.faults import FaultInjector
+    from paddle_tpu.fleet import fleet
+    from paddle_tpu.monitor import MonitorLogger
+
+    run_steps = int(os.environ.get("RUN_STEPS", "8"))
+    save_every = int(os.environ.get("SAVE_EVERY", "2"))
+    ckpt_root = os.environ.get("PADDLE_CHECKPOINT_ROOT")
+    restart_num = int(os.environ.get("PADDLE_RESTART_NUM", "0"))
+    metrics = os.environ.get("PADDLE_METRICS_PATH")
+
+    logger = None
+    if metrics:
+        monitor.enable()
+        # one file per rank (concurrent appenders into one JSONL tear
+        # lines) AND per incarnation (counters reset with the process, so
+        # mixing incarnations would fake recompile churn into the gates)
+        rank_hint = os.environ.get("PADDLE_TRAINER_ID", "0")
+        logger = monitor.get_monitor().attach_logger(
+            MonitorLogger(f"{metrics}.r{rank_hint}.i{restart_num}"))
+
+    losses = []
+    try:
+        # the bootstrap is INSIDE the classified handler: a peer that never
+        # dials in surfaces as CollectiveTimeoutError from fleet.init's
+        # watchdog-bounded barrier, and must exit 44 with a tombstone just
+        # like a mid-training failure — not as a raw traceback
+        fleet.init()  # heartbeat + watchdog + bounded bootstrap
+        rank, world = fleet.worker_index(), fleet.worker_num()
+        injector = FaultInjector.from_flags()
+
+        main_p, startup, loss = build_model()
+        compiled = fleet.main_program(main_p) if world > 1 else main_p
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+
+        cm = None
+        if ckpt_root:
+            cm = fluid.CheckpointManager(
+                ckpt_root, program=main_p, scope=scope, rank=rank,
+                world_size=world, mesh=fleet.mesh if world > 1 else None,
+                commit_timeout_s=30)
+
+        start = 0
+        restored = None
+        if cm is not None and restart_num > 0:
+            restored = cm.restore(scope=scope)
+        if restored is None:
+            exe.run(startup, scope=scope)
+        else:
+            start = restored
+
+        per = 32 // world
+        for step in range(start, run_steps):
+            xg, yg = step_batch(step)
+            if injector is not None:
+                injector.on_dispatch(step)  # kill_worker/stall_worker point
+            (lv,) = exe.run(compiled,
+                            feed={"x": xg[rank * per:(rank + 1) * per],
+                                  "y": yg[rank * per:(rank + 1) * per]},
+                            fetch_list=[loss], scope=scope)
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+            done = step + 1
+            if (cm is not None and save_every and done % save_every == 0
+                    and done < run_steps):
+                cm.save(step=done)
+    except DistributedError as e:
+        # classified gang failure: tombstone so live peers learn NOW, then
+        # exit with the code the launcher keys restart decisions on.
+        # os._exit, not sys.exit — a thread abandoned inside a wedged gloo
+        # collective must not keep this corpse half-alive.
+        print(f"DIST_FAILURE {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+        if logger is not None:
+            logger.write_snapshot()
+        dres.shutdown_health(mark_down=True)
+        os._exit(dres.exit_code_for(e))
+
+    print("RESULT " + json.dumps({
+        "rank": rank, "world": world, "restart_num": restart_num,
+        "start_step": start, "steps_run": len(losses), "losses": losses,
+        "params_sha": params_digest(scope)}), flush=True)
+    if logger is not None:
+        logger.write_snapshot()
+    dres.shutdown_health()
+
+
+if __name__ == "__main__":
+    main()

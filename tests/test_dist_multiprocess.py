@@ -6,14 +6,15 @@ import sys
 
 import numpy as np
 
-from dist_harness import REPO, WORKER, collect, parse_losses, spawn_workers, worker_env
+from dist_harness import WORKER, collect, parse_losses, worker_env, worker_gang
 
 
 def test_two_process_loss_parity_with_single_process():
     """2 procs x 2 virtual devices == 1 proc x 4 virtual devices, same data
     stream => identical per-step losses (sync-SGD parity, the
     test_dist_base contract)."""
-    outs = collect(spawn_workers(2, devices_per_proc=2))
+    with worker_gang(2, devices_per_proc=2) as gang:
+        outs = collect(gang)
 
     # both workers must observe the same (global) losses and 4 global devices
     assert outs[0]["n_dev"] == 4 and outs[1]["n_dev"] == 4
@@ -23,7 +24,11 @@ def test_two_process_loss_parity_with_single_process():
     env = worker_env({"RUN_LOCAL": "1"}, devices_per_proc=4)
     local = subprocess.Popen([sys.executable, WORKER], stdout=subprocess.PIPE,
                              stderr=subprocess.PIPE, env=env, text=True)
-    out, err = local.communicate(timeout=600)
+    try:
+        out, err = local.communicate(timeout=600)
+    except subprocess.TimeoutExpired:
+        local.kill()
+        raise
     assert local.returncode == 0, f"local run failed:\n{err[-4000:]}"
     ref = parse_losses(out, err, "local")
     assert ref["n_dev"] == 4

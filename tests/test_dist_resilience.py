@@ -1,0 +1,470 @@
+"""Unit tier of the distributed-resilience chaos suite (ISSUE 4): the
+heartbeat/watchdog health layer, the distributed fault-spec grammar, the
+coordinated-checkpoint commit protocol, the gang launcher's port/reap
+mechanics, and the perf_report dist gates — all in-process, CPU-only,
+sub-second.  The multi-process integration tier lives in
+tests/test_dist_chaos.py."""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import dist_resilience as dres
+from paddle_tpu.checkpoint_manager import (COMMITTED_MARKER, DIST_MARKER,
+                                           CheckpointManager)
+from paddle_tpu.dist_resilience import (CollectiveWatchdog, Heartbeat,
+                                        HeartbeatConfig, dump_stacks,
+                                        guard_blocking)
+from paddle_tpu.errors import (CollectiveTimeoutError, DistributedError,
+                               NumericError, PeerFailureError, TrainingError,
+                               classify)
+from paddle_tpu.faults import FaultInjector, parse_fault_spec
+from paddle_tpu.launch import Gang, allocate_port_block
+
+FAST = HeartbeatConfig(interval_s=0.02, miss_factor=4, startup_grace_s=5.0)
+
+
+# --- taxonomy ---------------------------------------------------------------
+
+def test_distributed_error_taxonomy():
+    e = PeerFailureError("w", rank=0, peers=[1, 3], collective="allreduce",
+                         step=7)
+    assert isinstance(e, DistributedError) and isinstance(e, TrainingError)
+    assert isinstance(e, RuntimeError)  # legacy catch sites keep working
+    assert classify(e) is e  # already classified: returned untouched
+    s = str(e)
+    assert "rank=0" in s and "peers=[1, 3]" in s and "allreduce" in s
+    t = CollectiveTimeoutError("t", rank=2, collective="barrier")
+    assert classify(t) is t and "barrier" in str(t)
+    assert dres.exit_code_for(e) == dres.EXIT_PEER_FAILURE == 43
+    assert dres.exit_code_for(t) == dres.EXIT_COLLECTIVE_TIMEOUT == 44
+    assert dres.exit_code_for(ValueError("x")) == 1
+
+
+# --- fault spec grammar -----------------------------------------------------
+
+def test_distributed_fault_spec_grammar():
+    fs = parse_fault_spec("kill_worker@3:1;stall_worker@6:0:0.25;nan@2")
+    assert [str(f) for f in fs] == ["kill_worker@3:1", "stall_worker@6:0:0.25",
+                                    "nan@2"]
+    assert fs[0].target_rank == 1
+    assert fs[1].target_rank == 0 and fs[1].stall_s == 0.25
+    assert fs[2].target_rank is None
+    for bad in ("kill_worker@3", "kill_worker@3:x", "stall_worker@3:1",
+                "stall_worker@3:1:fast", "kill_worker3:1"):
+        with pytest.raises(ValueError):
+            parse_fault_spec(bad)
+
+
+def test_ranked_faults_fire_only_on_matching_rank(monkeypatch):
+    kills = []
+    monkeypatch.setattr(os, "kill", lambda pid, sig: kills.append((pid, sig)))
+    # wrong rank: entry stays pending, nothing fires
+    inj = FaultInjector("kill_worker@3:1", rank=0)
+    inj.on_dispatch(3)
+    assert not kills and [str(f) for f in inj.pending()] == ["kill_worker@3:1"]
+    # matching rank: SIGKILL delivered (the hard death, not SIGTERM)
+    inj = FaultInjector("kill_worker@3:1", rank=1)
+    inj.on_dispatch(3)
+    assert kills == [(os.getpid(), signal.SIGKILL)]
+    assert inj.summary() == {"kill_worker": 1}
+
+
+def test_stall_worker_sleeps_for_spec_duration(monkeypatch):
+    import paddle_tpu.faults as faults_mod
+
+    naps = []
+    monkeypatch.setattr(faults_mod.time, "sleep", lambda s: naps.append(s))
+    inj = FaultInjector("stall_worker@5:0:0.4", rank=0)
+    inj.on_dispatch(4)
+    assert naps == []
+    inj.on_dispatch(5)
+    assert naps == [0.4]
+    inj.on_dispatch(5)  # fires exactly once
+    assert naps == [0.4]
+
+
+def test_fault_state_dir_spends_ranked_entries_across_incarnations(
+        tmp_path, monkeypatch):
+    """A gang restart replays the failed step; the once-per-gang ledger
+    must keep the same kill from firing in every incarnation (the bug the
+    first end-to-end run of run_gang hit)."""
+    monkeypatch.setenv("PADDLE_FAULT_STATE_DIR", str(tmp_path))
+    kills = []
+    monkeypatch.setattr(os, "kill", lambda pid, sig: kills.append(sig))
+    FaultInjector("kill_worker@3:0", rank=0).on_dispatch(3)  # incarnation 0
+    assert len(kills) == 1
+    assert any(n.startswith("fired-kill_worker@3") for n in os.listdir(tmp_path))
+    inj2 = FaultInjector("kill_worker@3:0", rank=0)  # incarnation 1
+    inj2.on_dispatch(3)
+    assert len(kills) == 1  # spent: did not fire again
+    assert inj2.pending() == []
+
+
+# --- heartbeat --------------------------------------------------------------
+
+def _wait_for(pred, timeout=3.0, every=0.01):
+    t0 = time.monotonic()
+    while not pred():
+        assert time.monotonic() - t0 < timeout, "condition never held"
+        time.sleep(every)
+
+
+def test_heartbeat_liveness_and_staleness_death(tmp_path):
+    h0 = Heartbeat(0, 2, config=FAST, hb_dir=str(tmp_path)).start()
+    h1 = Heartbeat(1, 2, config=FAST, hb_dir=str(tmp_path)).start()
+    try:
+        _wait_for(lambda: h0.observe().get(1) is not None)
+        assert h0.dead_peers() == [] and h1.dead_peers() == []
+        h1.stop()  # silent death: no tombstone, peers see staleness
+        t0 = time.monotonic()
+        _wait_for(lambda: h0.dead_peers() == [1])
+        # detected within a few liveness deadlines, not by luck of a long wait
+        assert time.monotonic() - t0 < FAST.deadline_s * 10
+    finally:
+        h0.stop()
+        h1.stop()
+
+
+def test_heartbeat_udp_transport_on_endpoint_contract():
+    """Multi-host path: beats as UDP datagrams to the PADDLE_TRAINER_
+    ENDPOINTS ports (a separate namespace from the coordinator's TCP
+    bind, so the ports are free to reuse)."""
+    base = allocate_port_block(2)
+    eps = [f"127.0.0.1:{base}", f"127.0.0.1:{base + 1}"]
+    h0 = Heartbeat(0, 2, endpoints=eps, config=FAST, hb_dir="").start()
+    h1 = Heartbeat(1, 2, endpoints=eps, config=FAST, hb_dir="").start()
+    try:
+        _wait_for(lambda: h0.observe().get(1) is not None
+                  and h1.observe().get(0) is not None)
+        assert h0.dead_peers() == [] and h1.dead_peers() == []
+        h1.stop(mark_down=True)  # FIN datagram: immediate tombstone
+        _wait_for(lambda: h0.dead_peers() == [1])
+    finally:
+        h0.stop()
+        h1.stop()
+
+
+def test_heartbeat_tombstone_is_immediate_death(tmp_path):
+    h0 = Heartbeat(0, 2, config=FAST, hb_dir=str(tmp_path)).start()
+    h1 = Heartbeat(1, 2, config=FAST, hb_dir=str(tmp_path)).start()
+    try:
+        _wait_for(lambda: h0.observe().get(1) is not None)
+        h1.stop(mark_down=True)  # classified death: explicit tombstone
+        _wait_for(lambda: h0.dead_peers() == [1], timeout=1.0)
+    finally:
+        h0.stop()
+        h1.stop()
+
+
+# --- watchdog ---------------------------------------------------------------
+
+def test_watchdog_timeout_raises_instead_of_hanging():
+    from paddle_tpu import monitor
+
+    wd = CollectiveWatchdog(heartbeat=None, timeout_s=0.1, poll_s=0.01)
+    dumps_before = monitor.counter("dist.stack_dumps").value
+    t0 = time.monotonic()
+    with pytest.raises(CollectiveTimeoutError) as ei:
+        wd.run(lambda: time.sleep(30), what="barrier")
+    assert time.monotonic() - t0 < 5.0  # raised promptly, no 30s hang
+    assert ei.value.collective == "barrier"
+    # stack dump only ticks counters when the monitor is enabled; assert
+    # the call path ran by checking it against an enabled monitor
+    monitor.enable()
+    try:
+        with pytest.raises(CollectiveTimeoutError):
+            wd.run(lambda: time.sleep(30), what="barrier2")
+        assert monitor.counter("dist.stack_dumps").value > dumps_before
+    finally:
+        monitor.disable()
+
+
+def test_watchdog_dead_peer_raises_peer_failure(tmp_path):
+    h0 = Heartbeat(0, 2, config=FAST, hb_dir=str(tmp_path)).start()
+    h1 = Heartbeat(1, 2, config=FAST, hb_dir=str(tmp_path)).start()
+    try:
+        _wait_for(lambda: h0.observe().get(1) is not None)
+        h1.stop()
+        wd = CollectiveWatchdog(heartbeat=h0, timeout_s=30, poll_s=0.01)
+        with pytest.raises(PeerFailureError) as ei:
+            wd.run(lambda: time.sleep(30), what="allreduce")
+        assert ei.value.peers == [1] and ei.value.rank == 0
+    finally:
+        h0.stop()
+        h1.stop()
+
+
+def test_watchdog_reclassifies_collective_error_after_peer_death(tmp_path):
+    """A SIGKILLed peer tears its sockets down, so the collective's raw
+    connection error usually races ahead of heartbeat staleness — the
+    watchdog must wait out one liveness deadline and reclassify, not
+    surface the raw error as if it were transient."""
+    h0 = Heartbeat(0, 2, config=FAST, hb_dir=str(tmp_path)).start()
+    h1 = Heartbeat(1, 2, config=FAST, hb_dir=str(tmp_path)).start()
+    try:
+        _wait_for(lambda: h0.observe().get(1) is not None)
+        h1.stop()  # dies silently...
+        wd = CollectiveWatchdog(heartbeat=h0, timeout_s=30, poll_s=0.01)
+
+        def gloo_like_failure():
+            raise RuntimeError("Connection closed by peer [127.0.0.1]:1234")
+
+        with pytest.raises(PeerFailureError) as ei:
+            wd.run(gloo_like_failure, what="executor.fetch")
+        assert isinstance(ei.value.__cause__, RuntimeError)
+    finally:
+        h0.stop()
+        h1.stop()
+
+
+def test_watchdog_exonerates_alive_peers_quickly(tmp_path):
+    """The flip side of reclassification: a raw error with every peer
+    provably alive (sequence advanced after the error) must re-raise as
+    itself — promptly, not after the whole liveness deadline, and never
+    as PeerFailureError."""
+    slow = HeartbeatConfig(interval_s=0.05, miss_factor=40,  # 2s deadline
+                           startup_grace_s=5.0)
+    h0 = Heartbeat(0, 2, config=slow, hb_dir=str(tmp_path)).start()
+    h1 = Heartbeat(1, 2, config=slow, hb_dir=str(tmp_path)).start()
+    try:
+        _wait_for(lambda: h0.observe().get(1) is not None)
+        wd = CollectiveWatchdog(heartbeat=h0, timeout_s=30, poll_s=0.01)
+
+        def raw_failure():
+            raise RuntimeError("transient wobble, nobody died")
+
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError) as ei:
+            wd.run(raw_failure, what="executor.fetch")
+        held = time.monotonic() - t0
+        assert not isinstance(ei.value, TrainingError)
+        # exonerated after ~2 beats, far inside the 2s liveness deadline
+        assert held < slow.deadline_s / 2, f"held re-raise {held:.2f}s"
+    finally:
+        h0.stop()
+        h1.stop()
+
+
+def test_watchdog_passes_results_and_errors_through(tmp_path):
+    wd = CollectiveWatchdog(heartbeat=None, timeout_s=5, poll_s=0.01)
+    assert wd.run(lambda: 42) == 42
+    with pytest.raises(ZeroDivisionError):
+        wd.run(lambda: 1 // 0)
+    # TrainingErrors skip the dead-peer reclassification wait entirely
+    h0 = Heartbeat(0, 2, config=FAST, hb_dir=str(tmp_path)).start()
+    try:
+        wd = CollectiveWatchdog(heartbeat=h0, timeout_s=5, poll_s=0.01)
+
+        def numeric():
+            raise NumericError("NaN")
+
+        t0 = time.monotonic()
+        with pytest.raises(TrainingError):
+            wd.run(numeric)
+        assert time.monotonic() - t0 < FAST.deadline_s  # no liveness wait
+    finally:
+        h0.stop()
+
+
+def test_guard_blocking_and_health_lifecycle(tmp_path, monkeypatch):
+    assert guard_blocking(lambda: 7) == 7  # inactive: direct call
+    assert dres.active_watchdog() is None
+    monkeypatch.setenv("PADDLE_HEARTBEAT_DIR", str(tmp_path))
+    wd = dres.init_health(rank=0, world=1, config=FAST)
+    try:
+        assert dres.active_watchdog() is wd
+        assert dres.init_health(rank=0, world=1) is wd  # idempotent
+        assert guard_blocking(lambda: 9) == 9  # routed through the watchdog
+    finally:
+        dres.shutdown_health()
+    assert dres.active_watchdog() is None and dres.active_heartbeat() is None
+
+
+def test_dump_stacks_names_every_thread():
+    text = dump_stacks("unit test", file=open(os.devnull, "w"))
+    assert "MainThread" in text and "unit test" in text
+
+
+# --- coordinated checkpoint commit ------------------------------------------
+
+def _model(seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        loss = fluid.layers.mean(fluid.layers.fc(x, 2))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    startup.random_seed = seed
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+    return main, scope
+
+
+def test_coordinated_commit_requires_every_rank(tmp_path):
+    root = str(tmp_path)
+    m0, s0 = _model()
+    m1, s1 = _model()
+    cm0 = CheckpointManager(root, program=m0, scope=s0, rank=0, world_size=2,
+                            commit_timeout_s=10)
+    cm1 = CheckpointManager(root, program=m1, scope=s1, rank=1, world_size=2,
+                            commit_timeout_s=10)
+    # rank 1 alone: shards land in the pending dir, nothing committed
+    cm1.save(step=2)
+    pending = os.path.join(root, "ckpt-0000000002.tmp")
+    assert os.path.exists(os.path.join(pending, "SHARD_DONE.p1"))
+    assert cm0.checkpoints() == []
+    # rank 0 joins: rank-0 commit renames into place with the marker
+    cm0.save(step=2)
+    final = os.path.join(root, "ckpt-0000000002")
+    assert os.path.exists(os.path.join(final, COMMITTED_MARKER))
+    assert os.path.exists(os.path.join(final, DIST_MARKER))
+    assert not os.path.exists(pending)
+    # restore round-trips state for both ranks' managers
+    m2, s2 = _model(seed=9)
+    assert CheckpointManager(root, program=m2, scope=s2).restore(scope=s2) == 2
+    w_name = next(n for n in s0.local_var_names() if "w" in n or "fc" in n)
+    np.testing.assert_array_equal(np.asarray(s2.find_var(w_name)),
+                                  np.asarray(s0.find_var(w_name)))
+
+
+def test_restore_skips_uncommitted_distributed_checkpoint(tmp_path):
+    """The satellite scenario verbatim: a worker crashes after its own
+    shard commits, leaving a mixed-step directory; restore must walk back
+    to the last coordinated step instead of loading it."""
+    root = str(tmp_path)
+    m0, s0 = _model()
+    m1, s1 = _model()
+    cm0 = CheckpointManager(root, program=m0, scope=s0, rank=0, world_size=2,
+                            commit_timeout_s=10)
+    cm1 = CheckpointManager(root, program=m1, scope=s1, rank=1, world_size=2,
+                            commit_timeout_s=10)
+    cm1.save(step=2)
+    cm0.save(step=2)  # committed at step 2
+    cm1.save(step=4)  # rank 0 "crashed": step 4 never commits
+    fresh = CheckpointManager(root, program=m0, scope=s0)
+    assert fresh.restore(scope=s0) == 2
+    # a mixed-step dir that somehow LOOKS final (legacy non-atomic rename)
+    # is still refused without its COMMITTED marker
+    bad = os.path.join(root, "ckpt-0000000006")
+    os.makedirs(bad)
+    with open(os.path.join(bad, "STEP"), "w") as f:
+        f.write("6")
+    with open(os.path.join(bad, DIST_MARKER), "w") as f:
+        f.write("2")
+    assert CheckpointManager(root, program=m0, scope=s0).restore(scope=s0) == 2
+
+
+def test_rank0_commit_wait_is_bounded_and_classified(tmp_path):
+    m0, s0 = _model()
+    cm0 = CheckpointManager(str(tmp_path), program=m0, scope=s0, rank=0,
+                            world_size=2, commit_timeout_s=0.3)
+    t0 = time.monotonic()
+    with pytest.raises(CollectiveTimeoutError):
+        cm0.save(step=2)  # rank 1 never arrives
+    assert time.monotonic() - t0 < 5.0
+    # heartbeat-aware: a DEAD peer short-circuits the timeout
+    hb_dir = str(tmp_path / "hb")
+    h0 = Heartbeat(0, 2, config=FAST, hb_dir=hb_dir).start()
+    h1 = Heartbeat(1, 2, config=FAST, hb_dir=hb_dir).start()
+    try:
+        _wait_for(lambda: h0.observe().get(1) is not None)
+        h1.stop(mark_down=True)
+        dres._HEARTBEAT = h0  # arm the process-global oracle
+        cm0.commit_timeout_s = 30
+        t0 = time.monotonic()
+        with pytest.raises(PeerFailureError):
+            cm0.save(step=4)
+        assert time.monotonic() - t0 < 10.0
+    finally:
+        dres._HEARTBEAT = None
+        h0.stop()
+        h1.stop()
+
+
+def test_single_process_checkpoints_unaffected(tmp_path):
+    """world_size=1 keeps the PR-3 contract: atomic rename, no DIST
+    marker, restore without commit ceremony."""
+    m0, s0 = _model()
+    cm = CheckpointManager(str(tmp_path), program=m0, scope=s0)
+    d = cm.save(step=3)
+    assert not os.path.exists(os.path.join(d, DIST_MARKER))
+    assert os.path.exists(os.path.join(d, COMMITTED_MARKER))
+    assert cm.restore(scope=s0) == 3
+
+
+# --- launcher mechanics -----------------------------------------------------
+
+def test_allocate_port_block_returns_bindable_contiguous_block():
+    import socket
+
+    base = allocate_port_block(4)
+    socks = []
+    try:
+        for i in range(4):
+            s = socket.socket()
+            socks.append(s)
+            s.bind(("127.0.0.1", base + i))  # every port genuinely free
+    finally:
+        for s in socks:
+            s.close()
+
+
+def test_gang_context_manager_reaps_on_body_failure():
+    """The spawn-leak satellite: a raising test body (or failed later
+    spawn) must leave zero live workers behind."""
+    leaked = []
+    try:
+        with Gang([sys.executable, "-c", "import time; time.sleep(600)"],
+                  n_procs=2, grace_s=2.0) as g:
+            procs = list(g.procs)
+            assert all(p.poll() is None for p in procs)
+            raise RuntimeError("test body failed")
+    except RuntimeError:
+        pass
+    leaked = [p.pid for p in procs if p.poll() is None]
+    assert not leaked, f"gang leaked live workers: {leaked}"
+
+
+# --- perf_report gates ------------------------------------------------------
+
+def _write_jsonl(path, records):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+
+
+def test_perf_report_dist_gates(tmp_path):
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    import perf_report
+
+    steps = [{"kind": "step", "recompiles_total": 1} for _ in range(6)]
+    snap = {"kind": "snapshot",
+            "counters": {"dist.heartbeat.sent": 200,
+                         "dist.heartbeat.missed": 2,
+                         "dist.gang_restarts": 1}}
+    events = [{"kind": "dist_event", "action": "gang_restart",
+               "incarnation": 1},
+              {"kind": "dist_event", "action": "peer_failure", "peers": [1]}]
+    p = str(tmp_path / "m.jsonl")
+    _write_jsonl(p, steps + events + [snap])
+    assert perf_report.heartbeat_miss_fraction(
+        [json.loads(l) for l in open(p)]) == pytest.approx(0.01)
+    assert perf_report.check(p, max_heartbeat_miss_frac=0.05,
+                             max_gang_restarts=1) == 0
+    assert perf_report.check(p, max_heartbeat_miss_frac=0.001) == 1
+    assert perf_report.check(p, max_gang_restarts=0) == 1
+    # a launcher-side file has no step records but must still be gateable
+    p2 = str(tmp_path / "launcher.jsonl")
+    _write_jsonl(p2, events + [snap])
+    assert perf_report.check(p2, max_gang_restarts=2) == 0
+    assert perf_report.check(p2, max_gang_restarts=0) == 1
+    # ...while the non-dist gates still demand step records
+    assert perf_report.check(p2, max_retry_frac=0.5) == 1

@@ -29,6 +29,20 @@
         paddle_tpu.resilience.resilient_train_loop): a healthy run sits
         near 0; above the threshold the run is burning its budget
         re-doing work (flaky data source, NaN-prone config, sick device).
+
+    python tools/perf_report.py --check metrics.jsonl --max-heartbeat-miss-frac 0.02
+        Gate the distributed health layer (paddle_tpu.dist_resilience):
+        heartbeat-miss transitions over beats sent, read from the newest
+        counter snapshot in the file (MonitorLogger.write_snapshot).  A
+        creeping fraction means peers keep falling past the liveness
+        deadline — flaky network, GC pauses, or a host about to die.
+
+    python tools/perf_report.py --check metrics.jsonl --max-gang-restarts 1
+        Gate gang restarts (paddle_tpu.launch run_gang dist_event records
+        / dist.gang_restarts counter): each one is a full
+        rollback-and-relaunch, so a chaos budget above the expected
+        schedule means workers are dying for reasons the fault spec does
+        not explain.
 """
 from __future__ import annotations
 
@@ -112,6 +126,21 @@ def render(path: str) -> str:
             f"inflight depth avg {sum(depths)/len(depths):.2f} "
             f"max {max(depths)}")
 
+    devents = [s for s in records if s.get("kind") == "dist_event"]
+    counters = snap.get("counters", {})
+    if devents or any(n.startswith("dist.") for n in counters):
+        rows = [(r.get("action", "?"),
+                 r.get("rank", r.get("incarnation", "")),
+                 r.get("peers", r.get("peer", r.get("what",
+                       r.get("after_death_of", "")))))
+                for r in devents]
+        hb = heartbeat_miss_fraction([snap] if counters else [])
+        parts.append(f"\n## distributed ({len(devents)} events, "
+                     f"heartbeat-miss fraction {hb:.4f}, "
+                     f"gang restarts {counters.get('dist.gang_restarts', 0)})\n"
+                     + (_fmt_table(rows, ["action", "rank/inc", "detail"])
+                        if rows else "(counters only)"))
+
     revents = [s for s in records if s.get("kind") == "resilience_event"]
     if revents:
         rows = [(r.get("action", "?"), r.get("class", "?"),
@@ -138,6 +167,38 @@ def retry_fraction(records):
     rec = sum(1 for r in records if r.get("kind") == "resilience_event"
               and r.get("action") in RECOVERY_ACTIONS)
     return rec / steps if steps else 0.0
+
+
+def _latest_dist_counters(lines):
+    """dist.* counters from the NEWEST record carrying a counter map (a
+    MonitorLogger.write_snapshot line, or a rendered snapshot dict)."""
+    for rec in reversed(lines):
+        counters = rec.get("counters")
+        if isinstance(counters, dict):
+            return {n: v for n, v in counters.items() if n.startswith("dist.")}
+    return {}
+
+
+def heartbeat_miss_fraction(lines):
+    """Missed-liveness transitions per beat sent, from the newest counter
+    snapshot in a metrics stream.  The distributed-health number: ~0 on a
+    healthy gang; each unit of the numerator is one peer observed falling
+    past the deadline (paddle_tpu.dist_resilience heartbeat)."""
+    c = _latest_dist_counters(lines)
+    sent = c.get("dist.heartbeat.sent", 0)
+    missed = c.get("dist.heartbeat.missed", 0)
+    return missed / sent if sent else 0.0
+
+
+def gang_restart_count(lines):
+    """Gang restarts: the launcher's dist_event records, falling back to
+    the dist.gang_restarts counter snapshot when the event lines were
+    rotated away."""
+    n = sum(1 for r in lines if r.get("kind") == "dist_event"
+            and r.get("action") == "gang_restart")
+    if n:
+        return n
+    return int(_latest_dist_counters(lines).get("dist.gang_restarts", 0))
 
 
 def host_blocked_fraction(pipeline_steps):
@@ -179,7 +240,9 @@ def diff(path_a: str, path_b: str) -> str:
 
 def check(path: str, steady_after: int = 2,
           max_host_blocked_frac: float = None,
-          max_retry_frac: float = None) -> int:
+          max_retry_frac: float = None,
+          max_heartbeat_miss_frac: float = None,
+          max_gang_restarts: int = None) -> int:
     """Return 0 when the metrics file is healthy, 1 otherwise (printed
     diagnosis either way).  Made for CI/bench scripts:
 
@@ -200,13 +263,20 @@ def check(path: str, steady_after: int = 2,
         print(f"perf_report --check: {path} is not valid JSONL: {e}")
         return 1
     steps = [r for r in lines if r.get("kind") == "step"]
-    if not steps:
+    # a launcher-side metrics file (gang restarts, dist events) carries no
+    # executor step records; the dist gates must still be checkable on it
+    dist_gates_only = (max_heartbeat_miss_frac is not None
+                       or max_gang_restarts is not None) \
+        and max_host_blocked_frac is None and max_retry_frac is None
+    if not steps and not dist_gates_only:
         print(f"perf_report --check: {path} contains no step records "
               f"({len(lines)} lines)")
         return 1
     failures = []
     steady = steps[steady_after:]
-    if not steady:
+    if not steps:
+        pass  # dist-gates-only file: no recompile gate to run
+    elif not steady:
         print(f"perf_report --check: only {len(steps)} steps, fewer than "
               f"--steady-after={steady_after}; recompile gate skipped")
     else:
@@ -259,6 +329,31 @@ def check(path: str, steady_after: int = 2,
         else:
             print(f"perf_report --check: recovery fraction {frac:.3f} <= "
                   f"{max_retry_frac}")
+    if max_heartbeat_miss_frac is not None:
+        frac = heartbeat_miss_fraction(lines)
+        if frac > max_heartbeat_miss_frac:
+            failures.append(
+                f"heartbeat-miss fraction {frac:.4f} exceeds the "
+                f"--max-heartbeat-miss-frac={max_heartbeat_miss_frac} gate "
+                f"— peers keep falling past the liveness deadline "
+                f"(flaky network, long GC/compile pauses, or a host on "
+                f"its way out); check dist.heartbeat.* counters and the "
+                f"stack dumps in worker stderr")
+        else:
+            print(f"perf_report --check: heartbeat-miss fraction "
+                  f"{frac:.4f} <= {max_heartbeat_miss_frac}")
+    if max_gang_restarts is not None:
+        n = gang_restart_count(lines)
+        if n > max_gang_restarts:
+            failures.append(
+                f"{n} gang restart(s) exceed the "
+                f"--max-gang-restarts={max_gang_restarts} gate — each one "
+                f"is a full rollback to the last coordinated checkpoint; "
+                f"workers are dying beyond what the fault schedule "
+                f"explains (see worker_death dist_event records)")
+        else:
+            print(f"perf_report --check: gang restarts {n} <= "
+                  f"{max_gang_restarts}")
     if failures:
         for f_ in failures:
             print(f"perf_report --check: {f_}")
@@ -288,10 +383,22 @@ def main(argv=None):
                     help="additionally gate recovery events per step "
                          "(resilience_event records from paddle_tpu."
                          "resilience.resilient_train_loop) at <= FRAC")
+    ap.add_argument("--max-heartbeat-miss-frac", type=float, default=None,
+                    metavar="FRAC",
+                    help="gate heartbeat-miss transitions per beat sent "
+                         "(dist.heartbeat.* counters from paddle_tpu."
+                         "dist_resilience, newest snapshot in the file) "
+                         "at <= FRAC")
+    ap.add_argument("--max-gang-restarts", type=int, default=None,
+                    metavar="N",
+                    help="gate gang restarts (paddle_tpu.launch "
+                         "gang_restart dist_event records / "
+                         "dist.gang_restarts counter) at <= N")
     args = ap.parse_args(argv)
     if args.check:
         return check(args.check, args.steady_after,
-                     args.max_host_blocked_frac, args.max_retry_frac)
+                     args.max_host_blocked_frac, args.max_retry_frac,
+                     args.max_heartbeat_miss_frac, args.max_gang_restarts)
     if args.diff:
         print(diff(*args.diff))
         return 0
