@@ -414,6 +414,103 @@ def bench_chaos(steps=48, batch_size=256, max_inflight=3,
             "batch_size": batch_size, "max_inflight": max_inflight}
 
 
+def bench_chaos_data(fault_spec="corrupt_chunk@2", steps=32, batch_size=64,
+                     budget=4, chunk_records=64):
+    """Data-corruption A/B (ISSUE 5): the same seeded MLP trained from a
+    RecordIO-backed checkpointable reader pipeline twice — once over
+    pristine files, once after the fault injector mutates chunks ON DISK
+    (`corrupt_chunk@N` / `truncated_file@N` via `on_files`) with a corrupt
+    budget armed.  Reports both rates, the corrupt-chunk ledger
+    (`data.corrupt_chunks` / `data.chunks_scanned`), and how many batches
+    survived — the cost of tolerating rotting storage as a number."""
+    import os
+    import shutil
+    import tempfile
+
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor, recordio
+    from paddle_tpu import reader as rd
+    from paddle_tpu.faults import FaultInjector
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data("x", [16], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h = fluid.layers.fc(x, 64, act="relu")
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(h, 1), y))
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    startup.random_seed = main_p.random_seed = 7
+
+    root = tempfile.mkdtemp(prefix="pt-chaos-data-")
+    rng = np.random.RandomState(0)
+    path = os.path.join(root, "train.rio")
+    recordio.write_arrays(
+        path,
+        [(rng.rand(16).astype("f4"),) for _ in range(steps * batch_size)],
+        max_chunk_records=chunk_records)
+
+    def make_factory(p):
+        def to_feed(samples):
+            xv = np.stack([s[0] for s in samples])
+            return {"x": xv, "y": xv.sum(1, keepdims=True)}
+
+        def factory():
+            return rd.map_readers(
+                to_feed, rd.batch(recordio.reader_creator(p), batch_size,
+                                  drop_last=True))
+
+        return factory
+
+    def run(p):
+        recordio.reset_corrupt_spent()
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        t0 = _time.perf_counter()
+        stats = fluid.resilient_train_loop(
+            exe, main_p, make_factory(p), [loss], scope=scope,
+            policy=fluid.RetryPolicy(backoff_base_s=0.0),
+            max_inflight=3, log_period=8)
+        return stats, _time.perf_counter() - t0
+
+    run(path)  # warmup/compile outside both timing windows
+    monitor.enable()
+    clean_stats, clean_wall = run(path)
+    corrupt_path = os.path.join(root, "train_corrupt.rio")
+    shutil.copyfile(path, corrupt_path)
+    injector = FaultInjector(fault_spec)
+    injector.on_files([corrupt_path])
+    monitor.reset()
+    fluid.set_flags({"FLAGS_data_corrupt_budget": budget})
+    try:
+        chaos_stats, chaos_wall = run(corrupt_path)
+    finally:
+        fluid.set_flags({"FLAGS_data_corrupt_budget": 0})
+    counters = monitor.get_monitor().counter_values()
+    monitor.disable()
+    clean_sps = clean_stats.steps / clean_wall
+    chaos_sps = chaos_stats.steps / chaos_wall if chaos_wall else 0.0
+    corrupt = int(counters.get("data.corrupt_chunks", 0))
+    scanned = int(counters.get("data.chunks_scanned", 0))
+    print(f"chaos-data: clean {clean_sps:.1f} steps/s, corrupted "
+          f"{chaos_sps:.1f} steps/s ({corrupt}/{scanned} chunks dropped, "
+          f"{clean_stats.steps - chaos_stats.steps} batch(es) lost)",
+          file=sys.stderr)
+    return {"metric": "chaos_data_train_steps_per_sec",
+            "value": round(chaos_sps, 2), "unit": "steps/sec",
+            "clean_steps_per_sec": round(clean_sps, 2),
+            "corrupt_overhead": round(1.0 - chaos_sps / clean_sps, 4)
+            if clean_sps else 0.0,
+            "fault_spec": fault_spec, "budget": budget,
+            "corrupt_chunks": corrupt, "chunks_scanned": scanned,
+            "data_corrupt_frac": round(corrupt / scanned, 5) if scanned else 0.0,
+            "clean_steps": clean_stats.steps, "chaos_steps": chaos_stats.steps,
+            "batches_lost": clean_stats.steps - chaos_stats.steps,
+            "survived": bool(chaos_stats.steps > 0),
+            "batch_size": batch_size, "chunk_records": chunk_records}
+
+
 def bench_chaos_dist(fault_spec, steps=12, n_procs=2, save_every=3,
                      max_restarts=2):
     """Multi-worker chaos benchmark: the same 2-worker sync-SGD gang run
@@ -478,6 +575,7 @@ def bench_chaos_dist(fault_spec, steps=12, n_procs=2, save_every=3,
 
 
 _DIST_FAULT_KINDS = ("kill_worker", "stall_worker")
+_DATA_FAULT_KINDS = ("corrupt_chunk", "truncated_file")
 
 
 def main():
@@ -492,10 +590,13 @@ def main():
         print(json.dumps(bench_pipeline()))
         return
     if "--chaos" in sys.argv:
-        # distributed entries route to the multi-worker gang bench; plain
-        # specs keep the single-process resilient-loop bench
+        # distributed entries route to the multi-worker gang bench, data
+        # entries to the RecordIO corruption A/B; plain specs keep the
+        # single-process resilient-loop bench
         if fault_spec and any(k in fault_spec for k in _DIST_FAULT_KINDS):
             print(json.dumps(bench_chaos_dist(fault_spec)))
+        elif fault_spec and any(k in fault_spec for k in _DATA_FAULT_KINDS):
+            print(json.dumps(bench_chaos_data(fault_spec)))
         elif fault_spec:
             print(json.dumps(bench_chaos(fault_spec=fault_spec)))
         else:

@@ -75,39 +75,59 @@ struct Scanner {
   std::vector<uint8_t> chunk;
   size_t pos = 0;
   uint32_t remaining = 0;
+  // --- stream-state + corruption-tolerance bookkeeping -------------------
+  bool tolerant = false;        // skip corrupt chunks instead of erroring
+  long long corrupt_chunks = 0; // chunks dropped (CRC fail / truncation)
+  long long chunk_index = -1;   // ordinal of the currently loaded chunk
+  uint32_t chunk_nrecs = 0;     // record count of the loaded chunk
 
+  // Loads the next chunk.  Returns false at clean EOF (g_error empty) or
+  // on error (g_error set).  In tolerant mode a CRC-failed chunk is
+  // skipped (counted, next chunk tried); a truncated / frame-broken tail
+  // ends the file cleanly after counting one corrupt chunk — resyncing a
+  // lost frame would require scanning for magic, and a truncated tail has
+  // no more data either way.
   bool load_chunk() {
-    uint32_t magic, n, crc;
-    uint64_t len;
-    if (fread(&magic, 4, 1, f) != 1) return false;  // clean EOF
-    if (magic != kMagic) {
-      g_error = "recordio: bad chunk magic";
-      return false;
+    for (;;) {
+      uint32_t magic, n, crc;
+      uint64_t len;
+      if (fread(&magic, 4, 1, f) != 1) return false;  // clean EOF
+      chunk_index++;  // a chunk frame begins here
+      if (magic != kMagic) {
+        if (tolerant) { corrupt_chunks++; return false; }
+        g_error = "recordio: bad chunk magic";
+        return false;
+      }
+      if (fread(&n, 4, 1, f) != 1 || fread(&len, 8, 1, f) != 1 ||
+          fread(&crc, 4, 1, f) != 1) {
+        if (tolerant) { corrupt_chunks++; return false; }
+        g_error = "recordio: truncated chunk header";
+        return false;
+      }
+      // a corrupt len must fail via rio_error, not via a std::bad_alloc
+      // escaping the C ABI (CRC can't validate it — it's read before payload)
+      long here = ftell(f);
+      if (here < 0 || len > static_cast<uint64_t>(file_size - here)) {
+        if (tolerant) { corrupt_chunks++; return false; }
+        g_error = "recordio: chunk length exceeds file size (corrupt header)";
+        return false;
+      }
+      chunk.resize(len);
+      if (len && fread(chunk.data(), 1, len, f) != len) {
+        if (tolerant) { corrupt_chunks++; return false; }
+        g_error = "recordio: truncated chunk payload";
+        return false;
+      }
+      if (crc32(chunk.data(), chunk.size()) != crc) {
+        if (tolerant) { corrupt_chunks++; continue; }  // skip, try the next
+        g_error = "recordio: chunk CRC mismatch";
+        return false;
+      }
+      pos = 0;
+      remaining = n;
+      chunk_nrecs = n;
+      return true;
     }
-    if (fread(&n, 4, 1, f) != 1 || fread(&len, 8, 1, f) != 1 ||
-        fread(&crc, 4, 1, f) != 1) {
-      g_error = "recordio: truncated chunk header";
-      return false;
-    }
-    // a corrupt len must fail via rio_error, not via a std::bad_alloc
-    // escaping the C ABI (CRC can't validate it — it's read before payload)
-    long here = ftell(f);
-    if (here < 0 || len > static_cast<uint64_t>(file_size - here)) {
-      g_error = "recordio: chunk length exceeds file size (corrupt header)";
-      return false;
-    }
-    chunk.resize(len);
-    if (len && fread(chunk.data(), 1, len, f) != len) {
-      g_error = "recordio: truncated chunk payload";
-      return false;
-    }
-    if (crc32(chunk.data(), chunk.size()) != crc) {
-      g_error = "recordio: chunk CRC mismatch";
-      return false;
-    }
-    pos = 0;
-    remaining = n;
-    return true;
   }
 };
 
@@ -196,6 +216,120 @@ void rio_scanner_close(void* handle) {
   delete s;
 }
 
+// --- stream-state + corruption-tolerance entries ---------------------------
+
+void rio_scanner_set_tolerant(void* handle, int tolerant) {
+  static_cast<Scanner*>(handle)->tolerant = tolerant != 0;
+}
+
+long long rio_scanner_corrupt_chunks(void* handle) {
+  return static_cast<Scanner*>(handle)->corrupt_chunks;
+}
+
+// Chunk frames seen so far (loaded or skipped) — the `data.chunks_scanned`
+// denominator on the Python side.
+long long rio_scanner_chunks_seen(void* handle) {
+  return static_cast<Scanner*>(handle)->chunk_index + 1;
+}
+
+// Position of the NEXT record rio_next would return, as (chunk ordinal,
+// record index within that chunk).  A freshly opened scanner reports (0, 0);
+// an exhausted chunk reports the next frame at record 0.
+int rio_scanner_tell(void* handle, long long* chunk_idx, long long* rec_idx) {
+  Scanner* s = static_cast<Scanner*>(handle);
+  if (s->remaining > 0) {
+    *chunk_idx = s->chunk_index;
+    *rec_idx = static_cast<long long>(s->chunk_nrecs - s->remaining);
+  } else {
+    *chunk_idx = s->chunk_index + 1;
+    *rec_idx = 0;
+  }
+  return 0;
+}
+
+// O(1)-per-chunk seek to (chunk ordinal, record index): chunk payloads
+// between here and the target are skipped with fseek (header reads only —
+// no payload IO, no CRC work), then the target chunk alone is loaded and
+// validated and `rec_idx` records are stepped over in memory.  This is the
+// `rio_scanner_seek` entry the resumable-stream protocol uses: resuming a
+// scan costs one chunk load, not a re-read of the dataset.
+int rio_scanner_seek(void* handle, long long chunk_idx, long long rec_idx) {
+  Scanner* s = static_cast<Scanner*>(handle);
+  g_error.clear();
+  if (chunk_idx < 0 || rec_idx < 0) {
+    g_error = "recordio: negative seek target";
+    return -1;
+  }
+  if (fseek(s->f, 0, SEEK_SET) != 0) {
+    g_error = "recordio: seek rewind failed";
+    return -1;
+  }
+  s->chunk_index = -1;
+  s->remaining = 0;
+  s->chunk_nrecs = 0;
+  s->pos = 0;
+  for (long long c = 0; c < chunk_idx; c++) {
+    uint32_t magic, n, crc;
+    uint64_t len;
+    if (fread(&magic, 4, 1, s->f) != 1) {
+      g_error = "recordio: seek target past EOF";
+      return -1;
+    }
+    if (magic != kMagic) {
+      g_error = "recordio: bad chunk magic during seek";
+      return -1;
+    }
+    if (fread(&n, 4, 1, s->f) != 1 || fread(&len, 8, 1, s->f) != 1 ||
+        fread(&crc, 4, 1, s->f) != 1) {
+      g_error = "recordio: truncated chunk header during seek";
+      return -1;
+    }
+    long here = ftell(s->f);
+    if (here < 0 || len > static_cast<uint64_t>(s->file_size - here)) {
+      g_error = "recordio: chunk length exceeds file size during seek";
+      return -1;
+    }
+    if (fseek(s->f, static_cast<long>(len), SEEK_CUR) != 0) {
+      g_error = "recordio: payload skip failed during seek";
+      return -1;
+    }
+    s->chunk_index++;
+  }
+  if (rec_idx == 0) return 0;  // next load_chunk() lands on the target
+  // the target chunk must load STRICTLY: a tolerant load would silently
+  // skip a corrupt target and step rec_idx records into the NEXT chunk —
+  // a mispositioned resume training on wrong data
+  bool was_tolerant = s->tolerant;
+  s->tolerant = false;
+  bool loaded = s->load_chunk();
+  s->tolerant = was_tolerant;
+  if (!loaded) {
+    if (g_error.empty())
+      g_error = "recordio: seek target chunk missing or corrupt";
+    return -1;
+  }
+  if (static_cast<uint64_t>(rec_idx) > s->remaining) {
+    g_error = "recordio: seek record index past chunk end";
+    return -1;
+  }
+  for (long long r = 0; r < rec_idx; r++) {
+    if (s->pos + 4 > s->chunk.size()) {
+      g_error = "recordio: record header past chunk end during seek";
+      return -1;
+    }
+    uint32_t l;
+    memcpy(&l, s->chunk.data() + s->pos, 4);
+    s->pos += 4;
+    if (s->pos + l > s->chunk.size()) {
+      g_error = "recordio: record payload past chunk end during seek";
+      return -1;
+    }
+    s->pos += l;
+    s->remaining--;
+  }
+  return 0;
+}
+
 }  // extern "C"
 
 // ---------------------------------------------------------------------------
@@ -233,6 +367,9 @@ struct SlotQueue {
   std::vector<SlotLayout> layout;
   size_t batch = 0;
   bool drop_last = true;
+  bool tolerant = false;  // skip corrupt chunks instead of killing the run
+  std::atomic<long long> corrupt_chunks{0};
+  std::atomic<long long> chunks_seen{0};
 
   std::mutex mu;
   std::condition_variable cv_put, cv_get;
@@ -313,7 +450,9 @@ struct SlotQueue {
         return;
       }
       fseek(sc.f, 0, SEEK_END); sc.file_size = ftell(sc.f); fseek(sc.f, 0, SEEK_SET);
+      sc.tolerant = tolerant;
       for (;;) {
+        g_error.clear();  // tolerant load_chunk EOFs must not read stale state
         if (sc.remaining == 0 && !sc.load_chunk()) {
           bool clean = g_error.empty();
           if (!clean) {
@@ -357,6 +496,8 @@ struct SlotQueue {
         q.push_back(std::move(pr));
         cv_get.notify_one();
       }
+      corrupt_chunks += sc.corrupt_chunks;
+      chunks_seen += sc.chunk_index + 1;
       fclose(sc.f);
     }
   }
@@ -414,11 +555,13 @@ bool parse_layout(const uint8_t* p, uint32_t len,
   return true;
 }
 
-bool peek_layout(const std::string& path, std::vector<SlotLayout>* out) {
+bool peek_layout(const std::string& path, std::vector<SlotLayout>* out,
+                 bool tolerant = false) {
   Scanner sc;
   sc.f = fopen(path.c_str(), "rb");
   if (!sc.f) { g_error = "slotq: cannot open " + path; return false; }
   fseek(sc.f, 0, SEEK_END); sc.file_size = ftell(sc.f); fseek(sc.f, 0, SEEK_SET);
+  sc.tolerant = tolerant;  // layout may have to come from the 2nd+ chunk
   g_error.clear();
   if (!sc.load_chunk() || sc.remaining == 0) {
     if (g_error.empty()) g_error = "slotq: empty file " + path;
@@ -449,13 +592,15 @@ bool peek_layout(const std::string& path, std::vector<SlotLayout>* out) {
 extern "C" {
 
 void* slotq_open(const char** paths, int n_files, long long batch_size,
-                 int n_threads, int drop_last) {
+                 int n_threads, int drop_last, int tolerant) {
   g_error.clear();
   auto* sq = new SlotQueue();
   for (int i = 0; i < n_files; i++) sq->files.emplace_back(paths[i]);
   sq->batch = static_cast<size_t>(batch_size);
   sq->drop_last = drop_last != 0;
-  if (sq->files.empty() || !peek_layout(sq->files[0], &sq->layout)) {
+  sq->tolerant = tolerant != 0;
+  if (sq->files.empty()
+      || !peek_layout(sq->files[0], &sq->layout, sq->tolerant)) {
     if (g_error.empty()) g_error = "slotq: empty file list";
     delete sq;
     return nullptr;
@@ -516,6 +661,14 @@ long long slotq_next_batch(void* h, void** bufs) {
       memcpy(dst + r * rl, local[r].bytes.data() + local[r].slot_off[s], rl);
   }
   return (long long)rows;
+}
+
+long long slotq_corrupt_chunks(void* h) {
+  return static_cast<SlotQueue*>(h)->corrupt_chunks.load();
+}
+
+long long slotq_chunks_seen(void* h) {
+  return static_cast<SlotQueue*>(h)->chunks_seen.load();
 }
 
 void slotq_close(void* h) { delete static_cast<SlotQueue*>(h); }

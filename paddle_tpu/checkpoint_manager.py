@@ -79,7 +79,7 @@ class CheckpointManager:
             names.append(RNG_STATE_VAR)
         return names
 
-    def save(self, step: Optional[int] = None):
+    def save(self, step: Optional[int] = None, sidecars=None):
         """Atomic snapshot: write to a temp dir, rename into place (a
         preempted half-written save can never be mistaken for a valid
         checkpoint), then rotate old ones.  Not interrupted by its own
@@ -87,13 +87,20 @@ class CheckpointManager:
         save commits (re-entering would trash the .tmp dir under the
         first writer).
 
+        `sidecars` (name -> str contents, e.g. the resilience layer's
+        RESUME.json) are written into the temp dir BEFORE the commit
+        marker/rename, so a checkpoint can never exist without its
+        sidecars (a post-rename write used to leave a crash window where
+        the snapshot committed but the data-stream cursor did not).
+
         With `world_size > 1` the temp dir is SHARED: every rank writes
         its shards plus a `SHARD_DONE.p<rank>` marker, and rank 0 alone —
         after observing every marker — writes `COMMITTED` and performs
         the rename.  A gang member crashing anywhere in that window
         leaves an uncommitted `.tmp` dir that `restore` never considers,
         so no restarted worker can resume from a step its peers don't
-        have."""
+        have.  Coordinated sidecar names must be rank-unique (the caller
+        namespaces them) — every rank writes its own before its marker."""
         step = self._step if step is None else step
         final = self._dir(step)
         tmp = final + ".tmp"
@@ -101,12 +108,15 @@ class CheckpointManager:
         try:
             with _MON.span("checkpoint.save", step=step, rank=self.rank):
                 if self.world_size > 1:
-                    self._save_coordinated(tmp, final, step)
+                    self._save_coordinated(tmp, final, step, sidecars)
                 else:
                     if os.path.exists(tmp):
                         shutil.rmtree(tmp)
                     _io.save_sharded(tmp, var_names=self._var_names(self.scope),
                                      scope=self.scope, program=self.program)
+                    for name, body in (sidecars or {}).items():
+                        with open(os.path.join(tmp, name), "w") as f:
+                            f.write(body)
                     with open(os.path.join(tmp, "STEP"), "w") as f:
                         f.write(str(step))
                     with open(os.path.join(tmp, COMMITTED_MARKER), "w") as f:
@@ -126,7 +136,8 @@ class CheckpointManager:
                 self._on_preempt(*deferred)
         return final
 
-    def _save_coordinated(self, tmp: str, final: str, step: int):
+    def _save_coordinated(self, tmp: str, final: str, step: int,
+                          sidecars=None):
         # NO rmtree of a pre-existing tmp here: peers may already be
         # writing into it (the launcher clears stale .tmp debris between
         # gang incarnations instead)
@@ -134,6 +145,9 @@ class CheckpointManager:
         _io.save_sharded(tmp, var_names=self._var_names(self.scope),
                          scope=self.scope, program=self.program,
                          process_index=self.rank)
+        for name, body in (sidecars or {}).items():
+            with open(os.path.join(tmp, name), "w") as f:
+                f.write(body)
         with open(os.path.join(tmp, DIST_MARKER), "w") as f:
             f.write(str(self.world_size))
         done = os.path.join(tmp, f"SHARD_DONE.p{self.rank}")

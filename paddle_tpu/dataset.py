@@ -29,6 +29,11 @@ class DatasetBase:
         self._use_vars: List[str] = []
         self._thread_num = 1
         self._drop_last = True
+        # stream-state protocol (reader.py): samples already consumed by
+        # the live batches() iterator — between batch yields this sits on
+        # a batch boundary, so it is exactly the resume cursor
+        self._consumed_samples = 0
+        self._resume_samples = 0
 
     # -- reference dataset.py config surface --
     def set_batch_size(self, batch_size: int):
@@ -47,25 +52,45 @@ class DatasetBase:
     def use_var_names(self):
         return list(self._use_vars)
 
-    def _iter_samples(self) -> Iterator[List[np.ndarray]]:
+    def _iter_samples(self, start: int = 0) -> Iterator[List[np.ndarray]]:
         raise NotImplementedError
 
+    # -- stream-state protocol (reader.is_checkpointable) --------------------
+    def checkpointable(self) -> bool:
+        return True
+
+    def state_dict(self) -> Dict[str, int]:
+        return {"samples_consumed": self._consumed_samples
+                or self._resume_samples}
+
+    def load_state_dict(self, state: Dict[str, int]):
+        self._resume_samples = int(state.get("samples_consumed", 0))
+        self._consumed_samples = 0
+
     def batches(self) -> Iterator[Dict[str, np.ndarray]]:
-        """Assemble sample tuples into stacked dense feed dicts."""
+        """Assemble sample tuples into stacked dense feed dicts.  Resumes
+        at a loaded stream state (InMemoryDataset seeks its sample list in
+        O(1); generic sources skip forward)."""
         if not self._use_vars:
             raise ValueError("dataset: call set_use_var first")
+        start, self._resume_samples = self._resume_samples, 0
+        self._consumed_samples = start
+        pulled = start
         buf: List[List[np.ndarray]] = []
-        for sample in self._iter_samples():
+        for sample in self._iter_samples(start):
             if len(sample) != len(self._use_vars):
                 raise ValueError(
                     f"dataset: record has {len(sample)} slots, expected "
                     f"{len(self._use_vars)} ({self._use_vars})")
             buf.append(sample)
+            pulled += 1
             if len(buf) == self._batch_size:
+                self._consumed_samples = pulled
                 yield {n: np.stack([s[i] for s in buf])
                        for i, n in enumerate(self._use_vars)}
                 buf = []
         if buf and not self._drop_last:
+            self._consumed_samples = pulled
             yield {n: np.stack([s[i] for s in buf])
                    for i, n in enumerate(self._use_vars)}
 
@@ -86,6 +111,11 @@ class QueueDataset(DatasetBase):
     def use_native(self, on: bool = True):
         self._native = bool(on)
 
+    def checkpointable(self) -> bool:
+        # multi-threaded parsing interleaves files irreproducibly; the
+        # native queue preserves file order only at one worker thread
+        return self._thread_num == 1
+
     def batches(self):
         if not self._use_vars:
             raise ValueError("dataset: call set_use_var first")
@@ -104,10 +134,24 @@ class QueueDataset(DatasetBase):
                 raise ValueError(
                     f"dataset: records have {len(reader.slots)} slots, "
                     f"expected {len(self._use_vars)} ({self._use_vars})")
+            # the native reader fast-forwards batches itself; translate the
+            # sample cursor into its batch cursor
+            start, self._resume_samples = self._resume_samples, 0
+            if start:
+                # ceil, not floor: every batch except the trailing partial
+                # one is full, so a cursor that is not a multiple of
+                # batch_size can only mean that partial batch was already
+                # yielded — floor would re-yield it (duplicate training data)
+                reader.load_state_dict({"files": self._filelist,
+                                        "batches_yielded":
+                                            -(-start // self._batch_size)})
+            consumed = start
             for arrays in reader:
+                consumed += int(arrays[0].shape[0]) if arrays else 0
+                self._consumed_samples = consumed
                 yield dict(zip(self._use_vars, arrays))
 
-    def _iter_samples(self):
+    def _iter_samples(self, start: int = 0):
         import queue
 
         q: "queue.Queue" = queue.Queue(maxsize=4096)
@@ -144,12 +188,16 @@ class QueueDataset(DatasetBase):
         t = threading.Thread(target=producer, daemon=True)
         t.start()
         try:
+            skipped = 0
             while True:
                 item = q.get()
                 if item is DONE:
                     if failure:
                         raise failure[0]
                     return
+                if skipped < start:
+                    skipped += 1  # streaming source: resume is a skip-forward
+                    continue
                 yield item
         finally:
             stop.set()  # early exit from batches(): release producer threads
@@ -179,10 +227,10 @@ class InMemoryDataset(DatasetBase):
         # across trainers through fleet; multi-process hook point)
         self.local_shuffle(seed)
 
-    def _iter_samples(self):
+    def _iter_samples(self, start: int = 0):
         if self._samples is None:
             raise RuntimeError("load_into_memory() first")
-        yield from self._samples
+        yield from self._samples[start:]  # O(1) seek: it is a list
 
 
 def train_from_dataset(executor, program, dataset, scope=None, fetch_list=None,

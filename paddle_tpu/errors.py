@@ -227,8 +227,10 @@ def classify(exc: BaseException, wrap_unknown: bool = False) -> BaseException:
                 return _wrap(TransientDeviceError, code=code, phase="device")
     # Producer-thread breadcrumb: the loader marks exceptions raised while
     # producing a batch, whatever their type (user generator bugs raise as
-    # themselves but recovery treats them as data failures).
-    if ctx.get("phase") == "loader":
+    # themselves but recovery treats them as data failures).  "feed" is the
+    # FeedSpec validation boundary (reader.py): a dtype/shape-mismatched or
+    # non-finite feed is a data failure caught before lowering.
+    if ctx.get("phase") in ("loader", "feed"):
         return _wrap(DataError)
     # The NaN/Inf guard's historical RuntimeError message.
     if isinstance(exc, (RuntimeError, FloatingPointError)) and "NaN/Inf" in msg:

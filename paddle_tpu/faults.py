@@ -24,6 +24,17 @@ Spec grammar (entries separated by ';', whitespace ignored):
                           the loop's deferred-flush handler is what gets
                           tested)
 
+data-layer entries (ISSUE 5) mutate RecordIO files ON DISK via the
+`on_files` hook (called by tests/bench with the pipeline's file list
+before the loader opens them), so the corruption exercises the real
+native scanner + CRC + FLAGS_data_corrupt_budget machinery:
+
+    corrupt_chunk@N       flip a payload byte of global chunk N (counted
+                          across the file list) — the CRC catches it and
+                          the budget decides skip vs abort
+    truncated_file@N      cut the file mid-payload of global chunk N (the
+                          torn-write / partial-copy failure mode)
+
 distributed entries (ISSUE 4) target a specific worker RANK; every
 worker of a gang parses the same spec and an entry fires only in the
 process whose rank matches (`PADDLE_TRAINER_ID`, or the `rank` ctor
@@ -73,9 +84,19 @@ from .errors import DataError, TransientDeviceError
 from .monitor import MONITOR as _MON
 
 _KINDS = ("bad_batch", "nan", "device", "preempt",
-          "kill_worker", "stall_worker")
+          "kill_worker", "stall_worker",
+          "corrupt_chunk", "truncated_file")
 # entries that only fire in the worker whose rank matches their arg
 _RANKED_KINDS = ("kill_worker", "stall_worker")
+# on-disk data faults (ISSUE 5): mutate RecordIO files handed to
+# `on_files` — corrupt_chunk@N flips a payload byte of the Nth chunk
+# (CRC catches it), truncated_file@N cuts the file mid-payload of the
+# Nth chunk.  Both exercise the recordio corrupt-budget path
+_FILE_KINDS = ("corrupt_chunk", "truncated_file")
+# entries whose firing must survive a gang restart: a restarted worker
+# replays the failed step (and re-opens its files), so without the
+# PADDLE_FAULT_STATE_DIR ledger the same fault would fire forever
+_LEDGER_KINDS = _RANKED_KINDS + _FILE_KINDS
 
 
 @dataclass
@@ -141,6 +162,41 @@ def parse_fault_spec(spec: str) -> List[Fault]:
     return faults
 
 
+def _mutate_chunk(paths, chunk_at: int, truncate: bool) -> bool:
+    """Apply one on-disk data fault: locate global chunk `chunk_at` across
+    the RecordIO `paths` (frames counted in list order) and either flip a
+    payload byte (CRC mismatch) or truncate the file mid-payload.  Returns
+    False when the chunk does not exist (entry stays pending — same
+    contract as a step index never reached)."""
+    import struct
+
+    seen = 0
+    for path in paths:
+        with open(path, "rb") as fh:
+            data = bytearray(fh.read())
+        off = 0
+        while off + 20 <= len(data):
+            magic, nrecs = struct.unpack_from("<II", data, off)
+            (plen,) = struct.unpack_from("<Q", data, off + 8)
+            if magic != 0x01020304 or off + 20 + plen > len(data):
+                break  # already-broken tail; stop framing this file
+            if seen == chunk_at:
+                if plen == 0:
+                    return False  # nothing to corrupt in an empty chunk
+                if truncate:
+                    # keep the header + half the payload: the scanner sees
+                    # a valid header whose payload read comes up short
+                    data = data[:off + 20 + max(1, int(plen) // 2)]
+                else:
+                    data[off + 20 + int(plen) // 2] ^= 0xFF
+                with open(path, "wb") as fh:
+                    fh.write(bytes(data))
+                return True
+            seen += 1
+            off += 20 + int(plen)
+    return False
+
+
 class FaultInjector:
     """Seeded, schedule-driven fault source.  One instance = one schedule;
     construct fresh (or `reset()`) per run."""
@@ -189,7 +245,7 @@ class FaultInjector:
 
     # -- hooks -------------------------------------------------------------
     def _ranked_marker(self, f: Fault) -> Optional[str]:
-        if self.state_dir is None or f.kind not in _RANKED_KINDS:
+        if self.state_dir is None or f.kind not in _LEDGER_KINDS:
             return None
         return os.path.join(self.state_dir, f"fired-{f.kind}@{f.at}-{f.arg}")
 
@@ -215,6 +271,32 @@ class FaultInjector:
                 _MON.counter(f"faults.{kind}").inc()
                 return f
         return None
+
+    def on_files(self, paths):
+        """Called with the RecordIO file list a data pipeline is about to
+        open (tests/bench call it explicitly before building the loader);
+        applies any pending corrupt_chunk@N / truncated_file@N entries by
+        mutating the files ON DISK — the corruption then flows through the
+        real native scanner + CRC + budget machinery, not a mock.  Chunk
+        index N counts frames across the concatenated file list.  Fires
+        once (per gang, when the launcher's fault ledger is armed).
+        Returns `paths` for chaining."""
+        for kind in _FILE_KINDS:
+            for f in list(self.faults):
+                if f.kind != kind or f.fired:
+                    continue
+                marker = self._ranked_marker(f)
+                if marker is not None and os.path.exists(marker):
+                    f.fired = True  # spent in an earlier gang incarnation
+                    continue
+                if _mutate_chunk(paths, f.at, truncate=(kind == "truncated_file")):
+                    f.fired = True
+                    if marker is not None:
+                        os.makedirs(self.state_dir, exist_ok=True)
+                        with open(marker, "w") as fh:
+                            fh.write(str(os.getpid()))
+                    _MON.counter(f"faults.{kind}").inc()
+        return paths
 
     def on_batch(self, batch_index: int, feed):
         """Called with every raw batch pulled from the loader; raises

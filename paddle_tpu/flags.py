@@ -108,6 +108,20 @@ DEFINE_string("FLAGS_fault_spec", "",
               "Each resilient_train_loop call builds one injector from the "
               "spec; every entry fires exactly once per injector (so once "
               "per call).  Empty (default) injects nothing")
+DEFINE_int("FLAGS_data_corrupt_budget", 0,
+           "number of corrupt/truncated RecordIO chunks one run may skip "
+           "before the data layer aborts with a classified DataError "
+           "(paddle_tpu/recordio.py; `data.corrupt_chunks` counts spends). "
+           "0 (default) keeps strict behavior: the first corrupt chunk "
+           "raises IOError immediately instead of being skipped")
+DEFINE_string("FLAGS_feed_validation", "shape",
+              "feed-boundary validation level at DataLoader/DataFeeder "
+              "(paddle_tpu/reader.py FeedSpec): 'off' trusts the caller, "
+              "'shape' (default) checks dtype-kind + shape against the feed "
+              "vars and raises DataError naming the slot BEFORE lowering "
+              "(a mismatched feed otherwise surfaces as an opaque XLA "
+              "error), 'full' additionally scans floating feeds for "
+              "NaN/Inf")
 DEFINE_float("FLAGS_dist_heartbeat_interval_s", 0.5,
              "seconds between liveness beats each worker publishes to its "
              "peers (paddle_tpu/dist_resilience.py).  The transport rides "

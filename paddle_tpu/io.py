@@ -299,6 +299,29 @@ def load_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
     return loaded
 
 
+# --- stream-state serde (ISSUE 5) -------------------------------------------
+# The resumable-reader protocol (paddle_tpu/reader.py state_dict) produces
+# nested dicts that may carry non-JSON values (random.Random state tuples);
+# RESUME.json stores them pickled + base64'd so the sidecar stays one
+# human-greppable JSON file.
+
+def pack_stream_state(state) -> str:
+    """Pickle + base64 a reader state for embedding in a JSON sidecar."""
+    import base64
+    import pickle
+
+    return base64.b64encode(
+        pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)).decode("ascii")
+
+
+def unpack_stream_state(packed: str):
+    """Inverse of pack_stream_state."""
+    import base64
+    import pickle
+
+    return pickle.loads(base64.b64decode(packed.encode("ascii")))
+
+
 def save_inference_model(
     dirname: str,
     feeded_var_names: Sequence[str],

@@ -19,7 +19,17 @@ worker spawning — plus what the test harness never had:
     workers resume from the last *coordinated* checkpoint
     (`CheckpointManager` rank-0 COMMITTED marker) with `step_offset`
     continuity, so the restarted run's params are bit-identical to an
-    uninterrupted one.
+    uninterrupted one.  A worker driving `resilient_train_loop` over a
+    checkpointable data source (ISSUE 5 stream-state protocol) resumes
+    its input stream by O(1) seek too: the committed checkpoint's
+    RESUME.json sidecar carries the pickled reader state, so a restart
+    never replays the dataset to find its place.
+
+The once-per-gang fault ledger (`PADDLE_FAULT_STATE_DIR`, exported per
+run_gang call) also covers the data faults `corrupt_chunk@N` /
+`truncated_file@N`: a restarted incarnation re-opens its RecordIO files,
+and without the ledger the injector would re-corrupt them every
+incarnation.
 
 CLI (the reference `python -m paddle.distributed.launch` shape):
 

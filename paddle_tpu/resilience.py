@@ -42,6 +42,19 @@ to a fault-free run over the surviving batches, and a rollback/resume
 run's params are bit-identical to an uninterrupted run (the RNG key rides
 in snapshots and checkpoints).
 
+Data-stream state (ISSUE 5): when the loader factory returns a source
+speaking the stream-state protocol (reader.is_checkpointable — RecordIO
+readers, shuffle/batch/chain/map/xmap decorators, DataLoader, datasets),
+every checkpoint's RESUME.json also carries the pickled state of its
+next batch, and rollback/resume rewinds by an O(1) `load_state_dict`
+seek — bit-identical even for shuffled sources, whose state carries the
+per-epoch RNG cursor.  Stateless sources keep the historical replay
+fast-forward, now LOUD (`resilience.replay_fallback` /
+`resilience.replayed_batches` counters, a `replay_fast_forward` span +
+event perf_report gates with --max-replay-batches) and guarded: a
+replayed batch differing from what the replay window recorded raises
+instead of silently training on different data.
+
 Monitor surface: `resilience.skipped_batches / skipped_steps / retries /
 rollbacks / degraded_inflight / preemptions` counters, `resilience.
 snapshot / recover / backoff` spans, one `kind="resilience_event"` record
@@ -51,7 +64,7 @@ per recovery action (rendered and CI-gated by `tools/perf_report.py
 from __future__ import annotations
 
 __all__ = ["RetryPolicy", "ResilienceStats", "resilient_train_loop",
-           "RESUME_FILE"]
+           "RESUME_FILE", "resume_sidecar_name"]
 
 import json
 import logging
@@ -67,6 +80,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import errors as _errors
+from . import io as _io
 from . import pipeline as _pipeline
 from .errors import (DataError, NumericError, PreemptionError,
                      TrainingError, TransientDeviceError)
@@ -75,6 +89,17 @@ from .monitor import MONITOR as _MON
 RESUME_FILE = "RESUME.json"
 
 _log = logging.getLogger("paddle_tpu.resilience")
+
+
+def resume_sidecar_name(rank: int = 0, world_size: int = 1) -> str:
+    """The RESUME sidecar's file name.  Coordinated checkpoints share one
+    pending dir across ranks, and CheckpointManager requires rank-unique
+    sidecar names — each rank's data-stream cursor is its own (sharded
+    sources), so a fixed name would let the last writer clobber every
+    other rank's stream state."""
+    if world_size > 1:
+        return f"RESUME.p{rank}.json"
+    return RESUME_FILE
 
 
 @dataclass
@@ -144,6 +169,33 @@ def _restore_scope(scope, snap: Dict[str, Any]):
         # to XLA — donating memory the snapshot (or the caller's ref run)
         # still references corrupts it in place
         scope.set_var(name, v.copy() if isinstance(v, np.ndarray) else v)
+
+
+def _feeds_equal(a, b) -> bool:
+    """Best-effort bit comparison of two feeds (dicts of arrays, tuples,
+    bare arrays).  Uncomparable shapes answer True — the divergence guard
+    must never false-positive on exotic feed types."""
+    try:
+        if isinstance(a, dict) or isinstance(b, dict):
+            if not (isinstance(a, dict) and isinstance(b, dict)):
+                return False
+            if set(a) != set(b):
+                return False
+            return all(np.array_equal(np.asarray(a[k]), np.asarray(b[k]))
+                       for k in a)
+        return bool(np.array_equal(np.asarray(a), np.asarray(b)))
+    except Exception:
+        return True
+
+
+def _as_iter(src):
+    """A data source may be an iterable (DataLoader, list) or a
+    decorator-style reader (zero-arg callable yielding items)."""
+    if hasattr(src, "__iter__"):
+        return iter(src)
+    if callable(src):
+        return iter(src())
+    raise TypeError(f"resilience: cannot iterate data source {type(src)!r}")
 
 
 def _event(action: str, cls: str, step=None, batch=None, **extra):
@@ -248,22 +300,42 @@ def resilient_train_loop(
 
     # ---- data cursor: one pass + bounded replay --------------------------
     it_box: Dict[str, Any] = {"it": None}
+    src_box: Dict[str, Any] = {"src": None, "stateful": False}
     consumed = 0                     # raw batches pulled from the source
     replay: "OrderedDict[int, dict]" = OrderedDict()    # batch idx -> feed
     pending: deque = deque()         # (batch idx, feed) queued for re-feed
     skipped_raw: set = set()         # raw batch indices dropped as bad
     stream = {"suspect": False}      # a producer-side error likely killed it
     step_batch: Dict[int, int] = {}  # global step -> raw batch idx it used
+    # batch idx -> the source's stream state BEFORE pulling it (checkpoints
+    # store the state of their next batch, making resume an O(1) seek)
+    state_at: "OrderedDict[int, Any]" = OrderedDict()
+    # after a replay fast-forward: batches the OLD replay window recorded
+    # that the rebuilt loader is about to re-yield — each refetch is
+    # compared so a non-deterministic factory dies loudly, not silently
+    verify_replay: Dict[int, Any] = {}
     snaps: "OrderedDict[int, dict]" = OrderedDict()     # step -> state snap
     start_step = 0                   # global step the next segment starts at
     preempt = {"hit": False}
 
     def _fresh_iter():
-        return iter(factory() if factory is not None else loader)
+        from .reader import is_checkpointable
+
+        src = factory() if factory is not None else loader
+        src_box["src"] = src
+        src_box["stateful"] = is_checkpointable(src)
+        return _as_iter(src)
 
     def _pull_raw():
         nonlocal consumed
         bi = consumed
+        if src_box["stateful"]:
+            try:
+                state_at[bi] = src_box["src"].state_dict()
+            except Exception:
+                state_at[bi] = None
+            while len(state_at) > window:
+                state_at.popitem(last=False)
         try:
             feed = next(it_box["it"])
         except StopIteration:
@@ -271,6 +343,15 @@ def resilient_train_loop(
         except BaseException as e:
             raise _errors.attach_context(e, batch_index=bi)
         consumed += 1
+        ref = verify_replay.pop(bi, None)
+        if ref is not None and not _feeds_equal(ref, feed):
+            raise RuntimeError(
+                f"resilience: replay divergence at batch {bi}: the rebuilt "
+                f"loader yielded a different batch than the replay window "
+                f"recorded — the factory is non-deterministic, and recovery "
+                f"would silently train on different data.  Seed the source "
+                f"(or use a checkpointable reader, which seeks instead of "
+                f"replaying)")
         if injector is not None:
             injector.on_batch(bi, feed)  # may raise DataError
         return bi, feed
@@ -288,6 +369,12 @@ def resilient_train_loop(
                 ce = _errors.classify(e)
                 if not isinstance(ce, DataError):
                     raise
+                if getattr(ce, "budget_exhausted", False) or \
+                        getattr(e, "budget_exhausted", False):
+                    # the data layer already spent its own corruption
+                    # budget (recordio FLAGS_data_corrupt_budget): terminal
+                    # by design, not one more skippable batch
+                    _reraise(ce, e)
                 if stats.skipped_batches >= policy.max_bad_batches:
                     # budget exhausted: terminal — surface the DataError
                     if ce is e:
@@ -349,14 +436,37 @@ def resilient_train_loop(
     def _flush_checkpoint(step: int) -> str:
         """Dispatch-boundary save: scope == state after `step` steps (the
         save's host copies block on anything still in flight).  RESUME.json
-        records where the data stream stands so resume can fast-forward."""
+        records where the data stream stands — and, for a checkpointable
+        source, its pickled stream state, so resume is an O(1) seek
+        instead of a replay.  Written as a `save(sidecars=...)` so the
+        snapshot and its cursor commit atomically."""
         cm._step = step
-        d = cm.save(step=step)
-        with open(os.path.join(d, RESUME_FILE), "w") as f:
-            json.dump({"step": step,
-                       "next_batch": step_batch.get(step, consumed),
-                       "skipped_batches": stats.skipped_batches}, f)
-        return d
+        nb = step_batch.get(step, consumed)
+        info = {"step": step, "next_batch": nb,
+                "skipped_batches": stats.skipped_batches}
+        st = state_at.get(nb) if src_box["stateful"] else None
+        if st is not None:
+            info["stream_state"] = _io.pack_stream_state(st)
+        name = resume_sidecar_name(getattr(cm, "rank", 0),
+                                   getattr(cm, "world_size", 1))
+        return cm.save(step=step, sidecars={name: json.dumps(info)})
+
+    def _read_resume(step: int) -> dict:
+        """The RESUME sidecar of the checkpoint that actually restored
+        (not latest() — restore may have walked past a corrupt newer one
+        whose sidecar would misalign the data stream).  Tries this rank's
+        namespaced name first, then the single-process name (a checkpoint
+        written before the gang grew)."""
+        names = [resume_sidecar_name(getattr(cm, "rank", 0),
+                                     getattr(cm, "world_size", 1)),
+                 RESUME_FILE]
+        for name in names:
+            try:
+                with open(os.path.join(cm._dir(step), name)) as f:
+                    return json.load(f)
+            except OSError:
+                continue
+        return {}
 
     def _on_dispatch(step: int, feed):
         time.sleep(0)  # let a just-delivered SIGTERM reach the handler
@@ -397,10 +507,20 @@ def resilient_train_loop(
             if bi in replay:
                 pending.append((bi, replay[bi]))
 
-    def _rewind_source_to(batch_idx: int):
-        """Rebuild the loader from the factory and fast-forward so the
-        next raw pull is `batch_idx` (rollback/resume reach further back
-        than the replay window)."""
+    def _rewind_source_to(batch_idx: int, stream_state=None):
+        """Position the data stream so the next raw pull is `batch_idx`
+        (rollback/resume reach further back than the replay window).
+
+        With `stream_state` (a checkpointable source's saved cursor) this
+        is an O(1) seek: rebuild from the factory, load_state_dict, done —
+        bit-identical even for shuffled sources, since the state carries
+        the RNG/buffer cursor.  Without it, fall back to the historical
+        replay: rebuild and pull `batch_idx` batches just to discard them
+        — O(dataset), loud (`resilience.replay_fast_forward` span + event,
+        `resilience.replayed_batches` counter), and guarded: a replayed
+        batch that differs from what the replay window recorded means the
+        factory is non-deterministic, and recovery raises instead of
+        silently training on different data."""
         nonlocal consumed
         if factory is None:
             raise RuntimeError(
@@ -408,18 +528,66 @@ def resilient_train_loop(
                 f"batch {batch_idx}, but `loader` is a bare iterable — "
                 "pass a zero-arg factory")
         pending.clear()
+        old_replay = dict(replay)
         replay.clear()
+        state_at.clear()
+        verify_replay.clear()
+        if stream_state is not None:
+            from .reader import is_checkpointable
+
+            src = factory()
+            if is_checkpointable(src):
+                with _MON.span("resilience.stream_seek", batch=batch_idx):
+                    src.load_state_dict(stream_state)
+                src_box["src"] = src
+                src_box["stateful"] = True
+                it_box["it"] = _as_iter(src)
+                consumed = batch_idx
+                state_at[batch_idx] = stream_state
+                _MON.counter("resilience.stream_seek").inc()
+                _event("stream_seek", "DataStream", batch=batch_idx)
+                return
+            _log.warning(
+                "resilience: a stream state was saved but the rebuilt "
+                "loader is not checkpointable (factory changed?); falling "
+                "back to replay fast-forward")
+        _MON.counter("resilience.replay_fallback").inc()
+        if batch_idx > 0:
+            _log.warning(
+                "resilience: data source is not checkpointable — replaying "
+                "%d batch(es) to fast-forward (O(dataset) resume; give the "
+                "loop a stateful reader to make this an O(1) seek)",
+                batch_idx)
         it_box["it"] = _fresh_iter()
         consumed = 0
-        while consumed < batch_idx:
-            try:
-                next(it_box["it"])
-            except StopIteration:
-                raise RuntimeError(
-                    f"resilience: loader exhausted at batch {consumed} while "
-                    f"fast-forwarding to {batch_idx} — the factory must "
-                    f"replay the same deterministic stream")
-            consumed += 1
+        with _MON.span("resilience.replay_fast_forward", batches=batch_idx):
+            while consumed < batch_idx:
+                try:
+                    feed = next(it_box["it"])
+                except StopIteration:
+                    raise RuntimeError(
+                        f"resilience: loader exhausted at batch {consumed} "
+                        f"while fast-forwarding to {batch_idx} — the factory "
+                        f"must replay the same deterministic stream")
+                ref = old_replay.get(consumed)
+                if ref is not None and not _feeds_equal(ref, feed):
+                    raise RuntimeError(
+                        f"resilience: replay divergence at batch {consumed}: "
+                        f"the rebuilt loader yielded a different batch than "
+                        f"the replay window recorded — the factory is "
+                        f"non-deterministic, and recovery would silently "
+                        f"train on different data.  Seed the source (or use "
+                        f"a checkpointable reader, which seeks instead of "
+                        f"replaying)")
+                consumed += 1
+        # batches past the fast-forward point that the old window recorded
+        # will be re-pulled for the redone steps — verify those refetches too
+        verify_replay.update(
+            {bi: f for bi, f in old_replay.items() if bi >= batch_idx})
+        if batch_idx > 0:
+            _MON.counter("resilience.replayed_batches").inc(batch_idx)
+            _event("replay_fast_forward", "DataStream", batch=batch_idx,
+                   batches=batch_idx)
 
     def _reraise(ce, orig):
         if ce is orig:
@@ -477,15 +645,14 @@ def resilient_train_loop(
                 restored = cm.restore(scope=scope, max_step=step)
                 if restored is None:
                     _reraise(ce, e)  # nothing at or before the failure
+                info = _read_resume(restored)
                 bi = step_batch.get(restored)
                 if bi is None:  # checkpoint predates this process: sidecar
-                    try:
-                        with open(os.path.join(cm._dir(restored),
-                                               RESUME_FILE)) as f:
-                            bi = int(json.load(f).get("next_batch", restored))
-                    except OSError:
-                        bi = restored + stats.skipped_batches
-                _rewind_source_to(bi)
+                    bi = int(info.get("next_batch",
+                                      restored + stats.skipped_batches))
+                sst = info.get("stream_state")
+                _rewind_source_to(
+                    bi, _io.unpack_stream_state(sst) if sst else None)
             snaps.clear()
             stats.rollbacks += 1
             _MON.counter("resilience.rollbacks").inc()
@@ -531,6 +698,15 @@ def resilient_train_loop(
         _signal.signal(_signal.SIGTERM, lambda s, f: preempt.update(hit=True))
         installed = True
 
+    # a new training run opens a fresh data-corruption budget window
+    # (FLAGS_data_corrupt_budget is per-run, spent by recordio scanners)
+    try:
+        from . import recordio as _recordio
+
+        _recordio.reset_corrupt_spent()
+    except Exception:
+        pass
+
     nan_check_prev = None
     if resolve_all:
         # can't skip/rollback a NaN the guard never sees: force the guard
@@ -546,18 +722,12 @@ def resilient_train_loop(
             restored = cm.restore(scope=scope)
             if restored is not None:
                 start_step = restored
-                info = {}
-                try:
-                    # from the RESTORED checkpoint's dir, not latest():
-                    # restore may have walked past a corrupt newer one
-                    # whose sidecar would misalign the data stream
-                    with open(os.path.join(cm._dir(restored),
-                                           RESUME_FILE)) as f:
-                        info = json.load(f)
-                except OSError:
-                    pass
+                info = _read_resume(restored)
                 stats.skipped_batches = int(info.get("skipped_batches", 0))
-                _rewind_source_to(int(info.get("next_batch", restored)))
+                sst = info.get("stream_state")
+                _rewind_source_to(
+                    int(info.get("next_batch", restored)),
+                    _io.unpack_stream_state(sst) if sst else None)
                 _event("resume", "PreemptionError", step=restored)
             else:
                 it_box["it"] = _fresh_iter()

@@ -161,6 +161,101 @@ def test_xmap_mapper_exception_reraised_not_hung():
         assert isinstance(ce, DataError) and ce.batch_index == 3
 
 
+def test_feedspec_shape_mismatch_raises_dataerror_before_lowering():
+    """ISSUE 5 acceptance: a shape-mismatched feed dies AT THE FEED
+    BOUNDARY, as a DataError naming the slot — no executor, no lowering,
+    no opaque XLA error."""
+    import pytest
+
+    from paddle_tpu.errors import DataError, classify
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+
+    def gen():
+        yield {"x": np.zeros((8, 3), "f4")}  # slot expects (-1, 4)
+
+    loader = fluid.DataLoader.from_generator([x], capacity=2).set_batch_generator(gen)
+    with pytest.raises(DataError, match="'x'.*shape") as ei:
+        list(loader)
+    assert ei.value.phase == "feed"
+    assert isinstance(classify(ei.value), DataError)
+
+
+def test_feedspec_dtype_kind_mismatch():
+    """int->float widening stays silent (the loader always cast); float
+    data into an int slot — a real bug — raises, naming the slot."""
+    import pytest
+
+    from paddle_tpu.errors import DataError
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lbl = fluid.layers.data("label", [1], dtype="int64")
+        xf = fluid.layers.data("xf", [2], dtype="float32")
+
+    def bad_gen():
+        yield {"label": np.zeros((4, 1), "f4"),  # float into int slot
+               "xf": np.zeros((4, 2), "f4")}
+
+    loader = fluid.DataLoader.from_generator([lbl, xf], capacity=2) \
+        .set_batch_generator(bad_gen)
+    with pytest.raises(DataError, match="'label'.*dtype"):
+        list(loader)
+
+    def ok_gen():
+        yield {"label": np.zeros((4, 1), "i8"),
+               "xf": np.zeros((4, 2), "i4")}  # int->float: fine
+
+    loader = fluid.DataLoader.from_generator([lbl, xf], capacity=2) \
+        .set_batch_generator(ok_gen)
+    (b,) = list(loader)
+    assert b["xf"].dtype == np.float32
+
+    feeder = fluid.DataFeeder([lbl])
+    with pytest.raises(DataError, match="'label'"):
+        feeder.feed([(np.float32(1.5),), (np.float32(2.5),)])
+
+
+def test_feedspec_finiteness_under_full_mode():
+    import pytest
+
+    from paddle_tpu.errors import DataError
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [2], dtype="float32")
+
+    def nan_gen():
+        a = np.zeros((4, 2), "f4")
+        a[1, 0] = np.nan
+        yield {"x": a}
+
+    loader = fluid.DataLoader.from_generator([x], capacity=2) \
+        .set_batch_generator(nan_gen)
+    fluid.set_flags({"FLAGS_feed_validation": "full"})
+    try:
+        with pytest.raises(DataError, match="'x'.*non-finite"):
+            list(loader)
+    finally:
+        fluid.set_flags({"FLAGS_feed_validation": "shape"})
+    # default mode: finiteness not scanned (the injector relies on NaNs
+    # flowing through to the resolution-time guard)
+    loader = fluid.DataLoader.from_generator([x], capacity=2) \
+        .set_batch_generator(nan_gen)
+    assert len(list(loader)) == 1
+    # off: even shape mismatches pass through (caller's problem)
+    fluid.set_flags({"FLAGS_feed_validation": "off"})
+    try:
+        def bad(): yield {"x": np.zeros((4, 7), "f4")}
+        loader = fluid.DataLoader.from_generator([x], capacity=2) \
+            .set_batch_generator(bad)
+        assert len(list(loader)) == 1
+    finally:
+        fluid.set_flags({"FLAGS_feed_validation": "shape"})
+
+
 def test_xmap_source_reader_exception_reraised():
     """The feeder thread dying (source reader bug) must surface too."""
     import pytest

@@ -1,11 +1,36 @@
 """Native RecordIO (C++ chunked CRC format, native/recordio.cc) round-trip
-+ corruption detection (reference: paddle/fluid/recordio/)."""
++ corruption detection (reference: paddle/fluid/recordio/) + the ISSUE 5
+on-disk robustness matrix: truncated final chunk, flipped byte mid-chunk,
+zero-length file, and mixed good/corrupt file lists — each asserting the
+exact `data.corrupt_chunks` spend and surviving-sample parity."""
 import os
 
 import numpy as np
 import pytest
 
-from paddle_tpu import recordio
+import paddle_tpu as fluid
+from paddle_tpu import monitor, recordio
+from paddle_tpu.errors import DataError
+
+
+@pytest.fixture
+def corrupt_budget():
+    """Arm a corrupt budget for the duration of a test, restore strict."""
+    def arm(n):
+        fluid.set_flags({"FLAGS_data_corrupt_budget": n})
+        recordio.reset_corrupt_spent()
+
+    try:
+        yield arm
+    finally:
+        fluid.set_flags({"FLAGS_data_corrupt_budget": 0})
+
+
+def _write(path, n, chunk=4, dim=3):
+    recordio.write_arrays(
+        path, [(np.full(dim, i, "f4"),) for i in range(n)],
+        max_chunk_records=chunk)
+    return path
 
 
 def test_roundtrip_bytes(tmp_path):
@@ -50,6 +75,243 @@ def test_empty_file_is_clean_eof(tmp_path):
     with recordio.Writer(p):
         pass
     assert list(recordio.Scanner(p)) == []
+
+
+def test_scanner_handle_released_without_context_manager(tmp_path):
+    """The ISSUE 5 satellite: iterating without `with` used to leak the
+    native handle; exhaustion/error/GC now close it (weakref.finalize is
+    the backstop, single-owner so no double close)."""
+    import gc
+    import weakref
+
+    p = str(tmp_path / "h.rio")
+    _write(p, 6)
+    s = recordio.Scanner(p)
+    assert list(s)  # exhaustion closes
+    assert s._h is None
+    s.close()  # idempotent
+    # abandoned mid-iteration: GC closes via the generator finally
+    s2 = recordio.Scanner(p)
+    it = iter(s2)
+    next(it)
+    fin = s2._finalizer
+    del it
+    gc.collect()
+    assert s2._h is None and not fin.alive
+    # never iterated at all: the finalizer alone releases it
+    s3 = recordio.Scanner(p)
+    fin3 = s3._finalizer
+    ref = weakref.ref(s3)
+    del s3
+    gc.collect()
+    assert ref() is None and not fin3.alive
+
+
+def test_zero_length_file_is_clean_eof(tmp_path):
+    p = str(tmp_path / "z.rio")
+    open(p, "wb").close()  # truly 0 bytes (not just a record-less file)
+    assert list(recordio.Scanner(p)) == []
+
+
+def test_truncated_final_chunk(tmp_path, corrupt_budget):
+    p = str(tmp_path / "t.rio")
+    _write(p, 12, chunk=4)  # 3 chunks of 4
+    raw = open(p, "rb").read()
+    open(p, "wb").write(raw[:len(raw) - 10])  # cut mid-payload of chunk 2
+    # strict: loud IOError (the length pre-check catches the cut payload)
+    with pytest.raises(IOError, match="truncated|exceeds file size"):
+        list(recordio.read_arrays(p))
+    # tolerant: chunks 0+1 survive, exactly one corrupt chunk spent
+    corrupt_budget(1)
+    monitor.reset()
+    monitor.enable()
+    try:
+        s = recordio.Scanner(p)
+        got = [recordio._unpack_arrays(r)[0][0] for r in s]
+        assert got == list(np.arange(8, dtype="f4"))
+        assert s.corrupt_chunks == 1
+        assert monitor.counter("data.corrupt_chunks").value == 1
+    finally:
+        monitor.disable()
+
+
+def test_flipped_byte_mid_chunk_crc_catch(tmp_path, corrupt_budget):
+    p = str(tmp_path / "f.rio")
+    _write(p, 12, chunk=4)
+    raw = bytearray(open(p, "rb").read())
+    # chunk frames: 20-byte header + payload; flip a byte inside chunk 1
+    import struct
+    (plen0,) = struct.unpack_from("<Q", raw, 8)
+    off1 = 20 + plen0
+    raw[off1 + 20 + 5] ^= 0xFF
+    open(p, "wb").write(bytes(raw))
+    corrupt_budget(1)
+    monitor.reset()
+    monitor.enable()
+    try:
+        got = [s[0][0] for s in recordio.read_arrays(p)]
+        # surviving-sample parity: chunks 0 and 2 exactly
+        assert got == [0, 1, 2, 3, 8, 9, 10, 11]
+        assert monitor.counter("data.corrupt_chunks").value == 1
+        assert monitor.counter("data.chunks_scanned").value == 3
+    finally:
+        monitor.disable()
+    # budget exhausted: terminal classified DataError
+    corrupt_budget(0)
+    fluid.set_flags({"FLAGS_data_corrupt_budget": 1})
+    recordio.reset_corrupt_spent()
+    recordio._spend_corrupt(1, "earlier-file")  # budget already spent
+    with pytest.raises(DataError, match="budget exceeded") as ei:
+        list(recordio.read_arrays(p))
+    assert getattr(ei.value, "budget_exhausted", False)
+
+
+def test_slot_batch_reader_mixed_good_corrupt_files(tmp_path, corrupt_budget):
+    good = str(tmp_path / "good.rio")
+    bad = str(tmp_path / "bad.rio")
+    recordio.write_arrays(
+        good, [(np.full(3, i, "f4"), np.asarray([i], "i4"))
+               for i in range(12)], max_chunk_records=4)
+    recordio.write_arrays(
+        bad, [(np.full(3, 100 + i, "f4"), np.asarray([100 + i], "i4"))
+              for i in range(12)], max_chunk_records=4)
+    raw = bytearray(open(bad, "rb").read())
+    import struct
+    (plen0,) = struct.unpack_from("<Q", raw, 8)
+    raw[20 + plen0 + 20 + 3] ^= 0xFF  # corrupt chunk 1 of the bad file
+    open(bad, "wb").write(bytes(raw))
+    corrupt_budget(2)
+    monitor.reset()
+    monitor.enable()
+    try:
+        with recordio.SlotBatchReader([good, bad], 4, n_threads=1,
+                                      drop_last=False) as r:
+            ids = sorted(int(v) for b in r for v in b[1].reshape(-1))
+        # parity: every sample except the bad file's chunk-1 four
+        assert ids == list(range(12)) + [100, 101, 102, 103,
+                                         108, 109, 110, 111]
+        assert monitor.counter("data.corrupt_chunks").value == 1
+    finally:
+        monitor.disable()
+    # strict mode keeps killing the stream
+    corrupt_budget(0)
+    with recordio.SlotBatchReader([good, bad], 4, n_threads=1) as r:
+        with pytest.raises(RuntimeError, match="CRC"):
+            list(r)
+
+
+def test_corrupt_budget_not_respent_across_epochs(tmp_path, corrupt_budget):
+    """Review regression: the per-run budget is a per-source high-water
+    mark — a multi-epoch run re-scanning the SAME corrupt chunk every
+    epoch must not re-spend it until one bad chunk kills the run."""
+    import struct
+
+    p = str(tmp_path / "ep.rio")
+    _write(p, 12, chunk=4)
+    raw = bytearray(open(p, "rb").read())
+    (plen0,) = struct.unpack_from("<Q", raw, 8)
+    raw[20 + plen0 + 20 + 5] ^= 0xFF  # corrupt chunk 1
+    open(p, "wb").write(bytes(raw))
+    corrupt_budget(1)
+    monitor.reset()
+    monitor.enable()
+    try:
+        r = recordio.reader_creator(p)
+        for epoch in range(3):  # would die at epoch 2 under cumulative spend
+            got = [s[0][0] for s in r()]
+            assert got == [0, 1, 2, 3, 8, 9, 10, 11], f"epoch {epoch}"
+        assert recordio.corrupt_spent() == 1
+        assert monitor.counter("data.corrupt_chunks").value == 1
+    finally:
+        monitor.disable()
+
+
+def test_queue_dataset_partial_batch_resume(tmp_path):
+    """Review regression: a cursor saved after the trailing partial batch
+    (drop_last=False) must not re-yield that batch on resume."""
+    p = str(tmp_path / "qd.rio")
+    recordio.write_arrays(
+        p, [(np.full(2, i, "f4"), np.asarray([i], "i4")) for i in range(10)],
+        max_chunk_records=4)
+
+    def make():
+        ds = fluid.QueueDataset()
+        ds.set_batch_size(4)
+        ds.set_thread(1)
+        ds.set_filelist([p])
+        ds.set_use_var(["a", "b"])
+        ds._drop_last = False
+        return ds
+
+    ds = make()
+    batches = list(ds.batches())
+    assert [b["b"].shape[0] for b in batches] == [4, 4, 2]
+    state = ds.state_dict()
+    assert state["samples_consumed"] == 10
+    ds2 = make()
+    ds2.load_state_dict(state)
+    assert list(ds2.batches()) == [], "resume at end must not re-yield the partial batch"
+
+
+def test_scanner_safe_after_exhaustion(tmp_path):
+    """Review regression: operations on an exhausted (auto-closed) scanner
+    must be safe — a second pass is clean EOF, tell/seek raise a clear
+    error instead of passing a NULL handle to the native layer."""
+    p = str(tmp_path / "sx.rio")
+    _write(p, 6)
+    with recordio.Scanner(p) as s:
+        assert sum(1 for _ in s) == 6
+        assert sum(1 for _ in s) == 0  # second pass: clean EOF, no crash
+        with pytest.raises(ValueError, match="closed"):
+            s.tell()
+        with pytest.raises(ValueError, match="closed"):
+            s.seek(0)
+
+
+def test_seek_into_corrupt_chunk_fails_not_mispositions(tmp_path, corrupt_budget):
+    """Review regression: a tolerant seek whose TARGET chunk is corrupt
+    must fail loudly — silently skipping it would apply the record offset
+    inside the next chunk and resume the stream mispositioned."""
+    import struct
+
+    p = str(tmp_path / "sc.rio")
+    _write(p, 9, chunk=3)  # 3 chunks of 3
+    s = recordio.Scanner(p)
+    it = iter(s)
+    for _ in range(4):
+        next(it)
+    state = s.state_dict()  # {chunk: 1, record: 1}
+    s.close()
+    raw = bytearray(open(p, "rb").read())
+    (plen0,) = struct.unpack_from("<Q", raw, 8)
+    raw[20 + plen0 + 20 + 2] ^= 0xFF  # corrupt chunk 1 (the seek target)
+    open(p, "wb").write(bytes(raw))
+    corrupt_budget(4)
+    s2 = recordio.Scanner(p)
+    with pytest.raises(IOError, match="CRC|corrupt"):
+        s2.load_state_dict(state)
+
+
+def test_fault_spec_file_kinds(tmp_path, corrupt_budget):
+    """corrupt_chunk@N / truncated_file@N mutate real files once, through
+    the grammar + on_files hook."""
+    from paddle_tpu.faults import FaultInjector, parse_fault_spec
+
+    faults = parse_fault_spec("corrupt_chunk@1;truncated_file@2")
+    assert [(f.kind, f.at) for f in faults] == [("corrupt_chunk", 1),
+                                               ("truncated_file", 2)]
+    p = str(tmp_path / "ff.rio")
+    _write(p, 16, chunk=4)  # 4 chunks
+    inj = FaultInjector("corrupt_chunk@1;truncated_file@2")
+    inj.on_files([p])
+    assert [f.kind for f in inj.fired()] == ["corrupt_chunk",
+                                             "truncated_file"]
+    inj.on_files([p])  # fires exactly once: file untouched now
+    corrupt_budget(4)
+    got = [s[0][0] for s in recordio.read_arrays(p)]
+    # chunk 0 intact; chunk 1 CRC-dead; chunk 2 truncated => file ends
+    assert got == [0, 1, 2, 3]
+    assert recordio.corrupt_spent() == 2
 
 
 def test_reader_creator_feeds_dataloader(tmp_path):

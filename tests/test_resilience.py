@@ -522,6 +522,62 @@ def test_resume_ignores_corrupt_newer_checkpoint_sidecar(tmp_path):
     _assert_state_equal(scope2, ref, "resume past corrupt sidecar")
 
 
+def test_corrupt_chunk_training_within_budget(tmp_path):
+    """ISSUE 5 acceptance: a RecordIO file with one corrupted chunk
+    completes training with data.corrupt_chunks == 1 under budget, and
+    aborts with a classified DataError when the budget is exceeded."""
+    from paddle_tpu import reader as rd
+    from paddle_tpu import recordio
+
+    main, startup, loss = _build()
+    p = str(tmp_path / "train.rio")
+    recordio.write_arrays(
+        p, [(np.full(4, i, "f4"),) for i in range(48)], max_chunk_records=6)
+
+    def factory():
+        def to_feed(samples):
+            xv = np.stack([s[0] for s in samples])
+            return {"x": xv, "y": xv.sum(1, keepdims=True)}
+
+        return rd.map_readers(
+            to_feed, rd.batch(recordio.reader_creator(p), 4, drop_last=True))
+
+    inj = FaultInjector("corrupt_chunk@2")
+    inj.on_files([p])
+    fluid.set_flags({"FLAGS_data_corrupt_budget": 1})
+    monitor.reset()
+    monitor.enable()
+    try:
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        stats = fluid.resilient_train_loop(
+            exe, main, factory, [loss], scope=scope,
+            policy=fluid.RetryPolicy(**FAST), max_inflight=3)
+        # 48 samples - chunk 2's six = 42 -> 10 full batches of 4
+        assert stats.steps == 10
+        assert monitor.counter("data.corrupt_chunks").value == 1
+    finally:
+        monitor.disable()
+        fluid.set_flags({"FLAGS_data_corrupt_budget": 0})
+
+    # a second corrupt chunk blows the budget of 1: terminal DataError,
+    # NOT one more skippable bad batch
+    FaultInjector("corrupt_chunk@5").on_files([p])
+    fluid.set_flags({"FLAGS_data_corrupt_budget": 1})
+    try:
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        with pytest.raises(DataError, match="budget exceeded"):
+            fluid.resilient_train_loop(
+                exe, main, factory, [loss], scope=scope,
+                policy=fluid.RetryPolicy(max_bad_batches=100, **FAST),
+                max_inflight=3)
+    finally:
+        fluid.set_flags({"FLAGS_data_corrupt_budget": 0})
+
+
 def test_classify_prefers_transient_code_over_loader_phase():
     """An XLA RESOURCE_EXHAUSTED raised in the producer thread is an HBM
     problem, not skippable data — the code match outranks the breadcrumb."""

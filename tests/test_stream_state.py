@@ -1,0 +1,474 @@
+"""Stream-state protocol (ISSUE 5): every reader combinator and source
+grows state_dict()/load_state_dict(), resume is an O(1) seek that is
+bit-identical even for shuffled sources, and the resilient loop stores
+the stream state in RESUME.json so preemption/rollback resume never
+replays the dataset.  CPU-only, deterministic — tier-1."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor, recordio
+from paddle_tpu import reader as rd
+from paddle_tpu.checkpoint_manager import CheckpointManager
+from paddle_tpu.faults import FaultInjector
+from paddle_tpu.reader import is_checkpointable
+
+FAST = dict(backoff_base_s=0.0)
+
+
+def _write_rio(tmp_path, n=24, dim=3, chunk=4, name="s.rio"):
+    p = str(tmp_path / name)
+    recordio.write_arrays(
+        p, [(np.full(dim, i, "f4"),) for i in range(n)], max_chunk_records=chunk)
+    return p
+
+
+def _drain_resume(reader_obj, k):
+    """Pull k items, snapshot, rebuild from state, return (head, tail)."""
+    it = iter(reader_obj())
+    head = [next(it) for _ in range(k)]
+    state = reader_obj.state_dict()
+    return head, state
+
+
+# --- per-combinator state round-trips ---------------------------------------
+
+def test_recordio_reader_state_roundtrip(tmp_path):
+    p = _write_rio(tmp_path)
+    r = recordio.reader_creator(p)
+    assert is_checkpointable(r)
+    head, state = _drain_resume(r, 10)
+    r2 = recordio.reader_creator(p)
+    r2.load_state_dict(state)
+    tail = [s[0][0] for s in r2()]
+    assert tail == list(range(10, 24))
+
+
+def test_shuffle_reshuffles_per_epoch_deterministically():
+    """The satellite golden test: same seed => same schedule across
+    reconstructions, but epoch k and epoch k+1 permute differently."""
+    def src():
+        yield from range(30)
+
+    s = rd.shuffle(src, 10, seed=42)
+    e0, e1 = list(s()), list(s())
+    assert sorted(e0) == sorted(e1) == list(range(30))
+    assert e0 != e1, "epochs must reshuffle differently"
+    s2 = rd.shuffle(src, 10, seed=42)
+    assert list(s2()) == e0 and list(s2()) == e1, \
+        "the epoch schedule must be deterministic under the same seed"
+
+
+def test_shuffle_state_resume_bit_identical(tmp_path):
+    p = _write_rio(tmp_path)
+    sh = rd.shuffle(recordio.reader_creator(p), 8, seed=5)
+    assert is_checkpointable(sh)
+    full = [s[0][0] for s in sh()]          # epoch 0, uninterrupted
+
+    sh2 = rd.shuffle(recordio.reader_creator(p), 8, seed=5)
+    it = iter(sh2())
+    head = [next(it)[0][0] for _ in range(11)]  # mid-buffer position
+    state = sh2.state_dict()
+    sh3 = rd.shuffle(recordio.reader_creator(p), 8, seed=5)
+    sh3.load_state_dict(state)
+    tail = [s[0][0] for s in sh3()]
+    assert head + tail == full, "shuffled resume must be bit-identical"
+
+
+def test_batch_chain_map_firstn_cache_state(tmp_path):
+    p1 = _write_rio(tmp_path, n=10, name="a.rio")
+    p2 = _write_rio(tmp_path, n=10, name="b.rio")
+
+    # batch over chain, interrupted across the file boundary
+    ch = rd.chain(recordio.reader_creator(p1), recordio.reader_creator(p2))
+    b = rd.batch(ch, 3, drop_last=False)
+    assert is_checkpointable(b)
+    it = iter(b())
+    head = [next(it) for _ in range(4)]     # 12 samples: into the 2nd file
+    state = b.state_dict()
+    ch2 = rd.chain(recordio.reader_creator(p1), recordio.reader_creator(p2))
+    b2 = rd.batch(ch2, 3, drop_last=False)
+    b2.load_state_dict(state)
+    tail = list(b2())
+    got = [s[0][0] for batch in head + tail for s in batch]
+    assert got == list(range(10)) + list(range(10))
+
+    # map + firstn
+    m = rd.firstn(rd.map_readers(lambda s: s[0] * 2, recordio.reader_creator(p1)), 7)
+    it = iter(m())
+    head = [next(it)[0] for _ in range(4)]
+    state = m.state_dict()
+    m2 = rd.firstn(rd.map_readers(lambda s: s[0] * 2, recordio.reader_creator(p1)), 7)
+    m2.load_state_dict(state)
+    # review regression: state_dict after load (before iterating) must
+    # report the LOADED yielded count, not a stale live one — this is
+    # exactly what the resilient loop snapshots before its first pull
+    assert m2.state_dict()["yielded"] == 4
+    tail = [a[0] for a in m2()]
+    assert head + tail == [0.0, 2.0, 4.0, 6.0, 8.0, 10.0, 12.0]
+
+    # cache: O(1) index state even over a non-checkpointable source
+    def plain():
+        yield from range(9)
+
+    c = rd.cache(plain)
+    assert is_checkpointable(c)
+    it = iter(c())
+    head = [next(it) for _ in range(5)]
+    c2_state = c.state_dict()
+    c.load_state_dict(c2_state)
+    assert head + list(c()) == list(range(9))
+
+
+def test_xmap_ordered_state_resume(tmp_path):
+    p = _write_rio(tmp_path, n=16)
+    x = rd.xmap_readers(lambda s: s[0][0] * 10, recordio.reader_creator(p),
+                        2, 4, order=True)
+    assert is_checkpointable(x)
+    it = iter(x())
+    head = [next(it) for _ in range(6)]
+    state = x.state_dict()
+    x2 = rd.xmap_readers(lambda s: s[0][0] * 10, recordio.reader_creator(p),
+                         2, 4, order=True)
+    x2.load_state_dict(state)
+    tail = list(x2())
+    assert head + tail == [float(i * 10) for i in range(16)]
+    # unordered xmap is honest about being non-resumable
+    xu = rd.xmap_readers(lambda s: s, recordio.reader_creator(p), 2, 4)
+    assert not is_checkpointable(xu)
+    with pytest.raises(TypeError, match="not checkpointable"):
+        xu.state_dict()
+
+
+def test_stateless_source_is_not_checkpointable():
+    def plain():
+        yield from range(5)
+
+    assert not is_checkpointable(plain)
+    assert not is_checkpointable(rd.batch(plain, 2))
+    with pytest.raises(TypeError, match="not checkpointable"):
+        rd.batch(plain, 2).state_dict()
+
+
+def test_dataloader_state_tracks_consumer_not_producer(tmp_path):
+    """The producer prefetches ahead; state_dict must reflect what the
+    CONSUMER saw, so in-flight prefetched batches are re-staged on resume."""
+    p = _write_rio(tmp_path, n=20, dim=4)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+
+    def make_loader():
+        gen = rd.map_readers(
+            lambda batch: {"x": np.stack([s[0] for s in batch])},
+            rd.batch(recordio.reader_creator(p), 2, drop_last=True))
+        return fluid.DataLoader.from_generator([x], capacity=4) \
+            .set_batch_generator(gen)
+
+    loader = make_loader()
+    assert loader.checkpointable()
+    it = iter(loader)
+    head = [np.asarray(next(it)["x"]) for _ in range(3)]
+    state = loader.state_dict()   # produced may be ahead; consumed == 3
+    loader2 = make_loader()
+    loader2.load_state_dict(state)
+    tail = [np.asarray(b["x"]) for b in loader2]
+    got = np.concatenate([a[:, 0] for a in head + tail])
+    np.testing.assert_array_equal(got, np.arange(20, dtype="f4"))
+
+
+def test_dataset_state(tmp_path):
+    p = str(tmp_path / "ds.rio")
+    recordio.write_arrays(
+        p, [(np.full(2, i, "f4"), np.asarray([i], "i8")) for i in range(12)],
+        max_chunk_records=5)
+    ds = fluid.InMemoryDataset()
+    ds.set_batch_size(2)
+    ds.set_filelist([p])
+    ds.set_use_var(["a", "b"])
+    ds.load_into_memory()
+    assert is_checkpointable(ds)
+    it = iter(ds.batches())
+    head = [next(it) for _ in range(3)]
+    state = ds.state_dict()
+    assert state["samples_consumed"] == 6
+    ds2 = fluid.InMemoryDataset()
+    ds2.set_batch_size(2)
+    ds2.set_filelist([p])
+    ds2.set_use_var(["a", "b"])
+    ds2.load_into_memory()
+    ds2.load_state_dict(state)
+    tail = list(ds2.batches())
+    ids = [int(v) for b in head + tail for v in b["b"].reshape(-1)]
+    assert ids == list(range(12))
+
+
+def test_slot_batch_reader_state(tmp_path):
+    p = str(tmp_path / "slots.rio")
+    recordio.write_arrays(
+        p, [(np.full(3, i, "f4"), np.asarray([i], "i4")) for i in range(12)],
+        max_chunk_records=4)
+    r = recordio.SlotBatchReader([p], 2, n_threads=1)
+    assert is_checkpointable(r)
+    it = iter(r)
+    head = [next(it) for _ in range(2)]
+    state = r.state_dict()
+    r.close()
+    r2 = recordio.SlotBatchReader([p], 2, n_threads=1)
+    r2.load_state_dict(state)
+    tail = list(iter(r2))
+    r2.close()
+    ids = [int(v) for b in head + tail for v in b[1].reshape(-1)]
+    assert ids == list(range(12))
+    # multi-threaded order is irreproducible -> honestly not checkpointable
+    r3 = recordio.SlotBatchReader([p, p], 2, n_threads=2)
+    assert not is_checkpointable(r3)
+    r3.close()
+
+
+# --- the acceptance criterion: O(1) resume over shuffle(recordio) -----------
+
+def _build_model(seed=11):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h = fluid.layers.fc(x, 8, act="relu")
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(h, 1), y))
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+    startup.random_seed = seed
+    main.random_seed = seed
+    return main, startup, loss
+
+
+def _params(scope):
+    return {n: np.asarray(scope.find_var(n)).copy()
+            for n in scope.local_var_names()}
+
+
+def _rio_factory(path, batch=4):
+    def to_feed(samples):
+        xv = np.stack([s[0] for s in samples]).astype("f4")
+        return {"x": xv, "y": xv.sum(1, keepdims=True)}
+
+    def factory():
+        return rd.map_readers(
+            to_feed,
+            rd.batch(rd.shuffle(recordio.reader_creator(path), 8, seed=3),
+                     batch, drop_last=True))
+
+    return factory
+
+
+def test_preempt_resume_over_shuffled_recordio_is_o1_and_bit_identical(tmp_path):
+    """ISSUE 5 acceptance: preemption + resume of a run over a
+    shuffle(recordio) source is bit-identical to an uninterrupted run
+    WITHOUT replaying from batch 0 — fast-forward batch count must be 0
+    (the stream seeks) and the seek counter must fire."""
+    p = _write_rio(tmp_path, n=48, dim=4, chunk=6)
+    main, startup, loss = _build_model()
+    factory = _rio_factory(p)
+
+    # reference: uninterrupted
+    exe = fluid.Executor(fluid.CPUPlace())
+    ref_scope = fluid.Scope()
+    exe.run(startup, scope=ref_scope)
+    ref_stats = fluid.resilient_train_loop(
+        exe, main, factory, [loss], scope=ref_scope, max_inflight=3)
+    ref = _params(ref_scope)
+
+    # interrupted at step 5
+    exe1 = fluid.Executor(fluid.CPUPlace())
+    scope1 = fluid.Scope()
+    exe1.run(startup, scope=scope1)
+    cm = CheckpointManager(str(tmp_path / "ckpt"), program=main, scope=scope1)
+    stats1 = fluid.resilient_train_loop(
+        exe1, main, _rio_factory(p), [loss], scope=scope1,
+        injector=FaultInjector("preempt@5"), checkpoint_manager=cm,
+        max_inflight=3)
+    assert stats1.preempted and stats1.resume_step == 5
+    with open(os.path.join(stats1.checkpoint_dir, "RESUME.json")) as f:
+        info = json.load(f)
+    assert "stream_state" in info, "checkpoint must carry the stream state"
+
+    # fresh process: restore + O(1) seek
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    scope2 = fluid.Scope()
+    exe2.run(startup, scope=scope2)
+    cm2 = CheckpointManager(str(tmp_path / "ckpt"), program=main, scope=scope2)
+    monitor.reset()
+    monitor.enable()
+    try:
+        stats2 = fluid.resilient_train_loop(
+            exe2, main, _rio_factory(p), [loss], scope=scope2,
+            checkpoint_manager=cm2, resume=True, max_inflight=3)
+    finally:
+        counters = monitor.get_monitor().counter_values()
+        monitor.disable()
+    assert stats2.steps == ref_stats.steps
+    assert counters.get("resilience.stream_seek", 0) == 1
+    assert counters.get("resilience.replayed_batches", 0) == 0, \
+        "stateful resume must not replay a single batch"
+    assert counters.get("resilience.replay_fallback", 0) == 0
+    for n, v in ref.items():
+        np.testing.assert_array_equal(
+            np.asarray(scope2.find_var(n)), v,
+            err_msg=f"state var {n} diverged after stream-state resume")
+
+
+def test_rollback_uses_stream_state(tmp_path):
+    """nan_mode='rollback' over a shuffled recordio source: the restored
+    checkpoint's stream state rewinds the shuffle mid-epoch, and the end
+    state matches the uninterrupted run bit-for-bit."""
+    p = _write_rio(tmp_path, n=48, dim=4, chunk=6)
+    main, startup, loss = _build_model()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    ref_scope = fluid.Scope()
+    exe.run(startup, scope=ref_scope)
+    fluid.resilient_train_loop(
+        exe, main, _rio_factory(p), [loss], scope=ref_scope, max_inflight=3)
+    ref = _params(ref_scope)
+
+    exe1 = fluid.Executor(fluid.CPUPlace())
+    scope1 = fluid.Scope()
+    exe1.run(startup, scope=scope1)
+    cm = CheckpointManager(str(tmp_path / "ck2"), program=main, scope=scope1,
+                           save_every_steps=3)
+    monitor.reset()
+    monitor.enable()
+    try:
+        stats = fluid.resilient_train_loop(
+            exe1, main, _rio_factory(p), [loss], scope=scope1,
+            injector=FaultInjector("nan@7"), nan_mode="rollback",
+            checkpoint_manager=cm, policy=fluid.RetryPolicy(**FAST),
+            max_inflight=3)
+        counters = monitor.get_monitor().counter_values()
+    finally:
+        monitor.disable()
+    assert stats.rollbacks == 1
+    assert counters.get("resilience.stream_seek", 0) == 1
+    assert counters.get("resilience.replayed_batches", 0) == 0
+    for n, v in ref.items():
+        np.testing.assert_array_equal(np.asarray(scope1.find_var(n)), v,
+                                      err_msg=f"{n} diverged after rollback")
+
+
+# --- stateless fallback: loud + divergence-guarded --------------------------
+
+def _feeds(n, batch=8):
+    rng = np.random.RandomState(0)
+    out = []
+    for _ in range(n):
+        xv = rng.rand(batch, 4).astype("f4")
+        out.append({"x": xv, "y": xv.sum(1, keepdims=True)})
+    return out
+
+
+def test_stateless_resume_replays_loudly(tmp_path):
+    """A plain-list factory (no stream state) still resumes, but the
+    fast-forward is visible: replay_fast_forward event with the batch
+    count + resilience.replayed_batches counter (what perf_report's
+    --max-replay-batches gates on)."""
+    main, startup, loss = _build_model()
+    feeds = _feeds(12)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    cm = CheckpointManager(str(tmp_path), program=main, scope=scope)
+    stats = fluid.resilient_train_loop(
+        exe, main, lambda: list(feeds), [loss], scope=scope,
+        injector=FaultInjector("preempt@5"), checkpoint_manager=cm,
+        max_inflight=3)
+    assert stats.preempted
+
+    exe2 = fluid.Executor(fluid.CPUPlace())
+    scope2 = fluid.Scope()
+    exe2.run(startup, scope=scope2)
+    cm2 = CheckpointManager(str(tmp_path), program=main, scope=scope2)
+    monitor.reset()
+    monitor.enable()
+    try:
+        stats2 = fluid.resilient_train_loop(
+            exe2, main, lambda: list(feeds), [loss], scope=scope2,
+            checkpoint_manager=cm2, resume=True, max_inflight=3)
+        counters = monitor.get_monitor().counter_values()
+        events = [r for r in monitor.step_records()
+                  if r.get("kind") == "resilience_event"
+                  and r.get("action") == "replay_fast_forward"]
+    finally:
+        monitor.disable()
+    assert stats2.steps == 12
+    assert counters.get("resilience.replay_fallback", 0) == 1
+    assert counters.get("resilience.replayed_batches", 0) == 5
+    assert len(events) == 1 and events[0]["batches"] == 5
+
+
+def test_replay_divergence_raises_clear_error(tmp_path):
+    """A factory whose replay yields a DIFFERENT batch than the replay
+    window recorded must raise, not silently train on different data."""
+    main, startup, loss = _build_model()
+    feeds = _feeds(10)
+    calls = {"n": 0}
+
+    def flaky_factory():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return list(feeds)
+        mutated = [dict(f) for f in feeds]
+        mutated[4] = {"x": mutated[4]["x"] + 1.0, "y": mutated[4]["y"]}
+        return mutated
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    cm = CheckpointManager(str(tmp_path), program=main, scope=scope,
+                           save_every_steps=3)
+    with pytest.raises(RuntimeError, match="replay divergence"):
+        fluid.resilient_train_loop(
+            exe, main, flaky_factory, [loss], scope=scope,
+            injector=FaultInjector("nan@5"), nan_mode="rollback",
+            checkpoint_manager=cm, policy=fluid.RetryPolicy(**FAST),
+            max_inflight=3)
+
+
+def test_resume_sidecar_name_is_rank_namespaced():
+    """Review regression: coordinated checkpoints share one pending dir;
+    a fixed RESUME.json would let the last rank clobber every other
+    rank's stream cursor."""
+    from paddle_tpu.resilience import RESUME_FILE, resume_sidecar_name
+
+    assert resume_sidecar_name(0, 1) == RESUME_FILE
+    assert resume_sidecar_name(0, 2) == "RESUME.p0.json"
+    assert resume_sidecar_name(3, 4) == "RESUME.p3.json"
+    assert len({resume_sidecar_name(r, 8) for r in range(8)}) == 8
+
+
+def test_perf_report_replay_and_corrupt_gates(tmp_path):
+    import sys
+
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent.parent))
+    from tools.perf_report import check, data_corrupt_fraction, replayed_batches
+
+    rows = [{"kind": "step", "recompiles_total": 0} for _ in range(6)]
+    rows += [{"kind": "resilience_event", "action": "replay_fast_forward",
+              "class": "DataStream", "at_batch": 5, "batches": 5}]
+    rows += [{"kind": "snapshot",
+              "counters": {"data.corrupt_chunks": 1,
+                           "data.chunks_scanned": 50}}]
+    assert replayed_batches(rows) == 5
+    assert data_corrupt_fraction(rows) == pytest.approx(0.02)
+    path = tmp_path / "m.jsonl"
+    path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    assert check(str(path), max_replay_batches=5) == 0
+    assert check(str(path), max_replay_batches=0) == 1
+    assert check(str(path), max_data_corrupt_frac=0.05) == 0
+    assert check(str(path), max_data_corrupt_frac=0.01) == 1
+    # counters-only file (loader-side): data gates still checkable
+    bare = tmp_path / "bare.jsonl"
+    bare.write_text(json.dumps(rows[-1]) + "\n")
+    assert check(str(bare), max_data_corrupt_frac=0.05) == 0

@@ -43,6 +43,17 @@
         rollback-and-relaunch, so a chaos budget above the expected
         schedule means workers are dying for reasons the fault spec does
         not explain.
+
+    python tools/perf_report.py --check metrics.jsonl --max-data-corrupt-frac 0.01
+        Gate the data layer (paddle_tpu.recordio): corrupt chunks dropped
+        per chunk scanned, from the newest counter snapshot.  The corrupt
+        budget keeps a run alive through isolated rot; this gate notices
+        when the rot rate itself is the problem.
+
+    python tools/perf_report.py --check metrics.jsonl --max-replay-batches 0
+        Gate the resume cost: batches replayed just to fast-forward a
+        stateless data source (replay_fast_forward resilience events).
+        0 asserts every source resumed via the O(1) stream-state seek.
 """
 from __future__ import annotations
 
@@ -169,14 +180,19 @@ def retry_fraction(records):
     return rec / steps if steps else 0.0
 
 
-def _latest_dist_counters(lines):
-    """dist.* counters from the NEWEST record carrying a counter map (a
-    MonitorLogger.write_snapshot line, or a rendered snapshot dict)."""
+def _latest_counters(lines, prefix):
+    """`prefix`-named counters from the NEWEST record carrying a counter
+    map (a MonitorLogger.write_snapshot line, or a rendered snapshot
+    dict)."""
     for rec in reversed(lines):
         counters = rec.get("counters")
         if isinstance(counters, dict):
-            return {n: v for n, v in counters.items() if n.startswith("dist.")}
+            return {n: v for n, v in counters.items() if n.startswith(prefix)}
     return {}
+
+
+def _latest_dist_counters(lines):
+    return _latest_counters(lines, "dist.")
 
 
 def heartbeat_miss_fraction(lines):
@@ -199,6 +215,33 @@ def gang_restart_count(lines):
     if n:
         return n
     return int(_latest_dist_counters(lines).get("dist.gang_restarts", 0))
+
+
+def data_corrupt_fraction(lines):
+    """Corrupt RecordIO chunks dropped per chunk scanned, from the newest
+    counter snapshot (`data.corrupt_chunks` / `data.chunks_scanned`,
+    paddle_tpu.recordio).  ~0 on healthy storage; a creeping fraction
+    means the dataset files are rotting (torn writes, bad disks) even
+    while the corrupt budget keeps the run alive."""
+    c = _latest_counters(lines, "data.")
+    scanned = c.get("data.chunks_scanned", 0)
+    corrupt = c.get("data.corrupt_chunks", 0)
+    return corrupt / scanned if scanned else 0.0
+
+
+def replayed_batches(lines):
+    """Batches pulled-and-discarded to fast-forward a stateless data
+    source on resume (`replay_fast_forward` resilience events, counter
+    fallback).  The resume-cost number: 0 when every source speaks the
+    stream-state protocol (O(1) seek); anything else is an O(dataset)
+    resume eating the recovery budget."""
+    n = sum(int(r.get("batches", 0)) for r in lines
+            if r.get("kind") == "resilience_event"
+            and r.get("action") == "replay_fast_forward")
+    if n:
+        return n
+    return int(_latest_counters(lines, "resilience.")
+               .get("resilience.replayed_batches", 0))
 
 
 def host_blocked_fraction(pipeline_steps):
@@ -242,7 +285,9 @@ def check(path: str, steady_after: int = 2,
           max_host_blocked_frac: float = None,
           max_retry_frac: float = None,
           max_heartbeat_miss_frac: float = None,
-          max_gang_restarts: int = None) -> int:
+          max_gang_restarts: int = None,
+          max_data_corrupt_frac: float = None,
+          max_replay_batches: int = None) -> int:
     """Return 0 when the metrics file is healthy, 1 otherwise (printed
     diagnosis either way).  Made for CI/bench scripts:
 
@@ -263,10 +308,13 @@ def check(path: str, steady_after: int = 2,
         print(f"perf_report --check: {path} is not valid JSONL: {e}")
         return 1
     steps = [r for r in lines if r.get("kind") == "step"]
-    # a launcher-side metrics file (gang restarts, dist events) carries no
-    # executor step records; the dist gates must still be checkable on it
+    # a launcher- or loader-side metrics file (gang restarts, dist events,
+    # data-layer counters) carries no executor step records; those gates
+    # must still be checkable on it
     dist_gates_only = (max_heartbeat_miss_frac is not None
-                       or max_gang_restarts is not None) \
+                       or max_gang_restarts is not None
+                       or max_data_corrupt_frac is not None
+                       or max_replay_batches is not None) \
         and max_host_blocked_frac is None and max_retry_frac is None
     if not steps and not dist_gates_only:
         print(f"perf_report --check: {path} contains no step records "
@@ -354,6 +402,31 @@ def check(path: str, steady_after: int = 2,
         else:
             print(f"perf_report --check: gang restarts {n} <= "
                   f"{max_gang_restarts}")
+    if max_data_corrupt_frac is not None:
+        frac = data_corrupt_fraction(lines)
+        if frac > max_data_corrupt_frac:
+            failures.append(
+                f"data-corrupt fraction {frac:.4f} exceeds the "
+                f"--max-data-corrupt-frac={max_data_corrupt_frac} gate — "
+                f"the dataset files are rotting faster than the corrupt "
+                f"budget should have to cover (torn writes, bad disks, a "
+                f"broken producer); check data.corrupt_chunks vs "
+                f"data.chunks_scanned and regenerate the files")
+        else:
+            print(f"perf_report --check: data-corrupt fraction {frac:.4f} "
+                  f"<= {max_data_corrupt_frac}")
+    if max_replay_batches is not None:
+        n = replayed_batches(lines)
+        if n > max_replay_batches:
+            failures.append(
+                f"{n} batch(es) replayed to fast-forward on resume exceed "
+                f"the --max-replay-batches={max_replay_batches} gate — the "
+                f"data source is stateless, so every resume is O(dataset); "
+                f"give the loop a checkpointable reader (stream-state "
+                f"protocol) to make resume an O(1) seek")
+        else:
+            print(f"perf_report --check: replayed batches {n} <= "
+                  f"{max_replay_batches}")
     if failures:
         for f_ in failures:
             print(f"perf_report --check: {f_}")
@@ -394,11 +467,24 @@ def main(argv=None):
                     help="gate gang restarts (paddle_tpu.launch "
                          "gang_restart dist_event records / "
                          "dist.gang_restarts counter) at <= N")
+    ap.add_argument("--max-data-corrupt-frac", type=float, default=None,
+                    metavar="FRAC",
+                    help="gate corrupt RecordIO chunks per chunk scanned "
+                         "(data.corrupt_chunks / data.chunks_scanned "
+                         "counters, newest snapshot) at <= FRAC")
+    ap.add_argument("--max-replay-batches", type=int, default=None,
+                    metavar="N",
+                    help="gate the resume cost: batches replayed to "
+                         "fast-forward a stateless data source "
+                         "(replay_fast_forward resilience events) at <= N "
+                         "— 0 asserts every source resumes via the O(1) "
+                         "stream-state seek")
     args = ap.parse_args(argv)
     if args.check:
         return check(args.check, args.steady_after,
                      args.max_host_blocked_frac, args.max_retry_frac,
-                     args.max_heartbeat_miss_frac, args.max_gang_restarts)
+                     args.max_heartbeat_miss_frac, args.max_gang_restarts,
+                     args.max_data_corrupt_frac, args.max_replay_batches)
     if args.diff:
         print(diff(*args.diff))
         return 0
