@@ -258,7 +258,9 @@ class _CompiledStep:
                         lambda a: jax.lax.pmean(a, batch_axis), stacked)
                     return stacked, srw
 
-                smapped = jax.shard_map(
+                from .jax_compat import shard_map as _shard_map
+
+                smapped = _shard_map(
                     worker, mesh=mesh,
                     in_specs=(rw_repl, ro_repl, ls_in_feeds, P()),
                     out_specs=([P()] * len(self.fetch_names), out_state_spec),
@@ -917,6 +919,20 @@ class Executor:
             mesh_platform = (
                 mesh.devices.flat[0].platform if mesh is not None else device.platform
             )
+            # Static analysis ahead of lowering (FLAGS_verify_program):
+            # once per compile-cache miss, so steady state pays nothing.
+            # A malformed program raises a classified error naming the
+            # op/var/block here instead of dying inside JAX tracing.
+            from ..flags import flag as _flagv
+
+            verify_level = _flagv("FLAGS_verify_program")
+            if verify_level not in ("", "off"):
+                from .analysis import check_program
+
+                with _MON.span("analysis.verify", program=program._uuid[:8]):
+                    check_program(program, level=verify_level,
+                                  feed_names=list(jfeeds),
+                                  fetch_names=fetch_names)
             with _MON.span("executor.build", program=program._uuid[:8]):
                 compiled = _CompiledStep(
                     program, list(jfeeds), fetch_names, scope,

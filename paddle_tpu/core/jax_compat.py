@@ -1,0 +1,66 @@
+"""Version-bridging shims over jax APIs that moved between releases.
+
+The framework tracks the CURRENT jax surface; older jaxlibs still in the
+fleet lag behind it.  Each shim prefers the modern spelling and falls back
+to the legacy location, so call sites stay written against one API.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    """`jax.shard_map` (new) / `jax.experimental.shard_map.shard_map` (old).
+
+    The replication-check kwarg was renamed `check_rep` -> `check_vma`
+    across the move; this shim accepts the new name and translates.
+    """
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        try:
+            return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=check_vma)
+        except TypeError:
+            # transitional releases export jax.shard_map without check_vma
+            return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as legacy_sm
+
+    # The new API leaves mesh axes that no spec mentions to GSPMD (auto);
+    # the legacy one maps over EVERY mesh axis, which breaks compositions
+    # like a pp-only pipeline on a dp×pp×mp step mesh: with check_rep=False
+    # the transpose rule psums cotangents over the unmentioned axes too,
+    # silently scaling gradients by their product.  Legacy partial-manual
+    # (`auto=`) is not a way out — it aborts in XLA (PartitionId under SPMD
+    # partitioning) on these jaxlibs.  Instead, when specs leave axes
+    # unmentioned, run fully manual WITH replication checking: inputs
+    # gather over the unmentioned axes (redundant compute, same numerics)
+    # and the tracked replication makes the transpose exact.
+    mentioned = set()
+    for spec in jax.tree_util.tree_leaves((in_specs, out_specs)):
+        for entry in spec:
+            if entry is None:
+                continue
+            mentioned.update(entry if isinstance(entry, (tuple, list)) else (entry,))
+    unmentioned = set(mesh.axis_names) - mentioned
+    mapped = legacy_sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                       check_rep=check_vma or bool(unmentioned))
+    if not unmentioned:
+        return mapped
+
+    # Known legacy-GSPMD miscompile: a value PRODUCED inside the enclosing
+    # jit (e.g. jnp.stack of per-stage params) entering a manual region on
+    # a multi-axis mesh gets sliced wrongly (devices receive the wrong
+    # stage's block).  Pinning every input replicated before the manual
+    # region sidesteps the bad full-to-shard; with all axes manual +
+    # check_rep this is also what the semantics require.
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    repl = NamedSharding(mesh, PartitionSpec())
+
+    def pinned(*args):
+        args = jax.tree_util.tree_map(
+            lambda a: jax.lax.with_sharding_constraint(a, repl)
+            if hasattr(a, "dtype") else a, args)
+        return mapped(*args)
+
+    return pinned

@@ -78,7 +78,7 @@ def run_ops(ctx: LoweringContext, ops: List[Operator], env: Dict[str, Any]) -> D
 
 
 def lower_one(ctx: LoweringContext, op: Operator, env: Dict[str, Any]) -> None:
-    opdef = get_op_def(op.type)
+    opdef = get_op_def(op.type, op=op, block=op.block)
     ins = {}
     for slot, names in op.inputs.items():
         vals = []

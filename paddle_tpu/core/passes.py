@@ -30,10 +30,29 @@ def registered_passes() -> List[str]:
     return sorted(_PASS_REGISTRY)
 
 
+def _verify_after(program, pass_name: str):
+    """Pass-safety harness: under FLAGS_verify_program, re-verify the
+    program after a rewrite so a pass bug surfaces as an immediate
+    diagnostic naming the offending op/var instead of wrong numerics (or
+    an opaque trace error) at lowering time."""
+    from ..flags import flag
+
+    level = flag("FLAGS_verify_program")
+    if level in ("", "off"):
+        return
+    from .analysis import SEV_ERROR, PassVerificationError, verify_program
+
+    diags = verify_program(program, level=level)
+    errors = [d for d in diags if d.severity == SEV_ERROR]
+    if errors:
+        raise PassVerificationError(pass_name, errors)
+
+
 def apply_pass(program, name: str, **kw):
     if name not in _PASS_REGISTRY:
         raise KeyError(f"unknown pass {name!r}; known: {registered_passes()}")
     _PASS_REGISTRY[name](program, **kw)
+    _verify_after(program, name)
     return program
 
 
@@ -58,6 +77,8 @@ class PassBuilder:
         return list(self._passes)
 
     def apply(self, program):
+        """Apply the pipeline; under FLAGS_verify_program each pass is
+        followed by a program verification (see `_verify_after`)."""
         for p in self._passes:
             apply_pass(program, p)
         return program
@@ -80,14 +101,22 @@ def remove_identity_ops(program, keep=()):
     output is kept, persistable, or read from another block (control-flow
     sub-blocks) are conservatively left in place."""
     keep = set(keep)
-    for block in program.blocks:
-        # reads of each var from OTHER blocks (sub-block capture)
-        outside_reads = set()
-        for other in program.blocks:
-            if other is block:
-                continue
-            for op in other.ops:
-                outside_reads.update(op.input_arg_names)
+    # one pre-pass over the whole program: per-block read sets + a global
+    # reader count per name, so "is this var read from ANOTHER block"
+    # (sub-block capture) is an O(1) lookup instead of an O(blocks^2)
+    # rescan of every other block's op list per block
+    block_reads = []
+    n_blocks_reading: Dict[str, int] = {}
+    for b in program.blocks:
+        reads = set()
+        for op in b.ops:
+            reads.update(op.input_arg_names)
+        block_reads.append(reads)
+        for n in reads:
+            n_blocks_reading[n] = n_blocks_reading.get(n, 0) + 1
+    for block, my_reads in zip(program.blocks, block_reads):
+        def read_outside(n):
+            return n_blocks_reading.get(n, 0) > (1 if n in my_reads else 0)
         # var -> index of its LAST write (one pass; keeps the hazard check
         # below O(1) per candidate instead of a tail rescan)
         last_write: Dict[str, int] = {}
@@ -107,7 +136,7 @@ def remove_identity_ops(program, keep=()):
             src = op.input_arg_names[0]
             dst = op.output_arg_names[0]
             dst_var = block._find_var_recursive(dst)
-            if (dst in keep or dst in outside_reads
+            if (dst in keep or read_outside(dst)
                     or (dst_var is not None and dst_var.persistable)):
                 kept.append(op)  # fetched / captured / state: not removable
                 continue

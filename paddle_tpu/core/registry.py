@@ -13,10 +13,16 @@ per-device kernel table and no grad makers:
 `lower(ctx, op, ins)` receives `ins` as {slot: [jax values]} and returns
 {slot: [jax values]}.  `ctx` is a LoweringContext (core/lowering.py) giving
 RNG keys, train/eval mode and mesh info.
+
+`infer(op, block)` is the compile-time InferShape role (reference
+shape_inference.h): validate input shapes/dtypes and declare outputs at
+`append_op` time.  Rules are registered next to the lowerings via
+`set_infer` / `core.analysis.register_rule`; `infer_and_check` classifies
+any failure as a `ShapeInferenceError` carrying op/var/block provenance.
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional
 
 OpLowerFn = Callable  # (ctx, op, ins) -> {slot: [values]}
 InferFn = Callable  # (op, block) -> None (sets output var shapes/dtypes)
@@ -36,20 +42,63 @@ def register_op(type: str, infer: Optional[InferFn] = None):
     """Decorator: @register_op("relu") def _relu(ctx, op, ins): ..."""
 
     def deco(fn: OpLowerFn):
-        _REGISTRY[type] = OpDef(type, fn, infer)
+        prev = _REGISTRY.get(type)
+        d = OpDef(type, fn, infer)
+        if infer is None and prev is not None and prev.infer is not None:
+            d.infer = prev.infer  # re-registration keeps an attached infer
+        _REGISTRY[type] = d
         return fn
 
     return deco
 
 
-def get_op_def(type: str) -> OpDef:
+def set_infer(type: str, infer: InferFn):
+    """Attach a build-time shape/dtype inference fn to a registered op."""
+    try:
+        _REGISTRY[type].infer = infer
+    except KeyError:
+        raise KeyError(
+            f"set_infer({type!r}): op has no registered lowering"
+        ) from None
+
+
+def suggest_ops(type: str, n: int = 3) -> List[str]:
+    """Nearest-matching registered op types for an unknown-op error."""
+    import difflib
+
+    return difflib.get_close_matches(type, sorted(_REGISTRY), n=n)
+
+
+def get_op_def(type: str, op=None, block=None) -> OpDef:
+    """Look up an op's definition.  On a miss, the error names the op's
+    block context (when given) and suggests nearest-matching registered
+    types instead of dumping the whole registry."""
     try:
         return _REGISTRY[type]
     except KeyError:
+        close = suggest_ops(type)
+        hint = (f"; did you mean: {', '.join(close)}?" if close
+                else "; see paddle_tpu.core.registry.registered_ops() for "
+                     "the full list")
+        where = ""
+        if block is not None:
+            idx = None
+            if op is not None:
+                try:
+                    idx = block.ops.index(op)
+                except ValueError:
+                    idx = None
+            where = (f" (block {block.idx}"
+                     + (f", op #{idx}" if idx is not None else "")
+                     + ")")
         raise NotImplementedError(
-            f"op {type!r} has no registered lowering; registered ops: "
-            f"{sorted(_REGISTRY)}"
+            f"op {type!r}{where} has no registered lowering{hint} "
+            f"({len(_REGISTRY)} ops registered)"
         ) from None
+
+
+def get_op_def_or_none(type: str) -> Optional[OpDef]:
+    return _REGISTRY.get(type)
 
 
 def has_op(type: str) -> bool:
@@ -64,8 +113,30 @@ def infer_and_check(op, block):
     """Run build-time shape/dtype inference if the op registered one.
 
     Mirrors the reference's compile-time InferShape (shape_inference.h); ops
-    the framework appends (feed/fetch/backward) are exempt.
-    """
+    the framework appends (feed/fetch/backward) are exempt.  Failures are
+    classified `ShapeInferenceError`s (core/analysis.py) so `append_op`
+    raises with op/var/block provenance instead of the program dying later
+    inside JAX tracing."""
     d = _REGISTRY.get(op.type)
-    if d is not None and d.infer is not None:
+    if d is None or d.infer is None:
+        return
+    from ..flags import flag as _flag
+
+    if _flag("FLAGS_verify_program") in ("", "off"):
+        return  # 'off' trusts the builder: the escape hatch for a program
+        # an (over-strict or wrong) infer rule would reject at build time
+    from ..monitor import MONITOR as _MON
+    from .analysis import ShapeInferenceError, StaticAnalysisError, _op_index
+
+    try:
         d.infer(op, block)
+        _MON.counter("analysis.infer_checks").inc()
+    except StaticAnalysisError:
+        _MON.counter("analysis.infer_failures").inc()
+        raise
+    except Exception as e:
+        _MON.counter("analysis.infer_failures").inc()
+        raise ShapeInferenceError(
+            f"shape/dtype inference crashed for op #{_op_index(block, op)} "
+            f"({op.type!r}) in block {block.idx}: {e!r}"
+        ) from e

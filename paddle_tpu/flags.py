@@ -114,6 +114,20 @@ DEFINE_int("FLAGS_data_corrupt_budget", 0,
            "(paddle_tpu/recordio.py; `data.corrupt_chunks` counts spends). "
            "0 (default) keeps strict behavior: the first corrupt chunk "
            "raises IOError immediately instead of being skipped")
+DEFINE_string("FLAGS_verify_program", "structural",
+              "static-analysis level applied to programs BEFORE lowering "
+              "(paddle_tpu/core/analysis.py): 'off' trusts the builder "
+              "(also disables append_op-time shape/dtype inference — the "
+              "escape hatch if an infer rule wrongly rejects a program), "
+              "'structural' (default) runs the program verifier "
+              "(def-before-use, dangling vars, unregistered ops, orphan "
+              "sub-blocks, duplicate parameter writes, feed/fetch targets) "
+              "on every executor compile-cache miss and after every "
+              "registered pass (PassBuilder/apply_pass), 'full' adds "
+              "whole-program shape/dtype re-inference and the hazard lints "
+              "(donation aliasing, recompile hazards, collective order, "
+              "RNG determinism).  Error-severity findings raise classified "
+              "ProgramVerificationError naming the op, var, and block")
 DEFINE_string("FLAGS_feed_validation", "shape",
               "feed-boundary validation level at DataLoader/DataFeeder "
               "(paddle_tpu/reader.py FeedSpec): 'off' trusts the caller, "

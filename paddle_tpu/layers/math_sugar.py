@@ -28,7 +28,12 @@ def binary(x, y, op_type: str):
     out_shape = x.shape
     if out_shape == (1,) and y.shape not in (None, (1,)):
         out_shape = y.shape
-    out = helper.create_variable_for_type_inference(x.dtype, shape=out_shape)
+    # compare/logical ops produce bool, whatever the operand dtype (found
+    # by the static verifier: a float-declared `equal` out is a builder bug)
+    from ..core.analysis import BOOL_OUT_OPS
+
+    out_dtype = "bool" if op_type in BOOL_OUT_OPS else x.dtype
+    out = helper.create_variable_for_type_inference(out_dtype, shape=out_shape)
     helper.append_op(
         op_type,
         inputs={"X": [x.name], "Y": [y.name]},

@@ -247,3 +247,38 @@ def _py_func(ctx, op, ins):
 
     outs = host_callback(ctx, host_fn, tuple(result_shape), *xs)
     return {"Out": list(outs)}
+
+
+# --- build-time shape/dtype inference --------------------------------------
+
+from ..core import analysis as _A
+
+
+def _infer_select_input(ctx):
+    out = None
+    for i in range(ctx.n_inputs("X")):
+        s = ctx.in_shape("X", i)
+        if s is None:
+            continue
+        if out is not None and _A.unify_shape(out, s) is None:
+            ctx.fail(f"select_input branches disagree on shape: "
+                     f"{tuple(out)} vs {tuple(s)}", var=ctx.op.input("X")[i])
+        out = s if out is None else _A.unify_shape(out, s)
+    ctx.set_out("Out", out, ctx.in_dtype("X"))
+
+
+_A.register_rule(["select_input"], _infer_select_input)
+
+
+def _infer_sub_block_op(ctx):
+    """while / conditional_block: validate the sub_block attr eagerly so a
+    broken builder fails at append time, not at lowering."""
+    sub = ctx.op.attrs.get("sub_block")
+    program = ctx.block.program
+    if sub is None or not isinstance(sub, int) \
+            or not (0 <= sub < len(program.blocks)) or sub == ctx.block.idx:
+        ctx.fail(f"sub_block attr {sub!r} does not name a valid other "
+                 f"block (program has {len(program.blocks)})")
+
+
+_A.register_rule(["while", "conditional_block"], _infer_sub_block_op)

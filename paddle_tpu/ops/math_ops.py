@@ -412,3 +412,99 @@ def _fill_zeros_like2(ctx, op, ins):
     dt = op.attr("dtype", None)
     dtype = x.dtype if dt in (None, -1) else canon_dtype(np_dtype(dt))
     return {"Out": jnp.zeros(x.shape, dtype)}
+
+
+# --- build-time shape/dtype inference --------------------------------------
+# (core/analysis.py rule factories; reference: each op's InferShape in its
+# .cc file.  Registered after the lowerings so set_infer always finds the
+# OpDef.)
+
+from ..core import analysis as _A
+
+_A.register_elementwise_infer(
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min",
+    "elementwise_pow", "elementwise_mod", "elementwise_floordiv", "minus")
+# (logical_xor lowers in ops/tail_ops.py, which imports after this module
+# at package init — its infer rule registers there, next to the lowering)
+_A.register_elementwise_infer(
+    *sorted(_A.BOOL_OUT_OPS - {"logical_xor"}), out_dtype="bool")
+_A.register_unary_infer("logical_not", out_dtype="bool")
+_A.register_unary_infer(
+    *_UNARY.keys(), "hard_shrink", "stanh", "leaky_relu", "elu",
+    "hard_sigmoid", "swish", "pow", "clip", "clip_by_norm", "softshrink")
+_A.register_reduce_infer("reduce_sum", "reduce_mean", "reduce_max",
+                         "reduce_min", "reduce_prod")
+
+
+def _infer_sum(ctx):
+    out = None
+    for i in range(ctx.n_inputs("X")):
+        s = ctx.in_shape("X", i)
+        if s is None:
+            continue
+        out = s if out is None else _A.fluid_broadcast(out, s, -1)
+        if out is None:
+            ctx.fail("summands have incompatible shapes",
+                     var=ctx.op.input("X")[i])
+    ctx.set_out("Out", out, ctx.in_dtype("X"))
+
+
+_A.register_rule(["sum"], _infer_sum)
+
+
+def _infer_mean(ctx):
+    ctx.set_out("Out", (1,), ctx.in_dtype("X"))
+
+
+_A.register_rule(["mean"], _infer_mean)
+
+
+def _infer_mul(ctx):
+    xs = ctx.in_shape("X")
+    ys = ctx.in_shape("Y")
+    if xs is None or ys is None:
+        return
+    xd = ctx.op.attr("x_num_col_dims", 1)
+    yd = ctx.op.attr("y_num_col_dims", 1)
+    if not (0 < xd <= len(xs) and 0 < yd < len(ys) + 1):
+        ctx.fail(f"num_col_dims ({xd},{yd}) out of range for X{tuple(xs)} "
+                 f"Y{tuple(ys)}")
+    inner_x = xs[xd:]
+    inner_y = ys[:yd]
+    if all(d != _A.DYN for d in inner_x) and all(d != _A.DYN for d in inner_y):
+        if int(np.prod(inner_x)) != int(np.prod(inner_y)):
+            ctx.fail(
+                f"flattened contraction dims do not match: "
+                f"X{tuple(xs)} cols {tuple(inner_x)} vs Y{tuple(ys)} rows "
+                f"{tuple(inner_y)}",
+                var=ctx.op.input("Y")[0])
+    ctx.set_out("Out", tuple(xs[:xd]) + tuple(ys[yd:]), ctx.in_dtype("X"))
+
+
+_A.register_rule(["mul"], _infer_mul)
+
+
+def _infer_matmul(ctx):
+    xs = ctx.in_shape("X")
+    ys = ctx.in_shape("Y")
+    if xs is None or ys is None or len(xs) < 2 or len(ys) < 2:
+        return
+    if ctx.op.attr("transpose_X", False):
+        xs = xs[:-2] + (xs[-1], xs[-2])
+    if ctx.op.attr("transpose_Y", False):
+        ys = ys[:-2] + (ys[-1], ys[-2])
+    if _A.unify_dim(xs[-1], ys[-2]) is None:
+        ctx.fail(f"contraction dims do not match: X[...,{xs[-1]}] vs "
+                 f"Y[{ys[-2]},...]", var=ctx.op.input("Y")[0])
+    bx, by = xs[:-2], ys[:-2]
+    if len(bx) < len(by):
+        bx, by = by, bx
+    batch = _A.fluid_broadcast(bx, by, -1) if by else tuple(bx)
+    if batch is None:
+        ctx.fail(f"batch dims do not broadcast: {tuple(xs[:-2])} vs "
+                 f"{tuple(ys[:-2])}")
+    ctx.set_out("Out", tuple(batch) + (xs[-2], ys[-1]), ctx.in_dtype("X"))
+
+
+_A.register_rule(["matmul"], _infer_matmul)

@@ -477,3 +477,16 @@ def _proximal_adagrad(ctx, op, ins):
     p_new = (jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
              / (1.0 + lr * l2))
     return {"ParamOut": p_new, "MomentOut": m_new}
+
+
+# --- build-time shape/dtype inference --------------------------------------
+# Every optimizer update writes `<Slot>Out` mirroring `<Slot>`'s
+# shape/dtype; Grad must match Param (reference: each optimizer op's
+# InferShape asserts exactly this before the kernel runs).
+
+from ..core import analysis as _A
+
+_A.register_state_update_infer(
+    "sgd", "momentum", "adam", "adagrad", "rmsprop", "adamax", "adadelta",
+    "lamb", "ftrl", "lars_momentum", "dpsgd", "proximal_gd",
+    "proximal_adagrad")

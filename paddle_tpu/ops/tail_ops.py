@@ -42,6 +42,11 @@ def _logical_xor(ctx, op, ins):
     return {"Out": jnp.logical_xor(first(ins, "X"), first(ins, "Y"))}
 
 
+from ..core import analysis as _A
+
+_A.register_elementwise_infer("logical_xor", out_dtype="bool")
+
+
 def _bool_reduce(fn):
     def lower(ctx, op, ins):
         x = first(ins, "X").astype(bool)

@@ -421,3 +421,192 @@ def _shard_index(ctx, op, ins):
     shard_size = (index_num + nshards - 1) // nshards
     in_shard = (x // shard_size) == shard_id
     return {"Out": jnp.where(in_shard, x % shard_size, ignore_value)}
+
+
+# --- build-time shape/dtype inference --------------------------------------
+
+from ..core import analysis as _A
+from ..core.dtypes import canonical_dtype as _canon
+
+
+_A.register_unary_infer("assign", "scale", "increment", "fill_zeros_like",
+                        "cumsum")
+
+
+def _infer_filled(ctx):
+    shape = ctx.op.attr("shape", None)
+    if not shape:
+        return
+    ctx.set_out("Out", tuple(shape), _canon(ctx.op.attr("dtype", "float32")))
+
+
+_A.register_rule(["fill_constant", "uniform_random", "gaussian_random",
+                  "truncated_gaussian_random"], _infer_filled)
+
+
+def _infer_cast(ctx):
+    dt = ctx.op.attr("out_dtype", ctx.op.attr("dtype", None))
+    ctx.set_out("Out", ctx.in_shape("X"), _canon(dt) if dt else None)
+
+
+_A.register_rule(["cast"], _infer_cast)
+
+
+def _infer_reshape(ctx):
+    xs = ctx.in_shape("X")
+    tgt = list(ctx.op.attr("shape", []))
+    if not tgt:
+        return
+    if tgt.count(-1) > 1:
+        ctx.fail(f"reshape target {tgt} has more than one -1")
+    if xs is None:
+        return
+    out = []
+    for i, s in enumerate(tgt):
+        if s == 0:
+            if i >= len(xs):
+                ctx.fail(f"reshape target {tgt} copies dim {i} (the 0 "
+                         f"entry) but X{tuple(xs)} has rank {len(xs)}")
+            out.append(xs[i])
+        else:
+            out.append(int(s))
+    x_known = all(d != _A.DYN for d in xs)
+    if x_known:
+        total = int(np.prod(xs)) if xs else 1
+        if -1 in out:
+            neg = out.index(-1)
+            rest = int(np.prod([d for j, d in enumerate(out) if j != neg]) or 1)
+            if rest <= 0 or total % rest != 0:
+                ctx.fail(f"cannot reshape X{tuple(xs)} ({total} elements) "
+                         f"into {tgt}")
+            out[neg] = total // rest
+        elif all(d != _A.DYN for d in out) and int(np.prod(out) if out else 1) != total:
+            ctx.fail(f"cannot reshape X{tuple(xs)} ({total} elements) into "
+                     f"{tgt} ({int(np.prod(out) if out else 1)} elements)")
+    ctx.set_out("Out", tuple(out), ctx.in_dtype("X"))
+    if ctx.op.output("XShape"):
+        ctx.set_out("XShape", (0,) + tuple(xs), ctx.in_dtype("X"))
+
+
+_A.register_rule(["reshape2", "reshape"], _infer_reshape)
+
+
+def _infer_transpose(ctx):
+    xs = ctx.in_shape("X")
+    axis = ctx.op.attr("axis")
+    if xs is None or axis is None:
+        return
+    if sorted(a % len(xs) for a in axis) != list(range(len(xs))):
+        ctx.fail(f"transpose axis {list(axis)} is not a permutation of "
+                 f"X{tuple(xs)}'s rank {len(xs)}")
+    ctx.set_out("Out", tuple(xs[a] for a in axis), ctx.in_dtype("X"))
+    if ctx.op.output("XShape"):
+        ctx.set_out("XShape", (0,) + tuple(xs), ctx.in_dtype("X"))
+
+
+_A.register_rule(["transpose2", "transpose"], _infer_transpose)
+
+
+def _infer_concat(ctx):
+    shapes = [ctx.in_shape("X", i) for i in range(ctx.n_inputs("X"))]
+    if any(s is None for s in shapes) or not shapes:
+        return
+    rank = len(shapes[0])
+    if any(len(s) != rank for s in shapes):
+        ctx.fail(f"concat inputs have mixed ranks: "
+                 f"{[tuple(s) for s in shapes]}")
+    axis = ctx.op.attr("axis", 0) % rank
+    out = list(shapes[0])
+    for i, s in enumerate(shapes[1:], start=1):
+        for d in range(rank):
+            if d == axis:
+                continue
+            u = _A.unify_dim(out[d], s[d])
+            if u is None:
+                ctx.fail(f"concat input {i} shape {tuple(s)} mismatches "
+                         f"{tuple(out)} outside axis {axis}",
+                         var=ctx.op.input("X")[i])
+            out[d] = u
+    cat = 0
+    for s in shapes:
+        if s[axis] == _A.DYN:
+            cat = _A.DYN
+            break
+        cat += s[axis]
+    out[axis] = cat
+    ctx.set_out("Out", tuple(out), ctx.in_dtype("X"))
+
+
+_A.register_rule(["concat"], _infer_concat)
+
+
+def _infer_split(ctx):
+    xs = ctx.in_shape("X")
+    if xs is None:
+        return
+    axis = ctx.op.attr("axis", 0) % len(xs)
+    num = ctx.op.attr("num", 0)
+    sections = ctx.op.attr("sections", [])
+    names = ctx.op.output("Out")
+    for i in range(len(names)):
+        out = list(xs)
+        if num:
+            if xs[axis] == _A.DYN:
+                out[axis] = _A.DYN
+            elif xs[axis] % num:
+                ctx.fail(f"split axis dim {xs[axis]} not divisible by "
+                         f"num={num}")
+            else:
+                out[axis] = xs[axis] // num
+        elif sections:
+            if i < len(sections):
+                out[axis] = sections[i]
+        ctx.set_out("Out", tuple(out), ctx.in_dtype("X"), i=i)
+
+
+_A.register_rule(["split"], _infer_split)
+
+
+def _infer_one_hot(ctx):
+    xs = ctx.in_shape("X")
+    depth = ctx.op.attr("depth")
+    if xs is None or depth is None:
+        return
+    base = tuple(xs[:-1]) if (xs and xs[-1] == 1) else tuple(xs)
+    ctx.set_out("Out", base + (int(depth),), "float32")
+
+
+_A.register_rule(["one_hot"], _infer_one_hot)
+
+
+def _infer_stack(ctx):
+    shapes = [ctx.in_shape("X", i) for i in range(ctx.n_inputs("X"))]
+    if any(s is None for s in shapes) or not shapes:
+        return
+    base = shapes[0]
+    for s in shapes[1:]:
+        u = _A.unify_shape(base, s)
+        if u is None:
+            ctx.fail(f"stack inputs have mismatched shapes: "
+                     f"{[tuple(s) for s in shapes]}")
+        base = u
+    axis = ctx.op.attr("axis", 0) % (len(base) + 1)
+    out = tuple(base[:axis]) + (len(shapes),) + tuple(base[axis:])
+    ctx.set_out("Y", out, ctx.in_dtype("X"))
+
+
+_A.register_rule(["stack"], _infer_stack)
+
+
+def _infer_gather(ctx):
+    xs = ctx.in_shape("X")
+    idx = ctx.in_shape("Index")
+    if xs is None or idx is None:
+        return
+    n = _A.DYN
+    if all(d != _A.DYN for d in idx):
+        n = int(np.prod(idx)) if idx else 1
+    ctx.set_out("Out", (n,) + tuple(xs[1:]), ctx.in_dtype("X"))
+
+
+_A.register_rule(["gather"], _infer_gather)

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..core.jax_compat import shard_map
+
 
 def _lookup_local(ids, table_local, axis_name: str):
     n = jax.lax.psum(1, axis_name)
@@ -40,7 +42,7 @@ def sharded_lookup(ids, table, mesh: Mesh, axis_name: str = "ep"):
     """ids: int (...,) replicated; table: (V, D) row-sharded over axis_name.
     Returns (..., D) replicated embeddings."""
     fn = functools.partial(_lookup_local, axis_name=axis_name)
-    shard = jax.shard_map(
+    shard = shard_map(
         fn,
         mesh=mesh,
         in_specs=(P(), P(axis_name, None)),

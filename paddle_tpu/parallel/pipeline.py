@@ -20,12 +20,18 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..core.jax_compat import shard_map
 
-def _pipeline_local(params, xs, fn: Callable, axis_name: str):
+
+def _pipeline_local(params, xs, stage_id, fn: Callable, axis_name: str, S: int):
     """Per-device body: params = this stage's params (leading axis 1),
-    xs = all microbatches (M, mb, ...) — only stage 0 reads them."""
-    S = jax.lax.psum(1, axis_name)
-    idx = jax.lax.axis_index(axis_name)
+    xs = all microbatches (M, mb, ...) — only stage 0 reads them.
+
+    The stage index arrives as a P(axis_name)-sharded iota INPUT rather
+    than `jax.lax.axis_index`: under a partially-manual shard_map (extra
+    mesh axes left to GSPMD, e.g. pp inside a dp×pp×mp step) axis_index
+    lowers to a PartitionId instruction SPMD partitioning rejects."""
+    idx = stage_id[0]
     params = jax.tree.map(lambda p: p[0], params)  # drop stage axis
     M = xs.shape[0]
     T = M + S - 1
@@ -41,23 +47,23 @@ def _pipeline_local(params, xs, fn: Callable, axis_name: str):
         x = jnp.where(idx == 0, x0, carry_in)
         y = fn(params, x)
         # last stage records microbatch (t - S + 1) once it's valid
+        # (a where-select, not lax.cond: replication checking cannot unify
+        # cond branches whose rep types differ, and the update is cheap)
         out_slot = t - (S - 1)
         valid = jnp.logical_and(idx == S - 1, out_slot >= 0)
-        ys = jax.lax.cond(
-            valid,
-            lambda ys: jax.lax.dynamic_update_index_in_dim(
-                ys, y, jnp.maximum(out_slot, 0), 0
-            ),
-            lambda ys: ys,
-            ys,
-        )
+        upd = jax.lax.dynamic_update_index_in_dim(
+            ys, y, jnp.maximum(out_slot, 0), 0)
+        ys = jnp.where(valid, upd, ys)
         nxt = jax.lax.ppermute(y, axis_name, perm)
         return nxt, ys
 
     _, ys = jax.lax.fori_loop(0, T, body, (jnp.zeros(mb_shape, xs.dtype), ys))
-    # only the last stage's ys is meaningful; broadcast it to the ring
-    ys_all = jax.lax.all_gather(ys, axis_name)  # (S, M, ...)
-    return ys_all[S - 1]
+    # only the last stage's ys is meaningful; a masked psum broadcasts it
+    # to the ring AND is provably replicated over axis_name, which lets
+    # replication checking (jax_compat legacy path) verify out_specs=P()
+    # where all_gather-then-index defeated the inference
+    return jax.lax.psum(
+        jnp.where(idx == S - 1, ys, jnp.zeros_like(ys)), axis_name)
 
 
 def gpipe(
@@ -77,11 +83,11 @@ def gpipe(
     """
     S = mesh.shape[axis_name]
     param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
-    shard = jax.shard_map(
-        functools.partial(_pipeline_local, fn=fn, axis_name=axis_name),
+    shard = shard_map(
+        functools.partial(_pipeline_local, fn=fn, axis_name=axis_name, S=S),
         mesh=mesh,
-        in_specs=(param_specs, P()),
+        in_specs=(param_specs, P(), P(axis_name)),
         out_specs=P(),
         check_vma=False,
     )
-    return shard(stacked_params, microbatches)
+    return shard(stacked_params, microbatches, jnp.arange(S, dtype=jnp.int32))

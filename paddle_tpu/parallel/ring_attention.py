@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..core.jax_compat import shard_map
+
 _NEG = -1e9
 
 
@@ -107,7 +109,7 @@ def ring_attention(
     b_ax = batch_axis if (batch_axis and batch_axis in mesh.shape) else None
     spec = P(b_ax, None, axis_name, None)
     fn = functools.partial(_ring_attention_local, axis_name=axis_name, causal=causal)
-    shard = jax.shard_map(
+    shard = shard_map(
         lambda q_, k_, v_: fn(q_, k_, v_),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -938,7 +938,12 @@ def test_tree_conv_golden_and_training():
     exe = fluid.Executor(fluid.CPUPlace())
     scope = fluid.Scope()
     exe.run(startup, scope=scope)
-    w = np.asarray(scope.find_var("tc_w"))
+    # owned copy, NOT np.asarray: that can be a zero-copy VIEW of the CPU
+    # device buffer, which the next run DONATES — the SGD update then
+    # rewrites the "snapshot" in place and the golden silently compares
+    # against post-step weights (the donation-aliasing hazard class
+    # core/analysis.py lint_donation documents)
+    w = np.array(scope.find_var("tc_w"), copy=True)
     (got,) = exe.run(main, feed={"n": nodes, "e": edges}, fetch_list=[out],
                      scope=scope)
     expect = _np_tree_conv(nodes[0], edges[0], w, 2)

@@ -118,3 +118,63 @@ def test_prune_requires_targets():
         fluid.layers.scale(x, scale=2.0)
     with pytest.raises(ValueError, match="targets"):
         passes.apply_pass(main, "prune_dead_ops")
+
+
+# --- pass safety (ISSUE 6): verifier-clean before/after each pass ----------
+
+def _zoo_mains():
+    from paddle_tpu.models import deepfm, resnet, transformer
+
+    r_main, _, _, r_f = resnet.build(depth=50, class_dim=10,
+                                     image_shape=(3, 32, 32))
+    b_main, _, _, b_f = transformer.build_bert(vocab_size=200, seq_len=16,
+                                               d_model=32, n_layers=1,
+                                               n_heads=2, d_ff=64)
+    d_main, _, _, d_f = deepfm.build()
+    return [("resnet", r_main, r_f["loss"].name),
+            ("bert", b_main, b_f["loss"].name),
+            ("deepfm", d_main, d_f["loss"].name)]
+
+
+def _errors(program):
+    from paddle_tpu.core import analysis
+
+    return [d for d in analysis.verify_program(program, level="full")
+            if d.severity == "error"]
+
+
+def test_registered_passes_keep_zoo_programs_verifier_clean():
+    """Golden pass-safety matrix: every registered pass applied to every
+    model-zoo program leaves it verifier-clean at level=full (the
+    PassBuilder harness also checks this live via FLAGS_verify_program)."""
+    for name, main, loss in _zoo_mains():
+        assert not _errors(main), f"{name}: dirty before any pass"
+        for pass_name in ("remove_identity_ops", "fold_scale_chains"):
+            passes.apply_pass(main, pass_name)
+            assert not _errors(main), f"{name}: dirty after {pass_name}"
+        passes.apply_pass(main, "prune_dead_ops", targets=[loss])
+        assert not _errors(main), f"{name}: dirty after prune_dead_ops"
+
+
+def test_pass_builder_verifies_under_flag():
+    """A pass that corrupts the program raises PassVerificationError from
+    PassBuilder.apply when FLAGS_verify_program is on (default)."""
+    import pytest
+
+    from paddle_tpu.core import analysis
+
+    @passes.register_pass("_test_clobber_input")
+    def _clobber(program):
+        program.global_block().ops[-1].inputs["X"] = ["never_defined"]
+        program._bump()
+
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [4], dtype="float32")
+            fluid.layers.scale(x, scale=2.0)
+        with pytest.raises(analysis.PassVerificationError,
+                           match="_test_clobber_input"):
+            passes.PassBuilder(["_test_clobber_input"]).apply(main)
+    finally:
+        passes._PASS_REGISTRY.pop("_test_clobber_input", None)

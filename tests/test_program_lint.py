@@ -1,0 +1,43 @@
+"""tools/program_lint.py: the static-analysis CI gate over the model zoo
+(tier-1 wiring for ISSUE 6 satellite: lint --check + coverage-floor gate)."""
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _run(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "program_lint.py"), *args],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+
+
+def test_lint_check_zoo_is_clean_and_covered():
+    r = _run("--check")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "CHECK OK" in r.stdout
+    assert "coverage" in r.stdout
+
+
+def test_lint_coverage_gate_trips_when_floor_unreachable():
+    # the ratchet works: an impossible floor must fail the gate
+    r = _run("--check", "--min-coverage", "1.01")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "infer_coverage_frac" in r.stdout
+
+
+def test_lint_renders_serialized_programs(tmp_path):
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        fluid.layers.relu(x)
+    p = tmp_path / "prog.json"
+    p.write_text(main.to_string())
+    r = _run(str(p))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "coverage" in r.stdout

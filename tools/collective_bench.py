@@ -52,6 +52,8 @@ def bench_collective(kind, size_mb, mesh, iters=4, chain=8, dtype="float32"):
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from paddle_tpu.core.jax_compat import shard_map as _shard_map
+
     n = mesh.devices.size
     elems = int(size_mb * 1e6) // np.dtype(dtype).itemsize
     elems -= elems % n  # reduce_scatter needs n | elems
@@ -72,8 +74,8 @@ def bench_collective(kind, size_mb, mesh, iters=4, chain=8, dtype="float32"):
         raise ValueError(kind)
 
     @jax.jit
-    @lambda f: jax.shard_map(f, mesh=mesh, in_specs=P("x", None),
-                             out_specs=P("x", None))
+    @lambda f: _shard_map(f, mesh=mesh, in_specs=P("x", None),
+                          out_specs=P("x", None))
     def step(v):
         row = v[0]
         for _ in range(chain):

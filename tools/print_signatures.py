@@ -32,6 +32,7 @@ MODULES = [
     "paddle_tpu.errors",
     "paddle_tpu.faults",
     "paddle_tpu.resilience",
+    "paddle_tpu.core.analysis",
 ]
 
 

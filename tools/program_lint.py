@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Render / CI-gate static analysis over Program IR (paddle_tpu/core/analysis.py).
+
+    python tools/program_lint.py
+        Build the model-zoo programs (ResNet-50, BERT, DeepFM: main +
+        startup each) and render every diagnostic the analysis suite
+        produces at --level (default full), plus the shape/dtype inference
+        coverage table (`analysis.infer_coverage_frac`).
+
+    python tools/program_lint.py prog.json [prog2.json ...]
+        Same, over serialized programs (Program.to_string() output) —
+        lint a saved inference model's program without building it.
+
+    python tools/program_lint.py --check [--min-coverage 0.8]
+        CI gate (same shape as perf_report --check): exit 1 if any
+        error-severity diagnostic is found OR the zoo's op-type inference
+        coverage drops below the floor.  Wired into the tier-1 flow via
+        tests/test_program_lint.py, so a new op landing in the zoo without
+        an infer rule fails CI instead of silently shrinking the verified
+        surface.
+
+    python tools/program_lint.py --level structural
+        Verifier-only (def-before-use, dangling vars, unregistered ops,
+        orphan sub-blocks, duplicate param writes); skips shape
+        re-inference and the hazard lints.
+
+Exit codes: 0 clean (warnings allowed), 1 errors or coverage below floor.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The landed floor for model-zoo op-type inference coverage.  Raise it when
+# coverage improves; never lower it (the ratchet that keeps the verified
+# surface from eroding).
+COVERAGE_FLOOR = 0.8
+
+
+def _fmt_table(rows, headers):
+    widths = [max(len(str(r[i])) for r in rows + [headers])
+              for i in range(len(headers))]
+    out = ["  ".join(str(h).ljust(w) for h, w in zip(headers, widths))]
+    for r in rows:
+        out.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+def zoo_programs():
+    """The model-zoo programs the acceptance coverage is measured over."""
+    from paddle_tpu.models import deepfm, resnet, transformer
+
+    out = []
+    m, s, _, _ = resnet.build(depth=50, class_dim=100, image_shape=(3, 32, 32))
+    out += [("resnet50/main", m), ("resnet50/startup", s)]
+    m, s, _, _ = transformer.build_bert(vocab_size=1000, seq_len=32,
+                                        d_model=64, n_layers=2, n_heads=4,
+                                        d_ff=128)
+    out += [("bert/main", m), ("bert/startup", s)]
+    m, s, _, _ = deepfm.build()
+    out += [("deepfm/main", m), ("deepfm/startup", s)]
+    return out
+
+
+def load_programs(paths):
+    from paddle_tpu.core.program import Program
+
+    out = []
+    for p in paths:
+        with open(p) as f:
+            out.append((os.path.basename(p), Program.parse_from_string(f.read())))
+    return out
+
+
+def lint(named_programs, level="full"):
+    """Run the analysis suite; returns (diag rows, coverage dict, n_errors)."""
+    from paddle_tpu.core import analysis
+
+    rows = []
+    n_errors = 0
+    for name, prog in named_programs:
+        for d in analysis.verify_program(prog, level=level):
+            if d.severity == analysis.SEV_ERROR:
+                n_errors += 1
+            rows.append((name, d.severity, d.code, d.block,
+                         "-" if d.op_idx is None else d.op_idx,
+                         d.op_type or "-", d.var or "-", d.message))
+    cov = analysis.infer_coverage([p for _, p in named_programs])
+    return rows, cov, n_errors
+
+
+def render(named_programs, level="full"):
+    from paddle_tpu.monitor import MONITOR
+
+    rows, cov, n_errors = lint(named_programs, level)
+    parts = [f"# program lint  level={level}  programs={len(named_programs)}"]
+    if rows:
+        parts.append("\n## diagnostics\n" + _fmt_table(
+            [r[:7] for r in rows],
+            ["program", "severity", "code", "block", "op", "type", "var"]))
+        parts.append("\n## messages")
+        for r in rows:
+            parts.append(f"- {r[0]}: [{r[1]}:{r[2]}] {r[7]}")
+    else:
+        parts.append("\nno diagnostics")
+    parts.append(
+        f"\n## shape/dtype inference coverage\n"
+        f"op types covered: {len(cov['covered_types'])} / "
+        f"{len(cov['covered_types']) + len(cov['missing_types'])} "
+        f"(frac {cov['frac']:.3f}; per-op {cov['op_frac']:.3f})")
+    if cov["missing_types"]:
+        parts.append("missing infer rules: " + ", ".join(cov["missing_types"]))
+    MONITOR.gauge("analysis.infer_coverage_frac").set(cov["frac"])
+    return "\n".join(parts), cov, n_errors
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("programs", nargs="*",
+                    help="serialized Program JSON files (default: build the "
+                         "model zoo)")
+    ap.add_argument("--check", action="store_true",
+                    help="CI gate: exit 1 on error diagnostics or coverage "
+                         "below the floor")
+    ap.add_argument("--level", default="full",
+                    choices=["structural", "full"])
+    ap.add_argument("--min-coverage", type=float, default=COVERAGE_FLOOR,
+                    help=f"coverage floor for --check (default "
+                         f"{COVERAGE_FLOOR})")
+    args = ap.parse_args(argv)
+
+    named = (load_programs(args.programs) if args.programs else zoo_programs())
+    text, cov, n_errors = render(named, args.level)
+    print(text)
+
+    if args.check:
+        failed = False
+        if n_errors:
+            print(f"\nCHECK FAILED: {n_errors} error-severity diagnostic(s)")
+            failed = True
+        if cov["frac"] < args.min_coverage:
+            print(f"\nCHECK FAILED: analysis.infer_coverage_frac "
+                  f"{cov['frac']:.3f} < floor {args.min_coverage}")
+            failed = True
+        if failed:
+            return 1
+        print(f"\nCHECK OK: 0 errors, coverage {cov['frac']:.3f} >= "
+              f"{args.min_coverage}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
