@@ -29,6 +29,9 @@ import numpy as np
 
 from tools.bench_kit import (make_bert_dispatch, make_resnet_dispatch,
                              spread_pct as _spread, timed_steps as _timed_steps)
+# ONE spread ceiling, shared with the --check-bench gate: ratcheting it in
+# perf_report ratchets the warm-until-stable target here in lockstep
+from tools.perf_report import MAX_SPREAD_PCT
 
 ROUND1_IMGS_PER_SEC = 2295.0  # BENCH_r01.json
 V5E_BF16_PEAK = 197e12
@@ -39,27 +42,66 @@ def _params_moved(dispatch, before, max_frozen_frac=0.25):
     two rounds of plausible-looking BERT numbers with ~96% of params frozen
     while the f32 embeddings moved — loss finiteness cannot catch that).
 
-    A bounded frozen fraction is tolerated: in bf16 at symmetric init the
-    attention q/k score grads (p*(dp - rowsum)) cancel below bf16
-    resolution on real TPU hardware, so q/k legitimately sit still for the
-    first steps (~9% of BERT's params; they move once the value path
-    differentiates — measured r5, docs/perf_r05.md).  Returns
-    {"frozen": n, "total": n, "min_moved_delta": d} for the record."""
+    ISSUE-7 resolution of BENCH_r05's "18/198 BERT params frozen": the
+    donation audit (tools/donation_audit.py) proves every zoo param is
+    donated and updated in place, so a zero param delta with a LIVE
+    first-order moment means the optimizer ran and the update rounded away
+    below the param dtype's resolution — exactly the bf16 q/k stall at
+    symmetric init (score grads cancel below bf16 ulp for the first steps;
+    measured r5, docs/perf_r05.md).  Those now count as `subresolution`,
+    not `frozen`; a param whose MOMENT is also dead is a genuinely dropped
+    update, and any such param fails the bench outright
+    (tests/test_donation_audit.py pins both classes).
+
+    Known ambiguity, strict on purpose: a param whose gradient is EXACTLY
+    zero for the whole window (dead ReLU unit) also shows a dead moment and
+    trips the hard fail.  After the r5 silent-freeze history we prefer the
+    loud false positive: if tools/donation_audit.py --check is green, the
+    param is a genuinely zero-gradient unit — re-bench with a different
+    seed/batch rather than raising the tolerance here."""
     after = dispatch.probe_param()
-    frozen = []
+    moments = (dispatch.probe_moments()
+               if hasattr(dispatch, "probe_moments") else {})
+    frozen, subres = [], []
     min_moved = float("inf")
     for name, b in before.items():
         d = float(np.abs(after[name] - b).max())
         if d == 0.0:
-            frozen.append(name)
+            m = moments.get(name)
+            if m is None:
+                # no first-order accumulator to consult (SGD-class
+                # optimizers keep none): a zero delta here is
+                # indistinguishable from a legitimately-zero gradient, so
+                # it counts against the bounded budget, not the hard fail
+                subres.append(name)
+            elif float(np.abs(m).max()) > 0.0:
+                subres.append(name)  # optimizer live, update < dtype ulp
+            else:
+                frozen.append(name)
         else:
             min_moved = min(min_moved, d)
-    assert len(frozen) <= max_frozen_frac * len(before), (
-        f"{len(frozen)}/{len(before)} params did not move during the bench "
-        f"(optimizer-freeze class bug): {sorted(frozen)[:5]}")
+    assert not frozen, (
+        f"{len(frozen)}/{len(before)} params have DEAD optimizer state "
+        f"(dropped-update class bug — see tools/donation_audit.py): "
+        f"{sorted(frozen)[:5]}")
+    assert len(subres) <= max_frozen_frac * len(before), (
+        f"{len(subres)}/{len(before)} params sat below update resolution "
+        f"(or have no optimizer accumulator to consult) during the bench "
+        f"window: {sorted(subres)[:5]}")
     assert min_moved < float("inf"), "no param moved at all"
-    return {"frozen": len(frozen), "total": len(before),
-            "min_moved_delta": min_moved}
+    return {"frozen": len(frozen), "subresolution": len(subres),
+            "total": len(before), "min_moved_delta": min_moved}
+
+
+def _gang_results(res):
+    """Every RESULT-line JSON record printed by a gang's workers (the
+    worker output protocol shared by the overlap and chaos A/Bs)."""
+    recs = []
+    for code, out, err in res.workers:
+        for line in (out or "").splitlines():
+            if line.startswith("RESULT "):
+                recs.append(json.loads(line[len("RESULT "):]))
+    return recs
 
 
 def bench_resnet50(batch_size=128, K=16, iters=4):
@@ -69,7 +111,8 @@ def bench_resnet50(batch_size=128, K=16, iters=4):
     # cache/VMEM behavior wins (docs/perf_r05.md)
     dispatch, _ = make_resnet_dispatch(batch_size=batch_size, K=K)
     before = dispatch.probe_param()
-    dt, out, ws = _timed_steps(dispatch, K=K, iters=iters, windows=3)
+    dt, out, ws = _timed_steps(dispatch, K=K, iters=iters, windows=3,
+                               spread_target=MAX_SPREAD_PCT)
     lossN = float(np.asarray(out[0]).reshape(-1)[-1])
     assert np.isfinite(lossN), f"non-finite resnet loss {lossN}"
     moved = _params_moved(dispatch, before)
@@ -139,7 +182,8 @@ def bench_mnist(batch_size=128, steps=40, K=20, iters=3):
         return exe.run(main, feed=feed, fetch_list=[loss_name], scope=scope,
                        steps=K, return_numpy=False)
 
-    dt, out, ws = _timed_steps(dispatch, K=K, iters=iters, windows=3)
+    dt, out, ws = _timed_steps(dispatch, K=K, iters=iters, windows=3,
+                               spread_target=MAX_SPREAD_PCT)
     imgs_per_sec = batch_size / dt
     print(f"mnist: parity={parity} converged={converged} "
           f"loss {tpu_losses[0]:.3f}->{tpu_losses[-1]:.3f}  "
@@ -164,7 +208,13 @@ def bench_nmt(K=8, iters=3, b=32):
 
     dispatch, _, mean_tokens = make_nmt_dispatch(K=K, b=b)
     before = dispatch.probe_param()
-    dt, out, ws = _timed_steps(dispatch, K=K, iters=iters, windows=3)
+    # warmup-until-stable windowing (ISSUE 7): BENCH_r05's 26.3% NMT spread
+    # was the first window still carrying warm-in (30.3 -> 22.8 ms); windows
+    # now extend until the trailing 3 agree to 5%, so kernel A/Bs on this
+    # config compare steady state against steady state.  spread_ok is the
+    # self-check the record carries (and perf_report's bench gate can read).
+    dt, out, ws = _timed_steps(dispatch, K=K, iters=iters, windows=3,
+                               spread_target=MAX_SPREAD_PCT)
     lv = float(np.asarray(out[0]).reshape(-1)[-1])
     assert np.isfinite(lv)
     moved = _params_moved(dispatch, before)
@@ -175,13 +225,15 @@ def bench_nmt(K=8, iters=3, b=32):
             "value": round(seqs, 2), "unit": "seqs/sec", "batch_size": b,
             "config": "base-6L-512d ragged", "tokens_per_sec": round(toks, 1),
             "params_moved": moved,
-            "steps_per_dispatch": K, "windows_ms": ws, "spread_pct": _spread(ws)}
+            "steps_per_dispatch": K, "windows_ms": ws,
+            "spread_pct": _spread(ws), "spread_ok": _spread(ws) <= MAX_SPREAD_PCT}
 
 
 def bench_bert(batch_size=256, seq_len=128, K=2, iters=4):
     dispatch, _ = make_bert_dispatch(batch_size=batch_size, seq_len=seq_len, K=K)
     before = dispatch.probe_param()
-    dt, out, ws = _timed_steps(dispatch, K=K, iters=iters, windows=2)
+    dt, out, ws = _timed_steps(dispatch, K=K, iters=iters, windows=2,
+                               spread_target=MAX_SPREAD_PCT)
     lossN = float(np.asarray(out[0]).reshape(-1)[-1])
     assert np.isfinite(lossN)
     moved = _params_moved(dispatch, before)
@@ -234,7 +286,8 @@ def bench_deepfm(batch_size=4096, K=16, iters=3):
     attach_param_probe(dispatch, main, scope)
     dispatch()  # compile before the probe so 'before' is post-init state
     before = dispatch.probe_param()
-    dt, out, ws = _timed_steps(dispatch, K=K, iters=iters, windows=3)
+    dt, out, ws = _timed_steps(dispatch, K=K, iters=iters, windows=3,
+                               spread_target=MAX_SPREAD_PCT)
     lossN = float(np.asarray(out[0]).reshape(-1)[-1])
     assert np.isfinite(lossN)
     moved = _params_moved(dispatch, before)
@@ -511,6 +564,72 @@ def bench_chaos_data(fault_spec="corrupt_chunk@2", steps=32, batch_size=64,
             "batch_size": batch_size, "chunk_records": chunk_records}
 
 
+def bench_overlap(steps=16, n_procs=2, bucket_mb=4.0, batch_size=256,
+                  width=1024, depth=4):
+    """2-process backward-overlapped gradient all-reduce A/B (ISSUE 7):
+    the same seeded MLP trained through real multi-process gangs
+    (paddle_tpu.launch.run_gang) under three grad-sync arms —
+
+      serial    one flat all-reduce after the whole backward (the
+                fetch-barrier baseline)
+      bucketed  size-capped buckets issued as grads become ready,
+                reverse-topological order (CompiledProgram.
+                with_grad_overlap; FLAGS_dp_bucket_mb-shaped)
+      gspmd     the pre-ISSUE-7 GSPMD-derived collectives, for reference
+
+    Reports each arm's gang rate plus the acceptance checks: the bucketed
+    arm must beat the serial baseline and the two must end bit-identical
+    (bucketing never changes what each grad element is summed with).  The
+    micro-version of this A/B (no process overhead, production bucketing
+    code) is tools/collective_bench.py --overlap."""
+    import os
+
+    from paddle_tpu.launch import run_gang
+
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tests", "dist_worker_overlap.py")
+
+    def one(mode):
+        res = run_gang(
+            [sys.executable, worker], n_procs,
+            extra_env={"GRAD_SYNC_MODE": mode, "RUN_STEPS": str(steps),
+                       "BUCKET_MB": str(bucket_mb),
+                       "BATCH_SIZE": str(batch_size),
+                       "MODEL_WIDTH": str(width),
+                       "MODEL_DEPTH": str(depth)},
+            max_restarts=0, timeout=540)
+        assert res.ok, f"{mode} overlap gang failed: {res.workers}"
+        recs = _gang_results(res)
+        assert len(recs) == n_procs, f"{mode}: got {len(recs)} RESULT lines"
+        shas = {r["params_sha"] for r in recs}
+        assert len(shas) == 1, f"{mode}: ranks diverged: {shas}"
+        # gang rate: the slowest worker's window is the gang's window
+        wall = max(r["wall_s"] for r in recs)
+        return {"steps_per_sec": round(steps / wall, 3),
+                "wall_s": round(wall, 4), "params_sha": shas.pop(),
+                "last_loss": recs[0]["last_loss"]}
+
+    arms = {m: one(m) for m in ("serial", "bucketed", "gspmd")}
+    parity = arms["serial"]["params_sha"] == arms["bucketed"]["params_sha"]
+    speedup = (arms["bucketed"]["steps_per_sec"]
+               / arms["serial"]["steps_per_sec"])
+    print(f"overlap: serial {arms['serial']['steps_per_sec']:.2f} steps/s, "
+          f"bucketed {arms['bucketed']['steps_per_sec']:.2f} steps/s "
+          f"(x{speedup:.3f}), gspmd {arms['gspmd']['steps_per_sec']:.2f} "
+          f"steps/s, bit-parity={parity}", file=sys.stderr)
+    return {"metric": "dp_grad_overlap_ab_steps_per_sec",
+            "value": arms["bucketed"]["steps_per_sec"], "unit": "steps/sec",
+            "serial_steps_per_sec": arms["serial"]["steps_per_sec"],
+            "bucketed_steps_per_sec": arms["bucketed"]["steps_per_sec"],
+            "gspmd_steps_per_sec": arms["gspmd"]["steps_per_sec"],
+            "speedup_vs_serial": round(speedup, 4),
+            "overlap_confirmed": bool(speedup > 1.0),
+            "bit_parity_serial_vs_bucketed": bool(parity),
+            "last_loss": arms["bucketed"]["last_loss"],
+            "n_procs": n_procs, "steps": steps, "bucket_mb": bucket_mb,
+            "batch_size": batch_size}
+
+
 def bench_chaos_dist(fault_spec, steps=12, n_procs=2, save_every=3,
                      max_restarts=2):
     """Multi-worker chaos benchmark: the same 2-worker sync-SGD gang run
@@ -543,11 +662,7 @@ def bench_chaos_dist(fault_spec, steps=12, n_procs=2, save_every=3,
                        checkpoint_root=root, extra_env=e,
                        max_restarts=restarts, timeout=540)
         wall = _time.perf_counter() - t0
-        shas = []
-        for code, out, err in res.workers:
-            for line in (out or "").splitlines():
-                if line.startswith("RESULT "):
-                    shas.append(json.loads(line[len("RESULT "):])["params_sha"])
+        shas = [r["params_sha"] for r in _gang_results(res)]
         return res, wall, shas
 
     clean_res, clean_wall, clean_shas = one(None, 0)
@@ -579,6 +694,13 @@ _DATA_FAULT_KINDS = ("corrupt_chunk", "truncated_file")
 
 
 def main():
+    # The MFU campaign's kernels are opt-in (FLAGS_use_pallas); the bench
+    # round measures them by default — platform-gated, so this is a no-op
+    # off-TPU, and `--no-pallas` A/Bs the composite baseline.
+    if "--no-pallas" not in sys.argv:
+        import paddle_tpu as fluid
+
+        fluid.set_flags({"FLAGS_use_pallas": True})
     per_model = "--per-model" in sys.argv
     fault_spec = None
     for i, a in enumerate(sys.argv):
@@ -588,6 +710,9 @@ def main():
             fault_spec = a.split("=", 1)[1]
     if "--pipeline" in sys.argv:
         print(json.dumps(bench_pipeline()))
+        return
+    if "--overlap" in sys.argv:
+        print(json.dumps(bench_overlap()))
         return
     if "--chaos" in sys.argv:
         # distributed entries route to the multi-worker gang bench, data
@@ -645,6 +770,9 @@ def main():
             "windows_ms": flag.get("windows_ms"),
             "batch_size": flag.get("batch_size"),
             "steps_per_dispatch": flag.get("steps_per_dispatch"),
+            # params_moved must ride the wrapper or check_bench's
+            # dead-optimizer-state gate can never fire for the flagship
+            "params_moved": flag.get("params_moved"),
             "vs_baseline_is": "this_round_imgs_per_sec / round1_imgs_per_sec",
             "models": {k: v for k, v in results.items() if k != "resnet50"},
         },

@@ -108,13 +108,15 @@ def _lowering_flags():
     """Process-global lowering options that change generated code; they must
     participate in the compile-cache key or toggling them would silently
     reuse stale executables."""
+    from ..flags import flag as _flagv
     from ..ops import nn_ops
 
     return ("nhwc", nn_ops._NHWC_LOWERING, "bn1p", nn_ops._BN_SINGLE_PASS,
             "bnbf16", nn_ops._BN_BF16_COMPUTE,
             "bnfused", nn_ops._BN_STATS_FUSED_PASS,
             "bnfdef", nn_ops._BN_BF16_FUSED_DEFAULT,
-            "bnbar", nn_ops._BN_UNFUSE_CONV)
+            "bnbar", nn_ops._BN_UNFUSE_CONV,
+            "pallas", bool(_flagv("FLAGS_use_pallas")))
 
 
 class _CompiledStep:
@@ -123,7 +125,7 @@ class _CompiledStep:
     def __init__(self, program: Program, feed_names: Sequence[str], fetch_names: Sequence[str], scope: Scope,
                  mesh=None, batch_axis: str = "dp", feed_shapes: Optional[Dict[str, tuple]] = None,
                  n_steps: int = 1, remat: bool = False, platform: Optional[str] = None,
-                 local_sgd: bool = False):
+                 local_sgd: bool = False, grad_overlap=None):
         self.mesh = mesh
         self.platform = platform
         self.batch_axis = batch_axis
@@ -179,10 +181,32 @@ class _CompiledStep:
         self.rw_names = [n for n in self.state_in_names if n in written_set]
         self.ro_names = [n for n in self.state_in_names if n not in written_set]
 
+        # Backward-overlapped dp gradient all-reduce (CompiledProgram.
+        # with_grad_overlap): the step runs inside a manual shard_map region
+        # and grads are bucket-psum'd via the LoweringContext hook.
+        self._grad_sync = None
+        if grad_overlap is not None:
+            overlap_mode, bucket_bytes = grad_overlap
+            if mesh is None or not dict(mesh.shape).get(batch_axis):
+                raise ValueError(
+                    "with_grad_overlap needs a mesh with a batch axis "
+                    "(CompiledProgram.with_data_parallel / with_mesh first)")
+            if program.sharding_hints:
+                raise NotImplementedError(
+                    "with_grad_overlap is a pure-dp path (replicated "
+                    "state); programs with sharding_hints keep the GSPMD "
+                    "collectives")
+            from ..parallel.distributed import make_grad_sync
+
+            self._grad_sync = make_grad_sync(batch_axis, bucket_bytes,
+                                             mode=overlap_mode)
+
         def step(state_rw: Dict[str, jnp.ndarray], state_ro: Dict[str, jnp.ndarray],
                  feeds: Dict[str, jnp.ndarray], key):
             ctx = LoweringContext(key, mesh=mesh, platform=self.platform)
             ctx.remat = self.remat
+            ctx.grad_sync = self._grad_sync
+            ctx.fetch_names = tuple(self.fetch_names)
             env = dict(state_ro)
             env.update(state_rw)
             env.update(feeds)
@@ -190,6 +214,51 @@ class _CompiledStep:
             new_state = {n: env[n] for n in written if n in env}
             fetches = [env[n] for n in self.fetch_names]
             return fetches, new_state, ctx.key
+
+        # dp geometry shared by every feed-sharding consumer below (LocalSGD
+        # and overlap shard_map in_specs, jit-level in_shardings).
+        # feed_shapes are the caller's LOCAL per-process shapes; when the
+        # batch axis spans processes each feed's global batch is
+        # local * dp_procs, so divisibility checks must use the per-process
+        # dp share, not the global dp size.
+        if mesh is not None:
+            n_dp = dict(mesh.shape).get(batch_axis, 0)  # 0: no data axis (e.g. pure pp mesh)
+            dp_spans = False
+            dp_procs = 1
+            if self.multiprocess and n_dp:
+                ax = list(mesh.axis_names).index(batch_axis)
+                line = np.moveaxis(mesh.devices, ax, 0).reshape(n_dp, -1)[:, 0]
+                procs = {d.process_index for d in line}
+                dp_spans = len(procs) > 1
+                dp_procs = max(len(procs), 1)
+            n_dp_local = max(n_dp // dp_procs, 1) if dp_spans else n_dp
+
+            def _feed_pspec(n):
+                # CONTRACT (cross-process dp): every feed with a batch dim
+                # is this process's slice of the global batch, sharded over
+                # the dp axis exactly when the local batch divides this
+                # process's dp share; replicated non-scalar data must be
+                # passed as a pre-placed jax.Array.  The ONE copy of this
+                # rule feeds the LocalSGD and overlap shard_map in_specs
+                # and the jit in_shardings — if two of them disagreed,
+                # shard_map would all-gather the batch and every worker
+                # would compute the full global batch (dp silently gone).
+                from jax.sharding import PartitionSpec as P
+
+                shape = feed_shapes.get(n, ())
+                bdim = 1 if n_steps > 1 else 0  # steps>1: axis 0 is scan
+                if (n_dp and len(shape) > bdim
+                        and shape[bdim] % n_dp_local == 0):
+                    return P(*([None] * bdim + [batch_axis]))
+                if dp_spans and len(shape) > bdim and shape[bdim] > 1:
+                    # replicating per-process data that differs across
+                    # processes silently breaks sync-SGD; refuse instead
+                    raise ValueError(
+                        f"multiprocess feed {n!r}: local batch "
+                        f"{shape[bdim]} is not divisible by this process's "
+                        f"dp share ({n_dp_local}); pad the local batch or "
+                        f"adjust the mesh")
+                return P()
 
         if n_steps > 1:
             # Multi-step dispatch: lax.scan the whole train step over feeds
@@ -228,14 +297,7 @@ class _CompiledStep:
                         "supported yet; use a single-controller dp mesh")
                 from jax.sharding import PartitionSpec as P
 
-                def _ls_feed_spec(n):
-                    shape = feed_shapes.get(n, ())
-                    n_dp = dict(mesh.shape)[batch_axis]
-                    if len(shape) > 1 and shape[1] % n_dp == 0:
-                        return P(None, batch_axis)
-                    return P()
-
-                ls_in_feeds = {n: _ls_feed_spec(n) for n in self.feed_names}
+                ls_in_feeds = {n: _feed_pspec(n) for n in self.feed_names}
                 rw_repl = {n: P() for n in self.rw_names}
                 ro_repl = {n: P() for n in self.ro_names}
                 out_state_spec = {n: P() for n in written}
@@ -280,6 +342,104 @@ class _CompiledStep:
                     (srw, key2), stacked = jax.lax.scan(body, (state_rw, key), feeds)
                     return stacked, srw, key2
 
+        if self._grad_sync is not None:
+            # Manual dp region around the (possibly scanned) step: each dp
+            # worker traces the program over ITS batch shard; the grad_sync
+            # hook mean-reduces gradients in buckets inside the backward, so
+            # parameter updates are identical across workers and the state
+            # stays replicated.  DDP semantics: dropout masks and BN batch
+            # stats are per-shard (each worker folds the step key with its
+            # dp index); fetches come back as the dp-mean (exact for the
+            # scalar losses/metrics training fetches).
+            from jax.sharding import PartitionSpec as P
+
+            from .jax_compat import shard_map as _shard_map
+
+            ov_in_feeds = {n: _feed_pspec(n) for n in self.feed_names}
+            rw_repl = {n: P() for n in self.rw_names}
+            ro_repl = {n: P() for n in self.ro_names}
+            out_state_spec = {n: P() for n in written}
+            inner_step = step
+            n_fetch = len(self.fetch_names)
+            # Written state whose update is NOT grad-derived needs its own
+            # sync: each worker folds ITS shard's statistics, so without
+            # one the P() out_spec would claim replication over genuinely
+            # divergent per-device buffers (rank-divergent checkpoints,
+            # undefined eval stats).  Two classes, two reductions:
+            #   - BN running mean/var: dp-MEAN — exact for the running
+            #     mean, the standard shard-mean approximation for the
+            #     running variance; normalization itself stays per-shard
+            #     (DDP semantics).
+            #   - additive accumulators (auc StatPos/StatNeg histograms):
+            #     delta-PSUM — new = old + psum(new - old), so the global
+            #     histogram counts every shard's samples exactly (integer
+            #     math, bit-identical across serial/bucketed arms).
+            bn_stat_names = set()
+            acc_stat_names = set()
+            # walk every block, not just the compiled op list — a BN inside
+            # a while/conditional sub-block still writes persistable stats
+            # into `written` and needs the same sync
+            for blk in program.blocks:
+                for op_ in blk.ops:
+                    if (op_.type in ("batch_norm", "sync_batch_norm")
+                            and not op_.attrs.get("is_test")
+                            and not op_.attrs.get("use_global_stats")):
+                        for slot in ("MeanOut", "VarianceOut"):
+                            bn_stat_names.update(op_.outputs.get(slot, ()))
+                    elif op_.type == "auc":
+                        for slot in ("StatPosOut", "StatNegOut"):
+                            acc_stat_names.update(op_.outputs.get(slot, ()))
+            bn_stat_names &= set(written)
+            acc_stat_names &= set(written)
+
+            def worker(state_rw, state_ro, feeds, key):
+                wk = jax.random.fold_in(key, jax.lax.axis_index(batch_axis))
+                fetches, new_state, _ = inner_step(state_rw, state_ro, feeds, wk)
+                # the dp-mean below is only meaningful for scalar losses/
+                # metrics (per step); a per-sample fetch would come back as
+                # the element-wise average of DIFFERENT samples across
+                # shards at 1/n_dp the batch — garbage with no error.
+                # Refuse at trace time instead.  (A fetch whose PER-SHARD
+                # size is 1 is indistinguishable from a scalar metric here
+                # and passes — shapes are shard-local inside shard_map.)
+                for fname, f in zip(self.fetch_names, fetches):
+                    if getattr(f, "size", 1) > max(n_steps, 1):
+                        raise ValueError(
+                            f"with_grad_overlap: fetch {fname!r} has shape "
+                            f"{f.shape} — overlap fetches are dp-MEANed "
+                            f"across workers, which is only exact for "
+                            f"scalar losses/metrics; fetch a reduced "
+                            f"scalar, or run evaluation through a program "
+                            f"compiled without grad overlap")
+                fetches = jax.tree_util.tree_map(
+                    lambda a: jax.lax.pmean(a, batch_axis), fetches)
+                if bn_stat_names or acc_stat_names:
+                    def _sync_stat(n, v):
+                        if n in bn_stat_names:
+                            return jax.lax.pmean(v, batch_axis)
+                        if n in acc_stat_names:
+                            # additive accumulator: every shard starts from
+                            # the same replicated base and adds its shard's
+                            # delta — psum the delta, not the state, or the
+                            # base would be counted n_dp times
+                            return state_rw[n] + jax.lax.psum(
+                                v - state_rw[n], batch_axis)
+                        return v
+                    new_state = {n: _sync_stat(n, v)
+                                 for n, v in new_state.items()}
+                return fetches, new_state
+
+            smapped = _shard_map(
+                worker, mesh=mesh,
+                in_specs=(rw_repl, ro_repl, ov_in_feeds, P()),
+                out_specs=([P()] * n_fetch, out_state_spec),
+                check_vma=False,
+            )
+
+            def step(state_rw, state_ro, feeds, key):
+                fetches, new_state = smapped(state_rw, state_ro, feeds, key)
+                return fetches, new_state, jax.random.fold_in(key, max(n_steps, 1))
+
         if mesh is None:
             self.jfn = jax.jit(step, donate_argnums=(0,))
             self.feed_specs = None
@@ -295,36 +455,11 @@ class _CompiledStep:
                 return NamedSharding(mesh, P(*hints[n]) if n in hints else P())
 
             repl = NamedSharding(mesh, P())
-            n_dp = dict(mesh.shape).get(batch_axis, 0)  # 0: no data axis (e.g. pure pp mesh)
-            # Does the batch axis cross process boundaries?  Only then are
-            # feeds process-local slices; otherwise (tp-only global mesh,
-            # single process) every process passes identical full arrays.
-            dp_spans = False
-            dp_procs = 1
-            if self.multiprocess and n_dp:
-                ax = list(mesh.axis_names).index(batch_axis)
-                line = np.moveaxis(mesh.devices, ax, 0).reshape(n_dp, -1)[:, 0]
-                procs = {d.process_index for d in line}
-                dp_spans = len(procs) > 1
-                dp_procs = max(len(procs), 1)
-            n_dp_local = max(n_dp // dp_procs, 1) if dp_spans else n_dp
 
             def feed_spec(n):
-                # CONTRACT (cross-process dp): every feed with a batch dim is
-                # this process's slice of the global batch; replicated
-                # non-scalar data must be passed as a pre-placed jax.Array.
-                shape = feed_shapes.get(n, ())
-                bdim = 1 if n_steps > 1 else 0  # steps>1: axis 0 is the scan axis
-                if n_dp and len(shape) > bdim and shape[bdim] % n_dp_local == 0:
-                    return NamedSharding(mesh, P(*([None] * bdim + [batch_axis])))
-                if dp_spans and len(shape) > bdim and shape[bdim] > 1:
-                    # replicating per-process data that differs across
-                    # processes silently breaks sync-SGD; refuse instead
-                    raise ValueError(
-                        f"multiprocess feed {n!r}: local batch {shape[bdim]} is "
-                        f"not divisible by this process's dp share "
-                        f"({n_dp_local}); pad the local batch or adjust the mesh")
-                return repl  # scalars / indivisible feeds replicate
+                # the dp feed-sharding contract lives in _feed_pspec (shared
+                # with the overlap shard_map in_specs); this just places it
+                return NamedSharding(mesh, _feed_pspec(n))
 
             rw_specs = {n: state_spec(n) for n in self.rw_names}
             ro_specs = {n: state_spec(n) for n in self.ro_names}
@@ -786,6 +921,7 @@ class Executor:
         batch_axis = "dp"
         remat = False
         local_sgd_every = 0
+        grad_overlap = None
         if hasattr(program, "program") and hasattr(program, "mesh"):  # CompiledProgram
             mesh = program.mesh
             batch_axis = getattr(program, "batch_axis", "dp")
@@ -795,6 +931,10 @@ class Executor:
             # memory_optimize_pass: trade FLOPs for activation memory)
             remat = bool(getattr(bs, "memory_optimize", False))
             local_sgd_every = int(getattr(program, "local_sgd_every", 0) or 0)
+            ov_mode = getattr(program, "grad_overlap_mode", None)
+            if ov_mode:
+                bucket_mb = float(getattr(program, "grad_overlap_bucket_mb", 0.0))
+                grad_overlap = (ov_mode, int(bucket_mb * 1e6))
             program = program.program
         if local_sgd_every:
             if steps == 1:
@@ -907,6 +1047,7 @@ class Executor:
             steps,
             remat,
             local_sgd_every,
+            grad_overlap,
             _lowering_flags(),
         )
         compiled = self._cache.pop(cache_key, None)
@@ -940,6 +1081,7 @@ class Executor:
                     feed_shapes={n: v.shape for n, v in jfeeds.items()},
                     n_steps=steps, remat=remat, platform=mesh_platform,
                     local_sgd=bool(local_sgd_every),
+                    grad_overlap=grad_overlap,
                 )
             self._cache[cache_key] = compiled
             from ..flags import flag as _flagv

@@ -46,6 +46,17 @@ class LoweringContext:
         self.env: Dict[str, Any] = {}
         # set by run_block_with_backward while sparse-grad taps are active
         self.sparse_taps = None
+        # backward-overlapped dp gradient all-reduce: when the executor runs
+        # the step inside a manual (shard_map) dp region, this holds the
+        # bucketed-psum callable from parallel.distributed.make_grad_sync;
+        # _run_one_backward_region applies it to the assembled grads so the
+        # optimizer segment consumes globally-reduced gradients
+        self.grad_sync = None
+        # fetch targets of the step being traced (set by the executor):
+        # lowerings that can skip optional output slots on a fused path
+        # (e.g. layer_norm Mean/Variance under FLAGS_use_pallas) consult
+        # this so a fetched slot keeps the composite that populates it
+        self.fetch_names = ()
         # BuildStrategy.memory_optimize: rematerialize the forward during
         # backward (jax.checkpoint) instead of keeping activations
         self.remat = False
@@ -245,6 +256,7 @@ def _run_one_backward_region(ctx: LoweringContext, ops: List[Operator], split: i
     env = dict(env)
     env.update(env_after)
     ctx.sparse_taps = None
+    named = []
     for p, g in zip(param_names, grad_names):
         if p in sparse_names:
             gval = _gather_sparse_grad(p, coll, dtaps, env)
@@ -252,6 +264,11 @@ def _run_one_backward_region(ctx: LoweringContext, ops: List[Operator], split: i
             gval = grads[p]
             if gval is None:  # non-float param leaked in; treat as zero
                 gval = jnp.zeros_like(env[p])
+        named.append((g, gval))
+    if ctx.grad_sync is not None:
+        synced = ctx.grad_sync(named)
+        named = [(g, synced.get(g, v)) for g, v in named]
+    for g, gval in named:
         env[g] = gval
         grads_so_far[g] = gval
     return env

@@ -158,6 +158,24 @@ DEFINE_float("FLAGS_dist_bootstrap_timeout_s", 120.0,
              "role): a gang whose worker never dials in raises "
              "CollectiveTimeoutError instead of blocking the others at "
              "startup")
+DEFINE_bool("FLAGS_use_pallas", False,
+            "route hot-kernel lowerings to the hand-fused Pallas TPU "
+            "kernels (ops/pallas_kernels.py: LayerNorm+residual, BN "
+            "scale/shift/relu epilogue, row-slab Adam; ops/"
+            "pallas_attention.py SDPA keeps its own use_pallas_sdpa attr). "
+            "OPT-IN: off (default) or a non-TPU backend keeps the XLA "
+            "composite for every kernel.  Participates in the executor "
+            "compile-cache key, so toggling recompiles instead of reusing "
+            "stale executables.  Parity: tests/test_pallas_kernels.py; "
+            "device A/B: tools/opbench.py --fused")
+DEFINE_float("FLAGS_dp_bucket_mb", 4.0,
+             "gradient-bucket size cap (MB) for the backward-overlapped "
+             "data-parallel all-reduce (parallel/distributed.py "
+             "make_grad_sync, CompiledProgram.with_grad_overlap): grads "
+             "are grouped reverse-topologically into buckets of at most "
+             "this many bytes and each bucket is all-reduced as soon as "
+             "its grads are ready, overlapping communication with the "
+             "rest of the backward pass (the DDP bucketing strategy)")
 DEFINE_bool("FLAGS_cudnn_deterministic", True,
             "accepted no-op: XLA TPU lowerings are deterministic by default")
 DEFINE_float("FLAGS_fraction_of_gpu_memory_to_use", 1.0,

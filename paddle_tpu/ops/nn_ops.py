@@ -307,16 +307,33 @@ def _batch_norm(ctx, op, ins):
         var_out = momentum * var_in + (1.0 - momentum) * var
         saved_mean, saved_var = mean, var
 
+    fuse_relu = op.attr("fuse_relu", False)  # core/passes.py fuse_bn_relu
+    from .pallas_kernels import use_pallas
+
     inv = jax.lax.rsqrt(var.reshape(bshape) + eps)
-    if bf16_fast:
-        # per-channel multipliers computed in f32, applied in bf16
-        mul = (inv * scale.astype(jnp.float32).reshape(bshape)).astype(x.dtype)
-        add = (bias.astype(jnp.float32).reshape(bshape)
-               - mean.reshape(bshape) * inv * scale.astype(jnp.float32).reshape(bshape)
-               ).astype(x.dtype)
-        y = x * mul + add
+    if use_pallas(ctx) and ch_axis == 1 and not nhwc_internal and x.ndim >= 3:
+        # fused epilogue kernel: the normalize/scale/shift(/relu) chain as
+        # one roofline-bandwidth pass with per-channel f32 multipliers; the
+        # producing conv keeps its clean MXU fusion (stats stay XLA
+        # reductions above)
+        from .pallas_kernels import bn_epilogue
+
+        sf = scale.astype(jnp.float32)
+        mul_c = inv.reshape(-1) * sf
+        add_c = bias.astype(jnp.float32) - mean.reshape(-1) * mul_c
+        y = bn_epilogue(x, mul_c, add_c, relu=fuse_relu)
     else:
-        y = (x - mean.reshape(bshape)) * inv * scale.reshape(bshape) + bias.reshape(bshape)
+        if bf16_fast:
+            # per-channel multipliers computed in f32, applied in bf16
+            mul = (inv * scale.astype(jnp.float32).reshape(bshape)).astype(x.dtype)
+            add = (bias.astype(jnp.float32).reshape(bshape)
+                   - mean.reshape(bshape) * inv * scale.astype(jnp.float32).reshape(bshape)
+                   ).astype(x.dtype)
+            y = x * mul + add
+        else:
+            y = (x - mean.reshape(bshape)) * inv * scale.reshape(bshape) + bias.reshape(bshape)
+        if fuse_relu:
+            y = jnp.maximum(y, 0.0)
     if nhwc_internal:
         y = jnp.transpose(y, (0, 3, 1, 2))
     return {
@@ -328,14 +345,56 @@ def _batch_norm(ctx, op, ins):
     }
 
 
+def _ln_stats_consumed(ctx, op):
+    """True when this layer_norm's Mean/Variance outputs are read by any op
+    or fetched — the fused kernel does not materialize them, so a consumer
+    must keep the composite lowering.
+
+    The program-wide read-name set is memoized on the LoweringContext (one
+    scan per trace, not one per layer_norm — a deep transformer would
+    otherwise rescan every op per LN on every compile-cache miss).  An op
+    never reads its own Mean/Variance outputs (def-before-use), so the
+    union over ALL ops matches the per-op exclusion it replaces."""
+    names = {n for slot in ("Mean", "Variance")
+             for n in op.outputs.get(slot, [])}
+    if not names:
+        return False
+    if names & set(getattr(ctx, "fetch_names", ()) or ()):
+        return True
+    read = getattr(ctx, "_program_read_names", None)
+    if read is None:
+        read = set()
+        for b in op.block.program.blocks:
+            for o in b.ops:
+                read.update(o.input_arg_names)
+        ctx._program_read_names = read
+    return bool(names & read)
+
+
 @register_op("layer_norm")
 def _layer_norm(ctx, op, ins):
     x = first(ins, "X")
     scale = first(ins, "Scale")
     bias = first(ins, "Bias")
+    # optional fused residual input (core/passes.py fuse_ln_residual): the
+    # residual add that fed this LN has been folded into the op, so the
+    # pre-norm sum never becomes a standalone HBM tensor on the fused path
+    residual = first(ins, "Residual") if ins.get("Residual") else None
     eps = op.attr("epsilon", 1e-5)
     begin = op.attr("begin_norm_axis", 1)
     axes = tuple(range(begin, x.ndim))
+    from .pallas_kernels import fused_ln_residual, use_pallas
+
+    if (use_pallas(ctx) and axes == (x.ndim - 1,)
+            and scale is not None and bias is not None
+            and not _ln_stats_consumed(ctx, op)):
+        # one-VMEM-pass kernel (residual add + stats + affine); Mean/Variance
+        # slots stay unset — safe because _ln_stats_consumed proved nothing
+        # reads or fetches them (a consumer keeps the composite below)
+        y = fused_ln_residual(x, residual, scale, bias, float(eps))
+        return {"Y": y}
+    if residual is not None:
+        x = x + match_dtype(x, residual)
     # standard TPU LN numerics: stats/normalize in f32 even for bf16
     # activations (bf16's 8-bit mantissa loses the mean under cancellation)
     xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x

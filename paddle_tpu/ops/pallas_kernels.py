@@ -1,0 +1,465 @@
+"""Fused Pallas TPU kernels beyond SDPA: LayerNorm+residual, BN epilogue
+(scale/shift/relu), and the row-slab Adam update.
+
+Reference role: the hand-fused device kernels of operators/fused/
+(fused_layernorm_residual_dropout_bias, conv_fusion, fused adam) — the
+reference's answer to per-op dispatch overhead across its 169k-LoC operator
+tree.  Here the XLA seam already fuses most elementwise chains, so each
+kernel below targets a case the r5 step-time profile showed XLA handling
+badly (see docs/performance.md):
+
+  * `fused_ln_residual` — residual add + LayerNorm over the last axis in one
+    VMEM pass: the [B,L,D] activation is read once forward (XLA's two-pass
+    mean/var formulation reads it twice, and the residual add materializes a
+    third stream) and once backward (stats recomputed flash-style).
+  * `fused_scale_shift_relu` — the BN inference/apply epilogue y =
+    max(x*mul + add, 0) with per-channel mul/add, applied AFTER the batch
+    stats are computed: keeps the conv's producer fusion clean (the r5
+    profile showed BN reductions fused INTO convs wrecking MXU tiling) while
+    the epilogue runs at roofline bandwidth.
+  * `fused_adam` — m/v/param in ONE pass over row slabs instead of the 5+
+    HBM round-trips of the composite (m, v, sqrt, div, sub chains), with
+    `input_output_aliases` pinning the update in place.
+
+Every kernel is an OPT-IN lowering alternative behind `FLAGS_use_pallas`
+(ops/nn_ops.py, ops/optimizer_ops.py): platform != TPU or flag off falls
+back to the XLA composite, which each kernel matches to per-dtype tolerance
+(tests/test_pallas_kernels.py runs the parity matrix in interpret mode; the
+interleaved device A/B lives in tools/opbench.py --fused).
+
+Kernel-shape contract: the last axis is the vector (lane) axis; leading
+axes flatten to rows.  Row slabs are chosen so slab * row_bytes fits the
+VMEM budget; slab counts that do not divide the row count fall back to the
+composite rather than pad (padding would re-introduce the HBM copy the
+kernel exists to remove).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+_VMEM_BUDGET = 8 * 1024 * 1024
+
+
+def _pick_slab(n_rows: int, row_bytes: int, n_bufs: int) -> int:
+    """Largest divisor of n_rows whose working set fits the VMEM budget."""
+    per_row = max(row_bytes * n_bufs, 1)
+    slab = max(1, int(_VMEM_BUDGET // per_row))
+    slab = min(slab, n_rows)
+    while n_rows % slab:
+        slab -= 1
+    return slab
+
+
+def pallas_supported(platform) -> bool:
+    """True when the opt-in kernels can lower on this backend."""
+    return platform == "tpu"
+
+
+def use_pallas(ctx) -> bool:
+    """The routing predicate every lowering alternative shares: the flag is
+    the opt-in, the platform is the capability."""
+    from ..flags import flag
+
+    return bool(flag("FLAGS_use_pallas")) and pallas_supported(
+        getattr(ctx, "platform", None))
+
+
+# --------------------------------------------------------------------------
+# fused LayerNorm + residual
+# --------------------------------------------------------------------------
+
+
+def _ln_rows(x):
+    """[.., D] -> ([R, D], unflatten)."""
+    D = x.shape[-1]
+    lead = x.shape[:-1]
+    R = int(np.prod(lead)) if lead else 1
+    return x.reshape(R, D), lambda y: y.reshape(*lead, D)
+
+
+def _ln_fwd_kernel(eps, has_res):
+    def kern(*refs):
+        if has_res:
+            x_ref, r_ref, s_ref, b_ref, o_ref = refs
+            r = x_ref[...].astype(jnp.float32) + r_ref[...].astype(jnp.float32)
+        else:
+            x_ref, s_ref, b_ref, o_ref = refs
+            r = x_ref[...].astype(jnp.float32)
+        mean = jnp.mean(r, axis=-1, keepdims=True)
+        c = r - mean
+        var = jnp.mean(c * c, axis=-1, keepdims=True)
+        y = c * jax.lax.rsqrt(var + eps)
+        y = (y * s_ref[...].astype(jnp.float32)
+             + b_ref[...].astype(jnp.float32))  # (1, D) broadcasts over rows
+        o_ref[...] = y.astype(o_ref.dtype)
+
+    return kern
+
+
+def _ln_bwd_kernel(eps, has_res, out_dtype):
+    """Recompute stats from x(+res), emit d(input) slab and ACCUMULATE
+    dscale/dbias across sequential grid steps (all steps map to the same
+    f32 accumulator block; TPU grids execute in order on one core)."""
+
+    def kern(*refs):
+        if has_res:
+            x_ref, r_ref, s_ref, g_ref, dx_ref, ds_ref, db_ref = refs
+            r = x_ref[...].astype(jnp.float32) + r_ref[...].astype(jnp.float32)
+        else:
+            x_ref, s_ref, g_ref, dx_ref, ds_ref, db_ref = refs
+            r = x_ref[...].astype(jnp.float32)
+        i = pl.program_id(0)
+
+        mean = jnp.mean(r, axis=-1, keepdims=True)
+        c = r - mean
+        var = jnp.mean(c * c, axis=-1, keepdims=True)
+        inv = jax.lax.rsqrt(var + eps)
+        xhat = c * inv
+        g = g_ref[...].astype(jnp.float32)
+        gs = g * s_ref[...].astype(jnp.float32)
+        m1 = jnp.mean(gs, axis=-1, keepdims=True)
+        m2 = jnp.mean(gs * xhat, axis=-1, keepdims=True)
+        dx = inv * (gs - m1 - xhat * m2)
+        dx_ref[...] = dx.astype(out_dtype)
+        ds = jnp.sum(g * xhat, axis=0)
+        db = jnp.sum(g, axis=0)
+
+        @pl.when(i == 0)
+        def _init():
+            ds_ref[...] = ds
+            db_ref[...] = db
+
+        @pl.when(i != 0)
+        def _acc():
+            ds_ref[...] += ds
+            db_ref[...] += db
+
+    return kern
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def fused_ln_residual(x, res, scale, bias, eps, interpret=False):
+    """y = LayerNorm(x + res) * scale + bias over the LAST axis.
+
+    res may be None (plain LN).  scale/bias are [D]; stats in f32; output
+    matches x.dtype.  bwd recomputes stats (nothing but x/res saved)."""
+    out, _ = _ln_fwd(x, res, scale, bias, eps, interpret)
+    return out
+
+
+def _ln_call(x2, res2, scale, bias, eps, interpret):
+    R, D = x2.shape
+    slab = _pick_slab(R, D * 4 * (4 if res2 is not None else 3), 1)
+    row_spec = pl.BlockSpec((slab, D), lambda i: (i, 0))
+    vec_spec = pl.BlockSpec((D,), lambda i: (0,))
+    args = (x2,) + ((res2,) if res2 is not None else ()) + (scale, bias)
+    in_specs = [row_spec] * (2 if res2 is not None else 1) + [vec_spec] * 2
+    return pl.pallas_call(
+        _ln_fwd_kernel(eps, res2 is not None),
+        grid=(R // slab,),
+        in_specs=in_specs,
+        out_specs=row_spec,
+        out_shape=jax.ShapeDtypeStruct((R, D), x2.dtype),
+        interpret=interpret,
+    )(*args)
+
+
+def _ln_fwd(x, res, scale, bias, eps, interpret):
+    x2, unflat = _ln_rows(x)
+    res2 = None if res is None else _ln_rows(res)[0]
+    out = _ln_call(x2, res2, scale, bias, eps, interpret)
+    return unflat(out), (x, res, scale, bias)
+
+
+def _ln_bwd(eps, interpret, saved, g):
+    x, res, scale, bias = saved
+    x2, unflat = _ln_rows(x)
+    res2 = None if res is None else _ln_rows(res)[0]
+    g2 = _ln_rows(g)[0]
+    R, D = x2.shape
+    slab = _pick_slab(R, D * 4 * (6 if res2 is not None else 5), 1)
+    row_spec = pl.BlockSpec((slab, D), lambda i: (i, 0))
+    vec_spec = pl.BlockSpec((D,), lambda i: (0,))
+    acc_spec = pl.BlockSpec((D,), lambda i: (0,))
+    args = (x2,) + ((res2,) if res2 is not None else ()) + (scale, g2)
+    in_specs = ([row_spec] * (2 if res2 is not None else 1)
+                + [vec_spec, row_spec])
+    dx2, ds, db = pl.pallas_call(
+        _ln_bwd_kernel(eps, res2 is not None, x2.dtype),
+        grid=(R // slab,),
+        in_specs=in_specs,
+        out_specs=[row_spec, acc_spec, acc_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, D), x2.dtype),
+            jax.ShapeDtypeStruct((D,), jnp.float32),
+            jax.ShapeDtypeStruct((D,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    dx = unflat(dx2)
+    dres = None if res is None else dx.astype(res.dtype)
+    return (dx, dres, ds.astype(scale.dtype), db.astype(bias.dtype))
+
+
+fused_ln_residual.defvjp(_ln_fwd, _ln_bwd)
+
+
+# --------------------------------------------------------------------------
+# fused BN epilogue: per-channel scale/shift (+ relu)
+# --------------------------------------------------------------------------
+
+
+def _epilogue_fwd_kernel(relu):
+    def kern(x_ref, m_ref, a_ref, o_ref):
+        y = (x_ref[...].astype(jnp.float32) * m_ref[...][:, None]
+             + a_ref[...][:, None])
+        if relu:
+            y = jnp.maximum(y, 0.0)
+        o_ref[...] = y.astype(o_ref.dtype)
+
+    return kern
+
+
+def _epilogue_bwd_kernel(relu, out_dtype):
+    def kern(x_ref, m_ref, a_ref, g_ref, dx_ref, dm_ref, da_ref):
+        x = x_ref[...].astype(jnp.float32)
+        mul = m_ref[...][:, None]
+        g = g_ref[...].astype(jnp.float32)
+        if relu:
+            live = (x * mul + a_ref[...][:, None]) > 0.0
+            g = jnp.where(live, g, 0.0)
+        dx_ref[...] = (g * mul).astype(out_dtype)
+        # dm/da are PER-ROW and each grid step owns a disjoint row slab
+        # (BlockSpec i -> (i,)), so a plain store is complete — unlike
+        # _ln_bwd_kernel, whose dscale/dbias block is shared across steps
+        # (i -> (0,)) and genuinely accumulates.
+        dm_ref[...] = jnp.sum(g * x, axis=-1)
+        da_ref[...] = jnp.sum(g, axis=-1)
+
+    return kern
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_scale_shift_relu(x, mul, add, relu=True, interpret=False):
+    """y = max(x * mul + add, 0) with PER-ROW mul/add over x:[R, W].
+
+    The BN-epilogue shape: callers flatten NCHW to [N*C, H*W] and tile the
+    per-channel f32 multipliers to N*C rows (ops/nn_ops.py _batch_norm).
+    Backward masks by recomputed sign, accumulates dmul/dadd per row."""
+    out, _ = _epilogue_fwd(x, mul, add, relu, interpret)
+    return out
+
+
+def _epilogue_fwd(x, mul, add, relu, interpret):
+    R, W = x.shape
+    slab = _pick_slab(R, W * 4 * 2, 1)
+    row_spec = pl.BlockSpec((slab, W), lambda i: (i, 0))
+    vec_spec = pl.BlockSpec((slab,), lambda i: (i,))
+    out = pl.pallas_call(
+        _epilogue_fwd_kernel(relu),
+        grid=(R // slab,),
+        in_specs=[row_spec, vec_spec, vec_spec],
+        out_specs=row_spec,
+        out_shape=jax.ShapeDtypeStruct((R, W), x.dtype),
+        interpret=interpret,
+    )(x, mul, add)
+    return out, (x, mul, add)
+
+
+def _epilogue_bwd(relu, interpret, saved, g):
+    x, mul, add = saved
+    R, W = x.shape
+    slab = _pick_slab(R, W * 4 * 3, 1)
+    row_spec = pl.BlockSpec((slab, W), lambda i: (i, 0))
+    vec_spec = pl.BlockSpec((slab,), lambda i: (i,))
+    dx, dm, da = pl.pallas_call(
+        _epilogue_bwd_kernel(relu, x.dtype),
+        grid=(R // slab,),
+        in_specs=[row_spec, vec_spec, vec_spec, row_spec],
+        out_specs=[row_spec, vec_spec, vec_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, W), x.dtype),
+            jax.ShapeDtypeStruct((R,), jnp.float32),
+            jax.ShapeDtypeStruct((R,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, mul, add, g)
+    return dx, dm.astype(mul.dtype), da.astype(add.dtype)
+
+
+fused_scale_shift_relu.defvjp(_epilogue_fwd, _epilogue_bwd)
+
+
+def bn_epilogue(x, mul, add, relu, interpret=False):
+    """Apply the fused epilogue to an NCHW/NC* activation given per-channel
+    f32 mul/add (channel axis 1): flatten to [N*C, prod(spatial)], tile the
+    channel vectors to rows, run the kernel, restore the shape."""
+    N, C = x.shape[0], x.shape[1]
+    W = int(np.prod(x.shape[2:])) if x.ndim > 2 else 1
+    x2 = x.reshape(N * C, W)
+    mul_r = jnp.tile(mul.reshape(-1), N)
+    add_r = jnp.tile(add.reshape(-1), N)
+    y = fused_scale_shift_relu(x2, mul_r, add_r, bool(relu), interpret)
+    return y.reshape(x.shape)
+
+
+# --------------------------------------------------------------------------
+# fused Adam row-slab update
+# --------------------------------------------------------------------------
+
+_ADAM_LANE = 256  # flatten to [R, _ADAM_LANE]; non-multiples fall back
+
+
+def _adam_kernel(beta1, beta2, eps, p_dtype):
+    def kern(p_ref, m_ref, v_ref, g_ref, lr_ref, po_ref, mo_ref, vo_ref):
+        p = p_ref[...].astype(jnp.float32)
+        g = g_ref[...].astype(jnp.float32)
+        m = beta1 * m_ref[...].astype(jnp.float32) + (1.0 - beta1) * g
+        v = beta2 * v_ref[...].astype(jnp.float32) + (1.0 - beta2) * (g * g)
+        lr_t = lr_ref[0, 0]
+        p2 = p - lr_t * m / (jnp.sqrt(v) + eps)
+        po_ref[...] = p2.astype(p_dtype)
+        mo_ref[...] = m.astype(mo_ref.dtype)
+        vo_ref[...] = v.astype(vo_ref.dtype)
+
+    return kern
+
+
+def adam_shape_ok(shape) -> bool:
+    """The no-padding contract: the element count must tile into
+    [R, _ADAM_LANE] rows exactly, else the lowering keeps the composite."""
+    n = int(np.prod(shape)) if len(shape) else 1
+    return n % _ADAM_LANE == 0
+
+
+def fused_adam(p, g, m, v, lr_t, beta1, beta2, eps, interpret=False):
+    """One-pass Adam over row slabs: returns (p2, m2, v2).
+
+    lr_t is the bias-corrected step size lr*sqrt(1-b2p)/(1-b1p), computed
+    by the caller (the beta-pow advance stays outside).  p/m/v are aliased
+    in place (`input_output_aliases`), so with the executor's donation this
+    is a true in-HBM update — no double-buffered copies of optimizer
+    state."""
+    shape = p.shape
+    n = int(np.prod(shape)) if len(shape) else 1
+    assert n % _ADAM_LANE == 0, "caller must check adam_shape_ok first"
+    R = n // _ADAM_LANE
+    p2 = p.reshape(R, _ADAM_LANE)
+    g2 = g.astype(jnp.float32).reshape(R, _ADAM_LANE)
+    m2 = m.reshape(R, _ADAM_LANE)
+    v2 = v.reshape(R, _ADAM_LANE)
+    lr2 = jnp.asarray(lr_t, jnp.float32).reshape(1, 1)
+    slab = _pick_slab(R, _ADAM_LANE * 4 * 7, 1)
+    row_spec = pl.BlockSpec((slab, _ADAM_LANE), lambda i: (i, 0))
+    lr_spec = pl.BlockSpec((1, 1), lambda i: (0, 0))
+    po, mo, vo = pl.pallas_call(
+        _adam_kernel(beta1, beta2, eps, p2.dtype),
+        grid=(R // slab,),
+        in_specs=[row_spec, row_spec, row_spec, row_spec, lr_spec],
+        out_specs=[row_spec, row_spec, row_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, _ADAM_LANE), p2.dtype),
+            jax.ShapeDtypeStruct((R, _ADAM_LANE), m2.dtype),
+            jax.ShapeDtypeStruct((R, _ADAM_LANE), v2.dtype),
+        ],
+        input_output_aliases={0: 0, 1: 1, 2: 2},
+        interpret=interpret,
+    )(p2, m2, v2, g2, lr2)
+    return po.reshape(shape), mo.reshape(shape), vo.reshape(shape)
+
+
+# --------------------------------------------------------------------------
+# kernel registry (tools/opbench.py --fused, parity matrix tests, docs)
+# --------------------------------------------------------------------------
+
+
+def _ln_example(dtype, rows=256, d=512, residual=True, rng_seed=0):
+    rng = np.random.RandomState(rng_seed)
+    x = jnp.asarray(rng.randn(rows, d), dtype)
+    res = jnp.asarray(rng.randn(rows, d), dtype) if residual else None
+    scale = jnp.asarray(rng.rand(d) + 0.5, jnp.float32)
+    bias = jnp.asarray(rng.randn(d) * 0.1, jnp.float32)
+    return (x, res, scale, bias)
+
+
+def _ln_reference(x, res, scale, bias, eps=1e-5):
+    r = x if res is None else x + res
+    rf = r.astype(jnp.float32)
+    mean = jnp.mean(rf, axis=-1, keepdims=True)
+    var = jnp.var(rf, axis=-1, keepdims=True)
+    y = (rf - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _epilogue_example(dtype, n=8, c=64, hw=196, rng_seed=0):
+    rng = np.random.RandomState(rng_seed)
+    x = jnp.asarray(rng.randn(n, c, hw), dtype)
+    mul = jnp.asarray(rng.rand(c) + 0.5, jnp.float32)
+    add = jnp.asarray(rng.randn(c) * 0.1, jnp.float32)
+    return (x, mul, add)
+
+
+def _epilogue_reference(x, mul, add, relu=True):
+    shp = (1, -1) + (1,) * (x.ndim - 2)
+    y = x.astype(jnp.float32) * mul.reshape(shp) + add.reshape(shp)
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x.dtype)
+
+
+def _adam_example(dtype, shape=(512, 256), rng_seed=0):
+    rng = np.random.RandomState(rng_seed)
+    p = jnp.asarray(rng.randn(*shape), dtype)
+    g = jnp.asarray(rng.randn(*shape) * 0.01, dtype)
+    m = jnp.asarray(rng.randn(*shape) * 0.001, jnp.float32)
+    v = jnp.asarray(rng.rand(*shape) * 1e-4, jnp.float32)
+    return (p, g, m, v)
+
+
+def _adam_reference(p, g, m, v, lr_t=1e-3, beta1=0.9, beta2=0.999, eps=1e-8):
+    gf = g.astype(jnp.float32)
+    m2 = beta1 * m + (1.0 - beta1) * gf
+    v2 = beta2 * v + (1.0 - beta2) * jnp.square(gf)
+    p2 = (p.astype(jnp.float32) - lr_t * m2 / (jnp.sqrt(v2) + eps)).astype(p.dtype)
+    return p2, m2, v2
+
+
+# name -> {fused, reference, example, tol}: `fused`/`reference` take the
+# example tuple; tolerances are per-dtype (bf16 carries its 8-bit mantissa).
+FUSED_KERNELS: Dict[str, dict] = {
+    "ln_residual": {
+        "fused": lambda args, interpret=False: fused_ln_residual(
+            args[0], args[1], args[2], args[3], 1e-5, interpret),
+        "reference": lambda args: _ln_reference(*args),
+        "example": _ln_example,
+        "tol": {"float32": 2e-5, "bfloat16": 5e-2},
+        "grad_argnums": (0, 1, 2, 3),
+    },
+    "bn_scale_shift_relu": {
+        "fused": lambda args, interpret=False: bn_epilogue(
+            args[0], args[1], args[2], True, interpret),
+        "reference": lambda args: _epilogue_reference(*args, relu=True),
+        "example": _epilogue_example,
+        "tol": {"float32": 2e-5, "bfloat16": 2e-2},
+        "grad_argnums": (0, 1, 2),
+    },
+    "adam_slab": {
+        "fused": lambda args, interpret=False: fused_adam(
+            args[0], args[1], args[2], args[3], 1e-3, 0.9, 0.999, 1e-8,
+            interpret),
+        "reference": lambda args: _adam_reference(*args),
+        "example": _adam_example,
+        "tol": {"float32": 2e-6, "bfloat16": 1e-2},
+        "grad_argnums": (),  # state update, not a differentiable layer
+    },
+}
+
+
+def registered_fused_kernels():
+    return sorted(FUSED_KERNELS)

@@ -48,6 +48,8 @@ class CompiledProgram:
         self.loss_name = None
         self.batch_axis = "dp"
         self.local_sgd_every = 0
+        self.grad_overlap_mode = None  # None | "bucketed" | "serial"
+        self.grad_overlap_bucket_mb = 0.0
 
     def with_data_parallel(
         self,
@@ -79,6 +81,45 @@ class CompiledProgram:
         self.batch_axis = batch_axis
         return self
 
+    def with_grad_overlap(self, bucket_mb: Optional[float] = None,
+                          mode: str = "bucketed") -> "CompiledProgram":
+        """Backward-overlapped data-parallel gradient all-reduce (the
+        PyTorch-DDP bucketing strategy, TPU-native): instead of GSPMD's
+        derived collectives, the step runs as a manual per-shard region in
+        which gradients are MEAN-all-reduced in size-capped buckets, issued
+        in reverse-topological order as backward produces them — XLA's
+        latency-hiding scheduler overlaps each bucket's collective with the
+        rest of the backward pass; the only barrier left at the optimizer
+        boundary is the final (smallest) bucket.
+
+        mode="serial" keeps ONE flat all-reduce after the whole backward —
+        the A/B baseline `bench.py --overlap` compares against; both modes
+        are element-wise identical (bucketing never changes what each grad
+        element is summed with), so final params stay bit-identical.
+
+        DDP semantics ride along: dropout masks and BN batch stats are
+        per-shard (the reference's multi-device behavior), unlike GSPMD's
+        global-batch semantics.  bucket_mb defaults to FLAGS_dp_bucket_mb.
+        Requires with_data_parallel/with_mesh first; composes with
+        steps>1 scans, not with with_local_sgd (no per-step grads to sync
+        in a LocalSGD round)."""
+        if mode not in ("bucketed", "serial"):
+            raise ValueError(f"with_grad_overlap: unknown mode {mode!r}")
+        if self.local_sgd_every:
+            raise ValueError(
+                "with_grad_overlap does not compose with with_local_sgd: "
+                "LocalSGD rounds deliberately run collective-free steps")
+        if bucket_mb is None:
+            from ..flags import flag
+
+            bucket_mb = float(flag("FLAGS_dp_bucket_mb"))
+        if bucket_mb <= 0:
+            raise ValueError(f"with_grad_overlap: bucket_mb must be > 0, "
+                             f"got {bucket_mb}")
+        self.grad_overlap_mode = mode
+        self.grad_overlap_bucket_mb = float(bucket_mb)
+        return self
+
     def with_local_sgd(self, sync_every: int = 4) -> "CompiledProgram":
         """LocalSGD mode (reference transpiler/collective.py:249 +
         DistributedStrategy.use_local_sgd): each dp worker runs `sync_every`
@@ -90,6 +131,10 @@ class CompiledProgram:
         per-sample outputs run a separate (non-LocalSGD) eval dispatch."""
         if sync_every < 1:
             raise ValueError(f"with_local_sgd: sync_every must be >= 1, got {sync_every}")
+        if self.grad_overlap_mode:
+            raise ValueError(
+                "with_local_sgd does not compose with with_grad_overlap: "
+                "LocalSGD rounds deliberately run collective-free steps")
         self.local_sgd_every = int(sync_every)
         return self
 

@@ -1,0 +1,94 @@
+"""Worker process for the bench.py --overlap A/B (ISSUE 7): trains the same
+seeded MLP under one grad-sync arm (GRAD_SYNC_MODE = gspmd | serial |
+bucketed) and prints RESULT json — wall time over the timed steps plus the
+final-params sha, so the parent can assert the bucketed arm beats the
+serial baseline at bit-identical final params."""
+import hashlib
+import json
+import os
+import sys
+import time
+
+# must be set before jax import
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=1").strip()
+
+import numpy as np  # noqa: E402
+
+
+def build_model(d_in=64, width=256, depth=3):
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = main.random_seed = 90
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [d_in], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h = x
+        for _ in range(depth):
+            h = fluid.layers.fc(h, width, act="relu")
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(h, 1), y))
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel import distributed as dist
+
+    mode = os.environ.get("GRAD_SYNC_MODE", "gspmd")
+    steps = int(os.environ.get("RUN_STEPS", "16"))
+    warm = int(os.environ.get("WARM_STEPS", "4"))
+    bucket_mb = float(os.environ.get("BUCKET_MB", "0.25"))
+    batch = int(os.environ.get("BATCH_SIZE", "64"))
+    width = int(os.environ.get("MODEL_WIDTH", "256"))
+    depth = int(os.environ.get("MODEL_DEPTH", "3"))
+
+    dist.init_distributed()  # PADDLE_TRAINER_* env contract
+    tid = dist.trainer_id()
+    nproc = dist.num_trainers()
+    mesh = dist.global_mesh()
+    n_dp = mesh.devices.size
+
+    prog, startup, loss = build_model(width=width, depth=depth)
+    compiled = fluid.CompiledProgram(prog).with_mesh(mesh)
+    if mode != "gspmd":
+        compiled = compiled.with_grad_overlap(bucket_mb=bucket_mb, mode=mode)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup, scope=scope)
+
+    rng = np.random.RandomState(1234)  # same global stream in every worker
+    per = batch // nproc
+    losses = []
+    wall = 0.0
+    for step in range(warm + steps):
+        xg = rng.rand(batch, 64).astype("f4")
+        yg = xg.sum(1, keepdims=True) * 0.1
+        xl = xg[tid * per:(tid + 1) * per]
+        yl = yg[tid * per:(tid + 1) * per]
+        if step == warm:
+            t0 = time.perf_counter()
+        (lv,) = exe.run(compiled, feed={"x": xl, "y": yl},
+                        fetch_list=[loss], scope=scope)
+        lv = float(np.asarray(lv).reshape(-1)[0])
+        if step >= warm:
+            losses.append(lv)
+    wall = time.perf_counter() - t0
+
+    h = hashlib.sha256()
+    for p in sorted(pp.name for pp in prog.all_parameters()):
+        h.update(np.asarray(scope.find_var(p)).tobytes())
+    print("RESULT " + json.dumps({
+        "trainer": tid, "mode": mode, "n_dp": int(n_dp), "steps": steps,
+        "wall_s": round(wall, 4), "steps_per_sec": round(steps / wall, 3),
+        "first_loss": round(losses[0], 6), "last_loss": round(losses[-1], 6),
+        "params_sha": h.hexdigest(),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
