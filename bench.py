@@ -104,6 +104,28 @@ def _gang_results(res):
     return recs
 
 
+def _gang_skew(res):
+    """Embed the gang's cross-rank skew record (ISSUE 8): the workers
+    streamed rank-tagged step records into run_gang's telemetry dir, so
+    tools/trace_merge.py can correlate them and name the round's
+    straggler.  {} when fewer than two ranks left telemetry (e.g. a rank
+    died before its first step) — `perf_report --check-bench` gates the
+    fields only when present."""
+    try:
+        from tools.trace_merge import skew_from_dir
+
+        rep = skew_from_dir(res.telemetry_dir) if res.telemetry_dir else None
+    except Exception:
+        rep = None
+    if not rep or not rep.get("steps_correlated"):
+        return {}
+    out = {"step_skew_frac": rep.get("mean_skew_frac"),
+           "max_step_skew_frac": rep.get("max_skew_frac"),
+           "skew_steps_correlated": rep.get("steps_correlated"),
+           "straggler_rank": rep.get("straggler", {}).get("rank")}
+    return {k: v for k, v in out.items() if v is not None}
+
+
 def bench_resnet50(batch_size=128, K=16, iters=4):
     # bs128/K=16 interleaved-A/B'd vs bs256/K8 and bs64/K32: 2573 vs 2445
     # vs 2351 imgs/s — the r4 "bs256 wins" result predates the single-pass
@@ -607,7 +629,8 @@ def bench_overlap(steps=16, n_procs=2, bucket_mb=4.0, batch_size=256,
         wall = max(r["wall_s"] for r in recs)
         return {"steps_per_sec": round(steps / wall, 3),
                 "wall_s": round(wall, 4), "params_sha": shas.pop(),
-                "last_loss": recs[0]["last_loss"]}
+                "last_loss": recs[0]["last_loss"],
+                "skew": _gang_skew(res)}
 
     arms = {m: one(m) for m in ("serial", "bucketed", "gspmd")}
     parity = arms["serial"]["params_sha"] == arms["bucketed"]["params_sha"]
@@ -626,6 +649,9 @@ def bench_overlap(steps=16, n_procs=2, bucket_mb=4.0, batch_size=256,
             "overlap_confirmed": bool(speedup > 1.0),
             "bit_parity_serial_vs_bucketed": bool(parity),
             "last_loss": arms["bucketed"]["last_loss"],
+            # the bucketed arm's cross-rank skew record (trace_merge over
+            # the gang's telemetry) — perf_report --check-bench gates it
+            **arms["bucketed"].get("skew", {}),
             "n_procs": n_procs, "steps": steps, "bucket_mb": bucket_mb,
             "batch_size": batch_size}
 
@@ -686,6 +712,10 @@ def bench_chaos_dist(fault_spec, steps=12, n_procs=2, save_every=3,
             "incarnations": chaos_res.incarnations,
             "worker_deaths": [d for i in chaos_res.incidents
                               for d in i.get("dead", [])],
+            # cross-rank skew over the CLEAN gang's telemetry (the chaos
+            # arm's skew measures the injected fault, not the gang)
+            **_gang_skew(clean_res),
+            "telemetry_dir": chaos_res.telemetry_dir,
             "bit_parity_vs_clean": parity}
 
 

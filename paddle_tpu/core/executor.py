@@ -142,6 +142,33 @@ class _CompiledStep:
         # entry cache jit provided); the signature is only computed on the
         # miss path, never in steady state.
         self.program_uuid = program._uuid[:8]
+        # cross-rank correlation key (ISSUE 8): every rank compiling this
+        # (program, mesh) pair derives the same digest, so
+        # tools/trace_merge.py can line up "the same collective-bearing
+        # step" across per-rank telemetry streams by (csig, step number).
+        # RANK-INVARIANT by construction: built from the program's
+        # structure (op types + arg names — identical when every rank
+        # built the same program, which the collective-order lint already
+        # demands), its static collective_signature, and the mesh shape —
+        # never from per-process identities like program._uuid.  None
+        # off-mesh: nothing to correlate.
+        self.csig = None
+        if mesh is not None:
+            try:
+                import hashlib
+
+                from .analysis import collective_signature
+
+                structure = tuple(
+                    (op.type, tuple(op.input_arg_names),
+                     tuple(op.output_arg_names))
+                    for blk in program.blocks for op in blk.ops)
+                self.csig = hashlib.sha1(
+                    repr((structure, collective_signature(program),
+                          tuple(sorted(dict(mesh.shape).items())))).encode()
+                ).hexdigest()[:8]
+            except Exception:
+                self.csig = None
         self._exec = None
         self._exec_by_sig: Dict[tuple, object] = {}
         self.last_lower_s = 0.0
@@ -1134,6 +1161,12 @@ class Executor:
             u8 = program._uuid[:8]
             feed_bytes = int(sum(getattr(v, "nbytes", 0) for v in jfeeds.values()))
             _MON.counter("executor.feed_bytes").inc(feed_bytes)
+            # dispatch-attempt census BEFORE the (possibly collective-
+            # blocking) dispatch: the heartbeat's beat payload reads this,
+            # and it is what makes a slow-but-alive rank's lag visible
+            # while its peers sit blocked inside the collective
+            _MON.counter("executor.steps_started").inc()
+            ts_dispatch = time.time()
             t_run0 = time.perf_counter()
         # dispatch is watchdog-guarded: on backends whose dispatch blocks
         # (CPU/gloo cross-process collectives), a dead peer wedges the
@@ -1156,7 +1189,7 @@ class Executor:
                                       host_plan, feed, scope,
                                       program._uuid[:8])
             if mon_on:
-                _MON.record_step({
+                rec = {
                     "program": u8,
                     "steps": steps,
                     "async": True,
@@ -1168,8 +1201,12 @@ class Executor:
                     "t_lower_s": compiled.last_lower_s if compiled.last_recompiled else 0.0,
                     "t_compile_s": compiled.last_compile_s if compiled.last_recompiled else 0.0,
                     "t_dispatch_s": t_dispatch,
+                    "ts_dispatch": ts_dispatch,
                     "feed_bytes": feed_bytes,
-                })
+                }
+                if compiled.csig is not None:
+                    rec["csig"] = compiled.csig
+                _MON.record_step(rec)
             return [FetchHandle(pending, i, n)
                     for i, n in enumerate(pending.want_names)]
         if mon_on:
@@ -1197,7 +1234,8 @@ class Executor:
         _MON.observe("executor.fetch", t_fetch, program=u8)
         t_total = time.perf_counter() - t_run0
         _MON.observe(f"executor.run[{u8}]", t_total)
-        _MON.record_step({
+        _MON.gauge("executor.last_step_s").set(t_execute)
+        rec = {
             "program": u8,
             "steps": steps,
             "cache_hit": cache_hit,
@@ -1211,8 +1249,12 @@ class Executor:
             "t_execute_s": t_execute,
             "t_fetch_s": t_fetch,
             "t_total_s": t_total,
+            "ts_dispatch": ts_dispatch,
             "feed_bytes": feed_bytes,
-        })
+        }
+        if compiled.csig is not None:
+            rec["csig"] = compiled.csig
+        _MON.record_step(rec)
         return out
 
     @staticmethod

@@ -71,17 +71,25 @@ _STRUCTURAL_OPS = ("feed", "fetch", "backward")
 
 
 def run_ops(ctx: LoweringContext, ops: List[Operator], env: Dict[str, Any]) -> Dict[str, Any]:
-    """Interpret `ops` over `env` (var name -> traced jax value), in order."""
+    """Interpret `ops` over `env` (var name -> traced jax value), in order.
+
+    Op-level provenance (ISSUE 8): each op's emission is wrapped in
+    `jax.named_scope("op<idx>:<type>")`, so XLA op metadata — and with it
+    device profiles, HLO dumps, and the merged gang traces — maps every
+    fused region back to the ProgramDesc op(s) that produced it.  Pure
+    trace-time cost: the scope name lands in the jaxpr/HLO, nothing runs
+    per step."""
     # per-op lower counts run at TRACE time only (this loop is the trace),
     # so the monitor's per-program op census costs nothing at execution
     mon_on = _MON.enabled
-    for op in ops:
+    for idx, op in enumerate(ops):
         if op.type in _STRUCTURAL_OPS:
             raise RuntimeError(
                 f"structural op {op.type!r} reached the lowering interpreter; "
                 "the executor must handle it"
             )
-        lower_one(ctx, op, env)
+        with jax.named_scope(f"op{idx}:{op.type}"):
+            lower_one(ctx, op, env)
         if mon_on:
             _MON.counter("lowering.ops_total").inc()
             _MON.counter("lowering.op." + op.type).inc()

@@ -32,17 +32,33 @@ when the endpoint list names more than one worker) starts the heartbeat
 and installs the process-global watchdog; until then `guard_blocking`
 is a direct call and the executor hot path pays one `is None` branch.
 
+Telemetry plane (ISSUE 8): every beat carries a small payload sampled
+from the monitor — steps started/completed, steps/sec EMA, last step
+time, HBM in use — so each worker holds a live table of what its peers
+are doing.  That table powers **straggler detection**: a rank whose
+dispatch count lags the gang for `FLAGS_dist_straggler_lag_steps` steps
+across consecutive beats is named (`dist.straggler_suspects` counter,
+`dist.step_skew_frac` / `dist.straggler_rank` gauges, one
+`kind="dist_event" action="straggler"` record) BEFORE any watchdog
+deadline fires — slow-but-alive is visible, not just dead.  Watchdog and
+peer-failure reports attach the offender's last telemetry snapshot, and
+both trigger a flight-recorder dump (monitor.dump_blackbox) so the last
+N steps before the failure survive as `BLACKBOX.p<rank>.json`.
+
 Monitor surface: `dist.heartbeat.sent / observed / missed`,
-`dist.peer_failures`, `dist.collective_timeouts`, `dist.stack_dumps`
-counters, `dist.alive_workers` gauge, and one `kind="dist_event"`
-record per transition (rendered + CI-gated by `tools/perf_report.py
---check --max-heartbeat-miss-frac`).
+`dist.peer_failures`, `dist.collective_timeouts`, `dist.stack_dumps`,
+`dist.straggler_suspects` counters, `dist.alive_workers` /
+`dist.step_skew_frac` / `dist.straggler_rank` gauges, and one
+`kind="dist_event"` record per transition (rendered + CI-gated by
+`tools/perf_report.py --check --max-heartbeat-miss-frac /
+--max-step-skew-frac`).
 """
 from __future__ import annotations
 
 __all__ = ["HeartbeatConfig", "Heartbeat", "CollectiveWatchdog",
            "init_health", "shutdown_health", "active_watchdog",
            "active_heartbeat", "guard_blocking", "dump_stacks",
+           "local_telemetry",
            "EXIT_PEER_FAILURE", "EXIT_COLLECTIVE_TIMEOUT"]
 
 import json
@@ -106,27 +122,39 @@ class _FileTransport:
     def _path(self, rank: int) -> str:
         return os.path.join(self.root, f"hb-{rank}")
 
-    def send(self, seq: int):
+    def send(self, seq: int, payload: Optional[dict] = None):
         tmp = self._path(self.rank) + ".tmp"
         with open(tmp, "w") as f:
-            f.write(str(seq))
+            if payload:
+                f.write(json.dumps({"seq": seq, "tel": payload}))
+            else:
+                f.write(str(seq))
         os.replace(tmp, self._path(self.rank))
 
-    def poll(self) -> Dict[int, int]:
-        """{peer rank: latest sequence seen} for every peer with a beat
-        on disk.  A DOWN-<rank> tombstone reports as seq -1 (explicitly
-        dead, no staleness wait needed)."""
+    def poll(self) -> Dict[int, tuple]:
+        """{peer rank: (latest sequence seen, telemetry payload or None)}
+        for every peer with a beat on disk.  A DOWN-<rank> tombstone
+        reports as seq -1 (explicitly dead, no staleness wait needed).
+        Plain-integer beat files (pre-telemetry writers) still parse."""
         out = {}
         for r in range(self.world):
             if r == self.rank:
                 continue
             if os.path.exists(os.path.join(self.root, f"DOWN-{r}")):
-                out[r] = -1
+                out[r] = (-1, None)
                 continue
             try:
                 with open(self._path(r)) as f:
-                    out[r] = int(f.read().strip() or 0)
-            except (OSError, ValueError):
+                    raw = f.read().strip() or "0"
+            except OSError:
+                continue
+            try:
+                if raw.startswith("{"):
+                    doc = json.loads(raw)
+                    out[r] = (int(doc["seq"]), doc.get("tel"))
+                else:
+                    out[r] = (int(raw), None)
+            except (ValueError, KeyError, TypeError):
                 continue
         return out
 
@@ -163,6 +191,7 @@ class _UdpTransport:
         self._sock.bind(self._peers[rank][1])
         self._sock.settimeout(0.05)
         self._latest: Dict[int, int] = {}
+        self._tel: Dict[int, dict] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._rx = threading.Thread(target=self._recv_loop,
@@ -172,7 +201,11 @@ class _UdpTransport:
     def _recv_loop(self):
         while not self._stop.is_set():
             try:
-                data, _ = self._sock.recvfrom(256)
+                # 64KB, not a small fixed cap: beats carry a telemetry
+                # payload now, and recvfrom TRUNCATES an oversized
+                # datagram — every beat from a chatty telemetry_fn would
+                # fail json parsing and read as the sender going stale
+                data, _ = self._sock.recvfrom(65536)
             except socket.timeout:
                 continue
             except OSError:
@@ -187,26 +220,37 @@ class _UdpTransport:
                 continue
             if r == self.rank:
                 continue
+            tel = msg.get("tel") if isinstance(msg, dict) else None
             with self._lock:
                 prev = self._latest.get(r)
                 if prev == -1:
                     continue  # tombstoned: a reordered late beat must not
                     # resurrect the peer (UDP gives no ordering)
                 self._latest[r] = -1 if seq == -1 else max(prev or 0, seq)
+                # telemetry only from an ADVANCING seq: a reordered late
+                # datagram must not roll a peer's step count backwards
+                # (stale lag would read as straggling)
+                if (isinstance(tel, dict) and seq != -1
+                        and seq > (prev or 0)):
+                    self._tel[r] = tel
 
-    def send(self, seq: int):
-        payload = json.dumps({"rank": self.rank, "seq": seq}).encode()
+    def send(self, seq: int, payload: Optional[dict] = None):
+        msg = {"rank": self.rank, "seq": seq}
+        if payload:
+            msg["tel"] = payload
+        data = json.dumps(msg).encode()
         for r, addr in self._peers:
             if r == self.rank:
                 continue
             try:
-                self._sock.sendto(payload, addr)
+                self._sock.sendto(data, addr)
             except OSError:
                 pass
 
-    def poll(self) -> Dict[int, int]:
+    def poll(self) -> Dict[int, tuple]:
         with self._lock:
-            return dict(self._latest)
+            return {r: (seq, self._tel.get(r))
+                    for r, seq in self._latest.items()}
 
     def mark_down(self):
         self.send(-1)
@@ -219,18 +263,48 @@ class _UdpTransport:
             pass
 
 
+def local_telemetry() -> dict:
+    """This worker's per-beat telemetry payload, sampled from the monitor:
+    dispatch attempts (`step` — incremented BEFORE the blocking collective,
+    so a rank stalled ahead of its dispatch lags visibly while its peers
+    sit blocked inside theirs), completed steps, the steps/sec EMA, the
+    last measured step time, and HBM in use.  Cheap: counter/gauge reads
+    plus one PJRT memory_stats query."""
+    tel = {
+        "step": int(_MON.counter("executor.steps_started").value),
+        "done": int(_MON.counter("executor.steps").value),
+        "sps": round(float(_MON.gauge("executor.steps_per_sec_ema").value), 4),
+    }
+    t_step = float(_MON.gauge("executor.last_step_s").value) or \
+        float(_MON.gauge("pipeline.last_step_wall_s").value)
+    if t_step:
+        tel["t_step_s"] = round(t_step, 6)
+    try:
+        hbm = _MON.gauge("memory.device_bytes_in_use").read()
+        if hbm == hbm:  # not NaN (XLA:CPU exposes no memory_stats)
+            tel["hbm_mb"] = round(hbm / 1e6, 1)
+    except Exception:
+        pass
+    return tel
+
+
 class Heartbeat:
     """One beat thread + peer observation table.
 
     `dead_peers()` is the liveness oracle the watchdog consults: a peer is
     dead when (a) it sent an explicit tombstone, or (b) its sequence has
     not advanced for `config.deadline_s` seconds of LOCAL monotonic time,
-    or (c) it was never observed at all past `startup_grace_s`."""
+    or (c) it was never observed at all past `startup_grace_s`.
+
+    Each beat also publishes `local_telemetry()` and folds peers' payloads
+    into an observation table (`telemetry()`), from which the beat thread
+    runs the straggler check: see `_straggler_check`."""
 
     def __init__(self, rank: int, world: int,
                  endpoints: Optional[Sequence[str]] = None,
                  config: Optional[HeartbeatConfig] = None,
-                 hb_dir: Optional[str] = None):
+                 hb_dir: Optional[str] = None,
+                 telemetry_fn: Optional[Callable[[], dict]] = None):
         self.rank = rank
         self.world = world
         self.config = config or HeartbeatConfig.from_flags()
@@ -253,6 +327,15 @@ class Heartbeat:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # telemetry plane: peers' latest beat payloads + straggler episode
+        # state (suspect (rank, step) pair, consecutive sightings)
+        self.telemetry_fn = telemetry_fn if telemetry_fn is not None \
+            else local_telemetry
+        self._peer_tel: Dict[int, dict] = {}
+        self._my_tel: Optional[dict] = None  # payload sent with my last beat
+        self._straggler: Optional[tuple] = None
+        self._straggler_seen = 0
+        self._straggler_reported: Optional[int] = None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "Heartbeat":
@@ -267,9 +350,21 @@ class Heartbeat:
     def _loop(self):
         while not self._stop.wait(self.config.interval_s):
             self._seq += 1
-            self.transport.send(self._seq)
+            try:
+                payload = self.telemetry_fn()
+            except Exception:
+                payload = None
+            self._my_tel = payload  # beat-epoch snapshot of SELF: the
+            # straggler check compares it against peers' equally-stale
+            # beat payloads (a LIVE local read vs stale peers fakes
+            # sps*interval steps of lag on any fast-stepping gang)
+            self.transport.send(self._seq, payload)
             _MON.counter("dist.heartbeat.sent").inc()
             self.observe()
+            try:
+                self._straggler_check()
+            except Exception:
+                pass  # telemetry must never kill the liveness thread
 
     def stop(self, mark_down: bool = False):
         self._stop.set()
@@ -295,7 +390,7 @@ class Heartbeat:
             polled = {}
         ages = {}
         with self._lock:
-            for r, seq in polled.items():
+            for r, (seq, tel) in polled.items():
                 prev = self._observed.get(r)
                 if prev is not None and prev[0] == -1:
                     continue  # tombstones are final: no resurrection
@@ -303,6 +398,8 @@ class Heartbeat:
                     self._observed[r] = (-1, now)
                 elif prev is None or seq > prev[0]:
                     self._observed[r] = (seq, now)
+                    if isinstance(tel, dict):
+                        self._peer_tel[r] = tel
                     _MON.counter("dist.heartbeat.observed").inc()
             for r, (seq, at) in self._observed.items():
                 ages[r] = 0.0 if seq == -1 else now - at
@@ -318,6 +415,89 @@ class Heartbeat:
         with self._lock:
             return {r: seq for r, (seq, _at) in self._observed.items()
                     if seq != -1}
+
+    def telemetry(self) -> Dict[int, dict]:
+        """{rank: latest beat payload} for every rank INCLUDING this one
+        (peers from their observed beats, self from the payload sent with
+        the last beat, falling back to a live `telemetry_fn` sample).
+        Tombstoned peers keep their last payload — that final snapshot is
+        exactly what a peer-failure report wants to show."""
+        mine = self._my_tel
+        if mine is None:
+            try:
+                mine = self.telemetry_fn()
+            except Exception:
+                mine = {}
+        with self._lock:
+            out = {r: dict(t) for r, t in self._peer_tel.items()}
+        out[self.rank] = dict(mine) if mine else {}
+        return out
+
+    def _straggler_check(self):
+        """Name a slow-but-ALIVE rank before any watchdog fires.
+
+        Signal: the dispatch-attempt counter each beat carries
+        (`executor.steps_started`, incremented before the blocking
+        collective).  In lock-step sync training the fast ranks enter
+        dispatch for step S and block there, while a straggler is still
+        grinding toward its own dispatch — so a sustained positive lag of
+        even one step is real skew, bounded only by how far ahead the
+        gang can run (1 for sync collectives).
+
+        Two guards keep the detector honest:
+
+          * every rank is compared at BEAT epoch — self from the payload
+            sent with the last beat, peers from their observed beats.
+            Comparing a live local counter against peers' beat-stale
+            payloads reads `sps * interval` phantom steps of lag into any
+            gang that steps faster than it beats.
+          * the suspect must hold the minimum at the SAME reported step
+            for `lag >= FLAGS_dist_straggler_lag_steps` across 3
+            consecutive beats.  A genuinely stuck rank reports a frozen
+            step; a healthy fast gang's momentary minimum advances every
+            beat, so sampling jitter can never accumulate sightings."""
+        if self.world < 2:
+            return
+        from .flags import flag as _flag
+
+        tel = self.telemetry()
+        with self._lock:
+            dead = set(self._reported_dead)
+        steps = {r: t.get("step") for r, t in tel.items()
+                 if r not in dead and isinstance(t.get("step"), (int, float))}
+        lag = 0.0
+        laggard = None
+        if len(steps) >= 2 and max(steps.values()) > 0:
+            lo = min(steps.values())
+            lag = float(max(steps.values()) - lo)
+            laggard = min(r for r, s in steps.items() if s == lo)
+        _MON.gauge("dist.step_skew_frac").set(lag)
+        threshold = float(_flag("FLAGS_dist_straggler_lag_steps"))
+        if laggard is None or lag < threshold:
+            self._straggler = None
+            self._straggler_seen = 0
+            self._straggler_reported = None
+            _MON.gauge("dist.straggler_rank").set(-1)
+            return
+        suspect = (laggard, steps[laggard])  # rank AND its frozen step
+        if suspect != self._straggler:
+            self._straggler = suspect
+            self._straggler_seen = 1
+            return
+        self._straggler_seen += 1
+        if self._straggler_seen < 3 or self._straggler_reported == laggard:
+            return
+        self._straggler_reported = laggard
+        behind_s = lag / tel.get(laggard, {}).get("sps", 0.0) \
+            if tel.get(laggard, {}).get("sps") else None
+        _MON.counter("dist.straggler_suspects").inc()
+        _MON.gauge("dist.straggler_rank").set(laggard)
+        _MON.record_step({
+            "kind": "dist_event", "action": "straggler", "rank": laggard,
+            "observer": self.rank, "lag_steps": lag, "skew_frac": lag,
+            "behind_s": round(behind_s, 3) if behind_s else None,
+            "telemetry": tel.get(laggard),
+        })
 
     def dead_peers(self) -> List[int]:
         ages = self.observe()
@@ -359,6 +539,10 @@ def dump_stacks(reason: str, file=None) -> str:
     for tid, frame in frames.items():
         parts.append(f"-- thread {names.get(tid, '?')} ({tid}) --")
         parts.append("".join(traceback.format_stack(frame)).rstrip())
+    # trailing marker: incident records keep only a bounded stderr TAIL,
+    # and the dump must stay identifiable even when the header scrolls
+    # out of the kept window
+    parts.append(f"==== end stack dump: {reason} ====")
     text = "\n".join(parts)
     print(text, file=file or sys.stderr, flush=True)
     _MON.counter("dist.stack_dumps").inc()
@@ -402,26 +586,36 @@ class CollectiveWatchdog:
     def _peer_failure(self, dead: List[int], what: str,
                       cause: Optional[BaseException] = None):
         dump_stacks(f"peer(s) {dead} dead during {what}")
+        # the offenders' last beat payloads: what each dead rank was doing
+        # (step, rate, HBM) the last time anyone heard from it
+        tel = self.heartbeat.telemetry() if self.heartbeat is not None else {}
+        offender_tel = {r: tel.get(r) for r in dead}
         _MON.counter("dist.peer_failures").inc()
         _MON.record_step({"kind": "dist_event", "action": "peer_failure",
-                          "peers": dead, "what": what, "rank": self.rank})
+                          "peers": dead, "what": what, "rank": self.rank,
+                          "telemetry": offender_tel})
+        _MON.dump_blackbox("peer_failure")
         raise PeerFailureError(
             f"peer worker(s) {dead} stopped heartbeating during {what}; "
-            f"this collective can never complete — exiting for gang restart",
+            f"this collective can never complete — exiting for gang "
+            f"restart (last telemetry: {offender_tel})",
             rank=self.rank, peers=dead, collective=what,
             phase="collective") from cause
 
     def _timeout(self, what: str, waited: float):
         dump_stacks(f"{what} exceeded watchdog deadline "
                     f"({waited:.1f}s > {self.timeout_s:.1f}s)")
+        tel = self.heartbeat.telemetry() if self.heartbeat is not None else {}
         _MON.counter("dist.collective_timeouts").inc()
         _MON.record_step({"kind": "dist_event", "action": "collective_timeout",
                           "what": what, "waited_s": round(waited, 3),
-                          "rank": self.rank})
+                          "rank": self.rank, "telemetry": tel})
+        _MON.dump_blackbox("watchdog_timeout")
         raise CollectiveTimeoutError(
             f"{what} did not complete within the {self.timeout_s:.1f}s "
             f"watchdog deadline (every peer still heartbeating — "
-            f"deadlocked collective or pathological straggler)",
+            f"deadlocked collective or pathological straggler; gang "
+            f"telemetry: {tel})",
             rank=self.rank, collective=what, phase="collective")
 
     def run(self, fn: Callable, what: str = "collective",

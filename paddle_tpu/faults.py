@@ -344,6 +344,10 @@ class FaultInjector:
         if f is not None:
             print(f"faults: kill_worker@{step}:{self.rank} firing (SIGKILL)",
                   file=sys.stderr, flush=True)
+            # the victim's own last words: dump the flight recorder BEFORE
+            # the SIGKILL (fsynced, so the black box survives the kill) —
+            # this is the only record a hard-killed rank ever leaves
+            _MON.dump_blackbox(f"kill_worker@{step}:{self.rank}")
             os.kill(os.getpid(), signal.SIGKILL)
         f = self._take("stall_worker", step)
         if f is not None:

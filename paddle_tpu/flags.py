@@ -149,6 +149,17 @@ DEFINE_float("FLAGS_dist_heartbeat_miss_factor", 10.0,
              "collective hang forever.  Keep the product in whole seconds: "
              "a beat thread can starve behind GIL-heavy import/compile "
              "phases, and a too-tight deadline reads starvation as death")
+DEFINE_float("FLAGS_dist_straggler_lag_steps", 1.0,
+             "live straggler detection (paddle_tpu/dist_resilience.py): a "
+             "rank whose dispatch-attempt count lags the gang by at least "
+             "this many steps across 3 consecutive heartbeats is named a "
+             "straggler (dist.straggler_suspects counter, "
+             "dist.step_skew_frac gauge, one 'straggler' dist_event) "
+             "before any watchdog deadline fires.  Sync collectives bound "
+             "the observable lag at ~1 (fast ranks block inside the "
+             "collective), so 1.0 with the 3-beat hold-down is the "
+             "sensitive-but-quiet default; raise it on pipelined meshes "
+             "that legitimately run ranks ahead")
 DEFINE_float("FLAGS_dist_watchdog_timeout_s", 120.0,
              "deadline armed around every collective/blocking device wait "
              "when the distributed health layer is active; on expiry all "

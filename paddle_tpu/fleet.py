@@ -98,6 +98,13 @@ class Fleet:
         # (monitor.merge_chrome_traces) show one row per worker
         _MON.set_lane(self._role.worker_index(),
                       f"trainer{self._role.worker_index()}")
+        # telemetry plane (ISSUE 8): when the gang supervisor assigned a
+        # rank-shared telemetry dir (PADDLE_TELEMETRY_DIR), stream this
+        # worker's rank-stamped metrics there and arm the flight recorder;
+        # a no-op outside a telemetry-armed gang
+        from .monitor import init_worker_telemetry as _init_tel
+
+        _init_tel(rank=self._role.worker_index())
         _MON.gauge("fleet.worker_num").set(self._role.worker_num())
         if len(eps) > 1:
             from . import dist_resilience as _dres

@@ -41,6 +41,15 @@ Monitor surface: the launcher process emits `dist.gang_restarts` /
 incident (`action="gang_restart"` / `"worker_death"` / `"gang_failed"`),
 written to `--metrics` as JSONL — the file `tools/perf_report.py --check
 --max-gang-restarts` gates in CI.
+
+Telemetry plane (ISSUE 8): every incarnation also gets a rank-shared
+telemetry directory (`--telemetry-root`, default under the checkpoint
+root), exported as `PADDLE_TELEMETRY_DIR`; each worker's `fleet.init`
+streams its rank-tagged metrics there and arms the flight recorder, the
+supervisor harvests `BLACKBOX.p<rank>.json` dumps into
+`INCIDENT.i<k>.json` ledgers across restarts, and `tools/trace_merge.py`
+/ `perf_report --postmortem` turn the directory into a merged timeline
+with straggler attribution.  See docs/observability.md §Debugging a gang.
 """
 from __future__ import annotations
 
@@ -55,6 +64,7 @@ import shutil
 import socket
 import subprocess
 import sys
+import tempfile
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
@@ -150,8 +160,6 @@ class Gang:
         self.endpoints: List[str] = []
 
     def __enter__(self) -> "Gang":
-        import tempfile
-
         self.base_port = allocate_port_block(self.n_procs)
         self.endpoints = [f"127.0.0.1:{self.base_port + i}"
                           for i in range(self.n_procs)]
@@ -263,6 +271,11 @@ class GangResult:
     workers: List[tuple] = field(default_factory=list)
     # one dict per death the supervisor observed across all incarnations
     incidents: List[dict] = field(default_factory=list)
+    # telemetry root: one i<k> dir per incarnation holding each rank's
+    # metrics.p<rank>.jsonl / BLACKBOX.p<rank>.json / trace.p<rank>.json,
+    # plus the supervisor's INCIDENT.i<k>.json files — the input of
+    # tools/trace_merge.py and perf_report --postmortem
+    telemetry_dir: Optional[str] = None
 
 
 def _clear_uncommitted(checkpoint_root: str):
@@ -283,6 +296,7 @@ def run_gang(argv: Sequence[str], n_procs: int, *,
              max_restarts: int = 2,
              checkpoint_root: Optional[str] = None,
              heartbeat_dir: Optional[str] = None,
+             telemetry_root: Optional[str] = None,
              timeout: float = 600,
              grace_s: float = 3.0,
              peer_grace_s: float = 15.0,
@@ -306,16 +320,26 @@ def run_gang(argv: Sequence[str], n_procs: int, *,
     # (kill_worker/stall_worker) record their firing here so a restarted
     # incarnation replaying the same step does not replay the fault
     if "PADDLE_FAULT_STATE_DIR" not in base_env:
-        import tempfile
-
         base_env["PADDLE_FAULT_STATE_DIR"] = (
             os.path.join(checkpoint_root, "fault-state") if checkpoint_root
             else tempfile.mkdtemp(prefix="pt-fault-state-"))
     os.makedirs(base_env["PADDLE_FAULT_STATE_DIR"], exist_ok=True)
+    # telemetry plane (ISSUE 8): one rank-shared directory per incarnation;
+    # workers (fleet.init -> monitor.init_worker_telemetry) stream their
+    # rank-stamped metrics there and dump BLACKBOX.p<rank>.json on death.
+    # Incarnation dirs are never cleared — a post-mortem wants the history.
+    if telemetry_root is None:
+        telemetry_root = (os.path.join(checkpoint_root, "telemetry")
+                          if checkpoint_root
+                          else tempfile.mkdtemp(prefix="pt-telemetry-"))
+    os.makedirs(telemetry_root, exist_ok=True)
+    result.telemetry_dir = telemetry_root
     for incarnation in range(max_restarts + 1):
         result.incarnations = incarnation + 1
         env = dict(base_env)
         env["PADDLE_RESTART_NUM"] = str(incarnation)
+        inc_tel = os.path.join(telemetry_root, f"i{incarnation}")
+        env["PADDLE_TELEMETRY_DIR"] = inc_tel
         hb = heartbeat_dir or (checkpoint_root and
                                os.path.join(checkpoint_root, "hb"))
         if hb:
@@ -355,6 +379,26 @@ def run_gang(argv: Sequence[str], n_procs: int, *,
             "stderr_tails": {r: (result.workers[r][2] or "")[-2000:]
                              for r in range(len(result.workers))},
         }
+        # harvest the incarnation's black boxes: every rank that managed a
+        # flight-recorder dump (injected kill, classified exit, crash hook)
+        # left BLACKBOX.p<rank>.json in its telemetry dir; the supervisor
+        # records the paths next to the death so `perf_report --postmortem
+        # <telemetry_root>` can merge them across restarts
+        try:
+            incident["blackboxes"] = sorted(
+                os.path.join(inc_tel, f) for f in os.listdir(inc_tel)
+                if f.startswith("BLACKBOX.p") and f.endswith(".json"))
+        except OSError:
+            incident["blackboxes"] = []
+        try:
+            import json as _json
+
+            with open(os.path.join(telemetry_root,
+                                   f"INCIDENT.i{incarnation}.json"),
+                      "w") as f:
+                _json.dump(incident, f, indent=1)
+        except OSError:
+            pass
         result.incidents.append(incident)
         _MON.counter("dist.worker_deaths").inc(max(len(dead), 1))
         _MON.record_step(incident)
@@ -395,6 +439,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="coordinated-checkpoint directory (also exported "
                          "as PADDLE_CHECKPOINT_ROOT to workers)")
     ap.add_argument("--timeout", type=float, default=600)
+    ap.add_argument("--telemetry-root", default=None,
+                    help="gang telemetry root (per-incarnation worker "
+                         "metrics/blackbox/trace dirs; default: "
+                         "<checkpoint-root>/telemetry or a temp dir) — the "
+                         "input of tools/trace_merge.py and perf_report "
+                         "--postmortem")
     ap.add_argument("--metrics", default=None,
                     help="JSONL file for the launcher's dist_event records "
                          "+ final counter snapshot (perf_report --check "
@@ -414,6 +464,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    devices_per_proc=ns.devices_per_proc,
                    max_restarts=ns.max_restarts,
                    checkpoint_root=ns.checkpoint_root,
+                   telemetry_root=ns.telemetry_root,
                    timeout=ns.timeout)
     for rank, (code, out, err) in enumerate(res.workers):
         sys.stdout.write(out or "")
@@ -426,7 +477,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         _monitor.get_monitor().detach_logger(logger)
     print(f"paddle_tpu.launch: {'ok' if res.ok else 'FAILED'} after "
-          f"{res.incarnations} incarnation(s), {res.restarts} restart(s)",
+          f"{res.incarnations} incarnation(s), {res.restarts} restart(s); "
+          f"telemetry in {res.telemetry_dir}",
           file=sys.stderr)
     return 0 if res.ok else 1
 

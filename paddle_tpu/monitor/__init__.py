@@ -28,10 +28,24 @@ See docs/observability.md.
 """
 from __future__ import annotations
 
-from .core import Counter, Gauge, Monitor, NULL_SPAN, Span  # noqa: F401
+from .core import (Counter, FLIGHT_RECORDER_CAP, Gauge, Monitor,  # noqa: F401
+                   NULL_SPAN, Span)
 from . import exporters as _exp
-from .exporters import MonitorLogger, prometheus_text, summary_table  # noqa: F401
+from .exporters import (MonitorLogger, escape_label_value,  # noqa: F401
+                        prometheus_text, summary_table)
 from .memstats import register_memory_gauges
+
+__all__ = [
+    "Counter", "Gauge", "Monitor", "MonitorLogger", "Span", "NULL_SPAN",
+    "FLIGHT_RECORDER_CAP", "MONITOR", "get_monitor", "enable", "disable",
+    "is_enabled", "reset", "span", "observe", "counter", "gauge",
+    "record_step", "step_records", "set_lane", "attach_logger",
+    "detach_logger", "export_prometheus", "export_json", "json_snapshot",
+    "export_chrome_trace", "merge_chrome_traces", "summary",
+    "prometheus_text", "escape_label_value", "arm_flight_recorder",
+    "dump_blackbox", "blackbox_snapshot", "init_worker_telemetry",
+    "telemetry_dir", "register_memory_gauges",
+]
 
 MONITOR = Monitor()
 register_memory_gauges(MONITOR)
@@ -95,8 +109,36 @@ def detach_logger(logger):
     return MONITOR.detach_logger(logger)
 
 
-def export_prometheus() -> str:
-    return prometheus_text(MONITOR)
+def arm_flight_recorder(path: str, rank: int = 0) -> Monitor:
+    """Name this process's black-box file (`BLACKBOX.p<rank>.json`); the
+    bounded last-N ring of steps/spans is dumped there on crash, watchdog
+    expiry, SIGTERM drain, and injected kills."""
+    return MONITOR.arm_flight_recorder(path, rank)
+
+
+def dump_blackbox(reason: str = "manual", path=None):
+    """Atomically write the flight-recorder black box (first dump wins);
+    returns its path, or None when unarmed."""
+    return MONITOR.dump_blackbox(reason, path)
+
+
+def blackbox_snapshot(reason: str = "manual") -> dict:
+    return MONITOR.blackbox_snapshot(reason)
+
+
+def init_worker_telemetry(telemetry_dir=None, rank=None, every: int = 1):
+    """Arm this worker's end of the gang telemetry plane (rank-stamped
+    JSONL stream + flight recorder + crash hook + exit-time Chrome trace);
+    no-op outside a telemetry-armed gang.  See exporters.py."""
+    return _exp.init_worker_telemetry(telemetry_dir, rank, MONITOR, every)
+
+
+def telemetry_dir():
+    return _exp.telemetry_dir()
+
+
+def export_prometheus(labels=None) -> str:
+    return prometheus_text(MONITOR, labels=labels)
 
 
 def export_json(path: str, include_steps: bool = True) -> str:

@@ -11,11 +11,25 @@ page, a JSON snapshot, a Chrome trace, or an appended JSONL stream.
 Disabled-mode contract (the hot-path budget): `span()` is one attribute
 load + branch returning a shared singleton (no allocation), `Counter.inc`
 / `Gauge.set` are one branch.  Tests pin this (tests/test_monitor.py).
+
+Flight recorder (ISSUE 8): alongside the capped buffers, the monitor
+keeps a small bounded ring of the most RECENT step records and span
+events.  `arm_flight_recorder(path, rank)` names a `BLACKBOX.p<rank>.json`
+destination; `dump_blackbox(reason)` writes the ring plus the live
+counter/gauge state there atomically (tmp + fsync + rename, so a SIGKILL
+half-write can never pass for a black box).  The first dump wins — a
+watchdog expiry that cascades into a crash keeps the watchdog's
+attribution.  Ring appends ride the locks the buffers already take, so
+the always-on recorder adds two deque appends to the hot path
+(tests/test_telemetry_plane.py bounds the cost).
 """
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 # Cap on buffered trace events / step records so an always-on monitor in a
@@ -23,6 +37,9 @@ from typing import Any, Callable, Dict, List, Optional
 # profiler's _EVENT_CAP).
 EVENT_CAP = 200_000
 STEP_CAP = 50_000
+# Flight-recorder ring depth: the "last N steps before it died" a crash
+# black box carries (per record class: step records and span events).
+FLIGHT_RECORDER_CAP = 256
 
 
 class _NullSpan:
@@ -147,6 +164,16 @@ class Monitor:
         self._gauges: Dict[str, Gauge] = {}
         self._steps: List[dict] = []
         self._loggers: List[Any] = []
+        # flight recorder: bounded rings of the NEWEST records (the capped
+        # buffers above keep the oldest), dumped as a black box on crash
+        self._bb_steps: deque = deque(maxlen=FLIGHT_RECORDER_CAP)
+        self._bb_events: deque = deque(maxlen=FLIGHT_RECORDER_CAP)
+        self._bb_path: Optional[str] = None
+        self._bb_rank = 0
+        self._bb_dumped: Optional[str] = None
+        # dump latch lock — NOT self._lock: blackbox_snapshot takes that
+        # one, and the latch must stay held across snapshot + write
+        self._bb_dump_lock = threading.Lock()
         # per-device/trainer lane for merged multi-process traces
         self.lane = 0
         self.lane_name = "paddle_tpu"
@@ -170,6 +197,11 @@ class Monitor:
             self._agg.clear()
             self._events.clear()
             self._steps.clear()
+            self._bb_steps.clear()
+            self._bb_events.clear()
+            # a reset starts a fresh run: the one-shot dump latch re-opens
+            # (the armed path survives — re-arm to change it)
+            self._bb_dumped = None
             for c in self._counters.values():
                 c.value = 0
             for g in self._gauges.values():
@@ -218,6 +250,7 @@ class Monitor:
                     a[3] = dur
             if len(self._events) < EVENT_CAP:
                 self._events.append((name, ts, dur, tid, depth, args))
+            self._bb_events.append((name, ts, dur, tid, depth, args))
 
     def span_stats(self) -> Dict[str, dict]:
         with self._lock:
@@ -276,10 +309,12 @@ class Monitor:
                         self._steps_per_sec_ema = inst if ema == 0.0 else 0.9 * ema + 0.1 * inst
                         rate_gauge.set(self._steps_per_sec_ema)
                 self._last_step_t = now
+        record.setdefault("lane", self.lane)
         record["step"] = steps_counter.value
         with self._lock:
             if len(self._steps) < STEP_CAP:
                 self._steps.append(record)
+            self._bb_steps.append(record)
         if is_exec_step:
             steps_counter.inc()
         for lg in list(self._loggers):
@@ -291,6 +326,72 @@ class Monitor:
     def step_records(self) -> List[dict]:
         with self._lock:
             return list(self._steps)
+
+    # -- flight recorder ---------------------------------------------------
+    def arm_flight_recorder(self, path: str, rank: int = 0) -> "Monitor":
+        """Name the black-box destination (`BLACKBOX.p<rank>.json` under a
+        gang's telemetry dir).  Arming does not enable the monitor — the
+        telemetry plane (exporters.init_worker_telemetry) does both."""
+        self._bb_path = str(path)
+        self._bb_rank = int(rank)
+        return self
+
+    def flight_recorder_path(self) -> Optional[str]:
+        return self._bb_path
+
+    def blackbox_snapshot(self, reason: str = "manual") -> dict:
+        """The flight-recorder ring rendered as one JSON-able document:
+        the last FLIGHT_RECORDER_CAP step records and span events plus the
+        live counter/gauge state — what the gang was doing right before it
+        died."""
+        with self._lock:
+            steps = list(self._bb_steps)
+            events = [
+                {"name": n, "ts": ts, "dur_s": dur, "tid": tid,
+                 "depth": depth,
+                 "args": ({k: str(v) for k, v in args.items()}
+                          if args else None)}
+                for (n, ts, dur, tid, depth, args) in self._bb_events
+            ]
+        try:
+            gauges = self.gauge_values()
+        except Exception:
+            gauges = {}
+        return {"kind": "blackbox", "reason": str(reason),
+                "rank": self._bb_rank, "pid": os.getpid(),
+                "ts": time.time(), "lane": self.lane,
+                "lane_name": self.lane_name, "steps": steps,
+                "events": events, "counters": self.counter_values(),
+                "gauges": gauges}
+
+    def dump_blackbox(self, reason: str = "manual",
+                      path: Optional[str] = None) -> Optional[str]:
+        """Write the black box atomically (tmp + fsync + rename) and return
+        its path; no-op (None) when unarmed.  The FIRST dump wins: a
+        watchdog expiry that cascades into a crash/exit keeps the
+        watchdog's attribution instead of being overwritten by the
+        secondary failure.  The latch is lock-held across snapshot+write:
+        a watchdog-thread dump racing a crash-hook dump must not both
+        pass the check and overwrite each other.  Never raises — this
+        runs on crash paths."""
+        with self._bb_dump_lock:
+            if self._bb_dumped is not None:
+                return self._bb_dumped
+            p = path or self._bb_path
+            if p is None:
+                return None
+            try:
+                snap = self.blackbox_snapshot(reason)
+                tmp = f"{p}.tmp{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump(snap, f, default=str)
+                    f.flush()
+                    os.fsync(f.fileno())  # to disk before a SIGKILL lands
+                os.replace(tmp, p)
+                self._bb_dumped = p
+                return p
+            except Exception:
+                return None
 
     # -- loggers -----------------------------------------------------------
     def attach_logger(self, logger):

@@ -11,6 +11,7 @@ Four formats, one source of truth (monitor.core.Monitor):
 from __future__ import annotations
 
 import json
+import os
 import re
 import time
 from typing import Dict, Optional
@@ -20,29 +21,74 @@ PROM_PREFIX = "paddle_tpu_"
 
 
 def _prom_name(name: str) -> str:
-    return PROM_PREFIX + _NAME_RE.sub("_", name)
+    """Sanitize an arbitrary span/counter/gauge name into a legal metric
+    name ([a-zA-Z_:][a-zA-Z0-9_:]*): every illegal character becomes `_`,
+    and the PROM_PREFIX guarantees a legal leading character even for
+    names that start with a digit.  Collisions (two raw names mapping to
+    one family) are disambiguated at emission with a `raw` label."""
+    return PROM_PREFIX + _NAME_RE.sub("_", str(name))
 
 
-def prometheus_text(mon) -> str:
-    """Prometheus text exposition format (one page per scrape)."""
+def escape_label_value(v) -> str:
+    r"""Escape a label value per the exposition format: backslash, double
+    quote, and newline must be written as \\, \", and \n."""
+    return (str(v).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _label_key(k) -> str:
+    """Sanitize a label NAME ([a-zA-Z_][a-zA-Z0-9_]*): illegal characters
+    become `_`, and a leading digit gets a `_` prefix (label names have
+    no PROM_PREFIX to fix their first character the way metric names do)."""
+    s = _NAME_RE.sub("_", str(k)) or "_"
+    return "_" + s if s[0].isdigit() else s
+
+
+def _label_str(labels) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{_label_key(k)}="{escape_label_value(v)}"'
+        for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def prometheus_text(mon, labels=None) -> str:
+    """Prometheus text exposition format (one page per scrape).
+
+    Hardened (ISSUE 8): metric names are sanitized, `labels` (e.g.
+    {"rank": 0}, what a multi-rank scrape endpoint stamps per worker) are
+    escaped per the format, a family's TYPE line is emitted exactly once,
+    and when two raw names sanitize to the same family the later samples
+    carry a `raw="<original>"` label instead of emitting an invalid
+    duplicate series."""
+    base = _label_str(labels)
     lines = []
+    seen_types = set()
+    family_raw: Dict[str, str] = {}
+
+    def emit(family: str, typ: str, raw: str, suffix: str, value: str):
+        first = family_raw.setdefault(family, raw)
+        if family not in seen_types:
+            seen_types.add(family)
+            lines.append(f"# TYPE {family} {typ}")
+        lab = base
+        if first != raw:  # sanitization collision: disambiguate the series
+            extra = f'raw="{escape_label_value(raw)}"'
+            lab = base[:-1] + "," + extra + "}" if base else "{" + extra + "}"
+        lines.append(f"{family}{suffix}{lab} {value}")
+
     for name, v in mon.counter_values().items():
-        p = _prom_name(name)
-        lines.append(f"# TYPE {p} counter")
-        lines.append(f"{p} {v}")
+        emit(_prom_name(name), "counter", name, "", str(v))
     for name, v in mon.gauge_values().items():
-        p = _prom_name(name)
-        lines.append(f"# TYPE {p} gauge")
-        lines.append(f"{p} {'NaN' if v != v else v}")
+        emit(_prom_name(name), "gauge", name, "", "NaN" if v != v else str(v))
     for name, s in sorted(mon.span_stats().items()):
         p = _prom_name(name)
-        lines.append(f"# TYPE {p}_seconds summary")
-        lines.append(f"{p}_seconds_count {s['calls']}")
-        lines.append(f"{p}_seconds_sum {s['total_s']:.9f}")
         # a summary family only admits _count/_sum/quantiles; max is its
         # own gauge so strict OpenMetrics parsers accept the page
-        lines.append(f"# TYPE {p}_max_seconds gauge")
-        lines.append(f"{p}_max_seconds {s['max_s']:.9f}")
+        emit(p + "_seconds", "summary", name, "_count", str(s["calls"]))
+        emit(p + "_seconds", "summary", name, "_sum", f"{s['total_s']:.9f}")
+        emit(p + "_max_seconds", "gauge", name, "", f"{s['max_s']:.9f}")
     return "\n".join(lines) + "\n"
 
 
@@ -145,12 +191,18 @@ class MonitorLogger:
     """
 
     def __init__(self, path: str, every: int = 1):
+        import threading
+
         self.path = path
         self.every = max(int(every), 1)
         self._n = 0
         self._mon = None  # set by Monitor.attach_logger callers via bind
         self._fh = None   # persistent append handle: one write+flush per
         # record instead of open/close syscalls on every training step
+        # records arrive from more than one thread (the heartbeat thread
+        # emits dist_events, the training thread emits steps); a lock keeps
+        # lines whole — interleaved partial writes would tear the JSONL
+        self._wlock = threading.Lock()
 
     def bind(self, mon):
         self._mon = mon
@@ -166,12 +218,15 @@ class MonitorLogger:
             self._fh.close()
 
     def on_step(self, record: dict):
-        self._n += 1
-        if self._n % self.every:
-            return
-        f = self._file()
-        f.write(json.dumps(record, default=str) + "\n")
-        f.flush()
+        with self._wlock:
+            # the sampling counter shares the lock: two threads racing
+            # `_n += 1` would lose updates and skew the every-N sampling
+            self._n += 1
+            if self._n % self.every:
+                return
+            f = self._file()
+            f.write(json.dumps(record, default=str) + "\n")
+            f.flush()
 
     def write_snapshot(self, mon=None):
         mon = mon or self._mon
@@ -179,8 +234,87 @@ class MonitorLogger:
             from . import MONITOR
 
             mon = MONITOR
-        f = self._file()
-        f.write(json.dumps(json_snapshot(mon, include_steps=False),
-                           default=str) + "\n")
-        f.flush()
+        line = json.dumps(json_snapshot(mon, include_steps=False),
+                          default=str) + "\n"
+        with self._wlock:
+            f = self._file()
+            f.write(line)
+            f.flush()
         return self.path
+
+
+# ---- the per-worker telemetry plane (ISSUE 8) -------------------------------
+
+_TELEMETRY: Dict[str, object] = {}
+
+
+def telemetry_dir() -> Optional[str]:
+    """The rank-stamped telemetry directory this process was armed with
+    (None outside a telemetry-armed gang)."""
+    return _TELEMETRY.get("dir")
+
+
+def init_worker_telemetry(telemetry_dir: Optional[str] = None,
+                          rank: Optional[int] = None, mon=None,
+                          every: int = 1):
+    """Arm this worker's end of the gang telemetry plane.
+
+    The gang supervisor (paddle_tpu.launch.run_gang) exports
+    `PADDLE_TELEMETRY_DIR` per incarnation; each worker (via `fleet.init`,
+    or an explicit call) then:
+
+      * enables the monitor and attaches a rank-stamped
+        `metrics.p<rank>.jsonl` MonitorLogger — the per-rank step/span/
+        dist_event stream `tools/trace_merge.py` correlates across ranks;
+      * arms the flight recorder at `BLACKBOX.p<rank>.json` (dumped on
+        crash, watchdog expiry, SIGTERM drain, and injected kills);
+      * chains `sys.excepthook` so an unhandled exception dumps the black
+        box before the traceback prints (the "crash" trigger);
+      * registers an atexit hook writing the final counter snapshot and a
+        `trace.p<rank>.json` Chrome trace for the merged timeline.
+
+    Idempotent per process; returns the attached MonitorLogger (None when
+    no directory is configured — the single-process default)."""
+    import atexit
+    import sys
+
+    if "logger" in _TELEMETRY:
+        return _TELEMETRY["logger"]
+    root = telemetry_dir or os.environ.get("PADDLE_TELEMETRY_DIR")
+    if not root:
+        return None
+    if rank is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if mon is None:
+        from . import MONITOR
+
+        mon = MONITOR
+    os.makedirs(root, exist_ok=True)
+    mon.enable()
+    mon.set_lane(rank, f"trainer{rank}")
+    mon.arm_flight_recorder(
+        os.path.join(root, f"BLACKBOX.p{rank}.json"), rank)
+    logger = MonitorLogger(
+        os.path.join(root, f"metrics.p{rank}.jsonl"), every=every)
+    logger.bind(mon)
+    mon.attach_logger(logger)
+    _TELEMETRY.update(dir=root, rank=rank, logger=logger)
+
+    prev_hook = sys.excepthook
+
+    def _crash_hook(tp, val, tb):
+        mon.dump_blackbox(f"crash:{getattr(tp, '__name__', tp)}")
+        prev_hook(tp, val, tb)
+
+    sys.excepthook = _crash_hook
+
+    def _final_flush():
+        try:
+            logger.write_snapshot(mon)
+            export_chrome_trace(mon, os.path.join(root,
+                                                  f"trace.p{rank}.json"))
+        except Exception:
+            pass
+
+    atexit.register(_final_flush)
+    return logger

@@ -143,6 +143,10 @@ def train_loop(
         now = time.perf_counter()
         stats.host_blocked_s += now - t_b0
         if _MON.enabled:
+            # per-step wall gauge: what the heartbeat's telemetry payload
+            # reports as this rank's step time when the async path (no
+            # executor.execute timing) is driving
+            _MON.gauge("pipeline.last_step_wall_s").set(now - last_drain_t)
             _MON.record_step({
                 "kind": "pipeline_step",
                 "pipeline_step": step_i,

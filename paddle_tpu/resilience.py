@@ -609,6 +609,9 @@ def resilient_train_loop(
                     stats.checkpoint_dir = _flush_checkpoint(step)
             _event("preempt_flush", "PreemptionError", step=step,
                    checkpoint=stats.checkpoint_dir)
+            # flight recorder: the drain IS this process's last act — dump
+            # the black box after the flush records landed in the ring
+            _MON.dump_blackbox("sigterm_drain")
             start_step = step
             return "preempted"
         if not isinstance(ce, TrainingError) or isinstance(ce, DataError):
@@ -765,6 +768,7 @@ def resilient_train_loop(
                     stats.checkpoint_dir = _flush_checkpoint(start_step)
                 _event("preempt_flush", "PreemptionError", step=start_step,
                        checkpoint=stats.checkpoint_dir)
+                _MON.dump_blackbox("sigterm_drain")
             break
         stats.steps = start_step
         stats.final_max_inflight = eff_inflight

@@ -49,6 +49,12 @@ def main():
 
     dist.init_distributed()  # PADDLE_TRAINER_* env contract
     tid = dist.trainer_id()
+    # telemetry plane: this worker skips fleet.init (no health layer in the
+    # A/B), so arm the rank-stamped stream directly — no-op outside a
+    # run_gang telemetry dir; gives bench.py --overlap its skew record
+    from paddle_tpu import monitor as _monitor
+
+    _monitor.init_worker_telemetry(rank=tid)
     nproc = dist.num_trainers()
     mesh = dist.global_mesh()
     n_dp = mesh.devices.size

@@ -1,0 +1,93 @@
+"""Gang worker for the telemetry-plane chaos suite (ISSUE 8).
+
+Trains RUN_STEPS sync-SGD steps through `resilient_train_loop` under the
+full stack: `fleet.init()` arms the heartbeat + watchdog AND the
+telemetry plane (the supervisor's PADDLE_TELEMETRY_DIR names this rank's
+metrics stream + flight recorder), faults come from FLAGS_fault_spec.
+
+The suite drives all four flight-recorder trigger paths through this one
+script:
+
+    kill_worker@S:RANK   the victim dumps (fsynced) before its SIGKILL;
+                         the survivor dumps on the peer-failure path
+    stall_worker@S:R:SECS with SECS > the watchdog deadline: the blocked
+                         peer dumps on watchdog expiry (and its live
+                         straggler detector names the stalled rank first)
+    preempt@S            SIGTERM -> resilient drain -> sigterm_drain dump
+    device@S             TransientDeviceError with a zero retry budget ->
+                         uncaught -> the crash excepthook dumps
+"""
+import json
+import os
+import sys
+
+# must be set before jax import
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_xla_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        _xla_flags + " --xla_force_host_platform_device_count=1").strip()
+
+import numpy as np  # noqa: E402
+
+
+def build_model():
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 17
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [16], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h = fluid.layers.fc(x, 32, act="relu")
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(h, 1), y))
+        fluid.optimizer.SGD(0.05).minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    import paddle_tpu as fluid
+    from paddle_tpu import dist_resilience as dres
+    from paddle_tpu.errors import DistributedError
+    from paddle_tpu.fleet import fleet
+
+    run_steps = int(os.environ.get("RUN_STEPS", "6"))
+    try:
+        fleet.init()  # heartbeat + watchdog + telemetry plane
+        rank, world = fleet.worker_index(), fleet.worker_num()
+
+        main_p, startup, loss = build_model()
+        compiled = fleet.main_program(main_p) if world > 1 else main_p
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+
+        per = 32 // world
+        rng = np.random.RandomState(99)
+        batches = []
+        for _ in range(run_steps):
+            xg = rng.rand(32, 16).astype("f4")
+            batches.append({"x": xg[rank * per:(rank + 1) * per],
+                            "y": xg.sum(1, keepdims=True)[
+                                rank * per:(rank + 1) * per]})
+
+        stats = fluid.resilient_train_loop(
+            exe, compiled, lambda: list(batches), [loss], scope=scope,
+            policy=fluid.RetryPolicy(max_device_retries=0,
+                                     backoff_base_s=0.0),
+            max_inflight=1, log_period=1)
+    except DistributedError as e:
+        print(f"DIST_FAILURE {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+        dres.shutdown_health(mark_down=True)
+        os._exit(dres.exit_code_for(e))
+
+    print("RESULT " + json.dumps({
+        "rank": rank, "world": world, "steps": stats.steps,
+        "preempted": stats.preempted}), flush=True)
+    dres.shutdown_health()
+
+
+if __name__ == "__main__":
+    main()

@@ -117,6 +117,62 @@ def test_prometheus_and_json_round_trip(tmp_path):
     assert "memory.live_array_bytes" in snap["gauges"]
 
 
+def test_prometheus_hostile_names_golden():
+    """Exporter hardening (ISSUE 8): hostile metric names sanitize to the
+    exposition grammar, label values escape, TYPE lines never repeat, and
+    sanitization collisions disambiguate with a raw= label instead of
+    emitting an invalid duplicate series."""
+    import re
+
+    monitor.enable()
+    monitor.counter("analysis.verify").inc(4)
+    monitor.counter('hostile "name"\n{x}').inc(1)
+    monitor.counter("a.b").inc(2)
+    monitor.counter("a_b").inc(3)          # collides with a.b post-sanitize
+    monitor.gauge("0starts.with digit").set(1.5)
+    with monitor.span('span "quoted"\nname'):
+        pass
+    text = monitor.export_prometheus(
+        labels={"rank": 0, 'bad"key': 'v"\n\\', "0zone": "a"})
+
+    name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    seen_types = set()
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# TYPE "):
+            fam = ln.split()[2]
+            assert name_re.match(fam), ln
+            assert fam not in seen_types, f"duplicate TYPE: {ln}"
+            seen_types.add(fam)
+            continue
+        # every sample: name{labels} value, name legal, labels escaped,
+        # label KEYS legal too (leading digit gets a _ prefix)
+        name = ln.split("{")[0].split(" ")[0]
+        assert name_re.match(name), ln
+        assert "\n" not in ln
+        if "{" in ln:
+            for kv in ln[ln.index("{") + 1:ln.rindex("}")].split('",'):
+                key = kv.split("=")[0]
+                assert re.match(r"^[a-zA-Z_][a-zA-Z0-9_]*$", key), ln
+        val = ln.rsplit(" ", 1)[1]
+        float(val)  # parses (NaN included)
+    assert "paddle_tpu_analysis_verify" in text
+    assert "paddle_tpu_hostile__name___x_" in text
+    assert "paddle_tpu_0starts_with_digit" in text  # prefix keeps it legal
+    # escaped label values: backslash, quote, newline per the format
+    assert 'bad_key="v\\"\\n\\\\"' in text
+    # digit-leading label key gets a _ prefix (no PROM_PREFIX on labels)
+    assert '_0zone="a"' in text and "{0zone" not in text
+    # collision: one family, second series disambiguated by raw label
+    assert text.count("# TYPE paddle_tpu_a_b counter") == 1
+    assert ('paddle_tpu_a_b{_0zone="a",bad_key="v\\"\\n\\\\",rank="0"} 2'
+            in text)
+    assert ',raw="a_b"} 3' in text
+    # hostile span name: the summary family is sanitized too
+    assert "# TYPE paddle_tpu_span__quoted__name_seconds summary" in text
+
+
 def test_monitor_logger_jsonl(tmp_path):
     monitor.enable()
     path = str(tmp_path / "metrics.jsonl")

@@ -1,0 +1,344 @@
+"""Telemetry plane unit suite (ISSUE 8): flight-recorder ring + dump
+semantics, heartbeat telemetry payloads, live straggler detection, the
+watchdog/preemption trigger paths in-process, and the monitor-overhead
+guard that keeps the always-on recorder off the dispatch hot path."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import monitor
+from paddle_tpu.dist_resilience import (CollectiveWatchdog, Heartbeat,
+                                        HeartbeatConfig, _FileTransport)
+from paddle_tpu.errors import CollectiveTimeoutError, PeerFailureError
+from paddle_tpu.monitor import FLIGHT_RECORDER_CAP, MONITOR
+
+
+@pytest.fixture(autouse=True)
+def _clean_monitor():
+    monitor.disable()
+    monitor.reset()
+    MONITOR._bb_path = None
+    yield
+    monitor.disable()
+    monitor.reset()
+    MONITOR._bb_path = None
+
+
+FAST = HeartbeatConfig(interval_s=0.05, miss_factor=4, startup_grace_s=10)
+
+
+# --- flight recorder ---------------------------------------------------------
+
+def test_flight_recorder_ring_bounded_and_dump_atomic(tmp_path):
+    monitor.enable()
+    path = str(tmp_path / "BLACKBOX.p3.json")
+    monitor.arm_flight_recorder(path, rank=3)
+    for i in range(FLIGHT_RECORDER_CAP + 40):
+        monitor.record_step({"t_total_s": 0.001, "i": i})
+    with monitor.span("executor.execute"):
+        pass
+    monitor.counter("executor.recompile").inc(2)
+
+    p = monitor.dump_blackbox("manual")
+    assert p == path and os.path.exists(path)
+    assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+    doc = json.load(open(path))
+    assert doc["kind"] == "blackbox" and doc["rank"] == 3
+    assert doc["reason"] == "manual"
+    # bounded ring keeps exactly the NEWEST records
+    assert len(doc["steps"]) == FLIGHT_RECORDER_CAP
+    assert doc["steps"][-1]["i"] == FLIGHT_RECORDER_CAP + 39
+    assert doc["steps"][0]["i"] == 40
+    assert doc["counters"]["executor.recompile"] == 2
+    assert any(e["name"] == "executor.execute" for e in doc["events"])
+    # step records are rank/lane-stamped for the merged post-mortem
+    assert all("lane" in s for s in doc["steps"])
+
+
+def test_flight_recorder_first_dump_wins(tmp_path):
+    monitor.enable()
+    path = str(tmp_path / "BLACKBOX.p0.json")
+    monitor.arm_flight_recorder(path, rank=0)
+    monitor.record_step({"t_total_s": 0.1})
+    assert monitor.dump_blackbox("watchdog_timeout") == path
+    # a cascading secondary failure must not overwrite the attribution
+    assert monitor.dump_blackbox("crash:RuntimeError") == path
+    assert json.load(open(path))["reason"] == "watchdog_timeout"
+    # unarmed monitor: dump is a None no-op
+    monitor.reset()
+    MONITOR._bb_path = None
+    assert monitor.dump_blackbox("manual") is None
+
+
+def test_watchdog_expiry_triggers_dump(tmp_path):
+    monitor.enable()
+    path = str(tmp_path / "BLACKBOX.p0.json")
+    monitor.arm_flight_recorder(path, rank=0)
+    wd = CollectiveWatchdog(heartbeat=None, timeout_s=0.15, poll_s=0.02)
+    with pytest.raises(CollectiveTimeoutError):
+        wd.run(lambda: time.sleep(1.0), what="test.collective")
+    doc = json.load(open(path))
+    assert doc["reason"] == "watchdog_timeout"
+    assert any(s.get("action") == "collective_timeout" for s in doc["steps"])
+
+
+def test_peer_failure_triggers_dump_with_offender_telemetry(tmp_path):
+    monitor.enable()
+    bb = str(tmp_path / "BLACKBOX.p0.json")
+    monitor.arm_flight_recorder(bb, rank=0)
+    hb_dir = str(tmp_path / "hb")
+    hb = Heartbeat(0, 2, config=FAST, hb_dir=hb_dir,
+                   telemetry_fn=lambda: {"step": 9, "sps": 2.0})
+    try:
+        # peer 1 beats once with telemetry, then tombstones
+        t1 = _FileTransport(hb_dir, 1, 2)
+        t1.send(1, {"step": 4, "sps": 1.0, "hbm_mb": 12.5})
+        hb.observe()
+        t1.mark_down()
+        time.sleep(FAST.interval_s / 2)  # let the poll rate-limit re-open
+        wd = CollectiveWatchdog(heartbeat=hb, timeout_s=30, rank=0)
+        with pytest.raises(PeerFailureError) as ei:
+            wd.check_peers("allreduce")
+        # the report names the offender and carries its LAST telemetry
+        assert ei.value.peers == [1]
+        assert "'step': 4" in str(ei.value)
+        doc = json.load(open(bb))
+        assert doc["reason"] == "peer_failure"
+        pf = [s for s in doc["steps"] if s.get("action") == "peer_failure"]
+        assert pf and pf[0]["telemetry"]["1"]["step"] == 4
+    finally:
+        hb.stop()
+
+
+def test_sigterm_drain_triggers_dump(tmp_path):
+    from paddle_tpu.faults import FaultInjector
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(x, 1), y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    feeds = [{"x": np.ones((2, 4), "f4"), "y": np.ones((2, 1), "f4")}
+             for _ in range(6)]
+
+    monitor.enable()
+    path = str(tmp_path / "BLACKBOX.p0.json")
+    monitor.arm_flight_recorder(path, rank=0)
+    stats = fluid.resilient_train_loop(
+        exe, main_p, lambda: list(feeds), [loss], scope=scope,
+        injector=FaultInjector("preempt@2"),
+        policy=fluid.RetryPolicy(backoff_base_s=0.0))
+    assert stats.preempted
+    doc = json.load(open(path))
+    assert doc["reason"] == "sigterm_drain"
+    assert any(s.get("kind") == "resilience_event" for s in doc["steps"])
+
+
+def test_kill_worker_fault_dumps_before_sigkill(tmp_path):
+    """In-process half of the kill trigger: a kill_worker entry targeting
+    ANOTHER rank must not dump or kill; the gang suite
+    (tests/test_gang_telemetry.py) covers the real SIGKILL path."""
+    from paddle_tpu.faults import FaultInjector
+
+    monitor.enable()
+    path = str(tmp_path / "BLACKBOX.p0.json")
+    monitor.arm_flight_recorder(path, rank=0)
+    inj = FaultInjector("kill_worker@2:1", rank=0)  # rank 1's fault
+    inj.on_dispatch(2)
+    assert not os.path.exists(path)
+    assert not inj.fired()
+
+
+# --- heartbeat telemetry + straggler detection -------------------------------
+
+def test_file_transport_payload_roundtrip(tmp_path):
+    t0 = _FileTransport(str(tmp_path), 0, 2)
+    t1 = _FileTransport(str(tmp_path), 1, 2)
+    t1.send(7, {"step": 3, "sps": 1.5})
+    polled = t0.poll()
+    assert polled[1] == (7, {"step": 3, "sps": 1.5})
+    # legacy plain-integer beat files still parse (payload None)
+    with open(os.path.join(str(tmp_path), "hb-1"), "w") as f:
+        f.write("9")
+    assert t0.poll()[1] == (9, None)
+    # tombstone wins
+    t1.mark_down()
+    assert t0.poll()[1] == (-1, None)
+
+
+def test_local_telemetry_reads_monitor():
+    from paddle_tpu.dist_resilience import local_telemetry
+
+    monitor.enable()
+    monitor.counter("executor.steps_started").inc(5)
+    monitor.counter("executor.steps").inc(4)
+    monitor.gauge("executor.steps_per_sec_ema").set(2.5)
+    monitor.gauge("executor.last_step_s").set(0.4)
+    tel = local_telemetry()
+    assert tel["step"] == 5 and tel["done"] == 4
+    assert tel["sps"] == 2.5 and tel["t_step_s"] == 0.4
+
+
+def _mk_hb(tmp_path, my_step):
+    return Heartbeat(0, 2, config=FAST, hb_dir=str(tmp_path),
+                     telemetry_fn=lambda: {"step": my_step, "sps": 2.0})
+
+
+def test_straggler_detection_names_lagging_rank(tmp_path):
+    monitor.enable()
+    hb = _mk_hb(tmp_path, my_step=10)
+    try:
+        t1 = _FileTransport(str(tmp_path), 1, 2)
+        t1.send(1, {"step": 3, "sps": 2.0})
+        hb.observe()
+        # persistence: under 3 consecutive sightings nothing is reported
+        hb._straggler_check()
+        hb._straggler_check()
+        assert monitor.counter("dist.straggler_suspects").value == 0
+        hb._straggler_check()
+        assert monitor.counter("dist.straggler_suspects").value == 1
+        assert monitor.gauge("dist.straggler_rank").value == 1
+        assert monitor.gauge("dist.step_skew_frac").value == 7.0
+        evs = [r for r in monitor.step_records()
+               if r.get("kind") == "dist_event"
+               and r.get("action") == "straggler"]
+        assert len(evs) == 1
+        assert evs[0]["rank"] == 1 and evs[0]["lag_steps"] == 7.0
+        assert evs[0]["telemetry"]["step"] == 3
+        # one episode reports ONCE, not per beat
+        hb._straggler_check()
+        assert monitor.counter("dist.straggler_suspects").value == 1
+        # the laggard catching back up clears the episode
+        t1.send(2, {"step": 10, "sps": 2.0})
+        time.sleep(FAST.interval_s / 3)
+        hb.observe()
+        hb._straggler_check()
+        assert monitor.gauge("dist.straggler_rank").value == -1
+        assert monitor.gauge("dist.step_skew_frac").value == 0.0
+    finally:
+        hb.stop()
+
+
+def test_healthy_fast_gang_never_accumulates_straggler_sightings(tmp_path):
+    """A gang stepping faster than it beats always shows SOME momentary
+    lag between beat-epoch samples; because a healthy rank's reported
+    step advances every beat, the (rank, step)-keyed persistence must
+    never reach the reporting threshold."""
+    monitor.enable()
+    my_step = {"v": 10}
+    hb = Heartbeat(0, 2, config=FAST, hb_dir=str(tmp_path),
+                   telemetry_fn=lambda: {"step": my_step["v"], "sps": 20.0})
+    try:
+        t1 = _FileTransport(str(tmp_path), 1, 2)
+        # rank 1 lags by 4 steps at every sample (sps * staleness), but
+        # its reported step ADVANCES between beats — it is keeping up
+        for k in range(8):
+            t1.send(k + 1, {"step": 6 + 4 * k, "sps": 20.0})
+            my_step["v"] = 10 + 4 * k
+            time.sleep(FAST.interval_s / 2)
+            hb.observe()
+            hb._straggler_check()
+        assert monitor.counter("dist.straggler_suspects").value == 0
+        # a genuinely FROZEN reported step still accumulates and fires
+        for _ in range(3):
+            hb._straggler_check()
+        assert monitor.counter("dist.straggler_suspects").value == 1
+    finally:
+        hb.stop()
+
+
+def test_straggler_below_threshold_is_quiet(tmp_path):
+    monitor.enable()
+    fluid.set_flags({"FLAGS_dist_straggler_lag_steps": 5})
+    try:
+        hb = _mk_hb(tmp_path, my_step=10)
+        try:
+            t1 = _FileTransport(str(tmp_path), 1, 2)
+            t1.send(1, {"step": 8, "sps": 2.0})  # lag 2 < threshold 5
+            hb.observe()
+            for _ in range(4):
+                hb._straggler_check()
+            assert monitor.counter("dist.straggler_suspects").value == 0
+            assert monitor.gauge("dist.step_skew_frac").value == 2.0
+        finally:
+            hb.stop()
+    finally:
+        fluid.set_flags({"FLAGS_dist_straggler_lag_steps": 1.0})
+
+
+def test_perf_report_skew_gate_counters_only(tmp_path):
+    """--max-step-skew-frac must work on a gauges-only snapshot line, the
+    same contract as the PR-4 dist gates."""
+    import subprocess
+    import sys as _sys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = str(tmp_path / "m.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps({"kind": "snapshot", "counters": {},
+                            "gauges": {"dist.step_skew_frac": 3.0}}) + "\n")
+    r = subprocess.run(
+        [_sys.executable, os.path.join(root, "tools", "perf_report.py"),
+         "--check", path, "--max-step-skew-frac", "2"],
+        capture_output=True, text=True)
+    assert r.returncode == 1 and "skew fraction 3.0" in r.stdout
+    r = subprocess.run(
+        [_sys.executable, os.path.join(root, "tools", "perf_report.py"),
+         "--check", path, "--max-step-skew-frac", "4"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout
+
+
+# --- the monitor-overhead guard (tier-1 satellite) ---------------------------
+
+def _per_call(fn, n):
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return (time.perf_counter() - t0) / n
+
+
+def test_monitor_hot_path_overhead_bounded(tmp_path):
+    """The always-on flight recorder must not tax the dispatch path: a
+    DISABLED monitor's span/counter entry points stay within a few
+    hundred ns (branch + singleton), and an ENABLED monitor with the
+    recorder armed stays within tens of µs per call.  Bounds are ~20x
+    above observed cost so a loaded CI box cannot flake them, while a
+    regression to per-call allocation/IO (the class of bug this guards
+    against) still lands orders of magnitude above."""
+    n = 20000
+    monitor.disable()
+    c = monitor.counter("guard.c")
+
+    def disabled_span():
+        with monitor.span("guard.s", step=1):
+            pass
+
+    assert _per_call(disabled_span, n) < 5e-6
+    assert _per_call(lambda: c.inc(), n) < 2e-6
+    assert _per_call(lambda: monitor.gauge("guard.g").set(1.0), n) < 5e-6
+
+    monitor.enable()
+    monitor.arm_flight_recorder(str(tmp_path / "bb.json"), 0)
+
+    def enabled_span():
+        with monitor.span("guard.s", step=1):
+            pass
+
+    assert _per_call(enabled_span, n) < 1e-4
+    assert _per_call(lambda: c.inc(), n) < 5e-5
+    assert _per_call(
+        lambda: monitor.record_step({"kind": "pipeline_step", "x": 1}),
+        2000) < 5e-4
+    # the armed ring stayed bounded through all of it
+    assert len(MONITOR._bb_events) <= FLIGHT_RECORDER_CAP
+    assert len(MONITOR._bb_steps) <= FLIGHT_RECORDER_CAP

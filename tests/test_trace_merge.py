@@ -1,0 +1,183 @@
+"""tools/trace_merge.py golden suite (ISSUE 8): synthetic 2-rank JSONL
+with a planted straggler must attribute the correct rank with measured
+skew; merged Chrome traces get one pid lane per rank; the CLI gates."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+import trace_merge  # noqa: E402
+
+STEP_S = 0.100     # synthetic mean step time
+PLANT_SKEW = 0.040  # rank 1 arrives this late from step 5 on
+
+
+def _write_rank(dirpath, rank, lag_from=None, lag_s=0.0, n=12):
+    """Synthetic per-rank metrics stream: csig-stamped step records with
+    ts_dispatch arrivals every STEP_S; `lag_from` plants a straggler."""
+    path = os.path.join(dirpath, f"metrics.p{rank}.jsonl")
+    with open(path, "w") as f:
+        for k in range(n):
+            ts = 100.0 + STEP_S * k
+            if lag_from is not None and k >= lag_from:
+                ts += lag_s
+            f.write(json.dumps({
+                "kind": "step", "step": k, "csig": "ab12cd34",
+                "lane": rank, "ts": ts + 0.001, "ts_dispatch": ts,
+                "t_total_s": STEP_S * 0.9,
+            }) + "\n")
+        # snapshot tail like a real MonitorLogger stream
+        f.write(json.dumps({"kind": "snapshot", "counters": {},
+                            "gauges": {}}) + "\n")
+    return path
+
+
+def _write_trace(dirpath, rank):
+    path = os.path.join(dirpath, f"trace.p{rank}.json")
+    with open(path, "w") as f:
+        json.dump({"traceEvents": [
+            {"name": "process_name", "ph": "M", "pid": 99,
+             "args": {"name": "old"}},
+            {"name": "executor.execute", "ph": "X", "pid": 99, "tid": 1,
+             "ts": 1.0, "dur": 2.0, "cat": "span"},
+        ]}, f)
+    return path
+
+
+def test_planted_straggler_attributed_with_measured_skew(tmp_path):
+    d = str(tmp_path)
+    _write_rank(d, 0)
+    _write_rank(d, 1, lag_from=5, lag_s=PLANT_SKEW)
+
+    report = trace_merge.skew_from_dir(d)
+    assert report is not None
+    assert report["ranks"] == [0, 1]
+    assert report["steps_correlated"] == 12
+    # the planted straggler is named...
+    assert report["straggler"]["rank"] == 1
+    # ...with the planted skew measured (exactly, on synthetic data)
+    assert report["straggler"]["mean_skew_s_when_last"] == pytest.approx(
+        PLANT_SKEW, rel=1e-6)
+    assert report["max_skew_s"] == pytest.approx(PLANT_SKEW, rel=1e-6)
+    assert report["max_skew_frac"] == pytest.approx(
+        PLANT_SKEW / STEP_S, rel=0.05)
+    # per-step attribution: lagged steps name rank 1 last, by the skew
+    lagged = [e for e in report["entries"] if e["step"] >= 5]
+    assert lagged and all(e["last_rank"] == 1 for e in lagged)
+    assert all(e["skew_s"] == pytest.approx(PLANT_SKEW, rel=1e-6)
+               for e in lagged)
+    # pre-plant steps carry no skew
+    assert all(e["skew_s"] == pytest.approx(0.0, abs=1e-9)
+               for e in report["entries"] if e["step"] < 5)
+
+
+def test_no_dominant_straggler_on_balanced_arrivals(tmp_path):
+    d = str(tmp_path)
+    _write_rank(d, 0)
+    _write_rank(d, 1)  # identical arrivals: ties, no straggler
+    report = trace_merge.skew_from_dir(d)
+    assert report["steps_correlated"] == 12
+    assert "straggler" not in report or \
+        report["last_arrival_counts"].get("1", 0) <= 12
+
+
+def test_merge_traces_one_lane_per_rank(tmp_path):
+    d = str(tmp_path)
+    _write_trace(d, 0)
+    _write_trace(d, 1)
+    out = str(tmp_path / "merged.json")
+    files = trace_merge.find_rank_files(d)
+    n = trace_merge.merge_traces(files["traces"], out)
+    assert n == 2
+    doc = json.load(open(out))
+    pids = {e["pid"] for e in doc["traceEvents"]}
+    assert pids == {0, 1}
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M"}
+    assert names == {"rank0", "rank1"}
+
+
+def test_cli_check_gate_and_report(tmp_path):
+    d = str(tmp_path)
+    _write_rank(d, 0)
+    _write_rank(d, 1, lag_from=0, lag_s=PLANT_SKEW)
+    rep = str(tmp_path / "skew.json")
+
+    def run(*extra):
+        return subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "trace_merge.py"),
+             d, *extra], capture_output=True, text=True)
+
+    # skew frac = 0.4: passes a 0.5 gate, fails a 0.2 gate naming rank 1
+    r = run("--report", rep, "--check", "--max-step-skew-frac", "0.5")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "STRAGGLER: rank 1" in r.stdout
+    assert json.load(open(rep))["straggler"]["rank"] == 1
+    r = run("--check", "--max-step-skew-frac", "0.2")
+    assert r.returncode == 1
+    assert "rank 1 is the straggler" in r.stdout
+
+
+def test_incarnations_never_correlate_across_restart_gap(tmp_path):
+    """A restarted gang replays the same global step numbers; pairing
+    rank 0's incarnation-1 records against rank 1's incarnation-0
+    records would read the whole restart gap (seconds) as skew and name
+    a healthy rank straggler."""
+    i0 = tmp_path / "i0"
+    i1 = tmp_path / "i1"
+    i0.mkdir(), i1.mkdir()
+    # incarnation 0: both ranks, balanced
+    _write_rank(str(i0), 0, n=6)
+    _write_rank(str(i0), 1, n=6)
+    # incarnation 1: only rank 0 left telemetry, 30s later (restart gap)
+    path = os.path.join(str(i1), "metrics.p0.jsonl")
+    with open(path, "w") as f:
+        for k in range(6):
+            ts = 130.0 + STEP_S * k
+            f.write(json.dumps({"kind": "step", "step": k,
+                                "csig": "ab12cd34", "lane": 0,
+                                "ts": ts, "ts_dispatch": ts}) + "\n")
+    report = trace_merge.skew_from_dir(str(tmp_path))
+    # only incarnation 0's steps correlate; the i1-vs-i0 30s gap is NOT
+    # skew and no straggler is invented
+    assert report["steps_correlated"] == 6
+    assert all(e["incarnation"] == 0 for e in report["entries"])
+    assert report["max_skew_s"] < 1.0
+    assert "straggler" not in report
+
+
+def test_incarnation_dirs_sort_numerically(tmp_path):
+    # i10 must beat i9 as "newest", not sort between i1 and i2
+    for k in (1, 9, 10):
+        d = tmp_path / f"i{k}"
+        d.mkdir()
+        _write_trace(str(d), 0)
+    files = trace_merge.find_rank_files(str(tmp_path))
+    assert files["traces"][0].endswith("i10/trace.p0.json")
+
+
+def test_cli_check_fails_on_empty_dir_even_with_out(tmp_path):
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "trace_merge.py"),
+         str(empty), "--out", str(tmp_path / "m.json"),
+         "--check", "--max-step-skew-frac", "0.5"],
+        capture_output=True, text=True)
+    assert r.returncode == 1  # a gate with zero evidence must not pass
+
+
+def test_torn_last_line_is_tolerated(tmp_path):
+    d = str(tmp_path)
+    _write_rank(d, 0)
+    p1 = _write_rank(d, 1, lag_from=3, lag_s=PLANT_SKEW)
+    with open(p1, "a") as f:
+        f.write('{"kind": "step", "step": 99, "csi')  # SIGKILL mid-write
+    report = trace_merge.skew_from_dir(d)
+    assert report["steps_correlated"] == 12
+    assert report["straggler"]["rank"] == 1

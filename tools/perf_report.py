@@ -37,6 +37,22 @@
         creeping fraction means peers keep falling past the liveness
         deadline — flaky network, GC pauses, or a host about to die.
 
+    python tools/perf_report.py --check metrics.jsonl --max-step-skew-frac 0.5
+        Gate the per-step cross-rank skew metric (ISSUE 8): the live
+        straggler detector's `straggler` dist_event records (falling back
+        to the dist.step_skew_frac gauge in the newest counter snapshot
+        — counters-only files work, same as the dist gates below).  Each
+        unit is one full step of sustained lag behind the gang: a rank
+        was slow-but-alive and everyone else waited for it.
+
+    python tools/perf_report.py --postmortem TELEMETRY_DIR
+        Render a merged gang post-mortem from the flight-recorder black
+        boxes (BLACKBOX.p<rank>.json) and supervisor INCIDENT files a
+        paddle_tpu.launch gang left in its telemetry root: names the
+        dead rank(s) and folds every rank's last-N step records into one
+        timeline.  See also tools/trace_merge.py for the merged Chrome
+        trace + straggler attribution over the same directory.
+
     python tools/perf_report.py --check metrics.jsonl --max-gang-restarts 1
         Gate gang restarts (paddle_tpu.launch run_gang dist_event records
         / dist.gang_restarts counter): each one is a full
@@ -73,6 +89,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -205,6 +222,33 @@ def _latest_counters(lines, prefix):
     return {}
 
 
+def _latest_gauges(lines, prefix):
+    for rec in reversed(lines):
+        gauges = rec.get("gauges")
+        if isinstance(gauges, dict):
+            return {n: v for n, v in gauges.items() if n.startswith(prefix)}
+    return {}
+
+
+def step_skew_frac(lines):
+    """The per-step cross-rank skew metric (ISSUE 8): the maximum skew
+    fraction over the live straggler detector's `straggler` dist_event
+    records, falling back to the `dist.step_skew_frac` gauge in the
+    newest snapshot (counters/gauges-only files, same as the PR-4 dist
+    gates).  ~0 on a healthy lock-step gang; each unit is one full step
+    of sustained lag behind the gang."""
+    fracs = [float(r.get("skew_frac", r.get("lag_steps", 0)) or 0)
+             for r in lines if r.get("kind") == "dist_event"
+             and r.get("action") == "straggler"]
+    if fracs:
+        return max(fracs)
+    g = _latest_gauges(lines, "dist.")
+    try:
+        return float(g.get("dist.step_skew_frac", 0.0) or 0.0)
+    except (TypeError, ValueError):
+        return 0.0
+
+
 def _latest_dist_counters(lines):
     return _latest_counters(lines, "dist.")
 
@@ -301,7 +345,8 @@ def check(path: str, steady_after: int = 2,
           max_heartbeat_miss_frac: float = None,
           max_gang_restarts: int = None,
           max_data_corrupt_frac: float = None,
-          max_replay_batches: int = None) -> int:
+          max_replay_batches: int = None,
+          max_step_skew_frac: float = None) -> int:
     """Return 0 when the metrics file is healthy, 1 otherwise (printed
     diagnosis either way).  Made for CI/bench scripts:
 
@@ -328,7 +373,8 @@ def check(path: str, steady_after: int = 2,
     dist_gates_only = (max_heartbeat_miss_frac is not None
                        or max_gang_restarts is not None
                        or max_data_corrupt_frac is not None
-                       or max_replay_batches is not None) \
+                       or max_replay_batches is not None
+                       or max_step_skew_frac is not None) \
         and max_host_blocked_frac is None and max_retry_frac is None
     if not steps and not dist_gates_only:
         print(f"perf_report --check: {path} contains no step records "
@@ -429,6 +475,23 @@ def check(path: str, steady_after: int = 2,
         else:
             print(f"perf_report --check: data-corrupt fraction {frac:.4f} "
                   f"<= {max_data_corrupt_frac}")
+    if max_step_skew_frac is not None:
+        frac = step_skew_frac(lines)
+        if frac > max_step_skew_frac:
+            stragglers = sorted({r.get("rank") for r in lines
+                                 if r.get("kind") == "dist_event"
+                                 and r.get("action") == "straggler"})
+            failures.append(
+                f"per-step cross-rank skew fraction {frac} exceeds the "
+                f"--max-step-skew-frac={max_step_skew_frac} gate — a rank "
+                f"is holding the gang back "
+                f"(straggler suspect(s): {stragglers or 'see gauge'}); "
+                f"check dist.straggler_* counters, the offender's "
+                f"telemetry in the straggler dist_events, and "
+                f"tools/trace_merge.py over the gang's telemetry dir")
+        else:
+            print(f"perf_report --check: step skew fraction {frac} <= "
+                  f"{max_step_skew_frac}")
     if max_replay_batches is not None:
         n = replayed_batches(lines)
         if n > max_replay_batches:
@@ -462,6 +525,12 @@ MFU_FLOORS = {
 # (BENCH_r05's NMT entry hit 26.3% from warm-in; tools/bench_kit.py
 # timed_steps(spread_target=...) now extends warmup until stable).
 MAX_SPREAD_PCT = 5.0
+# Ceiling on the per-step cross-rank skew a multi-process bench round may
+# embed (bench.py gangs compute it from worker telemetry via
+# tools/trace_merge.py): mean arrival skew above one full mean step time
+# means a rank spent every step waiting for a straggler — the round's
+# gang numbers measure the straggler, not the framework.
+MAX_BENCH_STEP_SKEW_FRAC = 1.0
 
 
 def _bench_records(path):
@@ -550,6 +619,19 @@ def check_bench(path, floors=None, max_spread_pct=None,
                 f"{model}: {pm['frozen']} param(s) with DEAD optimizer "
                 f"state (dropped-update class) — run tools/"
                 f"donation_audit.py --program {model}")
+        sk = rec.get("step_skew_frac")
+        if sk is not None and sk > MAX_BENCH_STEP_SKEW_FRAC:
+            failures.append(
+                f"{model}: embedded gang skew record reports mean "
+                f"per-step cross-rank skew {sk} > "
+                f"{MAX_BENCH_STEP_SKEW_FRAC} (straggler rank "
+                f"{rec.get('straggler_rank')}) — the round's gang "
+                f"numbers measure a straggler, not the framework; rerun "
+                f"on healthy workers (tools/trace_merge.py names the "
+                f"offender)")
+        elif sk is not None:
+            print(f"perf_report --check-bench: {model} gang skew frac "
+                  f"{sk} <= {MAX_BENCH_STEP_SKEW_FRAC}")
     ov = next((r for r in recs.values() if isinstance(r, dict)
                and r.get("metric", "").startswith("dp_grad_overlap")), None)
     if ov is None:
@@ -592,6 +674,110 @@ def check_bench(path, floors=None, max_spread_pct=None,
     return 0
 
 
+def postmortem(root: str, last_n: int = 30) -> int:
+    """Render a merged post-mortem from a gang's harvested telemetry
+    (`perf_report --postmortem <telemetry_root>`): every rank's
+    BLACKBOX.p<rank>.json flight-recorder dump plus the supervisor's
+    INCIDENT.i<k>.json files, folded into one last-N-steps timeline that
+    names the dead rank(s).  Returns 0 when at least one black box was
+    found, 1 otherwise."""
+    import glob as _glob
+
+    boxes = []
+    for p in sorted(_glob.glob(os.path.join(root, "**", "BLACKBOX.p*.json"),
+                               recursive=True)):
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+            doc["_path"] = p
+            boxes.append(doc)
+        except (OSError, json.JSONDecodeError):
+            continue
+    incidents = []
+    for p in sorted(_glob.glob(os.path.join(root, "**", "INCIDENT*.json"),
+                               recursive=True)):
+        try:
+            with open(p) as f:
+                incidents.append(json.load(f))
+        except (OSError, json.JSONDecodeError):
+            continue
+    if not boxes and not incidents:
+        print(f"perf_report --postmortem: no BLACKBOX.p*.json or "
+              f"INCIDENT*.json under {root} — was the gang telemetry "
+              f"plane armed (run_gang exports PADDLE_TELEMETRY_DIR)?")
+        return 1
+
+    print(f"# gang post-mortem  {root}")
+    # who died: the supervisor's incident ledger is authoritative.  Exit
+    # 43 (EXIT_PEER_FAILURE) is a survivor REACTING to someone else's
+    # death — list it separately so "dead rank(s)" names the rank that
+    # actually went down, not everyone its death took with it.
+    details = {d["rank"]: d for inc in incidents for d in inc.get("dead", [])}
+    reacting = sorted(r for r, d in details.items()
+                      if d.get("returncode") == 43)
+    dead = sorted(r for r in details if r not in set(reacting)) or reacting
+    if details:
+        print(f"dead rank(s): {dead} — " + "; ".join(
+            f"rank {r}: returncode {details[r]['returncode']}"
+            + (" (signaled)" if details[r].get("signaled") else "")
+            + (" [classified]" if details[r].get("classified") else "")
+            for r in dead))
+        if reacting and reacting != dead:
+            print(f"peer-failure reactions (exit 43): {reacting}")
+    elif boxes:
+        suspects = sorted({b.get("rank") for b in boxes
+                           if not str(b.get("reason", "")).startswith(
+                               ("peer_failure", "sigterm"))})
+        if suspects:
+            print(f"dead rank suspect(s) from black-box reasons: {suspects}")
+
+    print(f"\n## black boxes ({len(boxes)})")
+    rows = [("rank", "reason", "last_step", "records", "path")]
+    for b in sorted(boxes, key=lambda b: (b.get("rank", -1), b["_path"])):
+        steps = b.get("steps", [])
+        last = max((s.get("step", 0) for s in steps
+                    if isinstance(s.get("step"), int)), default="-")
+        rows.append((b.get("rank", "?"), b.get("reason", "?"), last,
+                     len(steps), os.path.relpath(b["_path"], root)))
+    print(_fmt_table(rows[1:], list(rows[0])))
+
+    # merged last-N timeline: every rank's ring, one stream, by wall time
+    merged = []
+    for b in boxes:
+        for s in b.get("steps", []):
+            if isinstance(s, dict) and s.get("ts") is not None:
+                merged.append((float(s["ts"]),
+                               s.get("lane", b.get("rank", "?")), s))
+    merged.sort(key=lambda t: t[0])
+    tail = merged[-last_n:]
+    if tail:
+        t0 = tail[0][0]
+        print(f"\n## merged timeline (last {len(tail)} records across "
+              f"ranks; t=0 at {t0:.3f})")
+        rows = []
+        for ts, rank, s in tail:
+            kind = s.get("kind", "step")
+            detail = ""
+            if kind == "step":
+                detail = (f"step {s.get('step')} "
+                          f"exec {s.get('t_execute_s', s.get('t_dispatch_s', 0)) * 1e3:.1f}ms")
+            elif kind == "dist_event":
+                detail = f"{s.get('action')} {s.get('peers', s.get('rank', ''))}"
+            elif kind == "pipeline_step":
+                detail = f"pstep {s.get('pipeline_step')}"
+            else:
+                detail = str({k: v for k, v in s.items()
+                              if k not in ("kind", "ts", "lane")})[:60]
+            rows.append((f"{ts - t0:+8.3f}s", f"r{rank}", kind, detail))
+        print(_fmt_table(rows, ["t", "rank", "kind", "detail"]))
+    for b in boxes:
+        c = b.get("counters", {})
+        dist = {k: v for k, v in c.items() if k.startswith("dist.") and v}
+        if dist:
+            print(f"\nrank {b.get('rank')} dist counters: {dist}")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -600,6 +786,15 @@ def main(argv=None):
                     help="diff two snapshots")
     ap.add_argument("--check", metavar="METRICS_JSONL",
                     help="CI gate over a MonitorLogger JSONL file")
+    ap.add_argument("--postmortem", metavar="TELEMETRY_DIR",
+                    help="render a merged gang post-mortem from harvested "
+                         "BLACKBOX.p<rank>.json flight-recorder dumps + "
+                         "INCIDENT files (paddle_tpu.launch telemetry "
+                         "root), naming the dead rank(s) and the last-N-"
+                         "steps timeline across ranks")
+    ap.add_argument("--postmortem-last-n", type=int, default=30,
+                    metavar="N",
+                    help="--postmortem: merged-timeline depth (default 30)")
     ap.add_argument("--check-bench", metavar="BENCH_JSON",
                     help="ratcheted bench-round gate (MFU_FLOORS, spread "
                          "ceiling, zero frozen params, overlap A/B) over a "
@@ -647,7 +842,21 @@ def main(argv=None):
                          "(replay_fast_forward resilience events) at <= N "
                          "— 0 asserts every source resumes via the O(1) "
                          "stream-state seek")
+    ap.add_argument("--max-step-skew-frac", type=float, default=None,
+                    metavar="FRAC",
+                    help="gate the MAX sustained straggler lag, in step "
+                         "units (straggler dist_event records from the "
+                         "live detector, dist.step_skew_frac gauge "
+                         "fallback), at <= FRAC.  The live detector only "
+                         "emits episodes at lag >= "
+                         "FLAGS_dist_straggler_lag_steps (default 1.0), "
+                         "so a gate under 1.0 means 'no straggler "
+                         "episode at all'; tools/trace_merge.py --check "
+                         "shares the flag name but gates the MEAN "
+                         "arrival skew per correlated step instead")
     args = ap.parse_args(argv)
+    if args.postmortem:
+        return postmortem(args.postmortem, last_n=args.postmortem_last_n)
     if args.check_bench:
         return check_bench(args.check_bench,
                            max_spread_pct=args.max_spread_pct,
@@ -656,7 +865,8 @@ def main(argv=None):
         return check(args.check, args.steady_after,
                      args.max_host_blocked_frac, args.max_retry_frac,
                      args.max_heartbeat_miss_frac, args.max_gang_restarts,
-                     args.max_data_corrupt_frac, args.max_replay_batches)
+                     args.max_data_corrupt_frac, args.max_replay_batches,
+                     args.max_step_skew_frac)
     if args.diff:
         print(diff(*args.diff))
         return 0

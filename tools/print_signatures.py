@@ -33,6 +33,12 @@ MODULES = [
     "paddle_tpu.faults",
     "paddle_tpu.resilience",
     "paddle_tpu.core.analysis",
+    # the distributed observability surface (ISSUE 8): the monitor's
+    # telemetry plane + flight recorder, the gang launcher, and the
+    # health layer's straggler/telemetry API are public contract now
+    "paddle_tpu.monitor",
+    "paddle_tpu.launch",
+    "paddle_tpu.dist_resilience",
 ]
 
 
