@@ -9,7 +9,11 @@ the serial-vs-overlapped loop A/B (paddle_tpu.pipeline.train_loop +
 Executor.run_async) and prints its own JSON line with both rates and
 host-blocked fractions.  `--chaos` runs the resilient loop under a fixed
 injected fault schedule (paddle_tpu.faults) and reports throughput plus
-the recovery ledger — the robustness overhead as a number.
+the recovery ledger — the robustness overhead as a number.  With a
+distributed spec (kill_worker@S:RANK), `--elastic` adds the ISSUE-9 arm:
+the same kill under elastic supervision (shrink to N-1, grow back),
+reporting resize overhead and post-resize throughput next to the
+fixed-size restart baseline.
 
 vs_baseline: the reference published no numbers (BASELINE.md), so the
 absolute series is tracked across rounds; vs_baseline = this round's
@@ -657,7 +661,7 @@ def bench_overlap(steps=16, n_procs=2, bucket_mb=4.0, batch_size=256,
 
 
 def bench_chaos_dist(fault_spec, steps=12, n_procs=2, save_every=3,
-                     max_restarts=2):
+                     max_restarts=2, elastic=False):
     """Multi-worker chaos benchmark: the same 2-worker sync-SGD gang run
     uninterrupted and under a distributed fault schedule
     (kill_worker@S:RANK / stall_worker@S:RANK:SECS), both through
@@ -665,20 +669,35 @@ def bench_chaos_dist(fault_spec, steps=12, n_procs=2, save_every=3,
     both gang rates, the restart ledger, and the end-state parity check —
     gang-restart overhead (detection + rollback + relaunch + replay) as a
     number, the multi-worker analogue of the single-process chaos bench
-    above."""
+    above.
+
+    `elastic=True` (ISSUE 9) switches every arm to the elastic worker
+    (checkpointable sharded streams, elastic CheckpointManager) and adds
+    a THIRD arm: the same kill under `run_gang(elastic=True)` — the gang
+    shrinks to N-1, keeps training, and grows back when capacity
+    returns.  The record reports resize overhead and the post-resize
+    (final grown incarnation) throughput next to the fixed-size restart
+    baseline.  Elastic parity is allclose-grade, not bit-grade: a
+    different world size reassociates the dp mean (docs/robustness.md)."""
     import os
     import tempfile
 
     from paddle_tpu.launch import run_gang
 
     worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "tests", "dist_worker_resilient.py")
+                          "tests",
+                          "dist_worker_elastic.py" if elastic
+                          else "dist_worker_resilient.py")
     env = {"RUN_STEPS": str(steps), "SAVE_EVERY": str(save_every),
            "FLAGS_dist_heartbeat_interval_s": "0.25",
            "FLAGS_dist_heartbeat_miss_factor": "12",
            "FLAGS_dist_watchdog_timeout_s": "60"}
+    if elastic:
+        # the grow decision needs the shrunk gang to live long enough to
+        # observe its commit; a tiny per-step sleep keeps the window open
+        env["PT_STEP_SLEEP"] = "0.05"
 
-    def one(spec, restarts):
+    def one(spec, restarts, run_elastic=False):
         root = tempfile.mkdtemp(prefix="pt-chaos-dist-")
         e = dict(env)
         if spec:
@@ -686,7 +705,8 @@ def bench_chaos_dist(fault_spec, steps=12, n_procs=2, save_every=3,
         t0 = _time.perf_counter()
         res = run_gang([sys.executable, worker], n_procs,
                        checkpoint_root=root, extra_env=e,
-                       max_restarts=restarts, timeout=540)
+                       max_restarts=restarts, timeout=540,
+                       elastic=run_elastic, min_procs=1)
         wall = _time.perf_counter() - t0
         shas = [r["params_sha"] for r in _gang_results(res)]
         return res, wall, shas
@@ -701,22 +721,54 @@ def bench_chaos_dist(fault_spec, steps=12, n_procs=2, save_every=3,
     print(f"chaos-dist: clean {clean_sps:.2f} steps/s, faulted "
           f"{chaos_sps:.2f} steps/s ({chaos_res.restarts} gang restart(s), "
           f"parity={parity})", file=sys.stderr)
-    return {"metric": "chaos_dist_train_steps_per_sec",
-            "value": round(chaos_sps, 3), "unit": "steps/sec",
-            "clean_steps_per_sec": round(clean_sps, 3),
-            "gang_restart_overhead": round(1.0 - chaos_sps / clean_sps, 4)
-            if clean_sps and chaos_sps else None,
-            "fault_spec": fault_spec, "n_procs": n_procs, "steps": steps,
-            "survived": bool(chaos_res.ok),
-            "gang_restarts": chaos_res.restarts,
-            "incarnations": chaos_res.incarnations,
-            "worker_deaths": [d for i in chaos_res.incidents
-                              for d in i.get("dead", [])],
-            # cross-rank skew over the CLEAN gang's telemetry (the chaos
-            # arm's skew measures the injected fault, not the gang)
-            **_gang_skew(clean_res),
-            "telemetry_dir": chaos_res.telemetry_dir,
-            "bit_parity_vs_clean": parity}
+    rec = {"metric": "chaos_dist_train_steps_per_sec",
+           "value": round(chaos_sps, 3), "unit": "steps/sec",
+           "clean_steps_per_sec": round(clean_sps, 3),
+           "gang_restart_overhead": round(1.0 - chaos_sps / clean_sps, 4)
+           if clean_sps and chaos_sps else None,
+           "fault_spec": fault_spec, "n_procs": n_procs, "steps": steps,
+           "survived": bool(chaos_res.ok),
+           "gang_restarts": chaos_res.restarts,
+           "incarnations": chaos_res.incarnations,
+           "worker_deaths": [d for i in chaos_res.incidents
+                             for d in i.get("dead", [])],
+           # cross-rank skew over the CLEAN gang's telemetry (the chaos
+           # arm's skew measures the injected fault, not the gang)
+           **_gang_skew(clean_res),
+           "telemetry_dir": chaos_res.telemetry_dir,
+           "bit_parity_vs_clean": parity}
+    if not elastic:
+        return rec
+    el_res, el_wall, el_shas = one(fault_spec, max_restarts,
+                                   run_elastic=True)
+    el_sps = steps / el_wall if el_res.ok else 0.0
+    # post-resize throughput: the final (grown-back) incarnation's own
+    # rate, from its RESULT line — what the gang sustains once capacity
+    # is back, with the resize machinery out of the hot path
+    post_sps = None
+    final = _gang_results(el_res)
+    if el_res.ok and final:
+        r0 = final[0]
+        if r0.get("steps_run") and r0.get("wall_s"):
+            post_sps = round(r0["steps_run"] / r0["wall_s"], 3)
+    print(f"chaos-dist --elastic: {el_sps:.2f} steps/s end-to-end "
+          f"({el_res.resizes} resize(s), sizes {el_res.size_history}), "
+          f"post-resize {post_sps} steps/s vs fixed-restart "
+          f"{chaos_sps:.2f}", file=sys.stderr)
+    rec["elastic"] = {
+        "steps_per_sec": round(el_sps, 3),
+        "post_resize_steps_per_sec": post_sps,
+        "resize_overhead": round(1.0 - el_sps / clean_sps, 4)
+        if clean_sps and el_sps else None,
+        "fixed_restart_steps_per_sec": round(chaos_sps, 3),
+        "survived": bool(el_res.ok),
+        "resizes": el_res.resizes,
+        "size_history": el_res.size_history,
+        "resize_events": el_res.resize_events,
+        "incarnations": el_res.incarnations,
+        "ranks_agree": bool(el_res.ok and len(set(el_shas)) == 1),
+    }
+    return rec
 
 
 _DIST_FAULT_KINDS = ("kill_worker", "stall_worker")
@@ -749,7 +801,8 @@ def main():
         # entries to the RecordIO corruption A/B; plain specs keep the
         # single-process resilient-loop bench
         if fault_spec and any(k in fault_spec for k in _DIST_FAULT_KINDS):
-            print(json.dumps(bench_chaos_dist(fault_spec)))
+            print(json.dumps(bench_chaos_dist(
+                fault_spec, elastic="--elastic" in sys.argv)))
         elif fault_spec and any(k in fault_spec for k in _DATA_FAULT_KINDS):
             print(json.dumps(bench_chaos_data(fault_spec)))
         elif fault_spec:

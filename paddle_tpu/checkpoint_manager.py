@@ -24,11 +24,30 @@ refuses any distributed checkpoint without `COMMITTED`, so a worker that
 crashed after its own shard landed can never leave a mixed-step
 directory that a restarted gang would happily load: either every rank's
 step N state is there, or the walk falls back to step N-k.
+
+Elastic N->M resume (ISSUE 9): every checkpoint records the world size
+that wrote it (the `DIST` marker; absent = 1).  `restore` compares it
+against the restoring manager's `world_size` — a mismatch on the default
+path raises a classified `CheckpointError` naming both sizes (loading
+anyway would misposition shards), while `elastic=True` consolidates the
+saved shards over the mesh and re-splits them for the new rank set
+(`io.load_sharded`'s region reader; SelectedRows tables re-dealt by row
+id).  After an elastic restore `restored_world` / `last_restored_dir`
+tell the resilience layer to repartition the data-stream cursors too
+(`paddle_tpu/elastic.py`).  Commits also garbage-collect: stale pending
+`.tmp` dirs at or below the committed step are swept, and — in the
+coordinated path — per-rank artifacts left in a reused pending dir by a
+LARGER dead incarnation (ghost shard manifests, SHARD_DONE markers,
+RESUME sidecars for ranks beyond the current world) are removed before
+the COMMITTED marker lands, so a resized gang can never commit a
+directory that mixes two world sizes (`resilience.ckpt_gc` counts the
+sweep).
 """
 from __future__ import annotations
 
 import logging
 import os
+import re
 import shutil
 import signal
 import time
@@ -43,12 +62,32 @@ log = logging.getLogger("paddle_tpu.checkpoint")
 COMMITTED_MARKER = "COMMITTED"
 DIST_MARKER = "DIST"
 
+# per-rank artifacts a coordinated save leaves in the pending dir; the
+# ghost sweep removes any whose rank is beyond the committing world size
+# (debris of a LARGER dead incarnation reusing the same step)
+_RANK_ARTIFACTS = (
+    re.compile(r"^SHARD_DONE\.p(\d+)$"),
+    re.compile(r"^__sharded_manifest__\.p(\d+)\.json$"),
+    re.compile(r"^RESUME\.p(\d+)\.json$"),
+    re.compile(r"\.p(\d+)s\d+\.npy$"),
+)
+
+
+def _artifact_rank(fname: str) -> Optional[int]:
+    """The rank a per-rank checkpoint artifact belongs to (None for
+    rank-agnostic files like STEP / COMMITTED / the proc-0 manifest)."""
+    for pat in _RANK_ARTIFACTS:
+        m = pat.search(fname)
+        if m:
+            return int(m.group(1))
+    return None
+
 
 class CheckpointManager:
     def __init__(self, root: str, program=None, scope=None, keep: int = 3,
                  save_every_steps: int = 0, mesh=None,
                  rank: int = 0, world_size: int = 1,
-                 commit_timeout_s: float = 60.0):
+                 commit_timeout_s: float = 60.0, elastic: bool = False):
         self.root = root
         self.program = program
         self.scope = scope
@@ -58,6 +97,14 @@ class CheckpointManager:
         self.rank = int(rank)
         self.world_size = int(world_size)
         self.commit_timeout_s = commit_timeout_s
+        # elastic=True opts restore into N->M re-sharding when the saved
+        # world size differs from ours; the default raises instead
+        self.elastic = bool(elastic)
+        # set by restore(): the world size that WROTE the restored
+        # checkpoint and its directory — the resilience layer keys its
+        # stream-cursor repartition on a mismatch with world_size
+        self.restored_world: Optional[int] = None
+        self.last_restored_dir: Optional[str] = None
         self._step = 0
         self._prev_handlers = {}
         self._saving = False
@@ -125,6 +172,7 @@ class CheckpointManager:
                         shutil.rmtree(final)
                     os.rename(tmp, final)
                     self._rotate()
+                    self._gc_stale_tmp(step)
             _MON.counter("checkpoint.saves").inc()
         finally:
             self._saving = False
@@ -159,6 +207,13 @@ class CheckpointManager:
             # matters at restart, and an uncommitted one is invisible there
             return
         self._wait_for_shards(tmp, step)
+        # ghost sweep BEFORE the commit marker: a pending dir reused at
+        # the same step by a previously-larger incarnation still holds
+        # that incarnation's per-rank manifests/shards/sidecars — ranks
+        # beyond our world size.  Committing them would mix two world
+        # sizes in one checkpoint (the manifest merge at load would stitch
+        # in ghost shards with divergent values).
+        self._sweep_ghost_ranks(tmp)
         with open(os.path.join(tmp, "STEP"), "w") as f:
             f.write(str(step))
         with open(os.path.join(tmp, COMMITTED_MARKER), "w") as f:
@@ -168,6 +223,7 @@ class CheckpointManager:
         os.rename(tmp, final)
         _MON.counter("checkpoint.commits").inc()
         self._rotate()
+        self._gc_stale_tmp(step)
 
     def _wait_for_shards(self, tmp: str, step: int):
         """Rank 0's bounded rendezvous: every rank's SHARD_DONE marker for
@@ -214,6 +270,63 @@ class CheckpointManager:
         for d in ckpts[:-self.keep] if self.keep > 0 else []:
             shutil.rmtree(os.path.join(self.root, d), ignore_errors=True)
 
+    # -- checkpoint GC (ISSUE 9) -------------------------------------------
+    def _gc_stale_tmp(self, committed_step: int) -> int:
+        """Sweep uncommitted pending dirs at or below the just-committed
+        step: debris of dead incarnations (a gang killed mid-save leaves
+        its `.tmp` behind, and repeated restarts accumulate one per
+        failed save).  Pending dirs for LATER steps are left alone — a
+        peer may legitimately be writing one right now."""
+        removed = 0
+        for name in os.listdir(self.root):
+            if not (name.startswith("ckpt-") and name.endswith(".tmp")):
+                continue
+            try:
+                step = int(name[len("ckpt-"):-len(".tmp")])
+            except ValueError:
+                continue
+            if step <= committed_step:
+                shutil.rmtree(os.path.join(self.root, name),
+                              ignore_errors=True)
+                removed += 1
+        if removed:
+            _MON.counter("resilience.ckpt_gc").inc(removed)
+            log.info("checkpoint GC: swept %d stale pending dir(s) at or "
+                     "below step %d", removed, committed_step)
+        return removed
+
+    def _sweep_ghost_ranks(self, tmp: str) -> int:
+        """Remove per-rank artifacts for ranks >= world_size from a
+        pending dir (shard files, per-rank manifests, SHARD_DONE markers,
+        RESUME sidecars left by a larger dead incarnation at this step)."""
+        removed = 0
+        try:
+            names = os.listdir(tmp)
+        except OSError:
+            return 0
+        for fname in names:
+            r = _artifact_rank(fname)
+            if r is not None and r >= self.world_size:
+                try:
+                    os.remove(os.path.join(tmp, fname))
+                    removed += 1
+                except OSError:
+                    pass
+        if removed:
+            _MON.counter("resilience.ckpt_gc").inc(removed)
+            log.info("checkpoint GC: swept %d ghost artifact(s) of ranks "
+                     ">= %d from %s", removed, self.world_size, tmp)
+        return removed
+
+    def saved_world(self, ckpt_dir: str) -> int:
+        """World size that wrote `ckpt_dir` (the DIST marker; absent or
+        unreadable = a single-process save)."""
+        try:
+            with open(os.path.join(ckpt_dir, DIST_MARKER)) as f:
+                return int(f.read().strip() or 1)
+        except (OSError, ValueError):
+            return 1
+
     def checkpoints(self):
         return sorted(d for d in os.listdir(self.root)
                       if d.startswith("ckpt-") and not d.endswith(".tmp"))
@@ -223,7 +336,8 @@ class CheckpointManager:
         return os.path.join(self.root, c[-1]) if c else None
 
     def restore(self, scope=None, mesh=None,
-                max_step: Optional[int] = None) -> Optional[int]:
+                max_step: Optional[int] = None,
+                elastic: Optional[bool] = None) -> Optional[int]:
         """Load the newest loadable snapshot; returns its step (None if
         none exist).  A corrupt newest checkpoint (missing STEP,
         unreadable shard, truncated manifest) is logged and skipped — the
@@ -233,7 +347,20 @@ class CheckpointManager:
 
         `max_step` bounds the walk: the resilience layer's rollback must
         not restore a checkpoint taken AFTER the failing step (its state
-        already contains the poison it is rolling back from)."""
+        already contains the poison it is rolling back from).
+
+        World-size contract: a checkpoint written by a DIFFERENT world
+        size than this manager's raises a classified `CheckpointError`
+        naming both sizes — loading it positionally would hand ranks the
+        wrong shards.  With `elastic=True` (argument or constructor) the
+        mismatch instead takes the elastic path: the saved shards are
+        consolidated over the mesh and re-split for the new rank set
+        (SelectedRows tables re-dealt by row id), `restored_world` /
+        `last_restored_dir` record the provenance, and the caller (the
+        resilience layer) repartitions the data-stream cursors to match."""
+        from .errors import CheckpointError
+
+        elastic = self.elastic if elastic is None else bool(elastic)
         ckpts = self.checkpoints()
         errors = []
         for name in reversed(ckpts):
@@ -252,11 +379,31 @@ class CheckpointManager:
             try:
                 with open(os.path.join(d, "STEP")) as f:
                     step = int(f.read())
-                if max_step is not None and step > max_step:
-                    continue
-                with _MON.span("checkpoint.restore", step=step):
+            except Exception as e:
+                errors.append((name, e))
+                _MON.counter("checkpoint.restore_skipped").inc()
+                log.warning("checkpoint %s is unreadable (%s: %s); falling "
+                            "back to the previous one", d, type(e).__name__, e)
+                continue
+            if max_step is not None and step > max_step:
+                continue
+            saved_world = self.saved_world(d)
+            if saved_world != self.world_size and not elastic:
+                raise CheckpointError(
+                    f"checkpoint {d} was saved by world size {saved_world} "
+                    f"but this manager restores for world size "
+                    f"{self.world_size} — refusing the non-elastic load "
+                    f"(shards would be mispositioned).  Pass elastic=True "
+                    f"to consolidate and re-shard for the new rank set",
+                    saved_world=saved_world, current_world=self.world_size,
+                    step=step)
+            try:
+                with _MON.span("checkpoint.restore", step=step,
+                               saved_world=saved_world,
+                               world=self.world_size):
                     _io.load_sharded(d, scope=scope or self.scope,
-                                     mesh=mesh or self.mesh)
+                                     mesh=mesh or self.mesh,
+                                     row_shard=(self.rank, self.world_size))
             except Exception as e:
                 errors.append((name, e))
                 _MON.counter("checkpoint.restore_skipped").inc()
@@ -264,6 +411,17 @@ class CheckpointManager:
                             "back to the previous one", d, type(e).__name__, e)
                 continue
             self._step = step
+            self.restored_world = saved_world
+            self.last_restored_dir = d
+            if saved_world != self.world_size:
+                _MON.counter("checkpoint.elastic_restores").inc()
+                _MON.record_step({
+                    "kind": "dist_event", "action": "elastic_restore",
+                    "step": step, "rank": self.rank,
+                    "from_world": saved_world, "to_world": self.world_size})
+                log.info("elastic restore: %s (saved by world %d) "
+                         "re-sharded for world %d, rank %d", d,
+                         saved_world, self.world_size, self.rank)
             if errors:
                 log.warning("restored %s after skipping %d corrupt "
                             "checkpoint(s): %s", d, len(errors),

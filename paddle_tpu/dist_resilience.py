@@ -695,10 +695,31 @@ def init_health(rank: int, world: int,
                 watchdog_timeout_s: Optional[float] = None) -> CollectiveWatchdog:
     """Start the heartbeat and install the process-global watchdog (what
     `fleet.init` does for every multi-worker gang).  Idempotent: a second
-    call returns the live watchdog."""
+    call with the SAME (rank, world) returns the live watchdog.
+
+    Elastic resize (ISSUE 9): a second call with a DIFFERENT (rank,
+    world) re-arms — the old heartbeat is stopped (its peer table,
+    reported-dead set, and straggler episode state all describe the
+    OLD membership; reading a departed rank's silence as a fresh death
+    would classify a planned resize as a peer failure) and a fresh
+    heartbeat + watchdog pair is armed against the resized peer set."""
     global _HEARTBEAT, _WATCHDOG
+    old = None
     with _HEALTH_LOCK:
         if _WATCHDOG is not None:
+            live = _HEARTBEAT
+            if live is not None and live.rank == rank and live.world == world:
+                return _WATCHDOG
+            # resized gang: the live health layer guards the wrong peers
+            old, _HEARTBEAT, _WATCHDOG = _HEARTBEAT, None, None
+    if old is not None:
+        old.stop()
+        _MON.counter("dist.health_rearm").inc()
+        _MON.record_step({"kind": "dist_event", "action": "health_rearm",
+                          "rank": rank, "world": world,
+                          "old_world": old.world})
+    with _HEALTH_LOCK:
+        if _WATCHDOG is not None:  # lost a re-arm race: use the winner's
             return _WATCHDOG
         hb = Heartbeat(rank, world, endpoints=endpoints, config=config)
         hb.start()

@@ -1,0 +1,140 @@
+"""Elastic N->M resume: repartitioning data-stream cursors across a
+world-size change.
+
+The parameter half of an elastic restore lives in `CheckpointManager.
+restore(elastic=True)` (consolidate the saved shards, re-split for the
+new rank set — `io.load_sharded` + `parallel/sharding.py`).  This module
+owns the DATA half: a coordinated checkpoint carries one
+`RESUME.p<rank>.json` sidecar per rank (`resilience.resume_sidecar_name`)
+with that rank's pickled stream cursor, and when the gang resumes at a
+different size those N cursors must become M cursors such that **no
+sample is dropped and none is double-trained**.
+
+`repartition_resume_info` is the entry point the resilient loop calls
+when `CheckpointManager.restored_world != world_size`:
+
+  * every old rank's sidecar is read and its cursor unpacked;
+  * the per-rank bookkeeping (`step`, `next_batch`) is checked for
+    sync-consistency — ranks of a coordinated checkpoint always agree,
+    and a disagreement means the checkpoint cannot be split exactly, so
+    it raises a classified `CheckpointError` instead of guessing;
+  * the cursors are re-split exactly via
+    `reader.repartition_stream_states` when the pipeline contains a
+    `reader.shard()` layer (the dp-sharded layout);
+  * pipelines whose cursors are NOT exactly re-splittable fall back to
+    dropping the stream state: the resilient loop then performs its loud
+    replay fast-forward to `next_batch` (`resilience.replay_fallback`
+    counters), which still trains the right samples — it just pays
+    O(dataset) to find them.
+
+Monitor surface: `resilience.cursor_repartition` /
+`resilience.cursor_fallback` counters and one `kind="dist_event"
+action="cursor_repartition"` record per elastic resume.
+"""
+from __future__ import annotations
+
+__all__ = ["collect_resume_infos", "repartition_resume_info"]
+
+import json
+import logging
+import os
+from typing import Dict, Optional
+
+from . import io as _io
+from .errors import CheckpointError
+from .monitor import MONITOR as _MON
+
+log = logging.getLogger("paddle_tpu.elastic")
+
+
+def collect_resume_infos(ckpt_dir: str, world: int) -> Dict[int, dict]:
+    """Read every rank's RESUME sidecar from a committed checkpoint dir
+    written by `world` ranks.  Returns {rank: parsed info}; ranks whose
+    sidecar is missing are absent (the caller decides how loud to be).
+    A world-1 checkpoint uses the unnamespaced RESUME.json."""
+    from .resilience import resume_sidecar_name
+
+    infos: Dict[int, dict] = {}
+    for r in range(world):
+        path = os.path.join(ckpt_dir, resume_sidecar_name(r, world))
+        try:
+            with open(path) as f:
+                infos[r] = json.load(f)
+        except (OSError, ValueError):
+            continue
+    return infos
+
+
+def repartition_resume_info(ckpt_dir: str, old_world: int,
+                            new_rank: int, new_world: int) -> dict:
+    """Merge a checkpoint's `old_world` RESUME sidecars and deal rank
+    `new_rank` of `new_world` its repartitioned cursor.
+
+    Deterministic and pure: every new rank computes the identical split
+    from the same on-disk sidecars and takes its own piece — no rank
+    writes anything, so concurrent elastic restores cannot race.
+
+    Returns an info dict shaped like a native sidecar ({"step",
+    "next_batch", "skipped_batches", "stream_state"?, "elastic_from"}).
+    `stream_state` is present only when the split is EXACT; its absence
+    tells the resilient loop to use its loud replay fast-forward.
+
+    Raises CheckpointError when the sidecars are mutually inconsistent
+    (different steps or batch positions — a torn checkpoint that cannot
+    be resumed without dropping or double-training data)."""
+    infos = collect_resume_infos(ckpt_dir, old_world)
+    if not infos:
+        # a checkpoint without sidecars (manual save) has no cursor to
+        # repartition; the caller starts the stream from scratch exactly
+        # as a same-size resume would
+        return {}
+    steps = {int(i["step"]) for i in infos.values() if "step" in i}
+    batches = {int(i["next_batch"]) for i in infos.values()
+               if "next_batch" in i}
+    if len(steps) > 1 or len(batches) > 1:
+        raise CheckpointError(
+            f"elastic resume from {ckpt_dir}: the {len(infos)} rank "
+            f"sidecars disagree (steps {sorted(steps)}, next_batch "
+            f"{sorted(batches)}) — a torn checkpoint cannot be "
+            f"repartitioned without dropping or double-training samples",
+            saved_world=old_world, current_world=new_world)
+    out = {
+        "step": steps.pop() if steps else 0,
+        "next_batch": batches.pop() if batches else 0,
+        # each rank skipped its own bad batches; the new partition can
+        # only carry the most conservative count forward
+        "skipped_batches": max((int(i.get("skipped_batches", 0))
+                                for i in infos.values()), default=0),
+        "elastic_from": old_world,
+    }
+    packed = [i.get("stream_state") for i in infos.values()]
+    exact = False
+    if len(infos) == old_world and all(p is not None for p in packed):
+        from .reader import repartition_stream_states
+
+        try:
+            states = [_io.unpack_stream_state(infos[r]["stream_state"])
+                      for r in range(old_world)]
+            new_states = repartition_stream_states(states, new_world)
+            out["stream_state"] = _io.pack_stream_state(
+                new_states[new_rank])
+            exact = True
+        except (ValueError, KeyError) as e:
+            log.warning(
+                "elastic resume: stream cursors from %s are not exactly "
+                "re-splittable (%s); falling back to replay fast-forward "
+                "to batch %d", ckpt_dir, e, out["next_batch"])
+    else:
+        log.warning(
+            "elastic resume: %d of %d rank sidecars carry a stream state "
+            "under %s; falling back to replay fast-forward to batch %d",
+            sum(p is not None for p in packed), old_world, ckpt_dir,
+            out["next_batch"])
+    _MON.counter("resilience.cursor_repartition" if exact
+                 else "resilience.cursor_fallback").inc()
+    _MON.record_step({
+        "kind": "dist_event", "action": "cursor_repartition",
+        "from_world": old_world, "to_world": new_world, "rank": new_rank,
+        "step": out["step"], "next_batch": out["next_batch"],
+        "exact": exact})
+    return out

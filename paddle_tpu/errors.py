@@ -18,6 +18,9 @@ routes on:
     PreemptionError       the pod is going away — flush a checkpoint and
                           exit resumable
     FatalError            everything else — never retried
+    CheckpointError       a checkpoint that must not be loaded as asked
+                          (world-size mismatch without elastic opt-in,
+                          inconsistent rank cursors) — never retried
 
 and, for the multi-worker tier (paddle_tpu/dist_resilience.py):
 
@@ -43,6 +46,7 @@ from __future__ import annotations
 
 __all__ = ["TrainingError", "DataError", "NumericError",
            "TransientDeviceError", "PreemptionError", "FatalError",
+           "CheckpointError",
            "DistributedError", "PeerFailureError", "CollectiveTimeoutError",
            "classify", "attach_context", "get_context"]
 
@@ -110,6 +114,23 @@ class FatalError(TrainingError):
     """Anything `classify` cannot place in a recoverable class: program
     bugs, INVALID_ARGUMENT compiles, user-code exceptions.  The resilient
     loop re-raises these untouched."""
+
+
+class CheckpointError(TrainingError):
+    """A checkpoint cannot be safely loaded as asked: the saved world size
+    does not match the restoring gang (and the caller did not opt into
+    elastic re-sharding), rank cursors are mutually inconsistent, or the
+    on-disk layout contradicts its own manifest.  Never retried — loading
+    anyway would misposition shards or double-train data, which is worse
+    than dying loudly.  `saved_world` / `current_world` carry the two
+    sizes when a world-size mismatch is the cause."""
+
+    def __init__(self, message: str, *, saved_world: Optional[int] = None,
+                 current_world: Optional[int] = None, **kw):
+        kw.setdefault("phase", "checkpoint")
+        super().__init__(message, **kw)
+        self.saved_world = saved_world
+        self.current_world = current_world
 
 
 class DistributedError(TrainingError):

@@ -166,12 +166,33 @@ def save_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
     # any caller that is not a real jax process) can exercise the
     # multi-writer layout; real gangs leave it None -> jax.process_index()
     proc = jax.process_index() if process_index is None else int(process_index)
+    from .core.selected_rows import SelectedRows
+
     entries = []
     for name in var_names:
         v = scope.find_var(name)
         if v is None:
             raise KeyError(f"save_sharded: {name!r} not found in scope")
         safe = name.replace("/", "%2F")
+        if isinstance(v, SelectedRows):
+            # sparse row-slab table: each rank owns a disjoint row-id set,
+            # stored as a (rows, values) pair — consolidation/resplit at
+            # load time is by ROW ID, never by positional index, so an
+            # elastic N->M restore re-deals rows exactly
+            rows = np.asarray(v.rows)
+            vals = np.asarray(v.values)
+            rows_f = f"{safe}.rows.p{proc}s0.npy"
+            vals_f = f"{safe}.vals.p{proc}s0.npy"
+            np.save(os.path.join(dirname, rows_f), rows)
+            stored_as = _save_array(os.path.join(dirname, vals_f), vals)
+            entries.append({"name": name, "selected_rows": True,
+                            "height": int(v.height),
+                            "global_shape": list(v.shape),
+                            "dtype": str(vals.dtype), "spec": None,
+                            "shards": [{"rows_file": rows_f,
+                                        "values_file": vals_f,
+                                        "stored_as": stored_as}]})
+            continue
         shards_meta = []
         spec = None
         if isinstance(v, jax.Array):
@@ -210,12 +231,22 @@ def save_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
 
 
 def load_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
-                 scope: Optional[Scope] = None, mesh=None):
+                 scope: Optional[Scope] = None, mesh=None,
+                 row_shard: Optional[tuple] = None):
     """Restore a sharded checkpoint.  With `mesh`, every var that recorded a
     PartitionSpec is rebuilt via jax.make_array_from_callback — each device
     reads exactly its slice from the shard files (memmapped, no full-array
     host materialization when the layouts match).  Without a mesh, shards
-    are assembled on host."""
+    are assembled on host.
+
+    The manifest merge + region reader make the load ELASTIC by
+    construction: shards saved by N processes cover the global array, and
+    whatever mesh the restoring process set brings (M processes, a
+    different axis split, or none at all) is served by re-slicing that
+    coverage.  SelectedRows entries consolidate by ROW ID and — when
+    `row_shard=(rank, world)` is given — re-deal each restoring rank
+    exactly the rows it owns under the canonical contiguous partition
+    (`parallel.sharding.row_range`)."""
     import jax
 
     import glob as _glob
@@ -234,6 +265,14 @@ def load_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
                 manifest["vars"].append(e)
                 by_name[e["name"]] = e
                 continue
+            if e.get("selected_rows") or tgt.get("selected_rows"):
+                # row slabs dedup by file, not by index (each process's
+                # slab is its own disjoint row-id set)
+                have = {sh.get("rows_file") for sh in tgt["shards"]}
+                for sh in e["shards"]:
+                    if sh.get("rows_file") not in have:
+                        tgt["shards"].append(sh)
+                continue
             have = {tuple(tuple(p) for p in sh["index"]) for sh in tgt["shards"]}
             for sh in e["shards"]:
                 if tuple(tuple(p) for p in sh["index"]) not in have:
@@ -243,6 +282,26 @@ def load_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
     for entry in manifest["vars"]:
         name = entry["name"]
         if want is not None and name not in want:
+            continue
+        if entry.get("selected_rows"):
+            from .core.selected_rows import SelectedRows
+            from .parallel.sharding import (consolidate_selected_rows,
+                                            repartition_selected_rows)
+
+            height = int(entry["height"])
+            slabs = []
+            for sh in entry["shards"]:
+                r = np.load(os.path.join(dirname, sh["rows_file"]))
+                v = _loaded_view(
+                    np.load(os.path.join(dirname, sh["values_file"])),
+                    sh.get("stored_as"))
+                slabs.append((r, v))
+            rows, vals = consolidate_selected_rows(slabs, height)
+            if row_shard is not None:
+                rows, vals = repartition_selected_rows(
+                    rows, vals, height, row_shard[0], row_shard[1])
+            scope.set_var(name, SelectedRows(rows, vals, height))
+            loaded.append(name)
             continue
         shape = tuple(entry["global_shape"])
         mms = [(sh["index"], _loaded_view(

@@ -25,6 +25,17 @@ worker spawning — plus what the test harness never had:
     RESUME.json sidecar carries the pickled reader state, so a restart
     never replays the dataset to find its place.
 
+  * **elastic gangs** (ISSUE 9) — with `elastic=True` (CLI `--elastic`)
+    the relaunch follows capacity: an unclassified death shrinks the
+    next incarnation to N−1 (classified 43/44 exits are survivors
+    reacting, not lost capacity) and workers resume via the elastic
+    checkpoint path (`CheckpointManager` N→M re-sharding + stream-cursor
+    repartition, `paddle_tpu/elastic.py`); once the shrunk gang commits
+    a fresh checkpoint and capacity returns, the supervisor drains it
+    gracefully (SIGTERM → flush → exit 0) and grows back toward
+    `--nproc`.  Every resize is a `gang_resize` dist_event gated by
+    `perf_report --check --max-gang-resizes`.
+
 The once-per-gang fault ledger (`PADDLE_FAULT_STATE_DIR`, exported per
 run_gang call) also covers the data faults `corrupt_chunk@N` /
 `truncated_file@N`: a restarted incarnation re-opens its RecordIO files,
@@ -276,6 +287,39 @@ class GangResult:
     # plus the supervisor's INCIDENT.i<k>.json files — the input of
     # tools/trace_merge.py and perf_report --postmortem
     telemetry_dir: Optional[str] = None
+    # elastic supervision (ISSUE 9): world-size changes across the run
+    resizes: int = 0
+    # one dict per resize: {"direction", "from_nprocs", "to_nprocs", ...}
+    resize_events: List[dict] = field(default_factory=list)
+    # gang size of each incarnation, in order (e.g. [2, 1, 2] for an
+    # N -> N-1 -> N cycle)
+    size_history: List[int] = field(default_factory=list)
+    final_nprocs: int = 0
+    # every incarnation's per-rank (returncode, stdout, stderr) — the
+    # last entry aliases `workers`; elastic accounting (which steps each
+    # incarnation actually trained) needs the full history
+    history: List[List[tuple]] = field(default_factory=list)
+
+
+def _latest_commit_step(checkpoint_root: Optional[str]) -> int:
+    """Step of the newest COMMITTED checkpoint under `checkpoint_root`
+    (-1 when none): the elastic supervisor's progress probe — growth only
+    interrupts a shrunk gang once it has durably committed something, so
+    a resize can never lose more work than a plain restart would."""
+    if not checkpoint_root or not os.path.isdir(checkpoint_root):
+        return -1
+    best = -1
+    for name in os.listdir(checkpoint_root):
+        if not name.startswith("ckpt-") or name.endswith(".tmp"):
+            continue
+        if not os.path.exists(os.path.join(checkpoint_root, name,
+                                           "COMMITTED")):
+            continue
+        try:
+            best = max(best, int(name[len("ckpt-"):]))
+        except ValueError:
+            continue
+    return best
 
 
 def _clear_uncommitted(checkpoint_root: str):
@@ -300,6 +344,9 @@ def run_gang(argv: Sequence[str], n_procs: int, *,
              timeout: float = 600,
              grace_s: float = 3.0,
              peer_grace_s: float = 15.0,
+             elastic: bool = False,
+             min_procs: int = 1,
+             capacity_fn=None,
              log: bool = True) -> GangResult:
     """Supervise `n_procs` copies of `argv` with gang-restart semantics.
 
@@ -311,7 +358,34 @@ def run_gang(argv: Sequence[str], n_procs: int, *,
     checkpoint debris is cleared, and the gang relaunches — workers
     restore the last COMMITTED coordinated checkpoint and continue with
     global step numbering.  After `max_restarts` exhausted the last
-    incarnation's outputs come back with ok=False."""
+    incarnation's outputs come back with ok=False.
+
+    Elastic mode (ISSUE 9, `elastic=True`): the relaunch after a death
+    follows CAPACITY instead of always reusing `n_procs`.
+
+      * **shrink-on-death**: each unclassified death (SIGKILL, crash —
+        NOT the classified 43/44 exits, which are survivors REACTING to a
+        peer's death and relaunchable on the same host) is lost capacity;
+        the next incarnation runs at `max(min_procs, cur - lost)` workers.
+        Workers restore the last COMMITTED checkpoint elastically
+        (CheckpointManager N->M re-sharding + cursor repartition) and the
+        run CONTINUES at reduced size within the same grace window a
+        fixed-size restart would need — never a same-size relaunch into
+        the missing capacity.
+      * **grow-on-capacity**: while running below `n_procs`, the
+        supervisor watches for (a) a NEW committed checkpoint — proof the
+        shrunk gang made durable progress, so growing cannot lose more
+        work than a restart — and (b) available capacity
+        (`capacity_fn()`, default: the target size, i.e. capacity returns
+        as soon as the shrunk gang commits).  Both true -> the gang is
+        drained gracefully (SIGTERM -> each worker's resilient loop
+        flushes a coordinated checkpoint and exits 0) and relaunched at
+        `min(n_procs, capacity)`.  Grows spend no restart budget.
+
+    Every resize emits a `kind="dist_event" action="gang_resize"` record
+    and bumps `dist.gang_resizes` (gated by `perf_report --check
+    --max-gang-resizes`); `GangResult.size_history` / `resize_events` /
+    `history` carry the full ledger."""
     result = GangResult()
     base_env = dict(extra_env or {})
     if checkpoint_root:
@@ -334,8 +408,14 @@ def run_gang(argv: Sequence[str], n_procs: int, *,
                           else tempfile.mkdtemp(prefix="pt-telemetry-"))
     os.makedirs(telemetry_root, exist_ok=True)
     result.telemetry_dir = telemetry_root
-    for incarnation in range(max_restarts + 1):
+    target = int(n_procs)
+    min_procs = max(1, int(min_procs))
+    cur = target
+    restarts_left = int(max_restarts)
+    incarnation = 0
+    while True:
         result.incarnations = incarnation + 1
+        result.size_history.append(cur)
         env = dict(base_env)
         env["PADDLE_RESTART_NUM"] = str(incarnation)
         inc_tel = os.path.join(telemetry_root, f"i{incarnation}")
@@ -346,12 +426,50 @@ def run_gang(argv: Sequence[str], n_procs: int, *,
             inc_dir = os.path.join(hb, f"i{incarnation}")
             shutil.rmtree(inc_dir, ignore_errors=True)
             env["PADDLE_HEARTBEAT_DIR"] = inc_dir
-        with Gang(argv, n_procs, devices_per_proc=devices_per_proc,
+        grow_to = None
+        with Gang(argv, cur, devices_per_proc=devices_per_proc,
                   extra_env=env, grace_s=grace_s) as gang:
-            try:
-                ok, codes = gang.wait_any_death_or_exit(timeout=timeout)
-            except TimeoutError:
-                ok, codes = False, [p.poll() for p in gang.procs]
+            # progress baseline for the grow decision: only a commit made
+            # by THIS (shrunk) incarnation proves it is safe to interrupt
+            commit_baseline = _latest_commit_step(checkpoint_root) \
+                if elastic else None
+            t0 = time.monotonic()
+            ok = False
+            while True:
+                codes = [p.poll() for p in gang.procs]
+                if any(c not in (None, 0) for c in codes):
+                    ok = False
+                    break
+                if all(c == 0 for c in codes):
+                    ok = True
+                    break
+                if time.monotonic() - t0 > timeout:
+                    ok = False
+                    break
+                if (elastic and grow_to is None and cur < target
+                        and checkpoint_root
+                        and _latest_commit_step(checkpoint_root)
+                        > commit_baseline):
+                    try:
+                        cap = int((capacity_fn or (lambda: target))())
+                    except Exception:
+                        cap = target
+                    want = min(target, max(cur, cap))
+                    if want > cur:
+                        # capacity is back and the shrunk gang has durable
+                        # progress: drain it gracefully (SIGTERM -> each
+                        # worker flushes a coordinated checkpoint and
+                        # exits 0) and relaunch at the grown size
+                        grow_to = want
+                        for p in gang.procs:
+                            if p.poll() is None:
+                                p.terminate()
+                        if log:
+                            print(f"paddle_tpu.launch: capacity returned — "
+                                  f"draining the {cur}-worker gang to grow "
+                                  f"back to {grow_to}",
+                                  file=sys.stderr, flush=True)
+                time.sleep(0.05)
             if not ok:
                 # survivors are raising classified errors right now (their
                 # watchdogs see the dead peer); give them one bounded
@@ -363,13 +481,32 @@ def run_gang(argv: Sequence[str], n_procs: int, *,
                     time.sleep(0.05)
                 codes = [p.poll() for p in gang.procs]
             result.workers = gang.communicate(timeout=grace_s)
-        if ok:
+            result.history.append(result.workers)
+        if ok and grow_to is None:
             result.ok = True
+            result.final_nprocs = cur
             return result
+        if ok and grow_to is not None:
+            # clean drain: every worker flushed and exited 0 — relaunch
+            # bigger.  Spends no restart budget (nothing failed).
+            resize = {"kind": "dist_event", "action": "gang_resize",
+                      "direction": "grow", "from_nprocs": cur,
+                      "to_nprocs": grow_to, "incarnation": incarnation + 1}
+            result.resizes += 1
+            result.resize_events.append(resize)
+            _MON.counter("dist.gang_resizes").inc()
+            _MON.record_step(resize)
+            if log:
+                print(f"paddle_tpu.launch: gang grown {cur} -> {grow_to} "
+                      f"workers (resumed from the drain checkpoint)",
+                      file=sys.stderr, flush=True)
+            cur = grow_to
+            incarnation += 1
+            continue
         dead = [(r, c) for r, c in enumerate(codes) if c not in (None, 0)]
         incident = {
             "kind": "dist_event", "action": "worker_death",
-            "incarnation": incarnation,
+            "incarnation": incarnation, "nprocs": cur,
             "dead": [{"rank": r, "returncode": c,
                       "classified": c in _CLASSIFIED_EXITS,
                       "signaled": (c is not None and c < 0)}
@@ -408,21 +545,46 @@ def run_gang(argv: Sequence[str], n_procs: int, *,
                 print(f"paddle_tpu.launch: worker {r} died "
                       f"(returncode {c}) in incarnation {incarnation}:\n"
                       f"{(err or '')[-2000:]}", file=sys.stderr, flush=True)
-        if incarnation == max_restarts:
+        if restarts_left == 0:
             break
         _clear_uncommitted(checkpoint_root or "")
+        nxt = cur
+        if elastic:
+            # classified 43/44 exits are survivors REACTING to a peer's
+            # death — relaunchable on the same host; only unclassified
+            # deaths (SIGKILL, crash, a never-exiting straggler) are
+            # capacity that actually left
+            lost = sum(1 for _r, c in dead if c not in _CLASSIFIED_EXITS)
+            if lost:
+                nxt = max(min_procs, cur - lost)
+        if nxt != cur:
+            resize = {"kind": "dist_event", "action": "gang_resize",
+                      "direction": "shrink", "from_nprocs": cur,
+                      "to_nprocs": nxt, "incarnation": incarnation + 1,
+                      "after_death_of": [r for r, _ in dead]}
+            result.resizes += 1
+            result.resize_events.append(resize)
+            _MON.counter("dist.gang_resizes").inc()
+            _MON.record_step(resize)
+        restarts_left -= 1
         result.restarts += 1
         _MON.counter("dist.gang_restarts").inc()
         _MON.record_step({"kind": "dist_event", "action": "gang_restart",
                           "incarnation": incarnation + 1,
+                          "nprocs": nxt,
                           "after_death_of": [r for r, _ in dead]})
         if log:
+            what = (f"continuing at {nxt} workers (elastic shrink)"
+                    if nxt != cur else f"relaunching {nxt} workers")
             print(f"paddle_tpu.launch: gang restart "
-                  f"{result.restarts}/{max_restarts} — relaunching "
-                  f"{n_procs} workers from the last coordinated checkpoint",
+                  f"{result.restarts}/{max_restarts} — {what} from the "
+                  f"last coordinated checkpoint",
                   file=sys.stderr, flush=True)
+        cur = nxt
+        incarnation += 1
     _MON.record_step({"kind": "dist_event", "action": "gang_failed",
                       "restarts": result.restarts})
+    result.final_nprocs = cur
     return result
 
 
@@ -435,6 +597,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="workers in the gang (PADDLE_TRAINERS_NUM role)")
     ap.add_argument("--devices-per-proc", type=int, default=1)
     ap.add_argument("--max-restarts", type=int, default=2)
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic supervision: continue at N-1 workers "
+                         "after an unclassified death (instead of a "
+                         "same-size relaunch) and grow back toward "
+                         "--nproc once the shrunk gang commits a "
+                         "checkpoint and capacity returns")
+    ap.add_argument("--min-procs", type=int, default=1,
+                    help="elastic floor: never shrink below this many "
+                         "workers")
     ap.add_argument("--checkpoint-root", default=None,
                     help="coordinated-checkpoint directory (also exported "
                          "as PADDLE_CHECKPOINT_ROOT to workers)")
@@ -465,7 +636,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    max_restarts=ns.max_restarts,
                    checkpoint_root=ns.checkpoint_root,
                    telemetry_root=ns.telemetry_root,
-                   timeout=ns.timeout)
+                   timeout=ns.timeout,
+                   elastic=ns.elastic, min_procs=ns.min_procs)
     for rank, (code, out, err) in enumerate(res.workers):
         sys.stdout.write(out or "")
         if code != 0:
@@ -476,9 +648,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         from . import monitor as _monitor
 
         _monitor.get_monitor().detach_logger(logger)
+    sizes = (f", sizes {res.size_history} ({res.resizes} resize(s))"
+             if res.resizes else "")
     print(f"paddle_tpu.launch: {'ok' if res.ok else 'FAILED'} after "
-          f"{res.incarnations} incarnation(s), {res.restarts} restart(s); "
-          f"telemetry in {res.telemetry_dir}",
+          f"{res.incarnations} incarnation(s), {res.restarts} restart(s)"
+          f"{sizes}; telemetry in {res.telemetry_dir}",
           file=sys.stderr)
     return 0 if res.ok else 1
 

@@ -499,6 +499,227 @@ def firstn(reader, n):
     return _FirstN(reader, n)
 
 
+# --- elastic sample sharding (ISSUE 9) --------------------------------------
+
+class _ShardReader(_StatefulDecorator):
+    """Strided sample shard of a global stream: rank `r` of `world` yields
+    exactly the samples whose GLOBAL index i satisfies i % world == r.
+    Every rank iterates the same base stream and keeps its 1/world — the
+    classic dp sharding that needs no index, and the ONE sharded layout
+    whose cursors are exactly re-splittable when the world size changes.
+
+    Stream state: `{"kind": "shard", rank, world, pos, base}` where `pos`
+    is the next GLOBAL index this rank will examine (last yielded id + 1
+    once iterating) and `base` is the wrapped reader's state at that
+    position (None for a non-checkpointable base: resume then replays
+    `pos` base items — loud, O(pos) — instead of seeking).
+
+    Elastic N->M: `repartition_shard_states` merges all N ranks' cursors
+    into the global consumed-prefix watermark G and deals M fresh cursors
+    positioned at G — no sample dropped, none double-trained — reusing
+    the highest rank's base state, which sits exactly at G.  See the
+    docstring there for why that works."""
+
+    def __init__(self, reader, rank: int, world: int):
+        if not (0 <= int(rank) < int(world)):
+            raise ValueError(f"shard: rank {rank} outside world {world}")
+        self.reader = reader
+        self.rank = int(rank)
+        self.world = int(world)
+        self._sources = (reader,)
+        self._resume: Optional[dict] = None
+        self._live: Optional[dict] = None
+
+    def checkpointable(self) -> bool:
+        # position is exact even over a stateless (but deterministic)
+        # base — resume degrades to a loud replay fast-forward of `pos`
+        # base items rather than an O(1) seek
+        return True
+
+    def _state(self, pos: int) -> dict:
+        base = self.reader.state_dict() if is_checkpointable(self.reader) \
+            else None
+        return {"kind": "shard", "rank": self.rank, "world": self.world,
+                "pos": int(pos), "base": base}
+
+    def state_dict(self) -> dict:
+        if self._live is not None:
+            return dict(self._live)
+        if self._resume is not None:
+            return dict(self._resume)
+        return self._state(0)
+
+    def load_state_dict(self, state: dict):
+        if state.get("kind") != "shard":
+            raise ValueError(f"shard.load_state_dict: not a shard cursor "
+                             f"({sorted(state)})")
+        if (int(state["world"]) != self.world
+                or int(state["rank"]) != self.rank):
+            raise ValueError(
+                f"shard.load_state_dict: cursor is for rank "
+                f"{state['rank']}/{state['world']} but this reader is rank "
+                f"{self.rank}/{self.world} — repartition the cursors "
+                f"(reader.repartition_stream_states) instead of loading a "
+                f"foreign rank's position")
+        self._resume = dict(state)
+        self._live = None
+
+    def __call__(self):
+        import logging
+
+        resume, self._resume = self._resume, None
+        pos = 0
+        src = self.reader
+        stateful = is_checkpointable(src)
+        if resume is not None:
+            pos = int(resume["pos"])
+            if resume.get("base") is not None and stateful:
+                src.load_state_dict(resume["base"])
+                it = iter(src() if callable(src) else src)
+            else:
+                # loud replay fast-forward: the base is deterministic but
+                # not seekable, so position by discarding `pos` items
+                it = iter(src() if callable(src) else src)
+                if pos:
+                    _MON.counter("data.shard_replay").inc(pos)
+                    logging.getLogger("paddle_tpu.reader").warning(
+                        "shard resume: base reader is not checkpointable — "
+                        "replaying %d item(s) to reach global position %d "
+                        "(give the shard a stateful base for an O(1) seek)",
+                        pos, pos)
+                    for _ in range(pos):
+                        try:
+                            next(it)
+                        except StopIteration:
+                            raise RuntimeError(
+                                f"shard resume: base stream ended at item "
+                                f"< {pos} while fast-forwarding — the base "
+                                f"must replay the same deterministic stream")
+        else:
+            it = iter(src() if callable(src) else src)
+        self._live = {"kind": "shard", "rank": self.rank,
+                      "world": self.world, "pos": pos,
+                      "base": (resume or {}).get("base")
+                      if resume is not None
+                      else (src.state_dict() if stateful else None)}
+        while True:
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            i = pos
+            pos += 1
+            if i % self.world == self.rank:
+                self._live = self._state(pos)
+                yield item
+
+
+def shard(reader, rank: int, world: int):
+    """Strided 1/world sample shard for `rank`; see _ShardReader (exact
+    elastic cursor repartitioning when the world size changes)."""
+    return _ShardReader(reader, rank, world)
+
+
+def repartition_shard_states(states: Sequence[dict], new_world: int
+                             ) -> List[dict]:
+    """Exactly re-split N shard cursors for M ranks.
+
+    Why this is exact: in lock-step training every rank has yielded the
+    same count j of samples, so the union of everything yielded is the
+    contiguous global prefix [0, G) with G = max(pos_r), and the N
+    cursor positions are exactly the multiset {G, G-1, ..., G-N+1} —
+    one per residue class, since rank r's last yield was ≡ r (mod N).
+    (Which RANK holds the maximum depends on where the stream last
+    started: a previous repartition at a watermark not divisible by N
+    rotates the assignment, so the check validates the multiset plus
+    each rank's residue, never a fixed rank order.)  The M new strided
+    shards all start examining at G: rank r' keeps the ids >= G with
+    id % M == r', which partitions [G, ...) with nothing dropped and
+    nothing repeated.  The old rank whose cursor sits at G saw its last
+    yield at id G-1, so its base state is exactly at G and every new
+    cursor can reuse it for an O(1) seek.
+
+    Raises ValueError when the cursors do NOT describe such a prefix
+    (mixed worlds, missing ranks, unequal yield counts) — the caller
+    falls back to a loud replay fast-forward or refuses, never to a
+    silent approximate split."""
+    import copy
+
+    if not states:
+        raise ValueError("repartition_shard_states: no cursors")
+    new_world = int(new_world)
+    if new_world < 1:
+        raise ValueError(f"repartition_shard_states: new_world={new_world}")
+    by_rank: Dict[int, dict] = {}
+    world = None
+    for st in states:
+        if not (isinstance(st, dict) and st.get("kind") == "shard"):
+            raise ValueError(
+                "repartition_shard_states: cursor is not a shard state")
+        w, r = int(st["world"]), int(st["rank"])
+        if world is None:
+            world = w
+        elif w != world:
+            raise ValueError(
+                f"repartition_shard_states: mixed worlds {world} vs {w}")
+        if r in by_rank:
+            raise ValueError(f"repartition_shard_states: duplicate rank {r}")
+        by_rank[r] = st
+    if sorted(by_rank) != list(range(world)):
+        raise ValueError(
+            f"repartition_shard_states: incomplete rank set "
+            f"{sorted(by_rank)} for world {world}")
+    G = max(int(st["pos"]) for st in by_rank.values())
+    boundary = all(int(st["pos"]) == G for st in by_rank.values())
+    if not boundary:
+        got = sorted(int(st["pos"]) for st in by_rank.values())
+        want = list(range(G - world + 1, G + 1))
+        if got != want:
+            raise ValueError(
+                f"repartition_shard_states: rank cursors are not a "
+                f"consistent prefix (positions {got}, expected the "
+                f"multiset {want} for watermark {G}) — an exact N->M "
+                f"split is impossible")
+        for r, st in by_rank.items():
+            p = int(st["pos"])
+            if (p - 1) % world != r:
+                raise ValueError(
+                    f"repartition_shard_states: rank {r}'s cursor at pos "
+                    f"{p} is not on its own residue class (last yield "
+                    f"must be ≡ {r} mod {world}) — the cursors belong to "
+                    f"a different shard layout")
+    donor = next(st for st in by_rank.values() if int(st["pos"]) == G)
+    return [{"kind": "shard", "rank": r, "world": new_world, "pos": G,
+             "base": copy.deepcopy(donor.get("base"))}
+            for r in range(new_world)]
+
+
+def repartition_stream_states(states: Sequence[dict], new_world: int
+                              ) -> List[dict]:
+    """Re-split whole-pipeline cursors N->M by descending through
+    single-source decorator states (`{"src": ...}` — batch readers and
+    friends) to the shard layer.  Decorators whose state is rank-local
+    (shuffle buffers, chain positions) cannot sit ABOVE the shard layer
+    and repartition exactly; anything below it rides along via the donor
+    base state."""
+    if all(isinstance(s, dict) and s.get("kind") == "shard" for s in states):
+        return repartition_shard_states(states, new_world)
+    if all(isinstance(s, dict) and set(s) == {"src"} for s in states):
+        inner = repartition_stream_states([s["src"] for s in states],
+                                          new_world)
+        return [{"src": st} for st in inner]
+    if all(isinstance(s, dict) and set(s) == {"srcs"}
+           and len(s["srcs"]) == 1 for s in states):
+        # a single-source map_readers wrapper
+        inner = repartition_stream_states([s["srcs"][0] for s in states],
+                                          new_world)
+        return [{"srcs": [st]} for st in inner]
+    raise ValueError(
+        "repartition_stream_states: no shard layer found in the cursors — "
+        "only pipelines of single-source decorators over reader.shard() "
+        "repartition exactly")
+
+
 # --- FeedSpec: the feed-boundary contract -----------------------------------
 
 def _kind_castable(src: np.dtype, dst: np.dtype) -> bool:

@@ -454,15 +454,30 @@ def resilient_train_loop(
     def _read_resume(step: int) -> dict:
         """The RESUME sidecar of the checkpoint that actually restored
         (not latest() — restore may have walked past a corrupt newer one
-        whose sidecar would misalign the data stream).  Tries this rank's
-        namespaced name first, then the single-process name (a checkpoint
-        written before the gang grew)."""
-        names = [resume_sidecar_name(getattr(cm, "rank", 0),
-                                     getattr(cm, "world_size", 1)),
+        whose sidecar would misalign the data stream).
+
+        Elastic resume (ISSUE 9): when the restored checkpoint was
+        written by a DIFFERENT world size (cm.restored_world), this
+        rank's own sidecar either does not exist or — worse — carries a
+        cursor for the OLD partition; the old world's sidecars are merged
+        and re-split instead (`elastic.repartition_resume_info`), exactly
+        when the pipeline allows it and via loud replay fast-forward when
+        not.  Otherwise: this rank's namespaced name first, then the
+        single-process name (a checkpoint written before the gang grew
+        past one worker, same size)."""
+        d = getattr(cm, "last_restored_dir", None) or cm._dir(step)
+        saved_world = getattr(cm, "restored_world", None)
+        cur_world = getattr(cm, "world_size", 1)
+        if saved_world and saved_world != cur_world:
+            from . import elastic as _elastic
+
+            return _elastic.repartition_resume_info(
+                d, saved_world, getattr(cm, "rank", 0), cur_world)
+        names = [resume_sidecar_name(getattr(cm, "rank", 0), cur_world),
                  RESUME_FILE]
         for name in names:
             try:
-                with open(os.path.join(cm._dir(step), name)) as f:
+                with open(os.path.join(d, name)) as f:
                     return json.load(f)
             except OSError:
                 continue

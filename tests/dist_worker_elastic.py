@@ -1,0 +1,223 @@
+"""Elastic gang worker (ISSUE 9): the chaos-suite worker for N->M->N
+world-size cycles.
+
+Differences from dist_worker_resilient.py, which pins the FIXED-size
+restart contract:
+
+  * the whole run drives `resilient_train_loop` over a CHECKPOINTABLE
+    sharded data pipeline (`reader.shard` -> `batch` -> `map_readers`
+    over a deterministic global sample stream), so every coordinated
+    checkpoint carries per-rank RESUME sidecars with exact stream
+    cursors;
+  * the CheckpointManager is constructed `elastic=True`: a restart at a
+    DIFFERENT world size consolidates the saved shards and re-splits
+    them for the new rank set, and the resume path repartitions the
+    stream cursors (paddle_tpu/elastic.py) so no sample is dropped or
+    double-trained across the resize;
+  * SIGTERM (the supervisor's grow-drain notice) is handled by the
+    resilient loop: flush one coordinated checkpoint + cursors, print
+    the RESULT line with `preempted=true`, exit 0;
+  * every logged step appends `{"step", "loss", "idsum"}` to a per-rank,
+    per-incarnation ledger file (PT_LEDGER_DIR) — `idsum` is computed
+    THROUGH the training feed (the mean of the id column, fetched from
+    the compiled step, times the global batch), so the chaos test can
+    verify exact sample coverage from what the gang actually trained on,
+    not from what the reader claims it handed over.
+
+Batches are sample-sharded by global id (rank r of world M trains the
+ids ≡ r mod M), so the GLOBAL batch of step s is ids
+[s*GBS, (s+1)*GBS) at EVERY world size — the loss trajectory is
+world-size invariant up to float summation order, which is the
+loss-parity contract the elastic chaos test asserts (allclose, not
+bit-equal: a different world size reassociates the mean).
+"""
+import json
+import os
+import sys
+import time
+
+# must be set before jax import
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_xla_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        _xla_flags + " --xla_force_host_platform_device_count=1").strip()
+
+import hashlib  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+GBS = int(os.environ.get("GLOBAL_BS", "16"))
+
+
+class CountingBase:
+    """Checkpointable base stream of global sample ids [0, n)."""
+
+    def __init__(self, n: int):
+        self.n = int(n)
+        self._next = 0
+
+    def state_dict(self):
+        return {"pos": self._next}
+
+    def load_state_dict(self, state):
+        self._next = int(state["pos"])
+
+    def __call__(self):
+        i = self._next
+        self._next = 0
+        while i < self.n:
+            self._next = i + 1
+            yield i
+            i += 1
+            self._next = i
+
+
+def sample(i: int):
+    """Deterministic global sample `i` — identical whichever rank, world
+    size, or incarnation materializes it."""
+    rng = np.random.RandomState(50000 + i)
+    x = rng.rand(8).astype("f4")
+    y = np.array([x.sum() * 0.5 + 0.05 * rng.rand()], "f4")
+    return x, y
+
+
+def build_model():
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 91
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        idf = fluid.layers.data("idf", [1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        # the accounting probe: mean of the id column over the GLOBAL
+        # batch — fetched from the compiled step, so it reports what was
+        # actually fed, dp-mean-combined across ranks
+        idmean = fluid.layers.mean(idf)
+        fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+    return main, startup, loss, idmean
+
+
+def params_digest(scope) -> str:
+    h = hashlib.sha256()
+    for name in sorted(scope.local_var_names()):
+        try:
+            a = np.asarray(scope.find_var(name))
+        except Exception:
+            continue
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def params_l2(scope) -> float:
+    total = 0.0
+    for name in sorted(scope.local_var_names()):
+        try:
+            a = np.asarray(scope.find_var(name))
+        except Exception:
+            continue
+        if a.dtype.kind != "f":
+            continue  # RNG key etc. would drown the float params
+        a = a.astype("f8")
+        total += float((a * a).sum())
+    return float(np.sqrt(total))
+
+
+def main():
+    import paddle_tpu as fluid
+    from paddle_tpu import dist_resilience as dres
+    from paddle_tpu import reader as R
+    from paddle_tpu.errors import DistributedError
+    from paddle_tpu.fleet import fleet
+
+    run_steps = int(os.environ.get("RUN_STEPS", "12"))
+    save_every = int(os.environ.get("SAVE_EVERY", "2"))
+    step_sleep = float(os.environ.get("PT_STEP_SLEEP", "0"))
+    ckpt_root = os.environ.get("PADDLE_CHECKPOINT_ROOT")
+    restart_num = int(os.environ.get("PADDLE_RESTART_NUM", "0"))
+    ledger_dir = os.environ.get("PT_LEDGER_DIR")
+    total = run_steps * GBS
+
+    t0 = time.perf_counter()
+    try:
+        fleet.init()
+        rank, world = fleet.worker_index(), fleet.worker_num()
+        per = GBS // world
+        assert per * world == GBS, f"GLOBAL_BS={GBS} must divide world={world}"
+
+        main_p, startup, loss, idmean = build_model()
+        compiled = fleet.main_program(main_p) if world > 1 else main_p
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+
+        def make_feed(ids):
+            xs, ys = zip(*(sample(i) for i in ids))
+            return {"x": np.stack(xs), "y": np.stack(ys),
+                    "idf": np.array(ids, "f4").reshape(-1, 1)}
+
+        def make_loader():
+            base = CountingBase(total)
+            return R.map_readers(
+                make_feed, R.batch(R.shard(base, rank, world), per,
+                                   drop_last=True))
+
+        cm = fluid.CheckpointManager(
+            ckpt_root, program=main_p, scope=scope, rank=rank,
+            world_size=world, mesh=fleet.mesh if world > 1 else None,
+            save_every_steps=save_every, commit_timeout_s=30,
+            elastic=True)
+
+        ledger = None
+        if ledger_dir:
+            os.makedirs(ledger_dir, exist_ok=True)
+            ledger = open(os.path.join(
+                ledger_dir, f"ledger.r{rank}.i{restart_num}.jsonl"), "w")
+
+        logged = []  # (global step, loss, idsum) this incarnation ran
+
+        def on_logged(step, vals):
+            lv = float(np.asarray(vals[0]).reshape(-1)[0])
+            im = float(np.asarray(vals[1]).reshape(-1)[0])
+            logged.append((step, lv))
+            if ledger is not None:
+                ledger.write(json.dumps(
+                    {"step": step, "loss": lv,
+                     "idsum": round(im * GBS)}) + "\n")
+                ledger.flush()
+            if step_sleep:
+                time.sleep(step_sleep)
+
+        stats = fluid.resilient_train_loop(
+            exe, compiled, make_loader, [loss, idmean], scope=scope,
+            checkpoint_manager=cm, resume=restart_num > 0,
+            max_inflight=1, log_period=1, on_logged=on_logged,
+            max_steps=run_steps)
+    except DistributedError as e:
+        print(f"DIST_FAILURE {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+        dres.shutdown_health(mark_down=True)
+        os._exit(dres.exit_code_for(e))
+
+    start_step = min((s for s, _ in logged), default=stats.steps)
+    print("RESULT " + json.dumps({
+        "rank": rank, "world": world, "restart_num": restart_num,
+        "start_step": start_step,
+        "steps_run": len(logged), "steps_total": stats.steps,
+        "preempted": bool(stats.preempted),
+        "wall_s": round(time.perf_counter() - t0, 4),
+        "restored_world": cm.restored_world,
+        "params_sha": params_digest(scope),
+        "params_l2": params_l2(scope)}), flush=True)
+    if ledger is not None:
+        ledger.close()
+    dres.shutdown_health()
+
+
+if __name__ == "__main__":
+    main()

@@ -326,9 +326,12 @@ def test_coordinated_commit_requires_every_rank(tmp_path):
     assert os.path.exists(os.path.join(final, COMMITTED_MARKER))
     assert os.path.exists(os.path.join(final, DIST_MARKER))
     assert not os.path.exists(pending)
-    # restore round-trips state for both ranks' managers
+    # restore round-trips state; a world-1 reader of a world-2 checkpoint
+    # must opt into the elastic consolidation (ISSUE 9: the silent
+    # world-size assumption now raises CheckpointError)
     m2, s2 = _model(seed=9)
-    assert CheckpointManager(root, program=m2, scope=s2).restore(scope=s2) == 2
+    assert CheckpointManager(root, program=m2, scope=s2).restore(
+        scope=s2, elastic=True) == 2
     w_name = next(n for n in s0.local_var_names() if "w" in n or "fc" in n)
     np.testing.assert_array_equal(np.asarray(s2.find_var(w_name)),
                                   np.asarray(s0.find_var(w_name)))
@@ -348,7 +351,7 @@ def test_restore_skips_uncommitted_distributed_checkpoint(tmp_path):
     cm1.save(step=2)
     cm0.save(step=2)  # committed at step 2
     cm1.save(step=4)  # rank 0 "crashed": step 4 never commits
-    fresh = CheckpointManager(root, program=m0, scope=s0)
+    fresh = CheckpointManager(root, program=m0, scope=s0, elastic=True)
     assert fresh.restore(scope=s0) == 2
     # a mixed-step dir that somehow LOOKS final (legacy non-atomic rename)
     # is still refused without its COMMITTED marker
@@ -358,7 +361,8 @@ def test_restore_skips_uncommitted_distributed_checkpoint(tmp_path):
         f.write("6")
     with open(os.path.join(bad, DIST_MARKER), "w") as f:
         f.write("2")
-    assert CheckpointManager(root, program=m0, scope=s0).restore(scope=s0) == 2
+    assert CheckpointManager(root, program=m0, scope=s0).restore(
+        scope=s0, elastic=True) == 2
 
 
 def test_rank0_commit_wait_is_bounded_and_classified(tmp_path):

@@ -1,0 +1,489 @@
+"""Elastic N->M resume (ISSUE 9), in-process tier-1 coverage.
+
+Three layers, each with its own exactness contract:
+
+  * parameters: `io.save_sharded` shards written under one world size /
+    mesh split must consolidate and re-split BIT-IDENTICALLY for any
+    other (the region reader stitches coverage; SelectedRows re-deal by
+    row id);
+  * stream cursors: N `reader.shard` cursors re-split into M cursors
+    with exact sample coverage — nothing dropped, nothing double-
+    trained — across the same N->M matrix;
+  * the CheckpointManager contract: a world-size mismatch RAISES a
+    classified CheckpointError on the default path and re-shards on the
+    elastic path; commits garbage-collect stale pending dirs and
+    ghost-rank artifacts (`resilience.ckpt_gc`).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as fluid
+from paddle_tpu import io as pio
+from paddle_tpu import reader as R
+from paddle_tpu.core.selected_rows import SelectedRows
+from paddle_tpu.errors import CheckpointError
+from paddle_tpu.monitor import MONITOR as _MON
+from paddle_tpu.parallel import make_mesh
+from paddle_tpu.parallel.sharding import (consolidate_selected_rows,
+                                          repartition_selected_rows,
+                                          row_range)
+from paddle_tpu.resilience import resume_sidecar_name
+
+
+@pytest.fixture(autouse=True)
+def _mon_enabled():
+    """Counter asserts need the monitor live (inc() is a no-op disabled)."""
+    from paddle_tpu import monitor
+
+    monitor.enable()
+    yield
+
+
+# --- helpers ----------------------------------------------------------------
+
+class CountingBase:
+    """Checkpointable base stream of ints [0, n) (the unit-test stand-in
+    for a RecordIO scanner)."""
+
+    def __init__(self, n):
+        self.n = n
+        self._next = 0
+
+    def state_dict(self):
+        return {"pos": self._next}
+
+    def load_state_dict(self, state):
+        self._next = int(state["pos"])
+
+    def __call__(self):
+        i = self._next
+        self._next = 0
+        while i < self.n:
+            self._next = i + 1
+            yield i
+            i += 1
+            self._next = i
+
+
+class StatelessBase:
+    """Deterministic but NOT checkpointable: resume must replay."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def __call__(self):
+        yield from range(self.n)
+
+
+def _prog_for(scope):
+    """A program whose persistables are exactly the scope's numeric vars
+    (CheckpointManager saves program persistables)."""
+    prog = fluid.Program()
+    blk = prog.global_block()
+    for name in scope.local_var_names():
+        a = np.asarray(scope.find_var(name))
+        blk.create_parameter(name, list(a.shape), str(a.dtype))
+    return prog
+
+
+def _coordinated_save(root, scope, step, world=2, sidecars=None):
+    """Drive one coordinated world-N save in-process (rank 0 commits)."""
+    prog = _prog_for(scope)
+    cms = [fluid.CheckpointManager(root, program=prog, scope=scope,
+                                   rank=r, world_size=world,
+                                   commit_timeout_s=10)
+           for r in range(world)]
+    for r in range(world - 1, -1, -1):  # rank 0 last: it waits + commits
+        side = {resume_sidecar_name(r, world): sidecars[r]} \
+            if sidecars else None
+        cms[r].save(step=step, sidecars=side)
+    return cms
+
+
+# --- satellite: explicit world-size check -----------------------------------
+
+def test_restore_world_mismatch_raises_classified(tmp_path):
+    scope = fluid.Scope()
+    scope.set_var("w", np.arange(12, dtype="f4").reshape(3, 4))
+    root = str(tmp_path / "ck")
+    _coordinated_save(root, scope, step=4, world=2)
+
+    cm1 = fluid.CheckpointManager(root, scope=fluid.Scope(), world_size=1)
+    with pytest.raises(CheckpointError) as ei:
+        cm1.restore()
+    assert "2" in str(ei.value) and "1" in str(ei.value)
+    assert ei.value.saved_world == 2 and ei.value.current_world == 1
+    # classified: classify() keeps it (a TrainingError the resilient loop
+    # must never retry), and it names the checkpoint phase
+    from paddle_tpu.errors import classify
+
+    assert classify(ei.value) is ei.value
+    assert ei.value.phase == "checkpoint"
+
+
+def test_restore_world_mismatch_elastic_loads(tmp_path):
+    scope = fluid.Scope()
+    want = np.arange(12, dtype="f4").reshape(3, 4)
+    scope.set_var("w", want)
+    root = str(tmp_path / "ck")
+    _coordinated_save(root, scope, step=4, world=2)
+
+    scope1 = fluid.Scope()
+    cm1 = fluid.CheckpointManager(root, scope=scope1, world_size=1,
+                                  elastic=True)
+    assert cm1.restore() == 4
+    np.testing.assert_array_equal(np.asarray(scope1.find_var("w")), want)
+    assert cm1.restored_world == 2
+    assert cm1.last_restored_dir and cm1.last_restored_dir.endswith(
+        "ckpt-0000000004")
+
+
+# --- tentpole: N->M parameter re-sharding matrix ----------------------------
+
+@pytest.mark.parametrize("n,m", [(1, 2), (2, 1), (2, 4), (4, 2),
+                                 (3, 2), (2, 3)])
+def test_param_resharding_matrix_bit_identical(tmp_path, n, m):
+    """Shards saved from an n-way split restore bit-identically onto an
+    m-way split (including the non-divisor 3<->2 'odd' transitions)."""
+    rows = 12  # divisible by 1..4 and 6: every split in the matrix works
+    arr = np.random.RandomState(7).rand(rows, 5).astype("f4")
+    vec = np.random.RandomState(8).rand(rows).astype("f4")
+    mesh_n = make_mesh((n,), ("mp",))
+    scope = fluid.Scope()
+    scope.set_var("w", jax.device_put(jnp.asarray(arr),
+                                      NamedSharding(mesh_n, P("mp", None))))
+    scope.set_var("v", jax.device_put(jnp.asarray(vec),
+                                      NamedSharding(mesh_n, P("mp"))))
+    ck = str(tmp_path / "ck")
+    pio.save_sharded(ck, var_names=["w", "v"], scope=scope)
+
+    # consolidate-and-resplit onto the m-way mesh
+    mesh_m = make_mesh((m,), ("mp",))
+    scope2 = fluid.Scope()
+    pio.load_sharded(ck, scope=scope2, mesh=mesh_m)
+    got_w = scope2.find_var("w")
+    np.testing.assert_array_equal(np.asarray(got_w), arr)
+    np.testing.assert_array_equal(np.asarray(scope2.find_var("v")), vec)
+    assert tuple(got_w.sharding.spec) == ("mp", None)
+    assert len({s.device for s in got_w.addressable_shards}) == m
+
+    # ...and onto no mesh at all (host consolidation)
+    scope3 = fluid.Scope()
+    pio.load_sharded(ck, scope=scope3)
+    np.testing.assert_array_equal(np.asarray(scope3.find_var("w")), arr)
+
+
+# --- tentpole: SelectedRows repartitioned by row id -------------------------
+
+def test_selected_rows_row_range_partition():
+    assert row_range(12, 0, 2) == (0, 6)
+    assert row_range(12, 1, 2) == (6, 12)
+    # ceil split: remainder rows land on leading ranks, tail rank clips
+    assert [row_range(10, r, 3) for r in range(3)] == [(0, 4), (4, 8),
+                                                      (8, 10)]
+    cover = set()
+    for r in range(3):
+        lo, hi = row_range(10, r, 3)
+        cover.update(range(lo, hi))
+    assert cover == set(range(10))
+
+
+def test_selected_rows_elastic_resharding(tmp_path):
+    """A row-slab table saved by 2 ranks re-deals exactly onto 3."""
+    height, d = 12, 2
+    vals = np.arange(height * d, dtype="f4").reshape(height, d)
+    ck = str(tmp_path / "ck")
+    for r in range(2):
+        lo, hi = row_range(height, r, 2)
+        sc = fluid.Scope()
+        sc.set_var("tbl", SelectedRows(
+            np.arange(lo, hi, dtype=np.int32), vals[lo:hi], height))
+        pio.save_sharded(ck, var_names=["tbl"], scope=sc, process_index=r)
+
+    for r in range(3):
+        sc = fluid.Scope()
+        pio.load_sharded(ck, scope=sc, row_shard=(r, 3))
+        got = sc.find_var("tbl")
+        assert isinstance(got, SelectedRows)
+        lo, hi = row_range(height, r, 3)
+        np.testing.assert_array_equal(np.asarray(got.rows),
+                                      np.arange(lo, hi))
+        np.testing.assert_array_equal(np.asarray(got.values), vals[lo:hi])
+    # without row_shard: the full consolidated table
+    sc = fluid.Scope()
+    pio.load_sharded(ck, scope=sc)
+    got = sc.find_var("tbl")
+    np.testing.assert_array_equal(np.asarray(got.rows), np.arange(height))
+    np.testing.assert_array_equal(np.asarray(got.values), vals)
+
+
+def test_selected_rows_overlapping_shards_raise():
+    with pytest.raises(CheckpointError):
+        consolidate_selected_rows(
+            [(np.array([0, 1]), np.ones((2, 2), "f4")),
+             (np.array([1, 2]), np.ones((2, 2), "f4"))], height=4)
+
+
+def test_repartition_selected_rows_is_exact():
+    rows = np.array([0, 3, 5, 9, 11], np.int32)
+    vals = np.arange(10, dtype="f4").reshape(5, 2)
+    pieces = [repartition_selected_rows(rows, vals, 12, r, 3)
+              for r in range(3)]
+    got_rows = np.concatenate([p[0] for p in pieces])
+    got_vals = np.concatenate([p[1] for p in pieces])
+    np.testing.assert_array_equal(np.sort(got_rows), rows)
+    order = np.argsort(got_rows)
+    np.testing.assert_array_equal(got_vals[order], vals)
+
+
+# --- tentpole: stream-cursor N->M matrix ------------------------------------
+
+def _make_pipeline(rank, world, bs, total=96, base_cls=CountingBase):
+    return R.batch(R.shard(base_cls(total), rank, world), bs,
+                   drop_last=True)
+
+
+@pytest.mark.parametrize("n,m", [(1, 2), (2, 1), (2, 4), (4, 2),
+                                 (2, 3), (3, 2)])
+def test_cursor_repartition_matrix_exact_coverage(n, m):
+    """Consume k global batches at world n, repartition the cursors to
+    world m, drain: every sample appears exactly once overall."""
+    GBS, total = 12, 96
+    readers = [_make_pipeline(r, n, GBS // n) for r in range(n)]
+    its = [iter(rd()) for rd in readers]
+    consumed = []
+    for _ in range(3):  # 3 global steps in lockstep
+        for it in its:
+            consumed.extend(next(it))
+    states = [rd.state_dict() for rd in readers]
+    new_states = R.repartition_stream_states(states, m)
+    rest = []
+    for r, st in enumerate(new_states):
+        rd = _make_pipeline(r, m, GBS // m)
+        rd.load_state_dict(st)
+        for b in rd():
+            rest.extend(b)
+    assert sorted(consumed) == list(range(3 * GBS))
+    assert sorted(consumed + rest) == list(range(total)), \
+        "elastic resplit dropped or duplicated samples"
+
+
+def test_cursor_repartition_exact_seek_no_replay():
+    """With a checkpointable base the resplit seeks O(1): the loud
+    shard-replay counter must not move."""
+    before = _MON.counter("data.shard_replay").value
+    test_cursor_repartition_matrix_exact_coverage(2, 3)
+    assert _MON.counter("data.shard_replay").value == before
+
+
+def test_cursor_repartition_stateless_base_replays_loudly():
+    GBS, total = 12, 48
+    readers = [_make_pipeline(r, 2, GBS // 2, total, StatelessBase)
+               for r in range(2)]
+    its = [iter(rd()) for rd in readers]
+    consumed = []
+    for _ in range(2):
+        for it in its:
+            consumed.extend(next(it))
+    states = [rd.state_dict() for rd in readers]
+    assert all(st["src"]["base"] is None for st in states)
+    new_states = R.repartition_stream_states(states, 1)
+    before = _MON.counter("data.shard_replay").value
+    rd = _make_pipeline(0, 1, GBS, total, StatelessBase)
+    rd.load_state_dict(new_states[0])
+    rest = [x for b in rd() for x in b]
+    assert sorted(consumed + rest) == list(range(total))
+    # the fallback replayed the consumed prefix — loudly
+    assert _MON.counter("data.shard_replay").value == before + len(consumed)
+
+
+def test_cursor_repartition_chained_non_aligned_watermark_stays_exact():
+    """Second resize after a split at a watermark NOT divisible by the
+    new world size: the rank->position assignment rotates by G mod M, so
+    the validator must accept the position MULTISET per residue class —
+    a fixed rank-ordered formula wrongly rejected this and silently
+    degraded every non-divisor resize chain to O(dataset) replay."""
+    total = 120
+    # world 2, global batch 10 -> watermark 10 (10 % 3 == 1: non-aligned)
+    gen1 = [_make_pipeline(r, 2, 5, total) for r in range(2)]
+    its = [iter(rd()) for rd in gen1]
+    consumed = []
+    for it in its:
+        consumed.extend(next(it))
+    st2 = R.repartition_stream_states([rd.state_dict() for rd in gen1], 3)
+    # world 3, per-rank batch 2: two lock-step global steps from pos 10
+    gen2 = []
+    for r, st in enumerate(st2):
+        rd = _make_pipeline(r, 3, 2, total)
+        rd.load_state_dict(st)
+        gen2.append(rd)
+    its = [iter(rd()) for rd in gen2]
+    for _ in range(2):
+        for it in its:
+            consumed.extend(next(it))
+    states = [rd.state_dict() for rd in gen2]
+    # the rotated positions are a consistent prefix: must NOT raise, and
+    # must stay an exact O(1) seek (no loud replay)
+    before = _MON.counter("data.shard_replay").value
+    st3 = R.repartition_stream_states(states, 2)
+    rest = []
+    for r, st in enumerate(st3):
+        rd = _make_pipeline(r, 2, 11, total)
+        rd.load_state_dict(st)
+        for b in rd():
+            rest.extend(b)
+    assert _MON.counter("data.shard_replay").value == before
+    assert sorted(consumed) == list(range(22))
+    assert sorted(consumed + rest) == list(range(22 + 88)), \
+        "chained resize dropped or duplicated samples"
+
+
+def test_cursor_repartition_inconsistent_raises():
+    readers = [_make_pipeline(r, 2, 6) for r in range(2)]
+    its = [iter(rd()) for rd in readers]
+    next(its[0])
+    next(its[0])  # rank 0 two batches ahead: not a consistent prefix
+    next(its[1])
+    states = [rd.state_dict() for rd in readers]
+    with pytest.raises(ValueError):
+        R.repartition_stream_states(states, 3)
+
+
+def test_shard_rejects_foreign_rank_cursor():
+    rd = R.shard(CountingBase(10), 0, 2)
+    st = rd.state_dict()
+    rd2 = R.shard(CountingBase(10), 0, 3)
+    with pytest.raises(ValueError):
+        rd2.load_state_dict(st)
+
+
+# --- RESUME sidecar repartition end-to-end ----------------------------------
+
+def test_resume_sidecar_repartition_end_to_end(tmp_path):
+    """Coordinated world-2 checkpoint with real sidecars -> elastic
+    world-1 resume info with an exactly-repositioned cursor."""
+    from paddle_tpu import elastic as EL
+
+    GBS, total = 12, 60
+    readers = [_make_pipeline(r, 2, GBS // 2, total) for r in range(2)]
+    its = [iter(rd()) for rd in readers]
+    consumed = []
+    for _ in range(2):  # 2 global steps -> checkpoint at step 2
+        for it in its:
+            consumed.extend(next(it))
+    sidecars = []
+    for rd in readers:
+        sidecars.append(json.dumps({
+            "step": 2, "next_batch": 2, "skipped_batches": 0,
+            "stream_state": pio.pack_stream_state(rd.state_dict())}))
+    scope = fluid.Scope()
+    scope.set_var("w", np.ones(3, "f4"))
+    root = str(tmp_path / "ck")
+    _coordinated_save(root, scope, step=2, world=2, sidecars=sidecars)
+    d = os.path.join(root, "ckpt-0000000002")
+
+    info = EL.repartition_resume_info(d, old_world=2, new_rank=0,
+                                      new_world=1)
+    assert info["step"] == 2 and info["next_batch"] == 2
+    assert info["elastic_from"] == 2
+    assert "stream_state" in info, "exact split expected for shard cursors"
+    rd1 = _make_pipeline(0, 1, GBS, total)
+    rd1.load_state_dict(pio.unpack_stream_state(info["stream_state"]))
+    rest = [x for b in rd1() for x in b]
+    assert sorted(consumed + rest) == list(range(total))
+
+
+def test_resume_sidecar_repartition_inconsistent_raises(tmp_path):
+    from paddle_tpu import elastic as EL
+
+    d = str(tmp_path / "ckpt-0000000002")
+    os.makedirs(d)
+    for r, nb in enumerate([2, 5]):  # torn: ranks disagree on position
+        with open(os.path.join(d, resume_sidecar_name(r, 2)), "w") as f:
+            json.dump({"step": 2, "next_batch": nb}, f)
+    with pytest.raises(CheckpointError):
+        EL.repartition_resume_info(d, old_world=2, new_rank=0, new_world=1)
+
+
+def test_resume_sidecar_repartition_fallback_without_stream_state(tmp_path):
+    from paddle_tpu import elastic as EL
+
+    d = str(tmp_path / "ckpt-0000000004")
+    os.makedirs(d)
+    for r in range(2):
+        with open(os.path.join(d, resume_sidecar_name(r, 2)), "w") as f:
+            json.dump({"step": 4, "next_batch": 4, "skipped_batches": 1}, f)
+    info = EL.repartition_resume_info(d, old_world=2, new_rank=1,
+                                      new_world=3)
+    assert info["next_batch"] == 4 and "stream_state" not in info
+    assert info["skipped_batches"] == 1
+
+
+# --- satellite: checkpoint GC -----------------------------------------------
+
+def test_commit_sweeps_stale_pending_dirs(tmp_path):
+    root = str(tmp_path / "ck")
+    os.makedirs(os.path.join(root, "ckpt-0000000002.tmp"))
+    with open(os.path.join(root, "ckpt-0000000002.tmp", "junk"), "w") as f:
+        f.write("debris of a dead incarnation")
+    os.makedirs(os.path.join(root, "ckpt-0000000099.tmp"))  # future save
+    scope = fluid.Scope()
+    scope.set_var("w", np.ones(3, "f4"))
+    cm = fluid.CheckpointManager(root, scope=scope)
+    before = _MON.counter("resilience.ckpt_gc").value
+    cm.save(step=6)
+    assert not os.path.exists(os.path.join(root, "ckpt-0000000002.tmp"))
+    # a pending dir for a LATER step may be a live writer: left alone
+    assert os.path.exists(os.path.join(root, "ckpt-0000000099.tmp"))
+    assert _MON.counter("resilience.ckpt_gc").value == before + 1
+
+
+def test_coordinated_commit_sweeps_ghost_rank_artifacts(tmp_path):
+    """A pending dir reused at the same step by a previously-LARGER
+    incarnation: per-rank files of ranks >= the committing world size
+    must not survive into the committed checkpoint."""
+    root = str(tmp_path / "ck")
+    tmp = os.path.join(root, "ckpt-0000000004.tmp")
+    os.makedirs(tmp)
+    ghosts = ["SHARD_DONE.p3", "RESUME.p2.json",
+              "__sharded_manifest__.p2.json", "w.p3s0.npy"]
+    for g in ghosts:
+        with open(os.path.join(tmp, g), "w") as f:
+            f.write("ghost of world 4")
+    scope = fluid.Scope()
+    scope.set_var("w", np.ones(3, "f4"))
+    before = _MON.counter("resilience.ckpt_gc").value
+    _coordinated_save(root, scope, step=4, world=2)
+    final = os.path.join(root, "ckpt-0000000004")
+    assert os.path.exists(os.path.join(final, "COMMITTED"))
+    for g in ghosts:
+        assert not os.path.exists(os.path.join(final, g)), g
+    # current ranks' artifacts survive
+    assert os.path.exists(os.path.join(final, "SHARD_DONE.p0"))
+    assert os.path.exists(os.path.join(final, "SHARD_DONE.p1"))
+    assert _MON.counter("resilience.ckpt_gc").value >= before + len(ghosts)
+
+
+# --- satellite: health layer re-arms on resize ------------------------------
+
+def test_init_health_rearms_on_world_change(tmp_path, monkeypatch):
+    from paddle_tpu import dist_resilience as dres
+
+    monkeypatch.setenv("PADDLE_HEARTBEAT_DIR", str(tmp_path / "hb"))
+    try:
+        wd2 = dres.init_health(0, 2)
+        assert dres.init_health(0, 2) is wd2  # same membership: idempotent
+        assert dres.active_heartbeat().world == 2
+        wd3 = dres.init_health(0, 3)  # resized: re-armed
+        assert wd3 is not wd2
+        assert dres.active_heartbeat().world == 3
+        assert dres.active_watchdog() is wd3
+    finally:
+        dres.shutdown_health()

@@ -275,6 +275,18 @@ def gang_restart_count(lines):
     return int(_latest_dist_counters(lines).get("dist.gang_restarts", 0))
 
 
+def gang_resize_count(lines):
+    """Elastic world-size changes (paddle_tpu.launch `gang_resize`
+    dist_events; dist.gang_resizes counter fallback).  Each shrink is a
+    worker's capacity genuinely lost, each grow an interruption of the
+    shrunk gang — both legitimate under chaos, both worth a budget."""
+    n = sum(1 for r in lines if r.get("kind") == "dist_event"
+            and r.get("action") == "gang_resize")
+    if n:
+        return n
+    return int(_latest_dist_counters(lines).get("dist.gang_resizes", 0))
+
+
 def data_corrupt_fraction(lines):
     """Corrupt RecordIO chunks dropped per chunk scanned, from the newest
     counter snapshot (`data.corrupt_chunks` / `data.chunks_scanned`,
@@ -346,7 +358,8 @@ def check(path: str, steady_after: int = 2,
           max_gang_restarts: int = None,
           max_data_corrupt_frac: float = None,
           max_replay_batches: int = None,
-          max_step_skew_frac: float = None) -> int:
+          max_step_skew_frac: float = None,
+          max_gang_resizes: int = None) -> int:
     """Return 0 when the metrics file is healthy, 1 otherwise (printed
     diagnosis either way).  Made for CI/bench scripts:
 
@@ -374,7 +387,8 @@ def check(path: str, steady_after: int = 2,
                        or max_gang_restarts is not None
                        or max_data_corrupt_frac is not None
                        or max_replay_batches is not None
-                       or max_step_skew_frac is not None) \
+                       or max_step_skew_frac is not None
+                       or max_gang_resizes is not None) \
         and max_host_blocked_frac is None and max_retry_frac is None
     if not steps and not dist_gates_only:
         print(f"perf_report --check: {path} contains no step records "
@@ -462,6 +476,22 @@ def check(path: str, steady_after: int = 2,
         else:
             print(f"perf_report --check: gang restarts {n} <= "
                   f"{max_gang_restarts}")
+    if max_gang_resizes is not None:
+        n = gang_resize_count(lines)
+        if n > max_gang_resizes:
+            shrinks = sum(1 for r in lines if r.get("kind") == "dist_event"
+                          and r.get("action") == "gang_resize"
+                          and r.get("direction") == "shrink")
+            failures.append(
+                f"{n} gang resize(s) ({shrinks} shrink(s)) exceed the "
+                f"--max-gang-resizes={max_gang_resizes} gate — the gang's "
+                f"world size is churning beyond what the fault schedule "
+                f"explains (each shrink is lost capacity, each grow an "
+                f"interruption of the shrunk gang; see gang_resize "
+                f"dist_event records)")
+        else:
+            print(f"perf_report --check: gang resizes {n} <= "
+                  f"{max_gang_resizes}")
     if max_data_corrupt_frac is not None:
         frac = data_corrupt_fraction(lines)
         if frac > max_data_corrupt_frac:
@@ -830,6 +860,13 @@ def main(argv=None):
                     help="gate gang restarts (paddle_tpu.launch "
                          "gang_restart dist_event records / "
                          "dist.gang_restarts counter) at <= N")
+    ap.add_argument("--max-gang-resizes", type=int, default=None,
+                    metavar="N",
+                    help="gate elastic world-size changes "
+                         "(paddle_tpu.launch gang_resize dist_event "
+                         "records / dist.gang_resizes counter) at <= N — "
+                         "each shrink is capacity lost, each grow an "
+                         "interruption of the shrunk gang")
     ap.add_argument("--max-data-corrupt-frac", type=float, default=None,
                     metavar="FRAC",
                     help="gate corrupt RecordIO chunks per chunk scanned "
@@ -866,7 +903,7 @@ def main(argv=None):
                      args.max_host_blocked_frac, args.max_retry_frac,
                      args.max_heartbeat_miss_frac, args.max_gang_restarts,
                      args.max_data_corrupt_frac, args.max_replay_batches,
-                     args.max_step_skew_frac)
+                     args.max_step_skew_frac, args.max_gang_resizes)
     if args.diff:
         print(diff(*args.diff))
         return 0

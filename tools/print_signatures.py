@@ -39,6 +39,8 @@ MODULES = [
     "paddle_tpu.monitor",
     "paddle_tpu.launch",
     "paddle_tpu.dist_resilience",
+    # elastic N->M resume (ISSUE 9): the cursor-repartition module
+    "paddle_tpu.elastic",
 ]
 
 
