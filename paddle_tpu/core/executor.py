@@ -13,6 +13,7 @@ donated to the executable each step, so parameter updates are in-place in HBM.
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Union
 
@@ -171,6 +172,11 @@ class _CompiledStep:
                 self.csig = None
         self._exec = None
         self._exec_by_sig: Dict[tuple, object] = {}
+        # serving clones share _CompiledStep instances across threads: two
+        # threads cold-starting the same signature must build ONE
+        # executable (a double trace+compile would double-count the
+        # recompile gate and waste the compile lane)
+        self._build_lock = threading.Lock()
         self.last_lower_s = 0.0
         self.last_compile_s = 0.0
         self.last_recompiled = False
@@ -565,16 +571,17 @@ class _CompiledStep:
         use (and after a state-aval change) with the block->jaxpr lowering
         and the XLA compile timed as separate monitor spans."""
         self.last_recompiled = False
-        if self._exec is not None:
+        exec_ = self._exec
+        if exec_ is not None:
             try:
-                return self._exec(state_rw, state_ro, feeds, key)
+                return exec_(state_rw, state_ro, feeds, key)
             except TypeError:
                 # state avals changed (dtype promotion, resharding): the
                 # aval check fires before execution, so donated buffers are
                 # untouched.  Try an executable built for this signature
                 # before recompiling (jit's multi-entry cache role).
                 cached = self._exec_by_sig.get(self._state_sig(state_rw, state_ro))
-                if cached is not None and cached is not self._exec:
+                if cached is not None and cached is not exec_:
                     try:
                         out = cached(state_rw, state_ro, feeds, key)
                         self._exec = cached
@@ -582,21 +589,35 @@ class _CompiledStep:
                     except TypeError:
                         pass
                 self._exec = None
-        t0 = time.perf_counter()
-        lowered = self.jfn.trace(state_rw, state_ro, feeds, key).lower()
-        t1 = time.perf_counter()
-        self._exec = lowered.compile()
-        t2 = time.perf_counter()
-        self._exec_by_sig[self._state_sig(state_rw, state_ro)] = self._exec
-        if len(self._exec_by_sig) > 8:
-            self._exec_by_sig.pop(next(iter(self._exec_by_sig)))
-        self.last_lower_s = t1 - t0
-        self.last_compile_s = t2 - t1
-        self.last_recompiled = True
+        with self._build_lock:
+            # a concurrent thread (serving clones share this step) may
+            # have built the executable while we waited for the lock:
+            # serve from its entry instead of compiling a duplicate
+            sig = self._state_sig(state_rw, state_ro)
+            cached = self._exec_by_sig.get(sig)
+            if cached is not None:
+                try:
+                    out = cached(state_rw, state_ro, feeds, key)
+                    self._exec = cached
+                    return out
+                except TypeError:
+                    pass
+            t0 = time.perf_counter()
+            lowered = self.jfn.trace(state_rw, state_ro, feeds, key).lower()
+            t1 = time.perf_counter()
+            built = lowered.compile()
+            t2 = time.perf_counter()
+            self._exec = built
+            self._exec_by_sig[sig] = built
+            if len(self._exec_by_sig) > 8:
+                self._exec_by_sig.pop(next(iter(self._exec_by_sig)))
+            self.last_lower_s = t1 - t0
+            self.last_compile_s = t2 - t1
+            self.last_recompiled = True
         _MON.observe("executor.lower", self.last_lower_s, program=self.program_uuid)
         _MON.observe("executor.compile", self.last_compile_s, program=self.program_uuid)
         _MON.counter("executor.recompile").inc()
-        return self._exec(state_rw, state_ro, feeds, key)
+        return built(state_rw, state_ro, feeds, key)
 
     def __call__(self, scope: Scope, feeds: Dict[str, jnp.ndarray], key):
         if self.mesh is not None:
@@ -784,6 +805,12 @@ class Executor:
     def __init__(self, place: Optional[Place] = None):
         self.place = place if place is not None else TPUPlace(0)
         self._cache: Dict[tuple, _CompiledStep] = {}
+        # compile-cache bookkeeping lock: the LRU pop/re-insert pair and
+        # the miss-path build/insert must be atomic — two serving threads
+        # racing the same key would otherwise each count a miss and build
+        # a duplicate _CompiledStep (the serving cache-share contract is
+        # one compiled entry per (program, bucket shape) signature)
+        self._cache_lock = threading.Lock()
         self._host_eval_cache: Dict[tuple, Program] = {}
 
     def close(self):
@@ -1077,13 +1104,17 @@ class Executor:
             grad_overlap,
             _lowering_flags(),
         )
-        compiled = self._cache.pop(cache_key, None)
+        # the bookkeeping lock covers only the dict operations: a HIT (the
+        # serving steady state) never waits behind a concurrent miss's
+        # verify/build, which a hot reload's staged warm would otherwise
+        # stretch into a traffic stall
+        with self._cache_lock:
+            compiled = self._cache.pop(cache_key, None)
+            if compiled is not None:
+                self._cache[cache_key] = compiled  # re-insert: true LRU order
+                _MON.counter("executor.cache_hit").inc()
         cache_hit = compiled is not None
-        if compiled is not None:
-            self._cache[cache_key] = compiled  # re-insert: true LRU order
-            _MON.counter("executor.cache_hit").inc()
-        else:
-            _MON.counter("executor.cache_miss").inc()
+        if compiled is None:
             mesh_platform = (
                 mesh.devices.flat[0].platform if mesh is not None else device.platform
             )
@@ -1110,11 +1141,22 @@ class Executor:
                     local_sgd=bool(local_sgd_every),
                     grad_overlap=grad_overlap,
                 )
-            self._cache[cache_key] = compiled
-            from ..flags import flag as _flagv
-
-            if len(self._cache) > _flagv("FLAGS_executor_cache_capacity"):  # LRU evict
-                self._cache.pop(next(iter(self._cache)))
+            with self._cache_lock:
+                existing = self._cache.get(cache_key)
+                if existing is not None:
+                    # a racing thread built this signature while we did:
+                    # adopt its entry so the signature keeps ONE
+                    # _CompiledStep (its _build_lock then keeps XLA
+                    # compiles single too); our duplicate build was cheap
+                    # (no trace/compile happens until _dispatch)
+                    compiled = existing
+                    cache_hit = True
+                    _MON.counter("executor.cache_hit").inc()
+                else:
+                    _MON.counter("executor.cache_miss").inc()
+                    self._cache[cache_key] = compiled
+                    if len(self._cache) > _flagv("FLAGS_executor_cache_capacity"):  # LRU evict
+                        self._cache.pop(next(iter(self._cache)))
 
         if mesh is None:
             # Single-device: pin feeds and any host-resident state.

@@ -21,6 +21,13 @@ routes on:
     CheckpointError       a checkpoint that must not be loaded as asked
                           (world-size mismatch without elastic opt-in,
                           inconsistent rank cursors) — never retried
+    ServingError          the serving runtime (paddle_tpu/serving/)
+                          refused or failed a request/control action on
+                          purpose: admission control shed it, its deadline
+                          expired, a published snapshot failed
+                          verification, or a model load would blow the
+                          HBM budget.  `reason` carries the stable
+                          machine-readable code clients route on
 
 and, for the multi-worker tier (paddle_tpu/dist_resilience.py):
 
@@ -46,7 +53,7 @@ from __future__ import annotations
 
 __all__ = ["TrainingError", "DataError", "NumericError",
            "TransientDeviceError", "PreemptionError", "FatalError",
-           "CheckpointError",
+           "CheckpointError", "ServingError",
            "DistributedError", "PeerFailureError", "CollectiveTimeoutError",
            "classify", "attach_context", "get_context"]
 
@@ -131,6 +138,57 @@ class CheckpointError(TrainingError):
         super().__init__(message, **kw)
         self.saved_world = saved_world
         self.current_world = current_world
+
+
+class ServingError(TrainingError):
+    """The serving runtime (paddle_tpu/serving/) refused or failed a
+    request or control action BY DESIGN — these are the classified,
+    expected failures that keep an overloaded or mid-reload server
+    degrading gracefully instead of wedging:
+
+        reason="overload"          admission control shed the request (the
+                                   bounded queue was full; serving it would
+                                   grow latency without bound)
+        reason="timeout"           the request's deadline expired before a
+                                   batch picked it up
+        reason="oversize"          the request carries more rows than the
+                                   largest compiled bucket; split it
+        reason="bad_request"       the request itself is malformed (empty,
+                                   scalar or mismatched batch dims, feed
+                                   names/shapes off the model's contract) —
+                                   rejected at admission so it can never
+                                   poison the batch it would join
+        reason="publish_rejected"  a staged snapshot failed verification
+                                   (torn/corrupt files, program verifier,
+                                   NaN weights, golden-smoke failure) and
+                                   was quarantined — the old model keeps
+                                   serving
+        reason="hbm_budget"        loading the model would exceed the HBM
+                                   budget and eviction could not free
+                                   enough
+        reason="model_missing"     no model under that name (never loaded,
+                                   unloaded, or evicted)
+        reason="shutdown"          the server is draining/stopped
+
+    Never retried blindly: "overload"/"timeout" are backpressure the
+    CLIENT routes on (retry elsewhere, degrade, drop); the rest are
+    operator-facing.  `model` names the model involved, when any."""
+
+    def __init__(self, message: str, *, reason: Optional[str] = None,
+                 model: Optional[str] = None, **kw):
+        kw.setdefault("phase", "serving")
+        super().__init__(message, **kw)
+        self.reason = reason
+        self.model = model
+
+    def __str__(self):
+        base = super().__str__()
+        ctx = []
+        if self.reason:
+            ctx.append(f"reason={self.reason}")
+        if self.model:
+            ctx.append(f"model={self.model}")
+        return f"{base} [{', '.join(ctx)}]" if ctx else base
 
 
 class DistributedError(TrainingError):

@@ -187,6 +187,36 @@ DEFINE_float("FLAGS_dp_bucket_mb", 4.0,
              "this many bytes and each bucket is all-reduced as soon as "
              "its grads are ready, overlapping communication with the "
              "rest of the backward pass (the DDP bucketing strategy)")
+DEFINE_int("FLAGS_serving_max_queue", 256,
+           "admission-control bound on the serving runtime's request "
+           "queue (paddle_tpu/serving/server.py): a submit() past this "
+           "depth is SHED with a classified ServingError(reason="
+           "'overload') instead of growing tail latency without bound "
+           "(serving.shed counter; perf_report --check "
+           "--max-shed-frac gates the rate).  Per-Server override via "
+           "Server(max_queue=...)")
+DEFINE_float("FLAGS_serving_default_deadline_ms", 0.0,
+             "default per-request deadline for serving submits that do "
+             "not pass their own deadline_ms: a request still queued when "
+             "its deadline expires is cancelled with ServingError(reason="
+             "'timeout') and the batch proceeds without it "
+             "(serving.timeouts counter).  0 (default) = no deadline")
+DEFINE_float("FLAGS_serving_hbm_budget_mb", 0.0,
+             "HBM budget for multi-model co-residency in the serving "
+             "model registry (paddle_tpu/serving/registry.py): loading a "
+             "model past the budget first evicts cold (LRU, non-active) "
+             "models, then refuses loudly with ServingError(reason="
+             "'hbm_budget') — never OOMs the chip mid-request.  Live "
+             "usage rides the monitor/memstats gauges.  0 (default) = "
+             "unlimited")
+DEFINE_string("FLAGS_serving_buckets", "1,2,4,8,16,32",
+              "comma-separated pad-to-bucket batch sizes the serving "
+              "runtime compiles (paddle_tpu/serving/batcher.py): a "
+              "request batch pads up to the next bucket so a novel size "
+              "NEVER triggers an inline recompile — buckets warm at "
+              "model load (or in the publisher's pre-swap compile lane) "
+              "and steady-state serving must keep executor.recompile "
+              "flat (perf_report --check's recompile gate)")
 DEFINE_bool("FLAGS_cudnn_deterministic", True,
             "accepted no-op: XLA TPU lowerings are deterministic by default")
 DEFINE_float("FLAGS_fraction_of_gpu_memory_to_use", 1.0,

@@ -10,13 +10,22 @@ TPU-first: the "analysis passes" are XLA (whole-program fusion happens at
 compile, so the reference's fuse pass pipeline has no residue to apply);
 the predictor is a pruned Program + Scope + Executor with the compiled
 executable cached after the first call.  clone() shares the weights
-(read-only Scope) but gets its own Executor — the reference's
-clone-per-thread contract.  Int8 models saved via
+(read-only Scope) AND the Executor — so every clone serves from the same
+compiled-executable cache entry per (program, feed-shape) signature and N
+clones never compile N times (XLA executables are thread-safe; the
+reference's clone-per-thread contract kept for the handle dicts, which
+stay private per clone).  Thread safety: `run`/`run_zero_copy` hold a
+per-predictor lock — the staged input/output handle dicts are shared
+mutable state, and two unsynchronized threads interleaving stage/execute/
+read would serve each other's tensors.  Concurrency scales by cloning
+(one predictor per thread), not by hammering one predictor from many.
+Int8 models saved via
 io.save_quantized_inference_model load transparently (weights dequantize
 from their int8 grid at load; the served numerics ARE the int8-representable
 values)."""
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -120,7 +129,8 @@ class PredictorTensor:
 
 
 class Predictor:
-    def __init__(self, config: AnalysisConfig, _shared=None):
+    def __init__(self, config: AnalysisConfig, _shared=None,
+                 executor: Optional[Executor] = None):
         self.config = config
         if _shared is not None:  # clone path: share program + weights
             self.program, self.feed_names, self.fetch_names, self.scope = _shared
@@ -129,9 +139,36 @@ class Predictor:
             exe = Executor(config.place)
             self.program, self.feed_names, self.fetch_names = _io.load_inference_model(
                 config.model_dir, exe, scope=self.scope)
-        self.exe = Executor(config.place)
+        # `executor` shares a compiled-executable cache across predictors:
+        # clone() passes its own, and the serving model registry
+        # (paddle_tpu/serving/registry.py) passes ONE executor for every
+        # model/version so each (program, bucket shape) signature compiles
+        # exactly once however many clones/versions serve it
+        self.exe = executor if executor is not None else Executor(config.place)
+        # run/run_zero_copy are serialized per predictor: the staged
+        # input/output handle dicts are shared mutable state (the
+        # reference's contract was clone-per-thread; we keep that as the
+        # scaling path and make the single-predictor path safe instead of
+        # silently racy)
+        self._lock = threading.RLock()
         self._inputs = {n: PredictorTensor(n) for n in self.feed_names}
         self._outputs = {n: PredictorTensor(n) for n in self.fetch_names}
+
+    def lock(self) -> "threading.RLock":
+        """The per-predictor serialization lock (re-entrant).  `run` and
+        `run_zero_copy` take it internally, which makes the dict API
+        atomic — but a zero-copy TRANSACTION spans three calls
+        (copy_from_cpu -> run_zero_copy -> copy_to_cpu), so threads
+        sharing one predictor must hold this lock across the whole
+        sequence:
+
+            with predictor.lock():
+                predictor.get_input_handle("x").copy_from_cpu(arr)
+                predictor.run_zero_copy()
+                out = predictor.get_output_handle(name).copy_to_cpu()
+
+        Or — the contract that actually scales — clone() per thread."""
+        return self._lock
 
     # -- classic dict API --------------------------------------------------
     def run(self, feeds: Dict[str, np.ndarray],
@@ -140,10 +177,11 @@ class Predictor:
         missing = set(self.feed_names) - set(feeds)
         if missing:
             raise KeyError(f"Predictor.run: missing feeds {sorted(missing)}")
-        return self.exe.run(
-            self.program, feed=dict(feeds),
-            fetch_list=list(fetch_names or self.fetch_names), scope=self.scope,
-            return_numpy=return_numpy)
+        with self._lock:
+            return self.exe.run(
+                self.program, feed=dict(feeds),
+                fetch_list=list(fetch_names or self.fetch_names), scope=self.scope,
+                return_numpy=return_numpy)
 
     # -- zero-copy handle API (reference ZeroCopyRun contract) -------------
     def get_input_names(self) -> List[str]:
@@ -161,25 +199,32 @@ class Predictor:
     def run_zero_copy(self):
         """Execute from the staged input handles into the output handles.
         Device-resident inputs pass straight to the executor (no host
-        round-trip); outputs stay device-resident until copy_to_cpu."""
-        feeds = {}
-        for n, h in self._inputs.items():
-            if h._value is None:
-                raise KeyError(f"input handle {n!r} has no data; call "
-                               "copy_from_cpu/share_external_data first")
-            feeds[n] = h._value
-        outs = self.exe.run(self.program, feed=feeds,
-                            fetch_list=list(self.fetch_names),
-                            scope=self.scope, return_numpy=False)
-        for n, v in zip(self.fetch_names, outs):
-            self._outputs[n]._value = v
-        return True
+        round-trip); outputs stay device-resident until copy_to_cpu.
+        Serialized per predictor (the handle dicts are shared state);
+        concurrent serving threads should each hold a clone()."""
+        with self._lock:
+            feeds = {}
+            for n, h in self._inputs.items():
+                if h._value is None:
+                    raise KeyError(f"input handle {n!r} has no data; call "
+                                   "copy_from_cpu/share_external_data first")
+                feeds[n] = h._value
+            outs = self.exe.run(self.program, feed=feeds,
+                                fetch_list=list(self.fetch_names),
+                                scope=self.scope, return_numpy=False)
+            for n, v in zip(self.fetch_names, outs):
+                self._outputs[n]._value = v
+            return True
 
     def clone(self) -> "Predictor":
-        """Serve from another thread: shared weights, private executor
-        (compile cache is per-executor; XLA executables are thread-safe)."""
+        """Serve from another thread: shared weights, SHARED executor —
+        every clone hits the same compiled-executable cache entry per
+        (program, feed signature), so N clones compile once (XLA
+        executables are thread-safe; pinned by the serving cache-share
+        test).  Handle dicts and the run lock stay private per clone."""
         return Predictor(self.config, _shared=(
-            self.program, self.feed_names, self.fetch_names, self.scope))
+            self.program, self.feed_names, self.fetch_names, self.scope),
+            executor=self.exe)
 
 
 def create_predictor(config: AnalysisConfig) -> Predictor:

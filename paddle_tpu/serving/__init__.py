@@ -1,0 +1,43 @@
+"""paddle_tpu.serving — robust serving runtime (ISSUE 11).
+
+Continuous batching into pre-compiled pad-to-bucket shapes, per-request
+deadlines, admission control with load shedding, multi-model
+co-residency under an HBM budget, and verified hot model reload with
+instant rollback — the serving half of the reference's ~29k-LoC
+`paddle/fluid/inference` stack, built robustness-first on top of the
+compiled-executable cache, `CheckpointManager`, and the monitor plane.
+
+    from paddle_tpu import serving
+
+    registry = serving.ModelRegistry(hbm_budget_mb=1024)
+    with serving.Server(registry, buckets=(1, 4, 8, 16)) as srv:
+        srv.load_model("ranker", "/models/ranker")     # warms every bucket
+        out = srv.infer("ranker", {"x": batch})        # pads, never compiles
+        srv.publish("ranker", ckpt_manager)            # verify -> swap
+        srv.rollback("ranker")                         # instant undo
+
+Failure semantics ride `paddle_tpu.errors.ServingError` (reason codes:
+overload / timeout / oversize / publish_rejected / hbm_budget /
+model_missing / shutdown); metrics ride the monitor (serving.* counters
+and gauges, `serving_batch` / `serving_event` records) and are gated by
+`perf_report --check --max-shed-frac/--max-p99-ms`.  See
+docs/serving.md.
+"""
+from __future__ import annotations
+
+from .batcher import (DEFAULT_BUCKETS, bucket_for, coalesce,  # noqa: F401
+                      concat_feeds, pad_feeds, parse_buckets, split_rows,
+                      validate_feeds)
+from .publisher import publish, rollback, verify_snapshot_dir  # noqa: F401
+from .registry import (ModelRegistry, ModelVersion,  # noqa: F401
+                       manifest_weight_bytes, synthetic_feeds)
+from .server import Future, Server  # noqa: F401
+
+__all__ = [
+    "DEFAULT_BUCKETS", "parse_buckets", "bucket_for", "pad_feeds",
+    "concat_feeds", "split_rows", "coalesce", "validate_feeds",
+    "ModelRegistry", "ModelVersion", "synthetic_feeds",
+    "manifest_weight_bytes",
+    "publish", "rollback", "verify_snapshot_dir",
+    "Server", "Future",
+]

@@ -1,0 +1,224 @@
+"""Verified hot model reload: publish / verify / swap / rollback.
+
+The robustness centerpiece of the serving runtime.  A training gang
+publishes into a live server by pointing `publish()` at either
+
+  * a `CheckpointManager` COMMITTED checkpoint directory (or the manager
+    itself — its `latest()` is used): a WEIGHTS-ONLY reload into the
+    model's existing program, or
+  * an inference-model directory (`io.save_inference_model` /
+    `save_quantized_inference_model` output): a full program + weights
+    replacement.
+
+Nothing touches traffic until the staged snapshot survives the whole
+verification ladder:
+
+  1. commit integrity — a distributed checkpoint without its COMMITTED
+     marker (or a `.tmp` pending dir) is torn by definition;
+  2. manifest/shard integrity — the manifest must parse and every shard
+     it names must load fully (a truncated .npy raises, never serves);
+  3. program verification — `core/analysis.check_program` (structural)
+     over the staged program with the model's feed/fetch targets;
+  4. weight health — any non-finite value in a staged float weight
+     rejects (a NaN weight WILL poison every request);
+  5. golden-input smoke inference — the staged predictor must produce
+     finite outputs on a golden batch (caller-provided, or synthesized
+     from the program's feed specs), and match `golden_expect` when the
+     caller pins one;
+  6. pre-swap compile lane — the serving buckets are warmed on the
+     STAGED version, so the post-swap steady state never compiles
+     inline.
+
+Any failure QUARANTINES the snapshot: the source dir lands in the
+registry's quarantine set (repeat publishes reject fast), a
+`serving.publish_rejected` event + counter record what and why, and a
+classified `ServingError(reason="publish_rejected")` raises — while the
+OLD version keeps serving untouched.  On success the swap is atomic
+(registry lock), in-flight batches finish on the version they acquired,
+and the previous version is retained for instant `rollback()`.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ..checkpoint_manager import COMMITTED_MARKER, DIST_MARKER, CheckpointManager
+from ..core.analysis import check_program
+from ..core.scope import Scope
+from ..errors import ServingError
+from ..inference import Predictor
+from ..monitor import MONITOR as _MON
+from .. import io as _io
+from .registry import ModelRegistry, ModelVersion, synthetic_feeds
+
+__all__ = ["publish", "rollback", "verify_snapshot_dir"]
+
+
+def _reject(registry: ModelRegistry, name: str, src: str, detail: str):
+    registry.quarantined.add(os.path.realpath(src))
+    _MON.counter("serving.publish_rejected").inc()
+    _MON.record_step({"kind": "serving_event", "action": "publish_rejected",
+                      "model": name, "src": src, "detail": detail})
+    raise ServingError(
+        f"publish of {src!r} into model {name!r} REJECTED and quarantined "
+        f"({detail}); the previous version keeps serving",
+        reason="publish_rejected", model=name)
+
+
+def verify_snapshot_dir(src: str) -> str:
+    """Static integrity checks every publish source must pass; returns
+    the snapshot kind ('inference' | 'checkpoint' | 'vars').  Raises
+    ValueError naming the defect — publish() maps that to a classified
+    rejection."""
+    if not os.path.isdir(src):
+        raise ValueError(f"{src!r} is not a directory")
+    if src.rstrip(os.sep).endswith(".tmp"):
+        raise ValueError("pending (.tmp) checkpoint dir — not committed")
+    # a distributed checkpoint must carry rank 0's COMMITTED marker; its
+    # absence means some rank's shards never arrived (torn commit)
+    if (os.path.exists(os.path.join(src, DIST_MARKER))
+            and not os.path.exists(os.path.join(src, COMMITTED_MARKER))):
+        raise ValueError("distributed checkpoint without COMMITTED marker "
+                         "(torn commit)")
+    if os.path.exists(os.path.join(src, _io.MODEL_FILENAME)):
+        return "inference"
+    if os.path.exists(os.path.join(src, _io.SHARDED_MANIFEST)):
+        return "checkpoint"
+    if os.path.exists(os.path.join(src, _io.MANIFEST)):
+        return "vars"
+    raise ValueError("no __model__.json, sharded manifest, or manifest — "
+                     "not a model or checkpoint directory")
+
+
+def _stage(registry: ModelRegistry, current: ModelVersion, src: str,
+           kind: str):
+    """Load the snapshot into a fresh staged scope; returns (program,
+    feed_names, fetch_names, scope).  Any load failure (truncated shard,
+    bad manifest JSON, missing param) raises — callers reject."""
+    staged = Scope()
+    if kind == "inference":
+        program, feed_names, fetch_names = _io.load_inference_model(
+            src, registry.executor, scope=staged)
+        return program, feed_names, fetch_names, staged
+    # weights-only reload: the program (and its feed/fetch contract) come
+    # from the version currently serving
+    params = [v.name for v in _io._persistables(current.program)]
+    if kind == "checkpoint":
+        _io.load_sharded(src, var_names=params, scope=staged)
+    else:
+        _io.load_vars(src, var_names=params, scope=staged)
+    return (current.program, current.feed_names, current.fetch_names, staged)
+
+
+def publish(registry: ModelRegistry, name: str, src,
+            golden_feeds: Optional[Dict[str, np.ndarray]] = None,
+            golden_expect: Optional[Sequence[np.ndarray]] = None,
+            golden_rtol: float = 1e-4, golden_atol: float = 1e-5,
+            warm_buckets: Optional[Sequence[int]] = None) -> ModelVersion:
+    """Verify `src` and atomically swap it in as model `name`'s served
+    version (old version retained for rollback()).  See the module
+    docstring for the verification ladder; every failure raises a
+    classified ServingError(reason="publish_rejected") with the old
+    version still serving."""
+    if isinstance(src, CheckpointManager):
+        latest = src.latest()
+        if latest is None:
+            _reject(registry, name, src.root,
+                    "CheckpointManager has no committed checkpoint")
+        src = latest
+    src = str(src)
+    with _MON.span("serving.publish", model=name):
+        # publish reloads an EXISTING model (use registry.load for new
+        # names); a missing target is the caller's error, not the
+        # snapshot's, so it raises model_missing rather than quarantining
+        active = registry.acquire(name)
+        if os.path.realpath(src) in registry.quarantined:
+            _reject(registry, name, src,
+                    "source already quarantined by an earlier rejected "
+                    "publish")
+        try:
+            kind = verify_snapshot_dir(src)
+        except ValueError as e:
+            _reject(registry, name, src, f"integrity: {e}")
+        try:
+            program, feed_names, fetch_names, staged = _stage(
+                registry, active, src, kind)
+        except Exception as e:
+            _reject(registry, name, src,
+                    f"staging failed ({type(e).__name__}: {e})")
+        # program verification (core/analysis): the staged program must
+        # pass the structural verifier with the serving feed/fetch targets
+        try:
+            check_program(program, level="structural",
+                          feed_names=feed_names, fetch_names=fetch_names)
+        except Exception as e:
+            _reject(registry, name, src, f"program verification: {e}")
+        # weight health: a non-finite weight poisons every request
+        for vname in staged.local_var_names():
+            arr = np.asarray(staged.find_var(vname))
+            if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                _reject(registry, name, src,
+                        f"non-finite values in staged weight {vname!r}")
+        # golden-input smoke on the staged predictor (shared executor:
+        # the smoke run is also the bucket-1-shaped compile)
+        predictor = Predictor(active.predictor.config,
+                              _shared=(program, feed_names,
+                                       fetch_names, staged),
+                              executor=registry.executor)
+        feeds = golden_feeds
+        if feeds is None:
+            feeds = synthetic_feeds(program, feed_names, rows=1)
+        try:
+            outs = predictor.run(feeds)
+        except Exception as e:
+            _reject(registry, name, src,
+                    f"golden smoke inference failed "
+                    f"({type(e).__name__}: {e})")
+        for fname, o in zip(fetch_names, outs):
+            arr = np.asarray(o)
+            if arr.dtype.kind == "f" and not np.isfinite(arr).all():
+                _reject(registry, name, src,
+                        f"golden smoke produced non-finite {fname!r}")
+        if golden_expect is not None:
+            if len(golden_expect) != len(fetch_names):
+                # zip() would silently stop comparing at the shorter list,
+                # leaving trailing fetches unverified — that is a caller
+                # bug the ladder must not paper over
+                _reject(registry, name, src,
+                        f"golden_expect carries {len(golden_expect)} "
+                        f"entries but the model fetches "
+                        f"{len(fetch_names)} ({fetch_names})")
+            for fname, got, want in zip(fetch_names, outs, golden_expect):
+                if not np.allclose(np.asarray(got), np.asarray(want),
+                                   rtol=golden_rtol, atol=golden_atol):
+                    _reject(registry, name, src,
+                            f"golden output {fname!r} drifted past "
+                            f"rtol={golden_rtol}")
+        version = ModelVersion(program, feed_names, fetch_names, staged,
+                               predictor, src=src)
+        # pre-swap compile lane: warm the serving buckets on the STAGED
+        # version so post-swap traffic never waits on XLA.  A model that
+        # cannot compile its buckets is not servable — same rejection
+        # path as every other rung (quarantine + event + classified)
+        try:
+            for b in sorted(set(int(b) for b in (warm_buckets or ()))):
+                with _MON.span("serving.warm", model=name, bucket=b):
+                    predictor.run(synthetic_feeds(program, feed_names, b))
+        except Exception as e:
+            _reject(registry, name, src,
+                    f"pre-swap bucket warm failed "
+                    f"({type(e).__name__}: {e})")
+        prev = registry.publish_version(name, version)
+        _MON.counter("serving.reloads").inc()
+        _MON.record_step({"kind": "serving_event", "action": "publish",
+                          "model": name, "src": src,
+                          "version": version.version,
+                          "prev_version": prev.version})
+    return version
+
+
+def rollback(registry: ModelRegistry, name: str) -> ModelVersion:
+    """Instantly re-activate the retained previous version."""
+    return registry.rollback(name)

@@ -1,0 +1,382 @@
+"""Multi-model co-residency under an HBM budget.
+
+The registry owns every loaded model's (program, scope, Predictor)
+triple, versioned so the publisher (publisher.py) can swap a verified
+new snapshot in atomically and keep the old version for instant
+rollback.  Two robustness contracts live here:
+
+  * ONE shared Executor for every model, version, and clone — the
+    compiled-executable cache is keyed by (program, scope, feed
+    signature), so N models aliasing one directory and N clones of one
+    predictor hit the SAME cache entry per bucket shape and never
+    compile N times (pinned by tests/test_serving.py's cache-share
+    tests).
+
+  * an HBM budget (FLAGS_serving_hbm_budget_mb or the constructor's
+    override): loading a model whose manifest-estimated weight bytes
+    would blow the budget first evicts cold models — least recently
+    USED first, never the model being loaded — and, when eviction
+    cannot free enough, refuses loudly with
+    ServingError(reason="hbm_budget") instead of letting PJRT OOM the
+    chip mid-request.  Live device usage is observable next to the
+    ledger through the monitor/memstats gauges
+    (`serving.hbm_used_mb` tracks the registry's ledger,
+    `memory.device_bytes_in_use` the allocator's truth).
+
+In-flight safety: `acquire()` hands out the active ModelVersion object;
+a batch that holds one keeps serving from it even if an eviction,
+unload, or publish replaces the registry entry mid-batch (Python
+references keep the old version alive until the batch finishes) — the
+zero-dropped-requests property the reload-under-load chaos test pins.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.dtypes import as_np_dtype
+from ..core.executor import Executor, TPUPlace
+from ..core.scope import Scope
+from ..errors import ServingError
+from ..flags import flag as _flag
+from ..inference import AnalysisConfig, Predictor
+from ..monitor import MONITOR as _MON
+from .. import io as _io
+
+__all__ = ["ModelVersion", "ModelRegistry", "synthetic_feeds",
+           "manifest_weight_bytes"]
+
+
+def synthetic_feeds(program, feed_names: Sequence[str], rows: int,
+                    seed: int = 0) -> Dict[str, np.ndarray]:
+    """Deterministic warm-up/golden feeds shaped from the program's feed
+    vars: batch dim -> `rows`, other dynamic (-1) dims -> 1; float feeds
+    get small positive values (0 sits on poles like log/1-over), int
+    feeds get zeros (id 0 is always a valid row of any table)."""
+    block = program.global_block()
+    rng = np.random.RandomState(seed)
+    feeds = {}
+    for name in feed_names:
+        var = block.var(name)
+        shape = [int(d) for d in (var.shape or [])]
+        if not shape:
+            shape = [rows]
+        else:
+            shape = [1 if d < 0 else d for d in shape]
+            shape[0] = rows
+        dtype = as_np_dtype(var.dtype) or np.float32
+        dtype = np.dtype(dtype)
+        if dtype.kind in "iu":
+            feeds[name] = np.zeros(shape, dtype)
+        elif dtype.kind == "b":
+            feeds[name] = np.zeros(shape, bool)
+        else:
+            feeds[name] = (rng.rand(*shape) * 0.1 + 0.05).astype(dtype)
+    return feeds
+
+
+def manifest_weight_bytes(model_dir: str) -> int:
+    """Pre-load HBM estimate from the model dir's manifest (shape x dtype
+    per persistable) — lets the budget refuse BEFORE any device
+    allocation happens.  0 when the manifest is absent/unreadable (the
+    load itself will fail loudly later)."""
+    total = 0
+    try:
+        with open(os.path.join(model_dir, _io.MANIFEST)) as f:
+            manifest = json.load(f)
+        for entry in manifest.get("vars", []):
+            n = 1
+            for d in entry.get("shape", []):
+                n *= max(int(d), 1)
+            try:
+                itemsize = np.dtype(entry.get("dtype", "float32")).itemsize
+            except TypeError:
+                itemsize = 2  # bfloat16-class dtypes numpy can't name
+            total += n * itemsize
+    except (OSError, ValueError, KeyError):
+        return 0
+    return total
+
+
+class ModelVersion:
+    """One immutable served version: program + weights scope + the
+    predictor bound to the registry's shared executor."""
+
+    _ids = iter(range(1, 1 << 62))
+
+    def __init__(self, program, feed_names, fetch_names, scope: Scope,
+                 predictor: Predictor, src: str):
+        self.version = next(ModelVersion._ids)
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        self.scope = scope
+        self.predictor = predictor
+        self.src = src
+        self.created_ts = time.time()
+        self.bytes = self._weight_bytes()
+        # per-thread predictor clones: a Predictor serializes on its own
+        # lock, so N server workers hammering ONE predictor would execute
+        # batches one at a time.  Clones share the weights AND the
+        # compiled-executable cache (inference.Predictor.clone), so this
+        # buys real parallelism at zero extra compiles; bounded by the
+        # process's thread count.
+        self._clones: Dict[int, Predictor] = {}
+        self._clones_lock = threading.Lock()
+
+    def _weight_bytes(self) -> int:
+        total = 0
+        for n in self.scope.local_var_names():
+            v = self.scope.find_var(n)
+            nb = getattr(v, "nbytes", None)
+            if nb is None:
+                try:
+                    nb = np.asarray(v).nbytes
+                except Exception:
+                    nb = 0
+            total += int(nb)
+        return total
+
+    def run(self, feeds, fetch_names=None):
+        tid = threading.get_ident()
+        p = self._clones.get(tid)
+        if p is None:
+            with self._clones_lock:
+                p = self._clones.get(tid)
+                if p is None:
+                    # first thread serves from the base predictor; later
+                    # threads get their own clone (clone-per-thread, the
+                    # documented scaling contract)
+                    p = (self.predictor if not self._clones
+                         else self.predictor.clone())
+                    self._clones[tid] = p
+        return p.run(feeds, fetch_names=fetch_names)
+
+
+class _Model:
+    def __init__(self, name: str, version: ModelVersion):
+        self.name = name
+        self.versions: List[ModelVersion] = [version]
+        self.active = version
+        self.last_used = time.monotonic()
+        # pinned while its load() is still warming: a concurrent load's
+        # budget eviction must not yank a model out from under its own
+        # warm-up (acquire() would raise model_missing from inside load)
+        self.pinned = False
+
+
+class ModelRegistry:
+    def __init__(self, place=None, hbm_budget_mb: Optional[float] = None,
+                 executor: Optional[Executor] = None, keep_versions: int = 2):
+        self.place = place if place is not None else TPUPlace(0)
+        # ONE executor == one compiled-executable cache for the whole
+        # registry (models, published versions, clones)
+        self.executor = executor if executor is not None else Executor(self.place)
+        self._budget_mb = hbm_budget_mb
+        self.keep_versions = max(int(keep_versions), 1)
+        self._models: Dict[str, _Model] = {}
+        self._lock = threading.RLock()
+        # publish-rejected source dirs: repeated publishes of a snapshot
+        # that already failed verification reject fast (publisher.py)
+        self.quarantined: set = set()
+        # weak ref: the global monitor's gauges must not pin a dead
+        # registry (and every model scope it holds) for the process life
+        w = weakref.ref(self)
+        _MON.gauge("serving.models").set_fn(
+            lambda: (lambda r: float(len(r._models)) if r else 0.0)(w()))
+        _MON.gauge("serving.hbm_used_mb").set_fn(
+            lambda: (lambda r: r.used_bytes() / 1e6 if r else 0.0)(w()))
+
+    # -- budget ------------------------------------------------------------
+    def budget_bytes(self) -> int:
+        mb = self._budget_mb
+        if mb is None:
+            mb = _flag("FLAGS_serving_hbm_budget_mb")
+        return int(float(mb or 0) * 1e6)
+
+    def used_bytes(self) -> int:
+        with self._lock:
+            seen, total = set(), 0
+            for m in self._models.values():
+                for v in m.versions:
+                    if id(v) not in seen:  # aliased dirs share versions
+                        seen.add(id(v))
+                        total += v.bytes
+            return total
+
+    def _event(self, action: str, **kw):
+        _MON.record_step({"kind": "serving_event", "action": action, **kw})
+
+    def _make_room(self, need: int, loading: str):
+        """Evict cold models (LRU, never `loading`) until `need` more
+        bytes fit under the budget; classified refusal when they can't."""
+        budget = self.budget_bytes()
+        if not budget:
+            return
+        while self.used_bytes() + need > budget:
+            victims = sorted(
+                (m for n, m in self._models.items()
+                 if n != loading and not m.pinned),
+                key=lambda m: m.last_used)
+            if not victims:
+                raise ServingError(
+                    f"loading {loading!r} needs ~{need/1e6:.1f} MB but the "
+                    f"HBM budget is {budget/1e6:.1f} MB with "
+                    f"{self.used_bytes()/1e6:.1f} MB resident and nothing "
+                    f"left to evict — raise FLAGS_serving_hbm_budget_mb or "
+                    f"shrink the model", reason="hbm_budget", model=loading)
+            victim = victims[0]
+            del self._models[victim.name]
+            _MON.counter("serving.evictions").inc()
+            self._event("evict", model=victim.name,
+                        freed_bytes=sum(v.bytes for v in victim.versions),
+                        for_model=loading)
+
+    # -- loading -----------------------------------------------------------
+    def load(self, name: str, model_dir: str,
+             config: Optional[AnalysisConfig] = None,
+             warm_buckets: Optional[Sequence[int]] = None) -> ModelVersion:
+        """Load an inference-model dir (io.save_inference_model output)
+        under `name`.  A dir already resident under another name is
+        ALIASED — the new name shares the same ModelVersion (and so the
+        same compiled executables and HBM bytes).  `warm_buckets`
+        pre-compiles the given batch buckets so first traffic never
+        waits on XLA."""
+        real = os.path.realpath(model_dir)
+        with self._lock:
+            alias = next((m for m in self._models.values()
+                          if os.path.realpath(m.active.src) == real), None)
+            if alias is not None:
+                entry = _Model(name, alias.active)
+                entry.versions = alias.versions
+                entry.pinned = True
+                self._models[name] = entry
+                self._event("load", model=name, alias_of=alias.name,
+                            version=alias.active.version)
+                version = alias.active
+            else:
+                need = manifest_weight_bytes(model_dir)
+                self._make_room(need, name)
+        if alias is None:
+            # the disk-heavy stage runs OUTSIDE the lock: acquire() from
+            # serving workers (one per batch) must never stall behind a
+            # cold model's weights streaming in
+            cfg = config or AnalysisConfig(model_dir, place=self.place)
+            predictor = Predictor(cfg, executor=self.executor)
+            version = ModelVersion(predictor.program,
+                                   predictor.feed_names,
+                                   predictor.fetch_names,
+                                   predictor.scope,
+                                   predictor, src=model_dir)
+            with self._lock:
+                # estimate was from the manifest and other loads may have
+                # landed meanwhile; the loaded truth may also differ
+                # (quantized int8 on disk dequantizes to float) —
+                # re-check and refuse rather than serve past the budget
+                budget = self.budget_bytes()
+                if budget and self.used_bytes() + version.bytes > budget:
+                    self._make_room(version.bytes, name)
+                    if self.used_bytes() + version.bytes > budget:
+                        raise ServingError(
+                            f"{name!r} loaded at {version.bytes/1e6:.1f} "
+                            f"MB, past the {budget/1e6:.1f} MB budget "
+                            f"even after eviction", reason="hbm_budget",
+                            model=name)
+                entry = _Model(name, version)
+                entry.pinned = True  # not evictable until this load returns
+                self._models[name] = entry
+                _MON.counter("serving.model_loads").inc()
+                self._event("load", model=name, version=version.version,
+                            bytes=version.bytes, src=model_dir)
+        try:
+            if warm_buckets:
+                # outside the lock: warming compiles, and acquire() from
+                # serving workers must not block behind XLA (alias warms
+                # are pure cache hits and cheap either way)
+                self.warm(name, warm_buckets)
+        finally:
+            with self._lock:
+                m = self._models.get(name)
+                if m is not None:
+                    m.pinned = False
+        return version
+
+    def warm(self, name: str, buckets: Sequence[int]) -> int:
+        """Compile every bucket shape for `name`'s active version by
+        running a synthetic batch through it (the load-time compile
+        lane); returns the number of buckets run."""
+        version = self.acquire(name)
+        for b in sorted(set(int(b) for b in buckets)):
+            with _MON.span("serving.warm", model=name, bucket=b):
+                version.run(synthetic_feeds(version.program,
+                                            version.feed_names, b))
+        return len(set(buckets))
+
+    # -- lookup / lifecycle ------------------------------------------------
+    def acquire(self, name: str) -> ModelVersion:
+        """The active version (bumps recency).  Hold the returned object
+        for the whole batch: swaps/evictions never invalidate it."""
+        with self._lock:
+            m = self._models.get(name)
+            if m is None:
+                raise ServingError(f"no model {name!r} loaded "
+                                   f"(loaded: {sorted(self._models)})",
+                                   reason="model_missing", model=name)
+            m.last_used = time.monotonic()
+            return m.active
+
+    def models(self) -> Dict[str, dict]:
+        with self._lock:
+            return {n: {"version": m.active.version,
+                        "versions": [v.version for v in m.versions],
+                        "bytes": m.active.bytes, "src": m.active.src}
+                    for n, m in self._models.items()}
+
+    def unload(self, name: str):
+        with self._lock:
+            m = self._models.pop(name, None)
+        if m is None:
+            raise ServingError(f"no model {name!r} to unload",
+                               reason="model_missing", model=name)
+        self._event("unload", model=name)
+
+    # -- version swap (publisher.py drives this) ---------------------------
+    def publish_version(self, name: str, version: ModelVersion) -> ModelVersion:
+        """Atomically make `version` the served one; returns the previous
+        active (retained for rollback, older history trimmed to
+        keep_versions)."""
+        with self._lock:
+            m = self._models.get(name)
+            if m is None:
+                raise ServingError(f"no model {name!r} to publish into",
+                                   reason="model_missing", model=name)
+            prev = m.active
+            m.versions.append(version)
+            m.active = version
+            if len(m.versions) > self.keep_versions:
+                m.versions = m.versions[-self.keep_versions:]
+            return prev
+
+    def rollback(self, name: str) -> ModelVersion:
+        """Re-activate the retained previous version (instant: it is
+        still loaded and its executables still cached)."""
+        with self._lock:
+            m = self._models.get(name)
+            if m is None:
+                raise ServingError(f"no model {name!r} to roll back",
+                                   reason="model_missing", model=name)
+            older = [v for v in m.versions if v is not m.active]
+            if not older:
+                raise ServingError(
+                    f"model {name!r} has no retained previous version",
+                    reason="model_missing", model=name)
+            m.active = older[-1]
+            _MON.counter("serving.rollbacks").inc()
+            self._event("rollback", model=name, version=m.active.version)
+            return m.active

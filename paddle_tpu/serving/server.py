@@ -1,0 +1,422 @@
+"""The serving runtime: bounded request queue -> continuous batches.
+
+`Server` is the robustness layer between callers and `Predictor`:
+
+  * admission control — the queue is BOUNDED (`max_queue`, default
+    FLAGS_serving_max_queue).  A submit past the bound is shed
+    immediately with `ServingError(reason="overload")`: under sustained
+    overload the queue depth (and therefore queueing latency) stays
+    constant and the overflow is an explicit, counted signal
+    (`serving.shed`) instead of an unbounded latency ramp.  The
+    `bench.py --serve` overload arm proves p99 stays bounded this way.
+
+  * per-request deadlines — `submit(deadline_ms=...)` (default
+    FLAGS_serving_default_deadline_ms; 0 = none).  A request still
+    queued when its deadline passes is cancelled with
+    `ServingError(reason="timeout")` at batch-build time and the batch
+    proceeds without it; a request picked up in time is always served
+    to completion (mid-flight XLA execution is not cancellable).
+
+  * continuous batching — worker threads drain the FIFO, coalesce
+    same-model requests up to the largest bucket, pad to the next
+    compiled bucket (batcher.py), run ONE predictor call, and split the
+    outputs back per request.  Novel request sizes therefore never
+    compile: models are warmed per bucket at load, and
+    `executor.recompile` staying flat in steady state is an acceptance
+    gate.
+
+  * observability — everything rides the monitor: counters
+    (serving.requests/completed/shed/timeouts/errors/batches/rows),
+    lazy gauges (`serving.queue_depth`, `serving.p50_ms`,
+    `serving.p99_ms`), per-bucket occupancy observations
+    (`serving.bucket[N].occupancy`), one `serving_batch` record per
+    executed batch and one `serving_event` per shed/timeout/reload —
+    all exported through the existing Prometheus / JSON / JSONL paths
+    and gated by `perf_report --check --max-shed-frac/--max-p99-ms`.
+
+Server-local stats (`stats()`) are tracked unconditionally so admission
+accounting stays exact even with the monitor disabled; the monitor
+counters mirror them when enabled.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..errors import ServingError, classify
+from ..flags import flag as _flag
+from ..monitor import MONITOR as _MON
+from . import batcher as _bk
+from . import publisher as _pub
+from .registry import ModelRegistry
+
+__all__ = ["Future", "Server"]
+
+
+class Future:
+    """Completion handle for one submitted request."""
+
+    __slots__ = ("_ev", "_result", "_exc", "t_enqueue")
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._result = None
+        self._exc = None
+        self.t_enqueue = time.monotonic()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def set_result(self, result):
+        if not self._ev.is_set():  # first completion wins
+            self._result = result
+            self._ev.set()
+
+    def set_exception(self, exc: BaseException):
+        if not self._ev.is_set():
+            self._exc = exc
+            self._ev.set()
+
+    def result(self, timeout: Optional[float] = None) -> List[np.ndarray]:
+        if not self._ev.wait(timeout):
+            raise TimeoutError("serving Future.result: not done yet")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def exception(self, timeout: Optional[float] = None):
+        self._ev.wait(timeout)
+        return self._exc
+
+
+class _Request:
+    __slots__ = ("model", "feeds", "rows", "deadline", "future")
+
+    def __init__(self, model, feeds, rows, deadline, future):
+        self.model = model
+        self.feeds = feeds
+        self.rows = rows
+        self.deadline = deadline  # absolute monotonic seconds, or None
+        self.future = future
+
+
+class Server:
+    """Continuous-batching model server over a `ModelRegistry`.
+
+        registry = serving.ModelRegistry()
+        with serving.Server(registry, buckets=(1, 4, 8)) as srv:
+            srv.load_model("m", "/models/m")           # warms every bucket
+            out = srv.infer("m", {"x": batch})          # sync
+            fut = srv.submit("m", {"x": batch}, deadline_ms=50)
+            srv.publish("m", ckpt_manager)              # verified hot reload
+    """
+
+    def __init__(self, registry: Optional[ModelRegistry] = None,
+                 buckets=None, max_queue: Optional[int] = None,
+                 default_deadline_ms: Optional[float] = None,
+                 workers: int = 1, start: bool = True):
+        self.registry = registry if registry is not None else ModelRegistry()
+        self.buckets = _bk.parse_buckets(buckets)
+        self.max_queue = int(max_queue if max_queue is not None
+                             else _flag("FLAGS_serving_max_queue"))
+        if default_deadline_ms is None:
+            default_deadline_ms = _flag("FLAGS_serving_default_deadline_ms")
+        self.default_deadline_ms = float(default_deadline_ms or 0.0)
+        self._n_workers = max(int(workers), 1)
+        self._q: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._threads: List[threading.Thread] = []
+        self._running = False
+        # accepting from construction: a not-yet-started server queues
+        # (admission control still applies); workers drain once start()
+        # runs.  stop() is what closes the door.
+        self._accepting = True
+        self._inflight = 0
+        # server-local exact ledger (monitor counters mirror it when the
+        # monitor is enabled; admission accounting must not depend on that)
+        # ledger identity (at rest): requests == completed + shed +
+        # timeouts + errors + shutdowns
+        self._stats = {"requests": 0, "completed": 0, "shed": 0,
+                       "timeouts": 0, "errors": 0, "shutdowns": 0,
+                       "batches": 0, "rows": 0, "padded_rows": 0}
+        self._lat_ms: collections.deque = collections.deque(maxlen=4096)
+        # gauges close over a WEAK ref (the global monitor must not keep a
+        # dead server — queue, latency window, registry — alive forever)
+        # and are released by stop() if still ours; gauge names are
+        # process-global, so with several servers the newest owner wins
+        w = weakref.ref(self)
+        self._gauge_fns = {
+            "serving.queue_depth":
+                lambda: (lambda s: float(len(s._q)) if s else 0.0)(w()),
+            "serving.p50_ms":
+                lambda: (lambda s: s._pct(50.0) if s else 0.0)(w()),
+            "serving.p99_ms":
+                lambda: (lambda s: s._pct(99.0) if s else 0.0)(w()),
+        }
+        for n, f in self._gauge_fns.items():
+            _MON.gauge(n).set_fn(f)
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        with self._cv:
+            if self._running:
+                return self
+            self._running = True
+            self._accepting = True
+        for i in range(self._n_workers):
+            t = threading.Thread(target=self._worker_loop, daemon=True,
+                                 name=f"serving-worker-{i}")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0):
+        """Stop accepting; with `drain` (default) serve out everything
+        already admitted first.  Requests still queued at a drain-less
+        stop fail with reason="shutdown"."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            self._accepting = False
+            if drain and self._threads:  # no workers -> nothing can drain
+                while (self._q or self._inflight) and \
+                        time.monotonic() < deadline:
+                    self._cv.wait(0.05)
+            self._running = False
+            self._cv.notify_all()
+            leftovers = list(self._q)
+            self._q.clear()
+        for r in leftovers:
+            r.future.set_exception(ServingError(
+                "server stopped before this request was served",
+                reason="shutdown", model=r.model))
+        if leftovers:
+            with self._cv:
+                self._stats["shutdowns"] += len(leftovers)
+            _MON.counter("serving.shutdowns").inc(len(leftovers))
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = []
+        for n, f in self._gauge_fns.items():
+            g = _MON.gauge(n)
+            if g.fn is f:  # release only if a newer server hasn't taken over
+                g.fn = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- model management (delegates) --------------------------------------
+    def load_model(self, name: str, model_dir: str, config=None,
+                   warm: bool = True):
+        """Registry load; `warm` (default) compiles every serving bucket
+        up front so first traffic never waits on XLA."""
+        return self.registry.load(
+            name, model_dir, config=config,
+            warm_buckets=self.buckets if warm else None)
+
+    def publish(self, name: str, src, warm: bool = True, **kw):
+        """Verified hot reload (publisher.publish): staged verification,
+        pre-swap bucket warm, atomic swap, old version retained."""
+        kw.setdefault("warm_buckets", self.buckets if warm else ())
+        return _pub.publish(self.registry, name, src, **kw)
+
+    def rollback(self, name: str):
+        return self.registry.rollback(name)
+
+    # -- request path ------------------------------------------------------
+    def submit(self, model: str, feeds: Dict[str, np.ndarray],
+               deadline_ms: Optional[float] = None) -> Future:
+        """Admit one request (all feeds batched on axis 0) or shed it.
+        Sheds raise immediately — an overloaded server answers 'no' in
+        O(1), it does not answer late.  Malformed requests (unknown
+        model, wrong feed names/shapes, oversize) are rejected HERE so
+        they can never poison the batch they would be coalesced into."""
+        version = self.registry.acquire(model)  # model_missing at the door
+        rows = _bk.batch_rows(feeds)
+        _bk.bucket_for(rows, self.buckets)  # oversize rejects at the door
+        _bk.validate_feeds(feeds, version.feed_names,
+                           version.program.global_block())
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        deadline = (time.monotonic() + float(deadline_ms) / 1e3
+                    if deadline_ms and deadline_ms > 0 else None)
+        fut = Future()
+        req = _Request(model, feeds, rows, deadline, fut)
+        with self._cv:
+            if not self._accepting:
+                raise ServingError("server is not accepting requests",
+                                   reason="shutdown", model=model)
+            self._stats["requests"] += 1
+            if len(self._q) >= self.max_queue:
+                self._stats["shed"] += 1
+                _MON.counter("serving.requests").inc()
+                _MON.counter("serving.shed").inc()
+                _MON.record_step({"kind": "serving_event", "action": "shed",
+                                  "model": model, "rows": rows,
+                                  "queue_depth": len(self._q)})
+                raise ServingError(
+                    f"queue depth {len(self._q)} at the admission bound "
+                    f"({self.max_queue}); request shed", reason="overload",
+                    model=model)
+            self._q.append(req)
+            _MON.counter("serving.requests").inc()
+            self._cv.notify()
+        return fut
+
+    def infer(self, model: str, feeds: Dict[str, np.ndarray],
+              deadline_ms: Optional[float] = None,
+              timeout: Optional[float] = None) -> List[np.ndarray]:
+        """Synchronous submit + wait."""
+        return self.submit(model, feeds, deadline_ms).result(timeout)
+
+    # -- worker ------------------------------------------------------------
+    def _take_batch(self):
+        """Under the lock: wait for work, then pick a same-model batch
+        (FIFO head defines the model; batcher.coalesce fills up to the
+        largest bucket)."""
+        with self._cv:
+            while self._running and not self._q:
+                self._cv.wait(0.05)
+            if not self._q:
+                return None
+            model, picked = _bk.coalesce(self._q, self.buckets[-1])
+            for r in picked:
+                self._q.remove(r)
+            self._inflight += 1
+            return model, picked
+
+    def _expire(self, picked):
+        """Split expired-vs-live at batch-build time; expired requests are
+        cancelled (classified timeout) and the batch proceeds without
+        them."""
+        now = time.monotonic()
+        live = []
+        for r in picked:
+            if r.deadline is not None and now > r.deadline:
+                with self._cv:  # the ledger is exact even with N workers
+                    self._stats["timeouts"] += 1
+                _MON.counter("serving.timeouts").inc()
+                _MON.record_step({"kind": "serving_event",
+                                  "action": "timeout", "model": r.model,
+                                  "rows": r.rows,
+                                  "late_ms": round((now - r.deadline) * 1e3, 3)})
+                r.future.set_exception(ServingError(
+                    f"deadline expired {round((now - r.deadline) * 1e3, 1)} ms "
+                    f"before the request reached a batch", reason="timeout",
+                    model=r.model))
+            else:
+                live.append(r)
+        return live
+
+    def _worker_loop(self):
+        while True:
+            taken = self._take_batch()
+            if taken is None:
+                return
+            model, picked = taken
+            try:
+                self._run_batch(model, picked)
+            except BaseException as e:  # noqa: BLE001
+                # a worker must survive ANYTHING (a logger's disk-full
+                # OSError in record_step, a result-splitting bug): a dead
+                # worker strands every future it picked and — at
+                # workers=1 — wedges the whole server.  Fail the batch's
+                # unresolved futures classified and keep serving.
+                ce = classify(e)
+                n = sum(1 for r in picked if not r.future.done())
+                for r in picked:
+                    r.future.set_exception(ce)
+                if n:
+                    with self._cv:
+                        self._stats["errors"] += n
+                    _MON.counter("serving.errors").inc(n)
+            finally:
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()
+
+    def _run_batch(self, model: str, picked):
+        live = self._expire(picked)
+        if not live:
+            return
+        t0 = time.monotonic()
+        try:
+            # acquire ONCE per batch: a publish() swapping mid-batch never
+            # touches us — this version object stays alive until we finish
+            version = self.registry.acquire(model)
+            feeds = _bk.concat_feeds([r.feeds for r in live])
+            rows = sum(r.rows for r in live)
+            bucket = _bk.bucket_for(rows, self.buckets)
+            padded = _bk.pad_feeds(feeds, bucket)
+            with _MON.span("serving.batch", model=model, bucket=bucket,
+                           rows=rows):
+                outs = version.run(padded)
+        except BaseException as e:
+            ce = classify(e)
+            with self._cv:
+                self._stats["errors"] += len(live)
+            _MON.counter("serving.errors").inc(len(live))
+            for r in live:
+                r.future.set_exception(ce)
+            return
+        offsets, at = [], 0
+        for r in live:
+            offsets.append((at, at + r.rows))
+            at += r.rows
+        per_req = _bk.split_rows(outs, offsets, bucket)
+        now = time.monotonic()
+        lat_max = 0.0
+        for r, vals in zip(live, per_req):
+            r.future.set_result(vals)
+            lat = (now - r.future.t_enqueue) * 1e3
+            lat_max = max(lat_max, lat)
+            self._lat_ms.append(lat)
+        with self._cv:
+            self._stats["completed"] += len(live)
+            self._stats["batches"] += 1
+            self._stats["rows"] += rows
+            self._stats["padded_rows"] += bucket - rows
+        _MON.counter("serving.completed").inc(len(live))
+        _MON.counter("serving.batches").inc()
+        _MON.counter("serving.rows").inc(rows)
+        _MON.counter("serving.padded_rows").inc(bucket - rows)
+        occupancy = rows / bucket
+        _MON.observe(f"serving.bucket[{bucket}].occupancy", occupancy)
+        _MON.record_step({
+            "kind": "serving_batch", "model": model, "bucket": bucket,
+            "rows": rows, "requests": len(live),
+            "occupancy": round(occupancy, 4),
+            "t_infer_s": round(now - t0, 6),
+            "lat_ms_max": round(lat_max, 3),
+            "queue_depth": len(self._q)})
+
+    # -- stats -------------------------------------------------------------
+    def _pct(self, q: float) -> float:
+        lat = list(self._lat_ms)
+        if not lat:
+            return 0.0
+        return float(np.percentile(np.asarray(lat), q))
+
+    def latency_ms(self) -> Dict[str, float]:
+        return {"p50": round(self._pct(50.0), 3),
+                "p99": round(self._pct(99.0), 3),
+                "samples": len(self._lat_ms)}
+
+    def stats(self) -> dict:
+        with self._cv:
+            s = dict(self._stats)
+        s["queue_depth"] = len(self._q)
+        s.update({f"lat_{k}_ms" if k != "samples" else "lat_samples": v
+                  for k, v in self.latency_ms().items()})
+        s["models"] = self.registry.models()
+        return s

@@ -71,6 +71,21 @@
         stateless data source (replay_fast_forward resilience events).
         0 asserts every source resumed via the O(1) stream-state seek.
 
+    python tools/perf_report.py --check metrics.jsonl --max-shed-frac 0.05
+        Gate the serving runtime's admission control (paddle_tpu.serving):
+        requests shed over requests offered, from the newest counter
+        snapshot (serving.shed / serving.requests; serving_event records
+        as fallback — counters-only files work).  Shedding is the DESIGNED
+        overload response, so the budget is "how much overload the round
+        was allowed to see", not "is shedding broken".
+
+    python tools/perf_report.py --check metrics.jsonl --max-p99-ms 50
+        Gate the serving tail: p99 request latency from the newest
+        snapshot's serving.p99_ms gauge (lat_ms_max over serving_batch
+        records as fallback).  The SLO number the overload arm of
+        `bench.py --serve` must hold WITH shedding active — bounded-queue
+        admission is what keeps it flat while load climbs.
+
     python tools/perf_report.py --check-bench BENCH_rNN.json
         Ratcheted bench-round gate (ISSUE 7): analytic MFU must clear the
         MFU_FLOORS landed with the last accepted round (resnet50's floor
@@ -182,6 +197,23 @@ def render(path: str) -> str:
                      f"gang restarts {counters.get('dist.gang_restarts', 0)})\n"
                      + (_fmt_table(rows, ["action", "rank/inc", "detail"])
                         if rows else "(counters only)"))
+
+    sbatches = [s for s in records if s.get("kind") == "serving_batch"]
+    sevents = [s for s in records if s.get("kind") == "serving_event"]
+    if sbatches or sevents:
+        lines = records + [snap]  # snap's counters/gauges = newest state
+        occ = [s.get("occupancy", 0.0) for s in sbatches]
+        parts.append(
+            f"\n## serving ({len(sbatches)} batches, {len(sevents)} "
+            f"events, shed frac {shed_fraction(lines):.4f}, "
+            f"p99 {serving_p99_ms(lines):.1f} ms"
+            + (f", mean occupancy {sum(occ)/len(occ):.3f}" if occ else "")
+            + ")")
+        rows = [(r.get("action", "?"), r.get("model", ""),
+                 r.get("reason", r.get("detail", r.get("rows", ""))))
+                for r in sevents]
+        if rows:
+            parts.append(_fmt_table(rows, ["action", "model", "detail"]))
 
     revents = [s for s in records if s.get("kind") == "resilience_event"]
     if revents:
@@ -314,6 +346,58 @@ def replayed_batches(lines):
                .get("resilience.replayed_batches", 0))
 
 
+def _has_serving_evidence(lines):
+    """True when the file carries ANY serving signal (records, counters,
+    or gauges).  The serving gates fail on a file with none — a typo'd
+    path or a run that silently logged nothing must not gate green
+    (the trace_merge zero-evidence class, PR 8)."""
+    if any(r.get("kind") in ("serving_batch", "serving_event")
+           for r in lines):
+        return True
+    return bool(_latest_counters(lines, "serving.")
+                or _latest_gauges(lines, "serving."))
+
+
+def shed_fraction(lines):
+    """Requests shed by serving admission control per request offered
+    (paddle_tpu.serving.Server), from the newest counter snapshot
+    (serving.shed / serving.requests), falling back to counting shed
+    serving_event records against completed+shed when the file carries
+    records but no snapshot.  ~0 on an unloaded server; each unit of the
+    numerator is one client told 'no' in O(1) instead of 'yes' late."""
+    c = _latest_counters(lines, "serving.")
+    req = c.get("serving.requests", 0)
+    if req:
+        return c.get("serving.shed", 0) / req
+    shed = sum(1 for r in lines if r.get("kind") == "serving_event"
+               and r.get("action") == "shed")
+    done = sum(int(r.get("requests", 0)) for r in lines
+               if r.get("kind") == "serving_batch")
+    total = shed + done
+    return shed / total if total else 0.0
+
+
+def serving_p99_ms(lines):
+    """p99 request latency (ms) from the newest snapshot's
+    serving.p99_ms gauge (the server keeps a sliding latency window),
+    falling back to the p99 of lat_ms_max over serving_batch records.
+    0.0 when the file carries no serving evidence."""
+    g = _latest_gauges(lines, "serving.")
+    try:
+        v = float(g.get("serving.p99_ms", 0.0) or 0.0)
+    except (TypeError, ValueError):
+        v = 0.0
+    if v:
+        return v
+    lats = [float(r.get("lat_ms_max", 0.0) or 0.0) for r in lines
+            if r.get("kind") == "serving_batch"]
+    lats = [x for x in lats if x > 0]
+    if not lats:
+        return 0.0
+    lats.sort()
+    return lats[min(int(0.99 * len(lats)), len(lats) - 1)]
+
+
 def host_blocked_fraction(pipeline_steps):
     """(blocked_s, wall_s, fraction) over `kind="pipeline_step"` records.
     The overlap-health number: a serial loop sits near 1.0 whenever the
@@ -359,7 +443,9 @@ def check(path: str, steady_after: int = 2,
           max_data_corrupt_frac: float = None,
           max_replay_batches: int = None,
           max_step_skew_frac: float = None,
-          max_gang_resizes: int = None) -> int:
+          max_gang_resizes: int = None,
+          max_shed_frac: float = None,
+          max_p99_ms: float = None) -> int:
     """Return 0 when the metrics file is healthy, 1 otherwise (printed
     diagnosis either way).  Made for CI/bench scripts:
 
@@ -388,7 +474,9 @@ def check(path: str, steady_after: int = 2,
                        or max_data_corrupt_frac is not None
                        or max_replay_batches is not None
                        or max_step_skew_frac is not None
-                       or max_gang_resizes is not None) \
+                       or max_gang_resizes is not None
+                       or max_shed_frac is not None
+                       or max_p99_ms is not None) \
         and max_host_blocked_frac is None and max_retry_frac is None
     if not steps and not dist_gates_only:
         print(f"perf_report --check: {path} contains no step records "
@@ -522,6 +610,42 @@ def check(path: str, steady_after: int = 2,
         else:
             print(f"perf_report --check: step skew fraction {frac} <= "
                   f"{max_step_skew_frac}")
+    if (max_shed_frac is not None or max_p99_ms is not None) \
+            and not _has_serving_evidence(lines):
+        failures.append(
+            f"serving gates given but {path} carries no serving evidence "
+            f"(no serving_batch/serving_event records and no serving.* "
+            f"counters/gauges in any snapshot) — was the monitor enabled "
+            f"and a MonitorLogger attached to the serving run?")
+        max_shed_frac = max_p99_ms = None  # no data to gate meaningfully
+    if max_shed_frac is not None:
+        frac = shed_fraction(lines)
+        if frac > max_shed_frac:
+            failures.append(
+                f"serving shed fraction {frac:.4f} exceeds the "
+                f"--max-shed-frac={max_shed_frac} gate — the server is "
+                f"shedding more of its offered load than the round "
+                f"budgeted; either traffic genuinely exceeds capacity "
+                f"(scale out, widen buckets, raise the queue bound) or "
+                f"batches got slower (check serving_batch t_infer_s and "
+                f"the recompile gate above)")
+        else:
+            print(f"perf_report --check: serving shed fraction "
+                  f"{frac:.4f} <= {max_shed_frac}")
+    if max_p99_ms is not None:
+        p99 = serving_p99_ms(lines)
+        if p99 > max_p99_ms:
+            failures.append(
+                f"serving p99 latency {p99:.1f} ms exceeds the "
+                f"--max-p99-ms={max_p99_ms} gate — the tail SLO broke; "
+                f"with admission control on, suspects are batch execution "
+                f"time (serving_batch t_infer_s), an inline recompile "
+                f"(recompile gate above), or a queue bound sized past the "
+                f"latency budget (max_queue x batch time is the worst-"
+                f"case wait)")
+        else:
+            print(f"perf_report --check: serving p99 {p99:.1f} ms <= "
+                  f"{max_p99_ms}")
     if max_replay_batches is not None:
         n = replayed_batches(lines)
         if n > max_replay_batches:
@@ -879,6 +1003,19 @@ def main(argv=None):
                          "(replay_fast_forward resilience events) at <= N "
                          "— 0 asserts every source resumes via the O(1) "
                          "stream-state seek")
+    ap.add_argument("--max-shed-frac", type=float, default=None,
+                    metavar="FRAC",
+                    help="gate serving admission-control sheds per "
+                         "request offered (serving.shed / "
+                         "serving.requests counters, shed serving_event "
+                         "records as fallback) at <= FRAC — the overload "
+                         "budget a serving round may spend")
+    ap.add_argument("--max-p99-ms", type=float, default=None,
+                    metavar="MS",
+                    help="gate serving p99 request latency "
+                         "(serving.p99_ms gauge, serving_batch "
+                         "lat_ms_max fallback) at <= MS — the tail SLO "
+                         "shedding must hold under overload")
     ap.add_argument("--max-step-skew-frac", type=float, default=None,
                     metavar="FRAC",
                     help="gate the MAX sustained straggler lag, in step "
@@ -903,7 +1040,8 @@ def main(argv=None):
                      args.max_host_blocked_frac, args.max_retry_frac,
                      args.max_heartbeat_miss_frac, args.max_gang_restarts,
                      args.max_data_corrupt_frac, args.max_replay_batches,
-                     args.max_step_skew_frac, args.max_gang_resizes)
+                     args.max_step_skew_frac, args.max_gang_resizes,
+                     args.max_shed_frac, args.max_p99_ms)
     if args.diff:
         print(diff(*args.diff))
         return 0

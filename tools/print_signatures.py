@@ -41,6 +41,9 @@ MODULES = [
     "paddle_tpu.dist_resilience",
     # elastic N->M resume (ISSUE 9): the cursor-repartition module
     "paddle_tpu.elastic",
+    # serving runtime (ISSUE 11): batching server, model registry,
+    # verified hot reload
+    "paddle_tpu.serving",
 ]
 
 
