@@ -45,6 +45,24 @@ ROUND1_IMGS_PER_SEC = 2295.0  # BENCH_r01.json
 V5E_BF16_PEAK = 197e12
 
 
+def _predicted_roofline(dispatch):
+    """The program's OWN static roofline MFU (core/resource_plan.py) for
+    the EXACT program + feed shapes this dispatch measured (bench_kit
+    attaches them) — the denominator perf_report --check-bench prints
+    measured MFU against, so a number far under roofline is named instead
+    of averaged away.  None when planning fails (a plan bug must never
+    block a bench round)."""
+    try:
+        from paddle_tpu.core.resource_plan import plan_program
+
+        plan = plan_program(dispatch.main_program, dispatch.feed_shapes,
+                            [dispatch.loss_name], steps=dispatch.steps)
+        return round(plan.predicted_mfu, 4)
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"bench: roofline prediction failed: {e!r}", file=sys.stderr)
+        return None
+
+
 def _params_moved(dispatch, before, max_frozen_frac=0.25):
     """Bench-level optimizer-liveness gate (the r5 bf16+Adam freeze shipped
     two rounds of plausible-looking BERT numbers with ~96% of params frozen
@@ -149,8 +167,10 @@ def bench_resnet50(batch_size=128, K=16, iters=4):
     imgs = batch_size / dt
     mfu = imgs * 3 * 4.089e9 / V5E_BF16_PEAK
     print(f"resnet50: {dt*1e3:.1f} ms  {imgs:.0f} imgs/s  mfu {mfu:.3f}", file=sys.stderr)
+    pred = _predicted_roofline(dispatch)
     return {"metric": "resnet50_train_imgs_per_sec_per_chip", "value": round(imgs, 2),
             "unit": "imgs/sec", "mfu_bf16_analytic": round(mfu, 4),
+            "mfu_predicted_roofline": pred,
             "batch_size": batch_size, "steps_per_dispatch": K,
             "params_moved": moved,
             "windows_ms": ws, "spread_pct": _spread(ws)}
@@ -272,8 +292,10 @@ def bench_bert(batch_size=256, seq_len=128, K=2, iters=4):
     flops_per_seq = 6 * 110e6 * seq_len
     mfu = seqs * flops_per_seq / V5E_BF16_PEAK
     print(f"bert: {dt*1e3:.1f} ms  {seqs:.0f} seqs/s  mfu {mfu:.3f}", file=sys.stderr)
+    pred = _predicted_roofline(dispatch)
     return {"metric": "bert_base_train_seqs_per_sec_per_chip", "value": round(seqs, 2),
             "unit": "seqs/sec", "mfu_bf16_analytic": round(mfu, 4),
+            "mfu_predicted_roofline": pred,
             "batch_size": batch_size, "seq_len": seq_len,
             "config": "fused-attention (output-dropout substitution)",
             "params_moved": moved,

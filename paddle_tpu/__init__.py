@@ -55,6 +55,7 @@ from . import transpiler  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
 from .core import passes  # noqa: F401
 from .core import analysis  # static program verifier/lints (ISSUE 6)  # noqa: F401
+from .core import resource_plan  # static peak-HBM/cost planner (ISSUE 12)  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import dygraph_grad_clip  # noqa: F401
 from . import recordio_writer  # noqa: F401

@@ -1132,6 +1132,21 @@ class Executor:
                     check_program(program, level=verify_level,
                                   feed_names=list(jfeeds),
                                   fetch_names=fetch_names)
+            if mesh is None:
+                # Static OOM pre-check (FLAGS_resource_precheck): the
+                # liveness plan predicts peak HBM for THIS (program, feed
+                # shapes) pair and raises classified ResourceError naming
+                # the watermark ops when it cannot fit the device — before
+                # the trace/compile below allocates anything.  Mesh runs
+                # skip it: per-device residency depends on sharding, which
+                # the single-device plan would overstate.
+                from .resource_plan import precheck_program
+
+                with _MON.span("analysis.plan", program=program._uuid[:8]):
+                    precheck_program(
+                        program,
+                        {n: np.shape(v) for n, v in jfeeds.items()},
+                        fetch_names, steps=steps, device=device)
             with _MON.span("executor.build", program=program._uuid[:8]):
                 compiled = _CompiledStep(
                     program, list(jfeeds), fetch_names, scope,

@@ -18,6 +18,10 @@ routes on:
     PreemptionError       the pod is going away — flush a checkpoint and
                           exit resumable
     FatalError            everything else — never retried
+    ResourceError         the static resource planner predicts the program
+                          cannot fit in device HBM (phase=build, raised
+                          before any XLA compile/allocate, naming the ops
+                          at the predicted peak) — never retried
     CheckpointError       a checkpoint that must not be loaded as asked
                           (world-size mismatch without elastic opt-in,
                           inconsistent rank cursors) — never retried
@@ -53,7 +57,7 @@ from __future__ import annotations
 
 __all__ = ["TrainingError", "DataError", "NumericError",
            "TransientDeviceError", "PreemptionError", "FatalError",
-           "CheckpointError", "ServingError",
+           "CheckpointError", "ServingError", "ResourceError",
            "DistributedError", "PeerFailureError", "CollectiveTimeoutError",
            "classify", "attach_context", "get_context"]
 
@@ -121,6 +125,31 @@ class FatalError(TrainingError):
     """Anything `classify` cannot place in a recoverable class: program
     bugs, INVALID_ARGUMENT compiles, user-code exceptions.  The resilient
     loop re-raises these untouched."""
+
+
+class ResourceError(FatalError):
+    """The static resource planner (core/resource_plan.py) predicts the
+    program cannot run within the device's HBM: the liveness-based
+    peak-memory estimate exceeds the known limit.  Raised at compile-cache
+    miss time, BEFORE any XLA compile or device allocation — the point is
+    to name the ops and buffers at the predicted peak (`watermark_ops`)
+    while there is still a Python stack to read, instead of an opaque
+    allocator RESOURCE_EXHAUSTED mid-compile.  phase="build"; never
+    retried (the program itself is too big, not the run — shrink the
+    batch, enable remat/BuildStrategy.memory_optimize, or shard).
+
+    Distinct from `TransientDeviceError(resource_exhausted=True)`: that is
+    the RUNTIME allocator actually failing (fragmentation, co-residency),
+    which a retry at lower in-flight depth may survive; this is a static
+    prediction that no retry changes."""
+
+    def __init__(self, message: str, *, needed_bytes: Optional[int] = None,
+                 limit_bytes: Optional[int] = None, watermark_ops=None, **kw):
+        kw.setdefault("phase", "build")
+        super().__init__(message, **kw)
+        self.needed_bytes = needed_bytes
+        self.limit_bytes = limit_bytes
+        self.watermark_ops = list(watermark_ops or [])
 
 
 class CheckpointError(TrainingError):

@@ -128,6 +128,21 @@ DEFINE_string("FLAGS_verify_program", "structural",
               "(donation aliasing, recompile hazards, collective order, "
               "RNG determinism).  Error-severity findings raise classified "
               "ProgramVerificationError naming the op, var, and block")
+DEFINE_string("FLAGS_resource_precheck", "on",
+              "static OOM pre-check on every executor compile-cache miss "
+              "(paddle_tpu/core/resource_plan.py): 'on' (default) plans the "
+              "program's liveness-based peak HBM and raises a classified "
+              "ResourceError naming the watermark ops when the plan exceeds "
+              "the device limit — BEFORE any XLA compile or allocation; "
+              "'off' skips planning entirely.  The limit comes from "
+              "FLAGS_resource_hbm_limit_mb when set, else the device's own "
+              "memory_stats bytes_limit; with neither known (XLA:CPU "
+              "exposes no stats) the check is a no-op")
+DEFINE_float("FLAGS_resource_hbm_limit_mb", 0.0,
+             "HBM limit (MB) the resource pre-check plans against; 0 "
+             "(default) auto-detects from the device's memory_stats.  Set "
+             "explicitly to plan for a different chip than the one "
+             "attached, or to exercise the over-budget path in tests")
 DEFINE_string("FLAGS_feed_validation", "shape",
               "feed-boundary validation level at DataLoader/DataFeeder "
               "(paddle_tpu/reader.py FeedSpec): 'off' trusts the caller, "

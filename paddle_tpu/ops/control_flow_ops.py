@@ -282,3 +282,12 @@ def _infer_sub_block_op(ctx):
 
 
 _A.register_rule(["while", "conditional_block"], _infer_sub_block_op)
+
+
+# Static cost rules (core/resource_plan.py): sub-block owners carry only
+# their own carry/select traffic — the planner descends into the body and
+# accounts its ops (one execution; trip counts are not static).
+
+from ..core import resource_plan as _RP
+
+_RP.register_bytes_cost("while", "conditional_block", "select_input")

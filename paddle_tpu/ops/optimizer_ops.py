@@ -507,3 +507,14 @@ _A.register_state_update_infer(
     "sgd", "momentum", "adam", "adagrad", "rmsprop", "adamax", "adadelta",
     "lamb", "ftrl", "lars_momentum", "dpsgd", "proximal_gd",
     "proximal_adagrad")
+
+# Static cost rules (core/resource_plan.py): optimizer updates are pure
+# bandwidth — every state slot reads + writes its full size per step (the
+# donation audit's point: aliasing saves RESIDENCY, not traffic).
+
+from ..core import resource_plan as _RP
+
+_RP.register_state_update_cost(
+    "sgd", "momentum", "adam", "adagrad", "rmsprop", "adamax", "adadelta",
+    "lamb", "ftrl", "lars_momentum", "dpsgd", "proximal_gd",
+    "proximal_adagrad")

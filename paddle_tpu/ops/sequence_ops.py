@@ -790,3 +790,53 @@ def _sequence_scatter(ctx, op, ins):
     bi = jnp.arange(b)[:, None]
     out = padded.at[bi, tgt].add(jnp.where(valid, upd, 0).astype(x.dtype))
     return {"Out": out[:, :D]}
+
+
+# --- build-time shape/dtype inference + static cost rules -------------------
+# (core/analysis.py + core/resource_plan.py; registered after the lowerings
+# like every other ops module so set_infer/set_cost always find the OpDef.)
+
+from ..core import analysis as _A
+from ..core import resource_plan as _RP
+
+
+def _infer_sequence_pool(ctx):
+    """[b, T, *f] pooled over the time axis -> [b, *f] (+ MaxIndex for
+    MAX pooling, same shape, int32)."""
+    xs = ctx.in_shape("X")
+    if xs is None or len(xs) < 2:
+        return
+    out = (xs[0],) + tuple(xs[2:])
+    ctx.set_out("Out", out, ctx.in_dtype("X"))
+    ctx.set_out("MaxIndex", out, "int32")
+
+
+_A.register_rule(["sequence_pool"], _infer_sequence_pool)
+
+
+def _infer_attention_bias(ctx):
+    """[b, 1, Tq, Tk] additive bias from the Q/K ragged carriers."""
+    qs = ctx.in_shape("Q")
+    ks = ctx.in_shape("K")
+    if qs is None or ks is None or len(qs) < 2 or len(ks) < 2:
+        return
+    ctx.set_out("Out", (qs[0], 1, qs[1], ks[1]), "float32")
+
+
+_A.register_rule(["attention_bias"], _infer_attention_bias)
+
+# position_encoding adds a sinusoid table to X: Out mirrors X
+_A.register_unary_infer("position_encoding")
+
+
+def _cost_sequence_pool(ctx):
+    return float(ctx.in_elems("X") * 2), ctx.io_bytes()
+
+
+_RP.register_cost(["sequence_pool", "sequence_softmax"], _cost_sequence_pool)
+_RP.register_elementwise_cost("position_encoding", "attention_bias",
+                              flops_per_elem=4.0)
+_RP.register_bytes_cost("sequence_mask", "sequence_expand",
+                        "sequence_expand_as", "sequence_reverse",
+                        "sequence_pad", "sequence_unpad", "sequence_concat",
+                        "sequence_slice", "dynamic_rnn")

@@ -452,3 +452,11 @@ def _decayed_adagrad(ctx, op, ins):
     eps = op.attr("epsilon", 1e-6)
     m2 = decay * m + (1.0 - decay) * g * g
     return {"ParamOut": p - lr * g / (jnp.sqrt(m2) + eps), "MomentOut": m2}
+
+
+# --- static cost rules (core/resource_plan.py) ------------------------------
+
+from ..core import resource_plan as _RP
+
+_RP.register_elementwise_cost("logical_xor")
+_RP.register_elementwise_cost("add_position_encoding", flops_per_elem=4.0)

@@ -30,14 +30,15 @@ from .batcher import (DEFAULT_BUCKETS, bucket_for, coalesce,  # noqa: F401
                       validate_feeds)
 from .publisher import publish, rollback, verify_snapshot_dir  # noqa: F401
 from .registry import (ModelRegistry, ModelVersion,  # noqa: F401
-                       manifest_weight_bytes, synthetic_feeds)
+                       manifest_weight_bytes, plan_model_bytes,
+                       synthetic_feeds)
 from .server import Future, Server  # noqa: F401
 
 __all__ = [
     "DEFAULT_BUCKETS", "parse_buckets", "bucket_for", "pad_feeds",
     "concat_feeds", "split_rows", "coalesce", "validate_feeds",
     "ModelRegistry", "ModelVersion", "synthetic_feeds",
-    "manifest_weight_bytes",
+    "manifest_weight_bytes", "plan_model_bytes",
     "publish", "rollback", "verify_snapshot_dir",
     "Server", "Future",
 ]

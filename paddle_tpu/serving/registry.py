@@ -13,13 +13,19 @@ rollback.  Two robustness contracts live here:
     tests).
 
   * an HBM budget (FLAGS_serving_hbm_budget_mb or the constructor's
-    override): loading a model whose manifest-estimated weight bytes
-    would blow the budget first evicts cold models — least recently
-    USED first, never the model being loaded — and, when eviction
-    cannot free enough, refuses loudly with
-    ServingError(reason="hbm_budget") instead of letting PJRT OOM the
-    chip mid-request.  Live device usage is observable next to the
-    ledger through the monitor/memstats gauges
+    override): before any device allocation the load is costed, in
+    fallback order — (1) the static resource plan of the saved program
+    at the largest bucket this load will warm (weights + activations +
+    staged feeds, core/resource_plan.py `plan_model_bytes`); (2)
+    manifest weight bytes (activations invisible); (3) nothing, in
+    which case the load proceeds unbudgeted, the silent bypass is
+    counted (`serving.unbudgeted_loads` + `unbudgeted_load` event) and
+    only the post-load re-check can refuse.  A load past the budget
+    first evicts cold models — least recently USED first, never the
+    model being loaded — and, when eviction cannot free enough,
+    refuses loudly with ServingError(reason="hbm_budget") instead of
+    letting PJRT OOM the chip mid-request.  Live device usage is
+    observable next to the ledger through the monitor/memstats gauges
     (`serving.hbm_used_mb` tracks the registry's ledger,
     `memory.device_bytes_in_use` the allocator's truth).
 
@@ -50,18 +56,16 @@ from ..monitor import MONITOR as _MON
 from .. import io as _io
 
 __all__ = ["ModelVersion", "ModelRegistry", "synthetic_feeds",
-           "manifest_weight_bytes"]
+           "manifest_weight_bytes", "plan_model_bytes"]
 
 
-def synthetic_feeds(program, feed_names: Sequence[str], rows: int,
-                    seed: int = 0) -> Dict[str, np.ndarray]:
-    """Deterministic warm-up/golden feeds shaped from the program's feed
-    vars: batch dim -> `rows`, other dynamic (-1) dims -> 1; float feeds
-    get small positive values (0 sits on poles like log/1-over), int
-    feeds get zeros (id 0 is always a valid row of any table)."""
+def synthetic_feed_shapes(program, feed_names: Sequence[str], rows: int
+                          ) -> Dict[str, tuple]:
+    """THE bucket-shape rule, shared by warm-up feeds and the pre-load
+    budget plan so the two can never diverge: batch dim -> `rows`, other
+    dynamic (-1) dims -> 1."""
     block = program.global_block()
-    rng = np.random.RandomState(seed)
-    feeds = {}
+    shapes = {}
     for name in feed_names:
         var = block.var(name)
         shape = [int(d) for d in (var.shape or [])]
@@ -69,7 +73,23 @@ def synthetic_feeds(program, feed_names: Sequence[str], rows: int,
             shape = [rows]
         else:
             shape = [1 if d < 0 else d for d in shape]
-            shape[0] = rows
+            shape[0] = int(rows)
+        shapes[name] = tuple(shape)
+    return shapes
+
+
+def synthetic_feeds(program, feed_names: Sequence[str], rows: int,
+                    seed: int = 0) -> Dict[str, np.ndarray]:
+    """Deterministic warm-up/golden feeds shaped from the program's feed
+    vars (`synthetic_feed_shapes`); float feeds get small positive values
+    (0 sits on poles like log/1-over), int feeds get zeros (id 0 is
+    always a valid row of any table)."""
+    block = program.global_block()
+    rng = np.random.RandomState(seed)
+    feeds = {}
+    for name, shape in synthetic_feed_shapes(program, feed_names,
+                                             rows).items():
+        var = block.var(name)
         dtype = as_np_dtype(var.dtype) or np.float32
         dtype = np.dtype(dtype)
         if dtype.kind in "iu":
@@ -81,11 +101,35 @@ def synthetic_feeds(program, feed_names: Sequence[str], rows: int,
     return feeds
 
 
+def plan_model_bytes(model_dir: str, rows: int) -> int:
+    """Pre-load HBM estimate from the STATIC RESOURCE PLAN of the saved
+    program at the `rows`-row bucket shape: weights + live activations +
+    staged feeds (core/resource_plan.py), i.e. what serving that bucket
+    actually holds resident — not manifest weight bytes alone.  Reads only
+    `__model__.json` (no weights touched).  0 when the program is
+    absent/unplannable; callers fall back to `manifest_weight_bytes`."""
+    try:
+        with open(os.path.join(model_dir, _io.MODEL_FILENAME)) as f:
+            doc = json.load(f)
+        from ..core.program import Program
+        from ..core.resource_plan import plan_program
+
+        program = Program.from_dict(doc)
+        feed_shapes = synthetic_feed_shapes(program, doc.get("feed_names", []),
+                                            rows)
+        plan = plan_program(program, feed_shapes, doc.get("fetch_names", []))
+        return int(plan.peak_bytes)
+    except Exception:
+        return 0
+
+
 def manifest_weight_bytes(model_dir: str) -> int:
     """Pre-load HBM estimate from the model dir's manifest (shape x dtype
-    per persistable) — lets the budget refuse BEFORE any device
-    allocation happens.  0 when the manifest is absent/unreadable (the
-    load itself will fail loudly later)."""
+    per persistable) — the FALLBACK when the saved program cannot be
+    planned (`plan_model_bytes`); activations and workspace are invisible
+    to it.  0 when the manifest is absent/unreadable (the load itself
+    will fail loudly later — and the registry counts the unbudgeted load,
+    see ModelRegistry.load)."""
     total = 0
     try:
         with open(os.path.join(model_dir, _io.MANIFEST)) as f:
@@ -249,6 +293,23 @@ class ModelRegistry:
         pre-compiles the given batch buckets so first traffic never
         waits on XLA."""
         real = os.path.realpath(model_dir)
+        # budget estimate, in fallback order (documented contract):
+        #   1. static resource plan at the LARGEST bucket this load will
+        #      warm — weights + activations + staged feeds
+        #      (core/resource_plan.py), what serving actually holds;
+        #   2. manifest weight bytes — activations invisible;
+        #   3. nothing — the load proceeds UNBUDGETED and only the
+        #      post-load re-check below can refuse; that silent bypass is
+        #      counted (serving.unbudgeted_loads) and recorded so an
+        #      operator can see budget-blind loads instead of discovering
+        #      them at the allocator.
+        # Estimated OUTSIDE the lock: plan_model_bytes reads and plans the
+        # saved program, which must never stall a serving worker's
+        # acquire() (wasted only in the rare alias case).
+        need = (plan_model_bytes(model_dir, max(warm_buckets))
+                if warm_buckets else 0)
+        if not need:
+            need = manifest_weight_bytes(model_dir)
         with self._lock:
             alias = next((m for m in self._models.values()
                           if os.path.realpath(m.active.src) == real), None)
@@ -261,7 +322,9 @@ class ModelRegistry:
                             version=alias.active.version)
                 version = alias.active
             else:
-                need = manifest_weight_bytes(model_dir)
+                if not need and self.budget_bytes():
+                    _MON.counter("serving.unbudgeted_loads").inc()
+                    self._event("unbudgeted_load", model=name, src=model_dir)
                 self._make_room(need, name)
         if alias is None:
             # the disk-heavy stage runs OUTSIDE the lock: acquire() from

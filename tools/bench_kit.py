@@ -152,7 +152,19 @@ def make_resnet_dispatch(batch_size=256, K=4, stem="space_to_depth",
     out = dispatch()
     assert np.isfinite(float(np.asarray(out[0]).reshape(-1)[-1]))
     attach_param_probe(dispatch, main, scope)
+    _attach_plan_inputs(dispatch, main, feed, loss_name, K)
     return dispatch, loss_name
+
+
+def _attach_plan_inputs(dispatch, main, feed, loss_name, K):
+    """Expose the EXACT program + feed shapes this dispatch measures, so
+    bench.py's static-roofline prediction (core/resource_plan.py) plans
+    the same computation instead of rebuilding from a copied config."""
+    dispatch.main_program = main
+    dispatch.feed_shapes = {n: tuple(np.shape(v)) for n, v in feed.items()}
+    dispatch.loss_name = loss_name
+    dispatch.steps = K
+    return dispatch
 
 
 def make_bert_dispatch(batch_size=256, seq_len=128, K=2, dtype="bfloat16",
@@ -191,6 +203,7 @@ def make_bert_dispatch(batch_size=256, seq_len=128, K=2, dtype="bfloat16",
     out = dispatch()
     assert np.isfinite(float(np.asarray(out[0]).reshape(-1)[-1]))
     attach_param_probe(dispatch, main, scope)
+    _attach_plan_inputs(dispatch, main, feed, loss_name, K)
     return dispatch, loss_name
 
 

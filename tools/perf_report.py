@@ -719,12 +719,18 @@ def _bench_records(path):
 
 
 def check_bench(path, floors=None, max_spread_pct=None,
-                require_overlap=False) -> int:
+                require_overlap=False, min_roofline_frac=None) -> int:
     """Ratcheted bench-round gate: MFU floors, spread ceiling, zero frozen
-    params, overlap A/B confirmation.  0 healthy / 1 failed, diagnosis
-    printed either way.  `require_overlap` fails rounds that do not embed a
-    dp_grad_overlap record (fresh-round acceptance; historical rounds
-    predate the overlap path and check without it)."""
+    params, overlap A/B confirmation, and the predicted-MFU column — every
+    record carrying the program's own static roofline prediction
+    (mfu_predicted_roofline, stamped by bench.py from
+    core/resource_plan.py) is printed as measured-vs-predicted so a
+    measured MFU far under the program's roofline is NAMED, not averaged
+    away; `min_roofline_frac` turns that naming into a hard gate.
+    0 healthy / 1 failed, diagnosis printed either way.  `require_overlap`
+    fails rounds that do not embed a dp_grad_overlap record (fresh-round
+    acceptance; historical rounds predate the overlap path and check
+    without it)."""
     floors = MFU_FLOORS if floors is None else floors
     max_spread = MAX_SPREAD_PCT if max_spread_pct is None else max_spread_pct
     try:
@@ -761,6 +767,36 @@ def check_bench(path, floors=None, max_spread_pct=None,
     for model, rec in sorted(recs.items()):
         if not isinstance(rec, dict) or "error" in rec:
             continue
+        # predicted-MFU column: the program's own static roofline
+        # (core/resource_plan.py) is the denominator that makes a low
+        # measured MFU attributable — "leaving 3x on the table" vs "this
+        # program is bandwidth-bound and 0.2 IS its roofline"
+        mfu = rec.get("mfu_bf16_analytic")
+        pred = rec.get("mfu_predicted_roofline")
+        if mfu is not None and pred:
+            frac = mfu / pred
+            print(f"perf_report --check-bench: {model} measured MFU {mfu} "
+                  f"vs static roofline {pred} ({frac:.2f}x of predicted)")
+            if min_roofline_frac is not None and frac < min_roofline_frac:
+                failures.append(
+                    f"{model}: measured MFU {mfu} is only {frac:.2f}x of "
+                    f"the program's own static roofline {pred} (floor "
+                    f"{min_roofline_frac}) — the gap is in the compiled "
+                    f"step (fusion/layout/overlap), not the hardware; "
+                    f"tools/resource_plan.py --bench names the per-model "
+                    f"gaps")
+            elif frac < 0.1:
+                print(f"perf_report --check-bench: NOTE: {model} runs at "
+                      f"{frac:.2f}x of its own static roofline — large "
+                      f"compiled-step factors on the table")
+        elif mfu is not None and min_roofline_frac is not None:
+            # gating on a ratio no record carries would be a green gate
+            # with no data (the PR-8/PR-10 class) — fail, don't skip
+            failures.append(
+                f"{model}: --min-roofline-frac set but the record carries "
+                f"no mfu_predicted_roofline to hold measured MFU against "
+                f"(bench.py stamps it; its roofline prediction failed or "
+                f"the round predates it)")
         spread = rec.get("spread_pct")
         if spread is not None and spread > max_spread:
             failures.append(
@@ -957,6 +993,12 @@ def main(argv=None):
                     metavar="PCT",
                     help="--check-bench: override the per-model window-"
                          f"spread ceiling (default {MAX_SPREAD_PCT})")
+    ap.add_argument("--min-roofline-frac", type=float, default=None,
+                    help="--check-bench: fail any model whose measured MFU "
+                         "is below this fraction of its own static roofline "
+                         "prediction (mfu_predicted_roofline, stamped by "
+                         "bench.py from core/resource_plan.py); without it "
+                         "the gap is printed/NOTEd, never averaged away")
     ap.add_argument("--require-overlap", action="store_true",
                     help="--check-bench: fail rounds that do not embed a "
                          "dp_grad_overlap record (fresh-round acceptance)")
@@ -1033,6 +1075,7 @@ def main(argv=None):
         return postmortem(args.postmortem, last_n=args.postmortem_last_n)
     if args.check_bench:
         return check_bench(args.check_bench,
+                           min_roofline_frac=args.min_roofline_frac,
                            max_spread_pct=args.max_spread_pct,
                            require_overlap=args.require_overlap)
     if args.check:

@@ -33,6 +33,8 @@ MODULES = [
     "paddle_tpu.faults",
     "paddle_tpu.resilience",
     "paddle_tpu.core.analysis",
+    # static resource planner (ISSUE 12): liveness peak-HBM + cost model
+    "paddle_tpu.core.resource_plan",
     # the distributed observability surface (ISSUE 8): the monitor's
     # telemetry plane + flight recorder, the gang launcher, and the
     # health layer's straggler/telemetry API are public contract now

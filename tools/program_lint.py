@@ -37,8 +37,10 @@ if __name__ == "__main__":
 
 # The landed floor for model-zoo op-type inference coverage.  Raise it when
 # coverage improves; never lower it (the ratchet that keeps the verified
-# surface from eroding).
-COVERAGE_FLOOR = 0.8
+# surface from eroding).  1.0 since the resource-plan PR: every op type in
+# the zoo (including the sequence ops the cost model exposed as uncovered —
+# attention_bias, position_encoding, sequence_pool) has an infer rule.
+COVERAGE_FLOOR = 1.0
 
 
 def _fmt_table(rows, headers):
