@@ -23,6 +23,7 @@ import numpy as np
 
 from ..dist_resilience import guard_blocking as _guard_blocking
 from ..monitor import MONITOR as _MON
+from . import locks
 from .dtypes import as_np_dtype
 from .lowering import LoweringContext, run_block_with_backward
 from .program import Program, Variable, default_main_program
@@ -176,7 +177,7 @@ class _CompiledStep:
         # threads cold-starting the same signature must build ONE
         # executable (a double trace+compile would double-count the
         # recompile gate and waste the compile lane)
-        self._build_lock = threading.Lock()
+        self._build_lock = locks.named_lock("executor.build", rank=26)
         self.last_lower_s = 0.0
         self.last_compile_s = 0.0
         self.last_recompiled = False
@@ -589,7 +590,7 @@ class _CompiledStep:
                     except TypeError:
                         pass
                 self._exec = None
-        with self._build_lock:
+        with self._build_lock:  # lock-ok: one XLA trace+compile per executable signature IS the lock's purpose; a hit path never reaches here and the cache lock stays free throughout
             # a concurrent thread (serving clones share this step) may
             # have built the executable while we waited for the lock:
             # serve from its entry instead of compiling a duplicate
@@ -810,7 +811,7 @@ class Executor:
         # racing the same key would otherwise each count a miss and build
         # a duplicate _CompiledStep (the serving cache-share contract is
         # one compiled entry per (program, bucket shape) signature)
-        self._cache_lock = threading.Lock()
+        self._cache_lock = locks.named_lock("executor.cache", rank=24)
         self._host_eval_cache: Dict[tuple, Program] = {}
 
     def close(self):

@@ -71,6 +71,7 @@ import traceback
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence
 
+from .core import locks
 from .errors import CollectiveTimeoutError, PeerFailureError, TrainingError
 from .monitor import MONITOR as _MON
 
@@ -192,7 +193,7 @@ class _UdpTransport:
         self._sock.settimeout(0.05)
         self._latest: Dict[int, int] = {}
         self._tel: Dict[int, dict] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("dist.transport", rank=44)
         self._stop = threading.Event()
         self._rx = threading.Thread(target=self._recv_loop,
                                     name="pt-heartbeat-rx", daemon=True)
@@ -324,7 +325,7 @@ class Heartbeat:
         # peer -> (last seq observed, monotonic time it was observed)
         self._observed: Dict[int, tuple] = {}
         self._reported_dead: set = set()
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("dist.heartbeat", rank=42)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # telemetry plane: peers' latest beat payloads + straggler episode
@@ -383,11 +384,17 @@ class Heartbeat:
         spin this at 20 Hz, and re-reading world-1 heartbeat files faster
         than beats can change is pure filesystem churn."""
         now = time.monotonic()
-        if now - self._last_poll >= self.config.interval_s / 4:
-            self._last_poll = now
-            polled = self.transport.poll()
-        else:
-            polled = {}
+        # the rate-limit state is read-modify-write shared by the beat
+        # thread and every watchdog poller: updated under the table lock
+        # (two unsynchronized observers would both pass the check and
+        # double-poll — the unguarded-shared-write class the concurrency
+        # lint flags).  The transport poll itself (file/socket I/O) stays
+        # OUTSIDE the lock: only the winner performs it.
+        with self._lock:
+            do_poll = now - self._last_poll >= self.config.interval_s / 4
+            if do_poll:
+                self._last_poll = now
+        polled = self.transport.poll() if do_poll else {}
         ages = {}
         with self._lock:
             for r, (seq, tel) in polled.items():
@@ -684,7 +691,7 @@ class CollectiveWatchdog:
 
 # ---- process-global health layer -------------------------------------------
 
-_HEALTH_LOCK = threading.Lock()
+_HEALTH_LOCK = locks.named_lock("dist.health", rank=40)
 _HEARTBEAT: Optional[Heartbeat] = None
 _WATCHDOG: Optional[CollectiveWatchdog] = None
 
@@ -704,30 +711,56 @@ def init_health(rank: int, world: int,
     would classify a planned resize as a peer failure) and a fresh
     heartbeat + watchdog pair is armed against the resized peer set."""
     global _HEARTBEAT, _WATCHDOG
-    old = None
-    with _HEALTH_LOCK:
-        if _WATCHDOG is not None:
-            live = _HEARTBEAT
-            if live is not None and live.rank == rank and live.world == world:
-                return _WATCHDOG
-            # resized gang: the live health layer guards the wrong peers
-            old, _HEARTBEAT, _WATCHDOG = _HEARTBEAT, None, None
-    if old is not None:
-        old.stop()
-        _MON.counter("dist.health_rearm").inc()
-        _MON.record_step({"kind": "dist_event", "action": "health_rearm",
-                          "rank": rank, "world": world,
-                          "old_world": old.world})
-    with _HEALTH_LOCK:
-        if _WATCHDOG is not None:  # lost a re-arm race: use the winner's
-            return _WATCHDOG
+    while True:
+        old = None
+        with _HEALTH_LOCK:
+            if _WATCHDOG is not None:
+                live = _HEARTBEAT
+                if live is not None and live.rank == rank \
+                        and live.world == world:
+                    return _WATCHDOG
+                # resized gang: the live health layer guards the wrong
+                # peers
+                old, _HEARTBEAT, _WATCHDOG = _HEARTBEAT, None, None
+        if old is not None:
+            old.stop()
+            _MON.counter("dist.health_rearm").inc()
+            _MON.record_step({"kind": "dist_event", "action": "health_rearm",
+                              "rank": rank, "world": world,
+                              "old_world": old.world})
+        # Construction BLOCKS — socket bind / heartbeat-dir I/O, the
+        # beat-0 send, the rx-thread start — so it happens outside
+        # _HEALTH_LOCK (the blocking-under-lock class the concurrency
+        # lint exists for: any thread consulting active_watchdog()/
+        # guard_blocking during a slow bind would stall behind gang
+        # init).  Two racing initializers may both construct; the loser
+        # stops its heartbeat immediately, and the sub-interval overlap
+        # of two bound beat sockets is absorbed by the miss_factor
+        # staleness budget (beats are lossy-tolerant by design).
         hb = Heartbeat(rank, world, endpoints=endpoints, config=config)
         hb.start()
         wd = CollectiveWatchdog(heartbeat=hb, timeout_s=watchdog_timeout_s,
                                 rank=rank)
-        _HEARTBEAT, _WATCHDOG = hb, wd
-        _MON.gauge("dist.alive_workers").set(world)
-        return wd
+        with _HEALTH_LOCK:
+            winner = _WATCHDOG
+            if winner is None:
+                _HEARTBEAT, _WATCHDOG = hb, wd
+        if winner is None:
+            _MON.gauge("dist.alive_workers").set(world)
+            return wd
+        # lost a re-arm race: tear ours down, and accept the CURRENTLY
+        # installed watchdog ONLY if it guards the membership this caller
+        # asked for — re-read under the lock, never the stale `winner`
+        # snapshot (further re-arms may have torn that one down already).
+        # Otherwise loop and re-arm: silently returning a watchdog for a
+        # different (rank, world) would leave a resized gang monitored
+        # against old peers.
+        hb.stop()
+        with _HEALTH_LOCK:
+            live = _HEARTBEAT
+            if _WATCHDOG is not None and live is not None \
+                    and live.rank == rank and live.world == world:
+                return _WATCHDOG
 
 
 def shutdown_health(mark_down: bool = False):

@@ -18,6 +18,12 @@ routes on:
     PreemptionError       the pod is going away — flush a checkpoint and
                           exit resumable
     FatalError            everything else — never retried
+    LockTimeoutError      a named-lock acquisition blew FLAGS_lock_timeout_s
+                          (core/locks.py) — names BOTH the wanted lock and
+                          every lock the thread holds, with their declared
+                          ranks, instead of hanging the worker forever —
+                          never retried (the lock order is wrong, not the
+                          run)
     ResourceError         the static resource planner predicts the program
                           cannot fit in device HBM (phase=build, raised
                           before any XLA compile/allocate, naming the ops
@@ -58,6 +64,7 @@ from __future__ import annotations
 __all__ = ["TrainingError", "DataError", "NumericError",
            "TransientDeviceError", "PreemptionError", "FatalError",
            "CheckpointError", "ServingError", "ResourceError",
+           "LockTimeoutError",
            "DistributedError", "PeerFailureError", "CollectiveTimeoutError",
            "classify", "attach_context", "get_context"]
 
@@ -125,6 +132,30 @@ class FatalError(TrainingError):
     """Anything `classify` cannot place in a recoverable class: program
     bugs, INVALID_ARGUMENT compiles, user-code exceptions.  The resilient
     loop re-raises these untouched."""
+
+
+class LockTimeoutError(FatalError):
+    """A `locks.named_lock` acquisition did not complete within
+    `FLAGS_lock_timeout_s` (core/locks.py).  A correctly ordered lock
+    graph cannot deadlock, so a blown lock deadline means either a
+    genuine deadlock (an acquisition path the concurrency lint did not
+    see inverted the declared ranks) or a critical section holding a hot
+    lock across blocking work — both program bugs, never retried.  The
+    message and fields name BOTH sides: `wanted`/`wanted_rank` is the
+    lock that timed out, `held` the [(name, rank), ...] this thread
+    already holds — exactly what a deadlock report needs, captured while
+    there is still a Python stack to read instead of a wedged worker to
+    SIGKILL."""
+
+    def __init__(self, message: str, *, wanted: Optional[str] = None,
+                 wanted_rank: Optional[int] = None, held=None,
+                 timeout_s: Optional[float] = None, **kw):
+        kw.setdefault("phase", "locking")
+        super().__init__(message, **kw)
+        self.wanted = wanted
+        self.wanted_rank = wanted_rank
+        self.held = list(held or [])
+        self.timeout_s = timeout_s
 
 
 class ResourceError(FatalError):

@@ -56,6 +56,10 @@ def set_flags(flags: Dict[str, Any]):
             apply_xla_dump()
         elif k == "FLAGS_compile_cache_dir":
             apply_compile_cache()
+        elif k in ("FLAGS_lock_telemetry", "FLAGS_lock_timeout_s"):
+            from .core import locks as _locks
+
+            _locks.refresh_from_flags()
 
 
 def get_flags(names) -> Dict[str, Any]:
@@ -232,6 +236,22 @@ DEFINE_string("FLAGS_serving_buckets", "1,2,4,8,16,32",
               "model load (or in the publisher's pre-swap compile lane) "
               "and steady-state serving must keep executor.recompile "
               "flat (perf_report --check's recompile gate)")
+DEFINE_bool("FLAGS_lock_telemetry", False,
+            "per-lock contention telemetry for every named framework lock "
+            "(paddle_tpu/core/locks.py): lock.<name>.acquires/contended/"
+            "wait_us/hold_us monitor counters plus lock.order_inversions "
+            "when an acquisition inverts the declared ranks.  OPT-IN: off "
+            "(default) keeps acquire/release at one branch over the raw "
+            "primitive (the monitor-overhead hot-path budget); gate the "
+            "measured contention with perf_report --check "
+            "--max-lock-wait-frac")
+DEFINE_float("FLAGS_lock_timeout_s", 0.0,
+             "deadline on every blocking named-lock acquisition "
+             "(paddle_tpu/core/locks.py): past it the acquire raises a "
+             "classified errors.LockTimeoutError naming the wanted lock "
+             "AND every lock the thread holds (with declared ranks) "
+             "instead of hanging the worker forever — a deadlock dies "
+             "loudly and attributable.  0 (default) = no deadline")
 DEFINE_bool("FLAGS_cudnn_deterministic", True,
             "accepted no-op: XLA TPU lowerings are deterministic by default")
 DEFINE_float("FLAGS_fraction_of_gpu_memory_to_use", 1.0,

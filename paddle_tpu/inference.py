@@ -25,11 +25,11 @@ from their int8 grid at load; the served numerics ARE the int8-representable
 values)."""
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from .core import locks
 from .core.executor import CPUPlace, Executor, Place, TPUPlace
 from .core.program import Program
 from .core.scope import Scope
@@ -150,11 +150,11 @@ class Predictor:
         # reference's contract was clone-per-thread; we keep that as the
         # scaling path and make the single-predictor path safe instead of
         # silently racy)
-        self._lock = threading.RLock()
+        self._lock = locks.named_rlock("inference.predictor", rank=20)
         self._inputs = {n: PredictorTensor(n) for n in self.feed_names}
         self._outputs = {n: PredictorTensor(n) for n in self.fetch_names}
 
-    def lock(self) -> "threading.RLock":
+    def lock(self) -> "locks.NamedLock":
         """The per-predictor serialization lock (re-entrant).  `run` and
         `run_zero_copy` take it internally, which makes the dict API
         atomic — but a zero-copy TRANSACTION spans three calls
@@ -177,7 +177,7 @@ class Predictor:
         missing = set(self.feed_names) - set(feeds)
         if missing:
             raise KeyError(f"Predictor.run: missing feeds {sorted(missing)}")
-        with self._lock:
+        with self._lock:  # lock-ok: serializing dispatch (compile included) per predictor IS the lock's documented contract; clone-per-thread is the concurrency path and shares the compiled-executable cache
             return self.exe.run(
                 self.program, feed=dict(feeds),
                 fetch_list=list(fetch_names or self.fetch_names), scope=self.scope,
@@ -202,7 +202,7 @@ class Predictor:
         round-trip); outputs stay device-resident until copy_to_cpu.
         Serialized per predictor (the handle dicts are shared state);
         concurrent serving threads should each hold a clone()."""
-        with self._lock:
+        with self._lock:  # lock-ok: same per-predictor serialization contract as run(); the staged handle dicts are the shared state being protected
             feeds = {}
             for n, h in self._inputs.items():
                 if h._value is None:

@@ -32,6 +32,8 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
+from ..core.locks import named_lock
+
 # Cap on buffered trace events / step records so an always-on monitor in a
 # long-running trainer cannot grow without bound (same role as the old
 # profiler's _EVENT_CAP).
@@ -108,7 +110,10 @@ class Counter:
         self.mon = mon
         self.name = name
         self.value = 0
-        self._lock = threading.Lock()
+        # telemetry=False on every monitor-internal lock: lock telemetry
+        # records through Counter.inc, so instrumenting the lock inc
+        # itself takes would recurse/deadlock
+        self._lock = named_lock("monitor.counter", rank=68, telemetry=False)
 
     def inc(self, n: int = 1):
         if self.mon.enabled:
@@ -154,7 +159,7 @@ class Monitor:
 
     def __init__(self):
         self.enabled = False
-        self._lock = threading.Lock()
+        self._lock = named_lock("monitor.registry", rank=64, telemetry=False)
         self._tls = threading.local()
         # span aggregates: name -> [calls, total_s, max_s, min_s]
         self._agg: Dict[str, list] = {}
@@ -173,13 +178,14 @@ class Monitor:
         self._bb_dumped: Optional[str] = None
         # dump latch lock — NOT self._lock: blackbox_snapshot takes that
         # one, and the latch must stay held across snapshot + write
-        self._bb_dump_lock = threading.Lock()
+        self._bb_dump_lock = named_lock("monitor.blackbox", rank=60,
+                                        telemetry=False)
         # per-device/trainer lane for merged multi-process traces
         self.lane = 0
         self.lane_name = "paddle_tpu"
         # steps/sec EMA state has its own lock: record_step also needs the
         # registry lock, and nesting the two would invite deadlock
-        self._rate_lock = threading.Lock()
+        self._rate_lock = named_lock("monitor.rate", rank=62, telemetry=False)
         self._last_step_t: Optional[float] = None
         self._steps_per_sec_ema = 0.0
 
@@ -374,7 +380,7 @@ class Monitor:
         a watchdog-thread dump racing a crash-hook dump must not both
         pass the check and overwrite each other.  Never raises — this
         runs on crash paths."""
-        with self._bb_dump_lock:
+        with self._bb_dump_lock:  # lock-ok: one-shot crash latch — the first-dump-wins guarantee REQUIRES holding it across snapshot+write; contention only exists while the process is already dying
             if self._bb_dumped is not None:
                 return self._bb_dumped
             p = path or self._bb_path

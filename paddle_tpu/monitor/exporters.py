@@ -191,7 +191,7 @@ class MonitorLogger:
     """
 
     def __init__(self, path: str, every: int = 1):
-        import threading
+        from ..core.locks import named_lock
 
         self.path = path
         self.every = max(int(every), 1)
@@ -202,7 +202,7 @@ class MonitorLogger:
         # records arrive from more than one thread (the heartbeat thread
         # emits dist_events, the training thread emits steps); a lock keeps
         # lines whole — interleaved partial writes would tear the JSONL
-        self._wlock = threading.Lock()
+        self._wlock = named_lock("monitor.logger", rank=66, telemetry=False)
 
     def bind(self, mon):
         self._mon = mon
@@ -218,7 +218,7 @@ class MonitorLogger:
             self._fh.close()
 
     def on_step(self, record: dict):
-        with self._wlock:
+        with self._wlock:  # lock-ok: serializing the append+flush per JSONL line IS this lock's purpose (torn interleaved writes corrupt the stream); off the executor hot path
             # the sampling counter shares the lock: two threads racing
             # `_n += 1` would lose updates and skew the every-N sampling
             self._n += 1
@@ -236,7 +236,7 @@ class MonitorLogger:
             mon = MONITOR
         line = json.dumps(json_snapshot(mon, include_steps=False),
                           default=str) + "\n"
-        with self._wlock:
+        with self._wlock:  # lock-ok: same whole-line serialization contract as on_step; snapshots are rare control-plane writes
             f = self._file()
             f.write(line)
             f.flush()

@@ -27,6 +27,8 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from .core import locks
+
 _MAGIC = b"PTPS"
 
 
@@ -96,7 +98,7 @@ class ParameterServer:
         self.accums: Dict[str, np.ndarray] = {}
         self.optimizer = optimizer
         self.lr = lr
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("ps.tables", rank=34)
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -177,13 +179,13 @@ class KVClient:
     def __init__(self, endpoint: str):
         host, port = endpoint.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)))
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("ps.client", rank=36)
 
     def _call(self, op: bytes, name: str, *arrays) -> bytes:
         payload = struct.pack("<I", len(name)) + name.encode()
         for a in arrays:
             payload += _pack_arr(np.asarray(a))
-        with self._lock:
+        with self._lock:  # lock-ok: one request/response exchange on one shared socket — serializing the framed protocol IS the lock's purpose (interleaved frames from two threads would corrupt the stream)
             _send_msg(self._sock, op, payload)
             rop, resp = _recv_msg(self._sock)
         if rop == b"e":
@@ -245,8 +247,10 @@ class AsyncCommunicator:
         self._client = client
         self._interval = send_interval_s
         self._queues: Dict[str, list] = {}
-        self._lock = threading.Lock()
-        self._drain_lock = threading.Lock()  # serializes in-flight drains
+        self._lock = locks.named_lock("ps.queue", rank=32)
+        # serializes in-flight drains (rank 30: held ACROSS ps.queue and
+        # the ps.client push — that span is the flush() barrier contract)
+        self._drain_lock = locks.named_lock("ps.drain", rank=30)
         self._stop = threading.Event()
         self._woke = threading.Event()
         self._error: Optional[BaseException] = None
@@ -271,7 +275,7 @@ class AsyncCommunicator:
     def _drain_one(self):
         # _drain_lock makes drains mutually exclusive, so flush() returns
         # only after any in-flight send completes (the barrier contract)
-        with self._drain_lock:
+        with self._drain_lock:  # lock-ok: the flush() barrier contract REQUIRES holding this across the merge+push — push_async never takes it, so producers stay unblocked
             with self._lock:
                 items = {n: q for n, q in self._queues.items() if q}
                 self._queues = {}

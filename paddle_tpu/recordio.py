@@ -14,16 +14,16 @@ import ctypes
 import os
 import struct
 import subprocess
-import threading
 import weakref
 from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
+from .core import locks
 from .monitor import MONITOR as _MON
 
 _LIB = None
-_LIB_LOCK = threading.Lock()
+_LIB_LOCK = locks.named_lock("data.recordio_lib", rank=50)
 
 # --- per-run corrupt-chunk budget -------------------------------------------
 # A CRC-failed or truncated chunk is dropped (not fatal) while the total
@@ -39,7 +39,7 @@ _LIB_LOCK = threading.Lock()
 # A source whose drop count rises past its previous high water (the rot
 # spread) spends the delta.
 
-_CORRUPT_LOCK = threading.Lock()
+_CORRUPT_LOCK = locks.named_lock("data.corrupt_budget", rank=52)
 _CORRUPT_HW: dict = {}  # source key -> max drops observed in one pass
 # scanned-chunk accounting uses the SAME high-water scheme: the
 # `--max-data-corrupt-frac` gate divides corrupt by scanned, and deduping
@@ -120,7 +120,7 @@ def _native_dir():
 def _lib():
     """Compile-on-first-use (cached .so next to the source)."""
     global _LIB
-    with _LIB_LOCK:
+    with _LIB_LOCK:  # lock-ok: one-shot g++ build of the native library — every caller needs the result before it can proceed, so serializing the compile under the lock IS the design; steady state is a dict hit
         if _LIB is not None:
             return _LIB
         src = os.path.join(_native_dir(), "recordio.cc")

@@ -129,6 +129,29 @@ def publish(registry: ModelRegistry, name: str, src,
                     "CheckpointManager has no committed checkpoint")
         src = latest
     src = str(src)
+    # One publish ladder at a time per model: a concurrent publish into
+    # the same model would double-stage/double-warm and could retain the
+    # LOSER's fresh version as the "previous" rollback target instead of
+    # the version traffic was actually on.  Serialization is an in-flight
+    # marker, not a lock held across the ladder — staging and the
+    # pre-swap warm block on disk/XLA for seconds, and no lock (so no
+    # other thread, not even another model's publish) waits that out.
+    with registry._publish_cv:
+        while name in registry._publishing:
+            registry._publish_cv.wait(0.1)
+        registry._publishing.add(name)
+    try:
+        return _publish_ladder(registry, name, src, golden_feeds,
+                               golden_expect, golden_rtol, golden_atol,
+                               warm_buckets)
+    finally:
+        with registry._publish_cv:
+            registry._publishing.discard(name)
+            registry._publish_cv.notify_all()
+
+
+def _publish_ladder(registry, name, src, golden_feeds, golden_expect,
+                    golden_rtol, golden_atol, warm_buckets):
     with _MON.span("serving.publish", model=name):
         # publish reloads an EXISTING model (use registry.load for new
         # names); a missing target is the caller's error, not the

@@ -46,6 +46,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..core import locks
 from ..core.dtypes import as_np_dtype
 from ..core.executor import Executor, TPUPlace
 from ..core.scope import Scope
@@ -172,7 +173,7 @@ class ModelVersion:
         # buys real parallelism at zero extra compiles; bounded by the
         # process's thread count.
         self._clones: Dict[int, Predictor] = {}
-        self._clones_lock = threading.Lock()
+        self._clones_lock = locks.named_lock("serving.clones", rank=16)
 
     def _weight_bytes(self) -> int:
         total = 0
@@ -225,7 +226,18 @@ class ModelRegistry:
         self._budget_mb = hbm_budget_mb
         self.keep_versions = max(int(keep_versions), 1)
         self._models: Dict[str, _Model] = {}
-        self._lock = threading.RLock()
+        self._lock = locks.named_rlock("serving.registry", rank=14)
+        # serializes publish() ladders PER MODEL (publisher.py): two
+        # concurrent publishes into one model would double-stage, double-
+        # warm, and leave "prev version for rollback" pointing at the
+        # LOSER's fresh version instead of the one traffic was on.  An
+        # in-flight set under its own condition — NOT a lock held across
+        # the ladder: staging+warm block on disk and XLA for seconds, and
+        # nothing (not even another model's publish) should queue behind
+        # that; losers wait on the condition, the ladder itself runs
+        # lock-free
+        self._publishing: set = set()
+        self._publish_cv = locks.named_condition("serving.publish", rank=10)
         # publish-rejected source dirs: repeated publishes of a snapshot
         # that already failed verification reject fast (publisher.py)
         self.quarantined: set = set()

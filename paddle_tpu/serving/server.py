@@ -48,6 +48,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..core import locks
 from ..errors import ServingError, classify
 from ..flags import flag as _flag
 from ..monitor import MONITOR as _MON
@@ -129,7 +130,7 @@ class Server:
         self.default_deadline_ms = float(default_deadline_ms or 0.0)
         self._n_workers = max(int(workers), 1)
         self._q: collections.deque = collections.deque()
-        self._cv = threading.Condition()
+        self._cv = locks.named_condition("serving.server", rank=12)
         self._threads: List[threading.Thread] = []
         self._running = False
         # accepting from construction: a not-yet-started server queues

@@ -1,0 +1,612 @@
+"""tools/concurrency_lint.py + paddle_tpu/core/locks.py: the concurrency
+static-analysis CI gate (ISSUE 13) and its runtime half.
+
+Covers: the golden whole-tree-is-clean gate, one planted defect per
+diagnostic class (rank inversion, blocking-under-lock, unnamed raw lock,
+unguarded shared write) each asserting the diagnostic names file:line and
+the lock(s), the `# lock-ok:` allowlist contract, the lock-telemetry
+counters, the classified lock-timeout error naming both locks, and the
+perf_report --max-lock-wait-frac gate (zero-evidence-fails convention).
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _run_lint(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "concurrency_lint.py"),
+         *args],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+
+
+def _run_perf(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_report.py"),
+         *args],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+
+
+# ---- the golden gate: the tree itself is clean ------------------------------
+
+def test_whole_tree_is_clean_and_gated():
+    r = _run_lint("--check")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "CHECK OK" in r.stdout
+    assert "0 errors" in r.stdout and "0 unnamed locks" in r.stdout
+    # the rank table renders every registered lock class
+    for name in ("serving.registry", "executor.build", "monitor.registry",
+                 "dist.heartbeat", "inference.predictor"):
+        assert name in r.stdout, f"rank table missing {name}"
+
+
+def test_allowlist_ratchet_trips_when_lowered():
+    # the ratchet works: pretending the allowlist budget is smaller than
+    # the landed entries must fail the gate
+    r = _run_lint("--check", "--max-allowlist", "0")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "allowlist" in r.stdout
+
+
+# ---- planted defects: one per diagnostic class ------------------------------
+
+def test_planted_rank_inversion_names_both_locks(tmp_path):
+    p = tmp_path / "scratch_inv.py"
+    p.write_text(
+        "from paddle_tpu.core import locks\n"
+        "A = locks.named_lock('scratch.outer', rank=10)\n"
+        "B = locks.named_lock('scratch.inner', rank=20)\n"
+        "def f():\n"
+        "    with B:\n"
+        "        with A:\n"           # line 6: rank 10 under rank 20
+        "            pass\n")
+    r = _run_lint(str(p), "--check")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "lock_order_inversion" in r.stdout
+    assert "scratch_inv:6" in r.stdout
+    assert "scratch.outer" in r.stdout and "scratch.inner" in r.stdout
+    assert "rank 10" in r.stdout and "rank 20" in r.stdout
+
+
+def test_planted_blocking_under_lock_names_lock_and_line(tmp_path):
+    p = tmp_path / "scratch_blk.py"
+    p.write_text(
+        "import time\n"
+        "from paddle_tpu.core import locks\n"
+        "L = locks.named_lock('scratch.hot', rank=10)\n"
+        "def f():\n"
+        "    with L:\n"
+        "        time.sleep(1.0)\n")  # line 6
+    r = _run_lint(str(p), "--check")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "blocking_under_lock" in r.stdout
+    assert "scratch_blk:6" in r.stdout
+    assert "scratch.hot" in r.stdout
+
+
+def test_planted_pr10_class_predictor_under_lock(tmp_path):
+    # the mechanically encoded PR-10/PR-11 review findings: Predictor
+    # construction / plan_model_bytes on the registry's lock
+    p = tmp_path / "scratch_pr10.py"
+    p.write_text(
+        "from paddle_tpu.core import locks\n"
+        "from paddle_tpu.inference import Predictor\n"
+        "from paddle_tpu.serving.registry import plan_model_bytes\n"
+        "class Reg:\n"
+        "    def __init__(self):\n"
+        "        self._lock = locks.named_lock('scratch.reg', rank=10)\n"
+        "    def load(self, cfg, d):\n"
+        "        with self._lock:\n"
+        "            need = plan_model_bytes(d, 8)\n"   # line 9
+        "            return Predictor(cfg), need\n")    # line 10
+    r = _run_lint(str(p), "--check")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "plan_model_bytes" in r.stdout and "Predictor" in r.stdout
+    assert "scratch_pr10:9" in r.stdout and "scratch_pr10:10" in r.stdout
+    assert "scratch.reg" in r.stdout
+
+
+def test_planted_unnamed_raw_lock(tmp_path):
+    p = tmp_path / "scratch_raw.py"
+    p.write_text(
+        "import threading\n"
+        "L = threading.Lock()\n")     # line 2
+    r = _run_lint(str(p), "--check")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "unnamed_lock" in r.stdout
+    assert "scratch_raw:2" in r.stdout
+    assert "unnamed raw threading" in r.stdout
+
+
+def test_unnamed_raw_lock_caught_through_module_alias(tmp_path):
+    p = tmp_path / "scratch_alias.py"
+    p.write_text(
+        "import threading as th\n"
+        "L = th.Lock()\n")
+    r = _run_lint(str(p), "--check")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "unnamed_lock" in r.stdout and "scratch_alias:2" in r.stdout
+
+
+def test_pragma_in_docstring_does_not_count_toward_ratchet(tmp_path):
+    p = tmp_path / "scratch_doc.py"
+    p.write_text(
+        '"""Module documenting the convention:\n'
+        "put '# lock-ok: reason' on the with line.\n"
+        '"""\n'
+        "X = 1\n")
+    r = _run_lint(str(p), "--check")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "allowlist_sites=0" in r.stdout
+
+
+def test_unnamed_raw_lock_has_no_pragma_escape(tmp_path):
+    # the unnamed-lock floor is zero, full stop: '# lock-ok:' allowlists
+    # audited blocking-under-lock, never a raw primitive
+    p = tmp_path / "scratch_sneaky.py"
+    p.write_text(
+        "import threading\n"
+        "L = threading.Lock()  # lock-ok: sneaky\n")
+    r = _run_lint(str(p), "--check")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "unnamed_lock" in r.stdout
+
+
+def test_planted_unguarded_lost_update(tmp_path):
+    p = tmp_path / "scratch_race.py"
+    p.write_text(
+        "import threading\n"
+        "from paddle_tpu.core import locks\n"
+        "class Ledger:\n"
+        "    def __init__(self):\n"
+        "        self.n = 0\n"
+        "        self._lock = locks.named_lock('scratch.led', rank=10)\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._loop).start()\n"
+        "    def _loop(self):\n"
+        "        self.n += 1\n"       # line 10: unlocked += in thread
+        "    def bump(self):\n"
+        "        self.n += 1\n")      # line 12: unlocked += from api
+    r = _run_lint(str(p), "--check")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "unguarded_shared_write" in r.stdout
+    assert "Ledger.n" in r.stdout
+    assert "lost-update" in r.stdout
+    assert "thread:_loop@10" in r.stdout and "api@12" in r.stdout
+
+
+def test_manual_acquire_release_tracks_held_stack(tmp_path):
+    # acquire()/release() critical sections (try/finally style) must be
+    # analyzed exactly like `with`: inversions and blocking inside them
+    # cannot escape the gate
+    p = tmp_path / "scratch_manual.py"
+    p.write_text(
+        "import time\n"
+        "from paddle_tpu.core import locks\n"
+        "A = locks.named_lock('scratch.m_outer', rank=9)\n"
+        "B = locks.named_lock('scratch.m_inner', rank=1)\n"
+        "def f():\n"
+        "    A.acquire()\n"
+        "    try:\n"
+        "        with B:\n"           # line 8: rank 1 under rank 9
+        "            pass\n"
+        "        time.sleep(5)\n"     # line 10: blocking while A held
+        "    finally:\n"
+        "        A.release()\n"
+        "    time.sleep(5)\n")        # line 13: after release — clean
+    r = _run_lint(str(p), "--check")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "lock_order_inversion" in r.stdout
+    assert "scratch_manual:8" in r.stdout
+    assert "blocking_under_lock" in r.stdout
+    assert "scratch_manual:10" in r.stdout
+    assert "scratch_manual:13" not in r.stdout  # release really popped
+
+
+def test_locked_is_truthful_for_reentrant_holder():
+    from paddle_tpu.core import locks
+
+    rl = locks.named_rlock("test.locked_probe", rank=970)
+    assert not rl.locked()
+    with rl:
+        assert rl.locked()  # a re-entrant probe would report False here
+    assert not rl.locked()
+
+
+def test_guarded_writes_and_pragma_are_clean(tmp_path):
+    # the same shapes, done right: common named lock + an audited
+    # `# lock-ok:` keep — zero diagnostics, allowlist counted
+    p = tmp_path / "scratch_ok.py"
+    p.write_text(
+        "import threading, time\n"
+        "from paddle_tpu.core import locks\n"
+        "class Ledger:\n"
+        "    def __init__(self):\n"
+        "        self.n = 0\n"
+        "        self._lock = locks.named_lock('scratch.ok', rank=10)\n"
+        "    def start(self):\n"
+        "        threading.Thread(target=self._loop).start()\n"
+        "    def _loop(self):\n"
+        "        with self._lock:\n"
+        "            self.n += 1\n"
+        "    def bump(self):\n"
+        "        with self._lock:  # lock-ok: audited scratch keep\n"
+        "            self.n += 1\n"
+        "            time.sleep(0.0)\n")
+    r = _run_lint(str(p), "--check")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "unguarded_shared_write" not in r.stdout
+    assert "audited scratch keep" in r.stdout  # allowlist rendered
+    assert "allowlist_sites=1" in r.stdout
+
+
+def test_condition_wait_on_own_lock_is_legal(tmp_path):
+    p = tmp_path / "scratch_cv.py"
+    p.write_text(
+        "import threading\n"
+        "from paddle_tpu.core import locks\n"
+        "class Q:\n"
+        "    def __init__(self):\n"
+        "        self._cv = locks.named_condition('scratch.cv', rank=10)\n"
+        "        self._evt = threading.Event()\n"
+        "    def take(self):\n"
+        "        with self._cv:\n"
+        "            self._cv.wait(0.05)\n"    # own lock: legal
+        "    def bad(self):\n"
+        "        with self._cv:\n"
+        "            self._evt.wait(1.0)\n")   # line 12: other waitable
+    r = _run_lint(str(p), "--check")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "scratch_cv:12" in r.stdout
+    # exactly ONE blocking diagnostic: the own-lock wait did not fire
+    assert "errors=1" in r.stdout
+    assert "scratch_cv:9" not in r.stdout
+
+
+# ---- runtime half: telemetry, timeout, registry ----------------------------
+
+def test_lock_telemetry_counters():
+    import paddle_tpu as fluid
+    from paddle_tpu.core import locks
+    from paddle_tpu.monitor import MONITOR
+
+    was_enabled = MONITOR.enabled
+    MONITOR.enable()
+    fluid.set_flags({"FLAGS_lock_telemetry": True})
+    try:
+        lk = locks.named_lock("test.telemetry", rank=900)
+
+        def worker():
+            for _ in range(30):
+                with lk:
+                    time.sleep(0.001)
+
+        ts = [threading.Thread(target=worker) for _ in range(3)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        c = MONITOR.counter_values()
+        assert c["lock.test.telemetry.acquires"] == 90
+        assert c["lock.test.telemetry.contended"] > 0
+        assert c["lock.test.telemetry.wait_us"] > 0
+        assert c["lock.test.telemetry.hold_us"] > 0
+    finally:
+        fluid.set_flags({"FLAGS_lock_telemetry": False})
+        if not was_enabled:
+            MONITOR.disable()
+
+
+def test_lock_telemetry_observes_runtime_inversion():
+    import paddle_tpu as fluid
+    from paddle_tpu.core import locks
+    from paddle_tpu.monitor import MONITOR
+
+    was_enabled = MONITOR.enabled
+    MONITOR.enable()
+    fluid.set_flags({"FLAGS_lock_telemetry": True})
+    try:
+        lo = locks.named_lock("test.inv_lo", rank=901)
+        hi = locks.named_lock("test.inv_hi", rank=902)
+        before = MONITOR.counter("lock.order_inversions").value
+        with hi:
+            with lo:  # descending ranks: observed, never raised
+                pass
+        assert MONITOR.counter("lock.order_inversions").value == before + 1
+    finally:
+        fluid.set_flags({"FLAGS_lock_telemetry": False})
+        if not was_enabled:
+            MONITOR.disable()
+
+
+def test_lock_timeout_raises_classified_error_naming_both_locks():
+    import paddle_tpu as fluid
+    from paddle_tpu import errors
+    from paddle_tpu.core import locks
+
+    a = locks.named_lock("test.timeout_a", rank=910)
+    b = locks.named_lock("test.timeout_b", rank=911)
+    release = threading.Event()
+
+    def holder():
+        with b:
+            release.wait(5.0)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    time.sleep(0.05)
+    fluid.set_flags({"FLAGS_lock_timeout_s": 0.2})
+    try:
+        with pytest.raises(errors.LockTimeoutError) as ei:
+            with a:
+                b.acquire()
+        e = ei.value
+        assert isinstance(e, errors.FatalError)  # classified, never retried
+        assert e.wanted == "test.timeout_b" and e.wanted_rank == 911
+        assert ("test.timeout_a", 910) in e.held
+        msg = str(e)
+        assert "test.timeout_b" in msg and "test.timeout_a" in msg
+        assert "910" in msg and "911" in msg
+    finally:
+        fluid.set_flags({"FLAGS_lock_timeout_s": 0.0})
+        release.set()
+        t.join()
+
+
+def test_duplicate_name_needs_same_rank():
+    from paddle_tpu.core import locks
+
+    locks.named_lock("test.dup", rank=920)
+    locks.named_lock("test.dup", rank=920)  # same rank: a lock class
+    with pytest.raises(ValueError):
+        locks.named_lock("test.dup", rank=921)
+
+
+def test_flag_toggle_mid_hold_does_not_strand_bookkeeping():
+    # telemetry toggled OFF between acquire and release must not leave a
+    # stale held-stack entry (it would poison this thread's later
+    # inversion counts and timeout reports) or a stale hold start
+    import paddle_tpu as fluid
+    from paddle_tpu.core import locks
+    from paddle_tpu.monitor import MONITOR
+
+    was_enabled = MONITOR.enabled
+    MONITOR.enable()
+    lk = locks.named_lock("test.toggle", rank=940)
+    try:
+        fluid.set_flags({"FLAGS_lock_telemetry": True})
+        lk.acquire()
+        fluid.set_flags({"FLAGS_lock_telemetry": False})
+        lk.release()
+        assert locks.held_locks() == []
+        # stale _t_hold must not leak into a wall-clock-sized hold_us
+        # after re-enable
+        time.sleep(0.05)
+        fluid.set_flags({"FLAGS_lock_telemetry": True})
+        with lk:
+            pass
+        hold = MONITOR.counter("lock.test.toggle.hold_us").value
+        assert hold < 40_000, f"bogus hold_us {hold} from stale start"
+    finally:
+        fluid.set_flags({"FLAGS_lock_telemetry": False})
+        if not was_enabled:
+            MONITOR.disable()
+
+
+def test_reentrant_hold_spans_first_acquire_to_last_release():
+    import paddle_tpu as fluid
+    from paddle_tpu.core import locks
+    from paddle_tpu.monitor import MONITOR
+
+    was_enabled = MONITOR.enabled
+    MONITOR.enable()
+    fluid.set_flags({"FLAGS_lock_telemetry": True})
+    try:
+        rl = locks.named_rlock("test.reent", rank=950)
+        with rl:
+            with rl:  # nested re-entry must not clobber the hold start
+                time.sleep(0.02)
+            time.sleep(0.02)
+        hold = MONITOR.counter("lock.test.reent.hold_us").value
+        assert hold >= 35_000, f"hold_us {hold} lost the outer span"
+    finally:
+        fluid.set_flags({"FLAGS_lock_telemetry": False})
+        if not was_enabled:
+            MONITOR.disable()
+
+
+def test_condition_wait_reacquire_exempt_from_lock_timeout():
+    # FLAGS_lock_timeout_s must not fire on Condition.wait's internal
+    # lock re-acquisition — that would propagate with the lock UNHELD and
+    # the enclosing with-block's release would mask the diagnostic
+    import paddle_tpu as fluid
+    from paddle_tpu.core import locks
+
+    cv = locks.named_condition("test.cv_timeout", rank=960)
+    fluid.set_flags({"FLAGS_lock_timeout_s": 0.05})
+    try:
+        got = []
+        started = threading.Event()
+
+        def waiter():
+            with cv:  # enters while the cv is free: no entry contention
+                started.set()
+                got.append(cv.wait(0.2))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        assert started.wait(5.0)
+        # hold the cv across the waiter's wait-timeout: its REACQUIRE
+        # queues behind us for ~0.1s > FLAGS_lock_timeout_s=0.05 — the
+        # exemption is what keeps that from raising inside wait()
+        with cv:
+            time.sleep(0.3)
+        t.join(5.0)
+        assert got == [False], got  # timed-out wait returned, no raise
+    finally:
+        fluid.set_flags({"FLAGS_lock_timeout_s": 0.0})
+
+
+def test_init_health_rearm_on_world_resize(tmp_path, monkeypatch):
+    # a second init_health with a DIFFERENT world must re-arm against the
+    # new membership, never hand back the stale watchdog
+    monkeypatch.setenv("PADDLE_HEARTBEAT_DIR", str(tmp_path))
+    from paddle_tpu import dist_resilience as dr
+
+    dr.shutdown_health()
+    try:
+        wd2 = dr.init_health(0, 2)
+        assert dr.active_heartbeat().world == 2
+        wd3 = dr.init_health(0, 3)
+        assert wd3 is not wd2
+        assert dr.active_heartbeat().world == 3
+        assert dr.init_health(0, 3) is wd3  # idempotent at same membership
+    finally:
+        dr.shutdown_health()
+
+
+def test_disabled_mode_is_raw_lock_fast_path():
+    # with telemetry and timeout off, acquire must not touch per-thread
+    # state (the held stack stays empty) — the hot-path budget
+    from paddle_tpu.core import locks
+
+    lk = locks.named_lock("test.fast", rank=930)
+    with lk:
+        assert locks.held_locks() == []
+
+
+# ---- perf_report --max-lock-wait-frac ---------------------------------------
+
+def _snapshot_line(counters):
+    return json.dumps({"kind": "snapshot", "ts": time.time(),
+                       "counters": counters, "gauges": {}, "spans": {}})
+
+
+def test_perf_report_lock_gate_trips_on_contention(tmp_path):
+    p = tmp_path / "metrics.jsonl"
+    p.write_text(_snapshot_line({
+        "lock.serving.registry.acquires": 100,
+        "lock.serving.registry.contended": 80,
+        "lock.serving.registry.wait_us": 900_000,
+        "lock.serving.registry.hold_us": 100_000}) + "\n")
+    r = _run_perf("--check", str(p), "--max-lock-wait-frac", "0.5")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "lock wait fraction 0.9000" in r.stdout
+    assert "serving.registry" in r.stdout  # names the worst lock
+
+
+def test_perf_report_lock_gate_passes_quiet_locks(tmp_path):
+    p = tmp_path / "metrics.jsonl"
+    p.write_text(_snapshot_line({
+        "lock.executor.cache.acquires": 1000,
+        "lock.executor.cache.contended": 1,
+        "lock.executor.cache.wait_us": 50,
+        "lock.executor.cache.hold_us": 10_000}) + "\n")
+    r = _run_perf("--check", str(p), "--max-lock-wait-frac", "0.2")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "lock wait fraction" in r.stdout
+
+
+def test_perf_report_lock_gate_fails_on_zero_evidence(tmp_path):
+    # the zero-evidence-fails convention: no lock.* counters anywhere
+    p = tmp_path / "metrics.jsonl"
+    p.write_text(_snapshot_line({"executor.steps": 5}) + "\n")
+    r = _run_perf("--check", str(p), "--max-lock-wait-frac", "0.5")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "no lock.* counters" in r.stdout
+
+
+# ---- audit-fix regressions (satellite: findings fixed this PR) --------------
+
+def test_publish_ladders_serialize_per_model(monkeypatch):
+    # two concurrent publishes into one model must run their ladders one
+    # at a time (in-flight marker under serving.publish) — and the marker
+    # is held WITHOUT any lock across the ladder, so a second model's
+    # publish is free to proceed
+    from paddle_tpu.serving import publisher
+    from paddle_tpu.serving.registry import ModelRegistry
+
+    reg = ModelRegistry()
+    events = []
+    ev_lock = threading.Lock()
+
+    def fake_ladder(registry, name, src, *a):
+        with ev_lock:
+            events.append(("start", name))
+        time.sleep(0.05)
+        with ev_lock:
+            events.append(("end", name))
+        return "v-" + name
+
+    monkeypatch.setattr(publisher, "_publish_ladder", fake_ladder)
+    ts = [threading.Thread(target=publisher.publish, args=(reg, "m", "/x"))
+          for _ in range(3)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    # strict alternation: every start is followed by its own end
+    for i in range(0, len(events), 2):
+        assert events[i][0] == "start" and events[i + 1][0] == "end", events
+    assert len(events) == 6
+    assert not reg._publishing  # marker always cleared
+
+
+def test_init_health_concurrent_racers_converge(tmp_path, monkeypatch):
+    # regression for the blocking-under-lock fix: heartbeat construction
+    # (socket/dir I/O, thread start) now happens OUTSIDE _HEALTH_LOCK;
+    # racing initializers must still converge on ONE watchdog and leak
+    # no loser heartbeats
+    monkeypatch.setenv("PADDLE_HEARTBEAT_DIR", str(tmp_path))
+    from paddle_tpu import dist_resilience as dr
+
+    dr.shutdown_health()
+    results = []
+
+    def racer():
+        results.append(dr.init_health(0, 2))
+
+    ts = [threading.Thread(target=racer) for _ in range(4)]
+    try:
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert len(results) == 4
+        assert all(r is results[0] for r in results), \
+            "racing init_health calls returned different watchdogs"
+        assert dr.active_watchdog() is results[0]
+    finally:
+        dr.shutdown_health()
+    # the losers' beat threads were stopped: no pt-heartbeat thread left
+    time.sleep(0.1)
+    assert not [t for t in threading.enumerate()
+                if t.name == "pt-heartbeat"]
+
+
+def test_heartbeat_observe_poll_rate_limit_is_guarded(tmp_path, monkeypatch):
+    # regression for the unguarded _last_poll read-modify-write: the
+    # rate-limit decision is now taken under the table lock, so N
+    # concurrent observers perform ONE transport poll per window
+    monkeypatch.setenv("PADDLE_HEARTBEAT_DIR", str(tmp_path))
+    from paddle_tpu.dist_resilience import Heartbeat, HeartbeatConfig
+
+    hb = Heartbeat(0, 2, config=HeartbeatConfig(interval_s=10.0))
+    polls = []
+    orig = hb.transport.poll
+    hb.transport.poll = lambda: (polls.append(1), orig())[1]
+    try:
+        hb.observe()          # first call past the -inf init: polls
+        n_first = len(polls)
+        ts = [threading.Thread(target=hb.observe) for _ in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert n_first == 1
+        assert len(polls) == 1, \
+            f"{len(polls)} transport polls inside one rate-limit window"
+    finally:
+        hb.stop()

@@ -1,0 +1,999 @@
+#!/usr/bin/env python
+"""Concurrency lint: static analysis over paddle_tpu's OWN source.
+
+The framework is a multi-threaded system (serving workers, batcher,
+heartbeat/watchdog threads, pipeline prefetch, monitor loggers), and the
+last several PRs each needed hand review to catch the same defect
+classes: blocking work held under a hot lock, lost-update counter races,
+lock-order inversions.  This tool makes those classes build-time
+failures.  Same render/--check CLI shape as program_lint/resource_plan:
+
+    python tools/concurrency_lint.py
+        Lint the whole paddle_tpu/ tree: render the lock rank table, the
+        observed acquisition graph, every diagnostic, and the allowlist.
+
+    python tools/concurrency_lint.py path.py [dir ...]
+        Lint specific files/directories (how the planted-defect tests
+        exercise each diagnostic class on scratch modules).
+
+    python tools/concurrency_lint.py --check [--max-allowlist N]
+        CI gate: exit 1 on any error-severity diagnostic, any unnamed
+        raw threading primitive, or an allowlist grown past the ratchet.
+        Wired into tier-1 via tests/test_concurrency_lint.py.
+
+Three analyses (all static, nothing is imported or executed):
+
+1. **Lock graph / rank order.**  Every framework lock is created through
+   `paddle_tpu.core.locks.named_lock("name", rank=N)` (or named_rlock /
+   named_condition) — the lint collects every creation site, maps lock
+   variables (module globals and `self._x` attributes) to their names,
+   then walks `with`/`.acquire()` nesting through every function,
+   following calls ONE level deep (self-methods, module functions, and
+   attribute/parameter types inferred from `self.x = ClassName(...)`
+   assignments and parameter annotations).  Any acquisition whose rank
+   is not strictly greater than every lock already held is a potential
+   deadlock: `lock_order_inversion` (or `self_deadlock` for nested
+   acquisition of a non-reentrant name), named with file:line and BOTH
+   lock names + declared ranks.
+
+2. **Blocking-under-lock.**  A registry of blocking calls — XLA
+   compile/_CompiledStep build, file/socket I/O, subprocess, time.sleep,
+   collective dispatch, Future.result, `.wait()` on anything that is not
+   the held lock itself — flagged whenever reachable (one call level
+   deep) while a named lock is held.  The registry mechanically encodes
+   the PR-10/PR-11 review findings (Predictor construction and
+   plan_model_bytes under the serving registry lock) so the class can
+   never land again.  Audited deliberate cases carry a `# lock-ok:
+   <reason>` pragma on the `with` (or call) line — the allowlist — and
+   the --check gate ratchets the allowlist count so it can only shrink.
+
+3. **Unguarded shared state.**  Per class: instance attributes written
+   from more than one thread entry point (methods launched via
+   `threading.Thread(target=self.m)`, atexit/excepthook hooks, plus the
+   public API surface as one combined entry) without a common named
+   lock.  An augmented write (`self.x += 1`, the PR-10 lost-update
+   class) is an error; plain multi-entry writes are warnings.
+
+Exit codes: 0 clean (warnings allowed), 1 errors / unnamed locks /
+allowlist above the ratchet.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+
+# The allowlist ratchet: the number of `# lock-ok:` pragma SITES in
+# paddle_tpu/ may only go DOWN (each is an audited, justified case of
+# deliberate blocking-under-lock).  Raising it requires the same review
+# a new lock would get.  Current sites: predictor run serialization
+# (x2), executor build lock, monitor blackbox latch, monitor JSONL
+# logger (x2), recordio g++ one-shot build, ps client protocol framing,
+# ps drain barrier.
+ALLOWLIST_MAX = 9
+
+PRAGMA = "# lock-ok:"
+
+NAMED_LOCK_FACTORIES = {"named_lock", "named_rlock", "named_condition"}
+RAW_PRIMITIVES = {"Lock", "RLock", "Condition", "Semaphore",
+                  "BoundedSemaphore", "Barrier"}
+
+# ---- the blocking-call registry ---------------------------------------------
+# Exact dotted call paths that block.
+BLOCKING_DOTTED = {
+    "time.sleep",
+    "subprocess.run", "subprocess.Popen", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output",
+    "os.fsync",
+    "socket.create_connection",
+    "shutil.copyfile", "shutil.copytree", "shutil.rmtree",
+}
+# Terminal method names that block on any receiver (socket/file/thread/
+# future/collective vocabulary).  ".wait" is handled specially: waiting
+# on the HELD lock's own condition is the point of a condition variable.
+BLOCKING_METHODS = {
+    "result", "join",
+    "recv", "recvfrom", "accept", "connect", "sendall", "sendto",
+    "fsync", "flush",
+    "compile",
+    "all_reduce", "all_gather", "all_to_all", "barrier", "broadcast",
+    "psum",
+}
+# Dotted paths that merely LOOK like blocking methods.
+NOT_BLOCKING_DOTTED = {"os.path.join"}
+# Callables (functions/constructors, matched by terminal name) whose
+# bodies block on disk or XLA — the PR-10/PR-11 review findings encoded:
+# Predictor() streams weights and compiles; plan_model_bytes reads and
+# plans a saved program; _CompiledStep() builds the step closure;
+# Heartbeat() binds sockets and starts threads.
+BLOCKING_CALLABLES = {
+    "open",
+    "Predictor", "_CompiledStep", "Heartbeat",
+    "plan_model_bytes", "manifest_weight_bytes",
+    "load_inference_model", "load_sharded", "load_vars",
+}
+
+# Files the scanner skips: the lock wrapper itself builds the raw
+# primitives every other file is forbidden to touch.
+SKIP_RELPATHS = {os.path.join("core", "locks.py")}
+
+
+def _dotted(node) -> str:
+    """'a.b.c' for Name/Attribute chains, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+class Diag:
+    __slots__ = ("severity", "code", "file", "line", "locks", "message",
+                 "allowed", "reason")
+
+    def __init__(self, severity, code, file, line, locks, message,
+                 allowed=False, reason=""):
+        self.severity = severity
+        self.code = code
+        self.file = file
+        self.line = line
+        self.locks = locks  # tuple of lock names involved
+        self.message = message
+        self.allowed = allowed  # pragma-allowlisted
+        self.reason = reason    # the pragma's justification text
+
+    def where(self):
+        return f"{self.file}:{self.line}"
+
+
+class LockDef:
+    __slots__ = ("name", "rank", "reentrant", "file", "line", "kind")
+
+    def __init__(self, name, rank, reentrant, file, line, kind):
+        self.name = name
+        self.rank = rank
+        self.reentrant = reentrant
+        self.file = file
+        self.line = line
+        self.kind = kind
+
+
+class FuncInfo:
+    __slots__ = ("module", "cls", "name", "file",
+                 "acquires", "blocking", "all_blocking", "calls", "writes")
+
+    def __init__(self, module, cls, name, file):
+        self.module = module
+        self.cls = cls          # class name or None
+        self.name = name
+        self.file = file
+        # (lockname, line, held_names_tuple) — every acquisition
+        self.acquires = []
+        # (desc, line, held_tuple, with_lines) — blocking call while held
+        self.blocking = []
+        # (desc, line) — every blocking-registry call, held or not (what
+        # a caller holding a lock inherits, one level deep)
+        self.all_blocking = []
+        # (callee_ref, line, held_tuple, with_lines)
+        self.calls = []
+        # (attr, line, frozenset(held), is_aug)
+        self.writes = []
+
+
+class ClassInfo:
+    __slots__ = ("name", "module", "file", "attr_locks", "attr_types",
+                 "methods", "thread_entries")
+
+    def __init__(self, name, module, file):
+        self.name = name
+        self.module = module
+        self.file = file
+        self.attr_locks = {}     # attr -> lock name
+        self.attr_types = {}     # attr -> class name (from ClassName(...))
+        self.methods = {}        # name -> FuncInfo
+        self.thread_entries = set()
+
+
+class ModuleInfo:
+    __slots__ = ("name", "file", "tree", "mod_locks", "classes",
+                 "functions", "import_aliases", "pragmas")
+
+    def __init__(self, name, file):
+        self.name = name
+        self.file = file
+        self.tree = None
+        self.mod_locks = {}      # var -> lock name
+        self.classes = {}
+        self.functions = {}      # name -> FuncInfo
+        self.import_aliases = {} # alias -> module dotted path
+        self.pragmas = {}        # line -> reason text
+
+
+class Analyzer:
+    def __init__(self):
+        self.modules = {}        # module name -> ModuleInfo
+        self.class_index = {}    # class name -> ClassInfo (global)
+        self.lock_defs = {}      # lock name -> LockDef
+        self.diags = []
+        self.edges = []          # (from_lock, to_lock, file, line, note)
+
+    # -- pass 1: parse, collect lock defs / maps / raw primitives ----------
+    def load(self, files):
+        for path, relname in files:
+            mi = ModuleInfo(relname, path)
+            try:
+                with open(path) as f:
+                    src = f.read()
+                mi.tree = ast.parse(src)
+            except (OSError, SyntaxError) as e:
+                self.diags.append(Diag(
+                    SEV_ERROR, "parse_error", relname, 0, (),
+                    f"cannot parse: {e}"))
+                continue
+            # pragmas come from COMMENT tokens only: the text '# lock-ok:'
+            # inside a docstring or string literal documents the
+            # convention, it does not grant (or count against) the
+            # allowlist ratchet
+            import io as _io
+            import tokenize
+
+            try:
+                for tok in tokenize.generate_tokens(
+                        _io.StringIO(src).readline):
+                    if tok.type == tokenize.COMMENT and PRAGMA in tok.string:
+                        mi.pragmas[tok.start[0]] = \
+                            tok.string.split(PRAGMA, 1)[1].strip()
+            except tokenize.TokenError:
+                pass
+            self.modules[mi.name] = mi
+            self._collect_module(mi)
+
+    def _lock_from_call(self, node):
+        """(name, rank, reentrant, kind) for a named_lock-family Call,
+        else None."""
+        if not isinstance(node, ast.Call):
+            return None
+        fn = node.func
+        term = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        if term not in NAMED_LOCK_FACTORIES:
+            return None
+        name = rank = None
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            name = node.args[0].value
+        if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+            rank = node.args[1].value
+        reentrant = term == "named_rlock"
+        for kw in node.keywords:
+            if kw.arg == "rank" and isinstance(kw.value, ast.Constant):
+                rank = kw.value.value
+            if kw.arg == "reentrant" and isinstance(kw.value, ast.Constant):
+                reentrant = bool(kw.value.value)
+        return name, rank, reentrant, term
+
+    def _register_lock(self, lock, file, line):
+        name, rank, reentrant, kind = lock
+        if name is None or not isinstance(rank, int):
+            self.diags.append(Diag(
+                SEV_ERROR, "unresolvable_lock", file, line, (name or "?",),
+                "named_lock name and rank must be literal constants — the "
+                "lint (and any reader) must be able to see the declared "
+                "order without executing the program"))
+            return
+        prev = self.lock_defs.get(name)
+        if prev is not None and prev.rank != rank:
+            self.diags.append(Diag(
+                SEV_ERROR, "rank_conflict", file, line, (name,),
+                f"lock {name!r} declared with rank {rank} here but rank "
+                f"{prev.rank} at {prev.file}:{prev.line} — one rank per "
+                f"name"))
+            return
+        if prev is None:
+            self.lock_defs[name] = LockDef(name, rank, reentrant, file,
+                                           line, kind)
+        elif reentrant and not prev.reentrant:
+            prev.reentrant = True
+
+    def _collect_module(self, mi):
+        # import aliases (for resolving _bk.coalesce-style calls)
+        for node in ast.walk(mi.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    mi.import_aliases[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    mi.import_aliases[a.asname or a.name] = a.name
+        # module-level lock vars
+        for node in mi.tree.body:
+            if isinstance(node, ast.Assign):
+                lock = self._lock_from_call(node.value)
+                if lock:
+                    self._register_lock(lock, mi.name, node.lineno)
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and lock[0]:
+                            mi.mod_locks[t.id] = lock[0]
+        # raw threading primitives anywhere in the file — including
+        # through module aliases (`import threading as th; th.Lock()`)
+        from_threading = {a.asname or a.name
+                          for n in ast.walk(mi.tree)
+                          if isinstance(n, ast.ImportFrom)
+                          and n.module == "threading"
+                          for a in n.names if a.name in RAW_PRIMITIVES}
+        threading_aliases = {"threading"} | {
+            a.asname or a.name
+            for n in ast.walk(mi.tree) if isinstance(n, ast.Import)
+            for a in n.names if a.name == "threading"}
+        for node in ast.walk(mi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            raw = None
+            if isinstance(fn, ast.Attribute) and fn.attr in RAW_PRIMITIVES \
+                    and isinstance(fn.value, ast.Name) \
+                    and fn.value.id in threading_aliases:
+                raw = fn.attr
+            elif isinstance(fn, ast.Name) and fn.id in from_threading:
+                raw = fn.id
+            if raw:
+                # NO pragma escape for this class: the unnamed-lock floor
+                # is zero, full stop — a '# lock-ok:' comment allowlists
+                # audited blocking-under-lock, never a raw primitive
+                self.diags.append(Diag(
+                    SEV_ERROR, "unnamed_lock", mi.name, node.lineno, (),
+                    f"raw threading.{raw}() — framework locks go through "
+                    f"paddle_tpu.core.locks.named_lock(name, rank) so they "
+                    f"carry an identity, a declared order, and telemetry"))
+        # classes and functions
+        for node in mi.tree.body:
+            if isinstance(node, ast.ClassDef):
+                ci = ClassInfo(node.name, mi.name, mi.name)
+                mi.classes[node.name] = ci
+                self.class_index.setdefault(node.name, ci)
+                for sub in node.body:
+                    if isinstance(sub, ast.FunctionDef):
+                        ci.methods[sub.name] = None  # filled in pass 2
+                        if sub.name == "__init__":
+                            self._collect_init(mi, ci, sub)
+                        self._collect_thread_entries(ci, sub)
+            elif isinstance(node, ast.FunctionDef):
+                mi.functions[node.name] = None
+
+    def _collect_init(self, mi, ci, fn):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                lock = self._lock_from_call(node.value)
+                if lock:
+                    self._register_lock(lock, mi.name, node.lineno)
+                    if lock[0]:
+                        ci.attr_locks[t.attr] = lock[0]
+                    continue
+                # attr type from any ClassName(...) call in the value
+                for c in ast.walk(node.value):
+                    if isinstance(c, ast.Call):
+                        cn = c.func.id if isinstance(c.func, ast.Name) \
+                            else (c.func.attr
+                                  if isinstance(c.func, ast.Attribute)
+                                  else "")
+                        if cn and cn[0].isupper():
+                            ci.attr_types.setdefault(t.attr, cn)
+                            break
+
+    def _collect_thread_entries(self, ci, fn):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            target = None
+            if d.endswith("Thread"):
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+            elif d == "atexit.register" and node.args:
+                target = node.args[0]
+            if (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                ci.thread_entries.add(target.attr)
+        # sys.excepthook = self.m
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and any(_dotted(t) == "sys.excepthook"
+                            for t in node.targets) \
+                    and isinstance(node.value, ast.Attribute) \
+                    and isinstance(node.value.value, ast.Name) \
+                    and node.value.value.id == "self":
+                ci.thread_entries.add(node.value.attr)
+
+    # -- pass 2: per-function walk -----------------------------------------
+    def analyze_functions(self):
+        for mi in self.modules.values():
+            if mi.tree is None:
+                continue
+            for node in mi.tree.body:
+                if isinstance(node, ast.FunctionDef):
+                    fi = FuncInfo(mi.name, None, node.name, mi.name)
+                    mi.functions[node.name] = fi
+                    _FuncWalker(self, mi, None, fi).run(node)
+                elif isinstance(node, ast.ClassDef):
+                    ci = mi.classes[node.name]
+                    for sub in node.body:
+                        if isinstance(sub, ast.FunctionDef):
+                            fi = FuncInfo(mi.name, ci.name, sub.name,
+                                          mi.name)
+                            ci.methods[sub.name] = fi
+                            _FuncWalker(self, mi, ci, fi).run(sub)
+
+    # -- pass 3: interprocedural (one level) + checks ----------------------
+    def _resolve_callee(self, mi, ref):
+        kind, a, b = ref
+        if kind == "cls":
+            ci = self.class_index.get(a)
+            if ci is None:
+                return None
+            fi = ci.methods.get(b)
+            return fi
+        if kind == "mod":
+            m = self.modules.get(a)
+            if m is None:
+                return None
+            if b in m.functions:
+                return m.functions[b]
+            if b in m.classes:
+                return m.classes[b].methods.get("__init__")
+            return None
+        return None
+
+    def _local_callees(self, fi):
+        """Callees that count as fi's own internals: same-class self-calls
+        and same-module functions — their behavior folds transitively into
+        fi's effective surface (a private helper must not hide blocking
+        work from fi's callers)."""
+        mi = self.modules[fi.module]
+        for ref, line, _held, _wl in fi.calls:
+            g = None
+            if ref[0] == "cls" and fi.cls is not None and ref[1] == fi.cls:
+                ci = mi.classes.get(fi.cls)
+                g = ci.methods.get(ref[2]) if ci else None
+            elif ref[0] == "mod" and ref[1] == fi.module:
+                g = mi.functions.get(ref[2])
+            if g is not None and g is not fi:
+                yield g, line
+
+    def _eff_blocking(self, fi, _stack=None):
+        """fi's blocking calls, with same-class/same-module helpers folded
+        in transitively; entries re-anchored to fi's own call lines."""
+        memo = self._memo_blocking
+        got = memo.get(id(fi))
+        if got is not None:
+            return got
+        stack = _stack or set()
+        if id(fi) in stack:
+            return []
+        stack = stack | {id(fi)}
+        out = list(fi.all_blocking)
+        for g, line in self._local_callees(fi):
+            gname = f"{g.cls}.{g.name}" if g.cls else g.name
+            for desc, _bl in self._eff_blocking(g, stack):
+                out.append((f"call to {gname}() which does {desc}", line))
+        memo[id(fi)] = out
+        return out
+
+    def _eff_acquires(self, fi, _stack=None):
+        memo = self._memo_acquires
+        got = memo.get(id(fi))
+        if got is not None:
+            return got
+        stack = _stack or set()
+        if id(fi) in stack:
+            return []
+        stack = stack | {id(fi)}
+        out = [(lockname, line) for lockname, line, _h in fi.acquires]
+        for g, line in self._local_callees(fi):
+            out.extend((lockname, line)
+                       for lockname, _l in self._eff_acquires(g, stack))
+        memo[id(fi)] = out
+        return out
+
+    def expand_calls(self):
+        """One call level deep from the caller's perspective: a caller
+        holding locks inherits its callee's effective acquisitions and
+        blocking calls (the callee's own private-helper structure is
+        folded — see _eff_blocking)."""
+        self._memo_blocking = {}
+        self._memo_acquires = {}
+        all_funcs = []
+        for mi in self.modules.values():
+            all_funcs.extend(f for f in mi.functions.values() if f)
+            for ci in mi.classes.values():
+                all_funcs.extend(f for f in ci.methods.values() if f)
+        for fi in all_funcs:
+            mi = self.modules[fi.module]
+            for ref, line, held, wlines in fi.calls:
+                if not held:
+                    continue
+                callee = self._resolve_callee(mi, ref)
+                if callee is None or callee is fi:
+                    continue
+                cname = (f"{callee.cls}.{callee.name}" if callee.cls
+                         else callee.name)
+                for lockname, _cline in self._eff_acquires(callee):
+                    fi.acquires.append((lockname, line, held))
+                for desc, bline in self._eff_blocking(callee):
+                    fi.blocking.append(
+                        (f"call to {cname}() which does {desc} "
+                         f"[{callee.file}:{bline}]", line, held, wlines))
+        return all_funcs
+
+    def check_edges(self, all_funcs):
+        ranks = {n: d.rank for n, d in self.lock_defs.items()}
+        reent = {n: d.reentrant for n, d in self.lock_defs.items()}
+        seen = set()
+        for fi in all_funcs:
+            for lockname, line, held in fi.acquires:
+                if not held:
+                    continue
+                if lockname in held:
+                    if not reent.get(lockname, False):
+                        key = (fi.file, line, lockname, lockname)
+                        if key not in seen:
+                            seen.add(key)
+                            self.diags.append(Diag(
+                                SEV_ERROR, "self_deadlock", fi.file, line,
+                                (lockname, lockname),
+                                f"re-acquiring non-reentrant lock "
+                                f"{lockname!r} (rank "
+                                f"{ranks.get(lockname, '?')}) while already "
+                                f"holding it — guaranteed deadlock; use "
+                                f"named_rlock if re-entry is intended"))
+                    continue
+                known = [(h, ranks[h]) for h in held if h in ranks]
+                if not known or lockname not in ranks:
+                    continue
+                top_name, top_rank = max(known, key=lambda kv: kv[1])
+                self.edges.append((top_name, lockname, fi.file, line))
+                if ranks[lockname] <= top_rank:
+                    key = (fi.file, line, top_name, lockname)
+                    if key not in seen:
+                        seen.add(key)
+                        self.diags.append(Diag(
+                            SEV_ERROR, "lock_order_inversion", fi.file,
+                            line, (top_name, lockname),
+                            f"acquiring lock {lockname!r} (rank "
+                            f"{ranks[lockname]}) while holding "
+                            f"{top_name!r} (rank {top_rank}) inverts the "
+                            f"declared order — another thread nesting "
+                            f"these the other way deadlocks; re-rank or "
+                            f"restructure"))
+
+    def check_blocking(self, all_funcs):
+        seen = set()
+        for fi in all_funcs:
+            mi = self.modules[fi.module]
+            for desc, line, held, wlines in fi.blocking:
+                key = (fi.file, line, desc)
+                if key in seen:
+                    continue
+                seen.add(key)
+                reason = None
+                for ln in (line,) + tuple(wlines):
+                    if ln in mi.pragmas:
+                        reason = mi.pragmas[ln]
+                        break
+                self.diags.append(Diag(
+                    SEV_ERROR, "blocking_under_lock", fi.file, line,
+                    tuple(held),
+                    f"{desc} while holding "
+                    f"{' -> '.join(repr(h) for h in held)} — blocking work "
+                    f"under a lock stalls every thread that wants it; move "
+                    f"the work outside the critical section or add "
+                    f"'# lock-ok: <reason>' after audit",
+                    allowed=reason is not None, reason=reason or ""))
+
+    def check_unguarded(self):
+        for mi in self.modules.values():
+            for ci in mi.classes.values():
+                self._check_class_unguarded(mi, ci)
+
+    def _entry_writes(self, ci, fi):
+        """fi's writes plus one level of self-call expansion; callee
+        writes inherit the locks held at the call site."""
+        out = list(fi.writes)
+        for ref, line, held, _wl in fi.calls:
+            if ref[0] != "cls" or ref[1] != ci.name:
+                continue
+            callee = ci.methods.get(ref[2])
+            if callee is None or callee is fi or callee.name == "__init__":
+                continue
+            for attr, wline, locks, aug in callee.writes:
+                out.append((attr, wline, locks | frozenset(held), aug))
+        return out
+
+    def _check_class_unguarded(self, mi, ci):
+        entries = {}  # entry label -> list of (attr, line, locks, aug)
+        for m in ci.thread_entries:
+            fi = ci.methods.get(m)
+            if fi is not None:
+                entries[f"thread:{m}"] = self._entry_writes(ci, fi)
+        api_writes = []
+        for name, fi in ci.methods.items():
+            if fi is None or name.startswith("_") \
+                    or name in ci.thread_entries:
+                continue
+            api_writes.extend(self._entry_writes(ci, fi))
+        if api_writes:
+            entries["api"] = api_writes
+        if len(entries) < 2 and not ci.thread_entries:
+            return
+        attrs = {}
+        for entry, writes in entries.items():
+            for attr, line, locks, aug in writes:
+                if attr in ci.attr_locks:
+                    continue
+                attrs.setdefault(attr, []).append((entry, line, locks, aug))
+        for attr, ws in sorted(attrs.items()):
+            ents = {e for e, _l, _k, _a in ws}
+            if len(ents) < 2 or not any(e.startswith("thread:")
+                                        for e in ents):
+                continue
+            common = None
+            for _e, _l, locks, _a in ws:
+                common = locks if common is None else (common & locks)
+            if common:
+                continue
+            has_aug = any(a for _e, _l, _k, a in ws)
+            lines = sorted({(e, l) for e, l, _k, _a in ws})
+            self.diags.append(Diag(
+                SEV_ERROR if has_aug else SEV_WARNING,
+                "unguarded_shared_write", mi.name,
+                min(l for _e, l in lines), (),
+                f"{ci.name}.{attr} written from multiple thread entry "
+                f"points without a common named lock: "
+                f"{', '.join(f'{e}@{l}' for e, l in lines)}"
+                + (" — includes a read-modify-write (+=), the lost-update "
+                   "race" if has_aug else
+                   " — concurrent plain stores; last writer wins "
+                   "silently")))
+
+
+class _FuncWalker(ast.NodeVisitor):
+    """Walks one function tracking the held-lock stack."""
+
+    def __init__(self, az, mi, ci, fi):
+        self.az = az
+        self.mi = mi
+        self.ci = ci
+        self.fi = fi
+        self.held = []       # lock names
+        self.with_lines = [] # line numbers of active lock-withs
+        self.param_types = {}
+
+    def run(self, node):
+        for arg in list(node.args.args) + list(node.args.kwonlyargs):
+            ann = arg.annotation
+            if isinstance(ann, ast.Name):
+                self.param_types[arg.arg] = ann.id
+            elif isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+                self.param_types[arg.arg] = ann.value.strip("'\"")
+        for stmt in node.body:
+            self.visit(stmt)
+
+    # nested defs/classes analyzed separately (closures are out of scope)
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+    # -- lock expression resolution ----------------------------------------
+    def _lock_name(self, expr):
+        if isinstance(expr, ast.Name):
+            return self.mi.mod_locks.get(expr.id)
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value,
+                                                          ast.Name):
+            base = expr.value.id
+            if base == "self" and self.ci is not None:
+                return self.ci.attr_locks.get(expr.attr)
+            pt = self.param_types.get(base)
+            if pt and pt in self.az.class_index:
+                return self.az.class_index[pt].attr_locks.get(expr.attr)
+        return None
+
+    def _record_acquire(self, lockname, line):
+        self.fi.acquires.append((lockname, line, tuple(self.held)))
+
+    # -- with ---------------------------------------------------------------
+    def visit_With(self, node):
+        base = len(self.held)
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+            ln = self._lock_name(item.context_expr)
+            if ln is not None:
+                self._record_acquire(ln, node.lineno)
+                self.held.append(ln)
+                self.with_lines.append(node.lineno)
+        for stmt in node.body:
+            self.visit(stmt)
+        # truncate to entry depth: releases the with's own locks AND any
+        # unbalanced manual acquire left open inside the body
+        del self.held[base:]
+        del self.with_lines[base:]
+
+    # -- calls ---------------------------------------------------------------
+    def _blocking(self, desc, line):
+        self.fi.all_blocking.append((desc, line))
+        if self.held:
+            self.fi.blocking.append((desc, line, tuple(self.held),
+                                     tuple(self.with_lines)))
+
+    def _callee_ref(self, fn):
+        if isinstance(fn, ast.Name):
+            return ("mod", self.mi.name, fn.id)
+        if isinstance(fn, ast.Attribute):
+            v = fn.value
+            if isinstance(v, ast.Name):
+                if v.id == "self" and self.ci is not None:
+                    return ("cls", self.ci.name, fn.attr)
+                pt = self.param_types.get(v.id)
+                if pt:
+                    return ("cls", pt, fn.attr)
+                tgt = self.mi.import_aliases.get(v.id)
+                if tgt:
+                    leaf = tgt.rsplit(".", 1)[-1]
+                    for modname in (tgt, leaf):
+                        if modname in self.az.modules:
+                            return ("mod", modname, fn.attr)
+            if isinstance(v, ast.Attribute) and isinstance(v.value,
+                                                           ast.Name) \
+                    and v.value.id == "self" and self.ci is not None:
+                t = self.ci.attr_types.get(v.attr)
+                if t:
+                    return ("cls", t, fn.attr)
+        return None
+
+    def visit_Call(self, node):
+        fn = node.func
+        dotted = _dotted(fn)
+        term_attr = fn.attr if isinstance(fn, ast.Attribute) else None
+        # lock method calls.  Manual acquire()/release() pairs track the
+        # held stack just like `with`: everything between them (in
+        # statement order) is analyzed as under the lock.  This
+        # OVERAPPROXIMATES conditional acquires (`ok = X.acquire(False)`)
+        # — a linter prefers a false positive over a hole — and a lock
+        # held past the end of the function simply stops being tracked
+        # there (cross-function holds are the caller's with-block to see).
+        if term_attr == "acquire":
+            ln = self._lock_name(fn.value)
+            if ln is not None:
+                self._record_acquire(ln, node.lineno)
+                self.held.append(ln)
+                self.with_lines.append(node.lineno)
+        elif term_attr == "release":
+            ln = self._lock_name(fn.value)
+            if ln is not None and ln in self.held:
+                i = len(self.held) - 1 - self.held[::-1].index(ln)
+                del self.held[i]
+                del self.with_lines[i]
+        elif term_attr == "wait":
+            ln = self._lock_name(fn.value)
+            if ln is not None and ln in self.held:
+                pass  # condition wait on the held lock releases it: legal
+            elif self.held:
+                what = dotted or "<expr>.wait"
+                if ln is not None:
+                    self._blocking(
+                        f"{what}() waits on lock {ln!r}, which this thread "
+                        f"does NOT hold", node.lineno)
+                else:
+                    self._blocking(f"blocking {what}()", node.lineno)
+        elif dotted in BLOCKING_DOTTED:
+            self._blocking(f"blocking call {dotted}()", node.lineno)
+        elif dotted not in NOT_BLOCKING_DOTTED and term_attr is not None \
+                and term_attr in BLOCKING_METHODS \
+                and not isinstance(fn.value, ast.Constant):
+            self._blocking(f"blocking call {dotted or term_attr}()",
+                           node.lineno)
+        elif isinstance(fn, ast.Name) and fn.id in BLOCKING_CALLABLES:
+            self._blocking(f"blocking call {fn.id}()", node.lineno)
+        elif term_attr in BLOCKING_CALLABLES:
+            self._blocking(f"blocking call {dotted or term_attr}()",
+                           node.lineno)
+        ref = self._callee_ref(fn)
+        if ref is not None:
+            self.fi.calls.append((ref, node.lineno, tuple(self.held),
+                                  tuple(self.with_lines)))
+        for a in node.args:
+            self.visit(a)
+        for kw in node.keywords:
+            self.visit(kw.value)
+        if isinstance(fn, ast.Attribute):
+            self.visit(fn.value)
+
+    # -- writes --------------------------------------------------------------
+    def _write_target_attr(self, t):
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id == "self":
+            return t.attr
+        if isinstance(t, ast.Subscript):
+            v = t.value
+            if isinstance(v, ast.Attribute) and isinstance(v.value,
+                                                           ast.Name) \
+                    and v.value.id == "self":
+                return v.attr
+        return None
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            attr = self._write_target_attr(t)
+            if attr is not None:
+                self.fi.writes.append((attr, node.lineno,
+                                       frozenset(self.held), False))
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        attr = self._write_target_attr(node.target)
+        if attr is not None:
+            self.fi.writes.append((attr, node.lineno,
+                                   frozenset(self.held), True))
+        self.generic_visit(node)
+
+
+# ---- driver -----------------------------------------------------------------
+
+def collect_files(paths):
+    out = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append((p, os.path.splitext(os.path.basename(p))[0]))
+            continue
+        root = os.path.abspath(p)
+        for dirpath, _dirs, names in os.walk(root):
+            for n in sorted(names):
+                if not n.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, n)
+                rel = os.path.relpath(full, root)
+                if rel in SKIP_RELPATHS:
+                    continue
+                # module key: the dotted-ish relative path without .py
+                mod = os.path.splitext(rel)[0].replace(os.sep, "/")
+                out.append((full, mod))
+    return out
+
+
+def lint(paths):
+    az = Analyzer()
+    az.load(collect_files(paths))
+    az.analyze_functions()
+    all_funcs = az.expand_calls()
+    az.check_edges(all_funcs)
+    az.check_blocking(all_funcs)
+    az.check_unguarded()
+    return az
+
+
+def _fmt_table(rows, headers):
+    widths = [max(len(str(r[i])) for r in rows + [headers])
+              for i in range(len(headers))]
+    out = ["  ".join(str(h).ljust(w) for h, w in zip(headers, widths))]
+    for r in rows:
+        out.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+def render(az):
+    parts = [f"# concurrency lint  modules={len(az.modules)}  "
+             f"locks={len(az.lock_defs)}"]
+    if az.lock_defs:
+        rows = [(d.rank, n, d.kind + (" (reentrant)" if d.reentrant else ""),
+                 f"{d.file}:{d.line}")
+                for n, d in sorted(az.lock_defs.items(),
+                                   key=lambda kv: kv[1].rank)]
+        parts.append("\n## lock rank table (ascending = outer -> inner)\n"
+                     + _fmt_table(rows, ["rank", "name", "kind", "defined"]))
+    edges = sorted({(a, b) for a, b, _f, _l in az.edges})
+    if edges:
+        parts.append("\n## observed acquisition edges\n" + "\n".join(
+            f"- {a} -> {b}" for a, b in edges))
+    active = [d for d in az.diags if not d.allowed]
+    allowed = [d for d in az.diags if d.allowed]
+    if active:
+        parts.append("\n## diagnostics\n" + _fmt_table(
+            [(d.severity, d.code, d.where(),
+              " -> ".join(d.locks) if d.locks else "-") for d in active],
+            ["severity", "code", "where", "locks"]))
+        parts.append("\n## messages")
+        for d in active:
+            parts.append(f"- {d.where()}: [{d.severity}:{d.code}] "
+                         f"{d.message}")
+    else:
+        parts.append("\nno active diagnostics")
+    # the ratchet counts pragma SITES (one audited decision each), used
+    # or not — a dormant pragma is still standing permission
+    sites = sorted((mi.name, ln, reason)
+                   for mi in az.modules.values()
+                   for ln, reason in mi.pragmas.items())
+    if sites:
+        parts.append(f"\n## allowlist ({len(sites)} '# lock-ok:' sites, "
+                     f"ratchet {ALLOWLIST_MAX}; "
+                     f"{len(allowed)} finding(s) covered)")
+        for f, ln, reason in sites:
+            parts.append(f"- {f}:{ln} — {reason}")
+        for d in allowed:
+            parts.append(f"  · covered: {d.where()} [{d.code}]")
+    n_err = sum(1 for d in active if d.severity == SEV_ERROR)
+    n_warn = sum(1 for d in active if d.severity == SEV_WARNING)
+    n_unnamed = sum(1 for d in active if d.code == "unnamed_lock")
+    parts.append(f"\n## summary\nerrors={n_err} warnings={n_warn} "
+                 f"unnamed_locks={n_unnamed} allowlist_sites={len(sites)}")
+    return "\n".join(parts), n_err, n_unnamed, len(sites)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the paddle_tpu/ "
+                         "tree next to this tool)")
+    ap.add_argument("--check", action="store_true",
+                    help="CI gate: exit 1 on errors, unnamed locks, or an "
+                         "allowlist above the ratchet")
+    ap.add_argument("--max-allowlist", type=int, default=ALLOWLIST_MAX,
+                    help=f"allowlist ratchet for --check (default "
+                         f"{ALLOWLIST_MAX}); lower it as entries retire, "
+                         f"never raise it without review")
+    args = ap.parse_args(argv)
+
+    paths = args.paths
+    if not paths:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = [os.path.join(repo, "paddle_tpu")]
+    az = lint(paths)
+    text, n_err, n_unnamed, n_allowed = render(az)
+    print(text)
+    if args.check:
+        failed = False
+        if n_err:
+            print(f"\nCHECK FAILED: {n_err} error-severity diagnostic(s)")
+            failed = True
+        if n_unnamed:
+            print(f"\nCHECK FAILED: {n_unnamed} unnamed raw threading "
+                  f"primitive(s) — floor is zero")
+            failed = True
+        if n_allowed > args.max_allowlist:
+            print(f"\nCHECK FAILED: {n_allowed} allowlist entries exceed "
+                  f"the ratchet ({args.max_allowlist}) — new "
+                  f"blocking-under-lock keeps need the same review a new "
+                  f"lock would get")
+            failed = True
+        if failed:
+            return 1
+        print(f"\nCHECK OK: 0 errors, 0 unnamed locks, "
+              f"{n_allowed}/{args.max_allowlist} allowlist entries")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -86,6 +86,16 @@
         `bench.py --serve` must hold WITH shedding active — bounded-queue
         admission is what keeps it flat while load climbs.
 
+    python tools/perf_report.py --check metrics.jsonl --max-lock-wait-frac 0.2
+        Gate named-lock contention (paddle_tpu/core/locks.py, recorded
+        when the run sets FLAGS_lock_telemetry=1): of all time threads
+        spent holding-or-waiting-on named locks, the share spent WAITING
+        (sum lock.*.wait_us / (wait_us + hold_us), newest counter
+        snapshot).  A file with no lock.* counters FAILS the gate — zero
+        evidence must not gate green (the PR 8/10 convention).  The
+        failure message names the worst locks so the fix starts at the
+        right critical section.
+
     python tools/perf_report.py --check-bench BENCH_rNN.json
         Ratcheted bench-round gate (ISSUE 7): analytic MFU must clear the
         MFU_FLOORS landed with the last accepted round (resnet50's floor
@@ -398,6 +408,40 @@ def serving_p99_ms(lines):
     return lats[min(int(0.99 * len(lats)), len(lats) - 1)]
 
 
+def _has_lock_evidence(lines):
+    """True when the file carries named-lock telemetry (lock.* counters
+    from FLAGS_lock_telemetry, paddle_tpu/core/locks.py).  The lock gate
+    fails on a file with none — gating a run that never measured its
+    locks green would be the zero-evidence class again."""
+    return bool(_latest_counters(lines, "lock."))
+
+
+def lock_wait_fraction(lines):
+    """(fraction, per_lock) — of all time threads spent in named-lock
+    critical sections plus the queues in front of them, the share spent
+    WAITING: sum(lock.*.wait_us) / (sum wait_us + sum hold_us), from the
+    newest counter snapshot.  0 on an uncontended process; creeping up
+    means a hot lock is serializing threads (the contention ledger names
+    which — per_lock maps name -> (wait_us, hold_us, contended)).
+    Thread-count independent, which is what makes it gateable: it does
+    not change just because the run got longer or wider."""
+    c = _latest_counters(lines, "lock.")
+    per_lock = {}
+    for k, v in c.items():
+        if k == "lock.order_inversions":
+            continue
+        base, _, leaf = k.rpartition(".")
+        name = base[len("lock."):]
+        if leaf in ("wait_us", "hold_us", "contended"):
+            slot = per_lock.setdefault(name, {"wait_us": 0, "hold_us": 0,
+                                              "contended": 0})
+            slot[leaf] = v
+    wait = sum(s["wait_us"] for s in per_lock.values())
+    hold = sum(s["hold_us"] for s in per_lock.values())
+    frac = wait / (wait + hold) if (wait + hold) else 0.0
+    return frac, per_lock
+
+
 def host_blocked_fraction(pipeline_steps):
     """(blocked_s, wall_s, fraction) over `kind="pipeline_step"` records.
     The overlap-health number: a serial loop sits near 1.0 whenever the
@@ -445,7 +489,8 @@ def check(path: str, steady_after: int = 2,
           max_step_skew_frac: float = None,
           max_gang_resizes: int = None,
           max_shed_frac: float = None,
-          max_p99_ms: float = None) -> int:
+          max_p99_ms: float = None,
+          max_lock_wait_frac: float = None) -> int:
     """Return 0 when the metrics file is healthy, 1 otherwise (printed
     diagnosis either way).  Made for CI/bench scripts:
 
@@ -476,7 +521,8 @@ def check(path: str, steady_after: int = 2,
                        or max_step_skew_frac is not None
                        or max_gang_resizes is not None
                        or max_shed_frac is not None
-                       or max_p99_ms is not None) \
+                       or max_p99_ms is not None
+                       or max_lock_wait_frac is not None) \
         and max_host_blocked_frac is None and max_retry_frac is None
     if not steps and not dist_gates_only:
         print(f"perf_report --check: {path} contains no step records "
@@ -646,6 +692,33 @@ def check(path: str, steady_after: int = 2,
         else:
             print(f"perf_report --check: serving p99 {p99:.1f} ms <= "
                   f"{max_p99_ms}")
+    if max_lock_wait_frac is not None:
+        if not _has_lock_evidence(lines):
+            failures.append(
+                f"--max-lock-wait-frac given but {path} carries no lock.* "
+                f"counters in any snapshot — was the run launched with "
+                f"FLAGS_lock_telemetry=1 and a MonitorLogger snapshot "
+                f"written?  (zero evidence must not gate green)")
+        else:
+            frac, per_lock = lock_wait_fraction(lines)
+            if frac > max_lock_wait_frac:
+                worst = sorted(per_lock.items(),
+                               key=lambda kv: -kv[1]["wait_us"])[:3]
+                worst_s = ", ".join(
+                    f"{n} (wait {s['wait_us']/1e3:.1f} ms / hold "
+                    f"{s['hold_us']/1e3:.1f} ms, {s['contended']} "
+                    f"contended)" for n, s in worst)
+                failures.append(
+                    f"lock wait fraction {frac:.4f} exceeds the "
+                    f"--max-lock-wait-frac={max_lock_wait_frac} gate — "
+                    f"threads are queueing on named locks instead of "
+                    f"working; worst: {worst_s}.  Shrink the critical "
+                    f"section (the concurrency lint's blocking-under-lock "
+                    f"registry is the usual culprit list) or split the "
+                    f"lock")
+            else:
+                print(f"perf_report --check: lock wait fraction "
+                      f"{frac:.4f} <= {max_lock_wait_frac}")
     if max_replay_batches is not None:
         n = replayed_batches(lines)
         if n > max_replay_batches:
@@ -1058,6 +1131,14 @@ def main(argv=None):
                          "(serving.p99_ms gauge, serving_batch "
                          "lat_ms_max fallback) at <= MS — the tail SLO "
                          "shedding must hold under overload")
+    ap.add_argument("--max-lock-wait-frac", type=float, default=None,
+                    metavar="FRAC",
+                    help="gate named-lock contention at <= FRAC: "
+                         "wait/(wait+hold) over the lock.* counters "
+                         "FLAGS_lock_telemetry records "
+                         "(paddle_tpu/core/locks.py).  Fails on a file "
+                         "with no lock telemetry at all — zero evidence "
+                         "must not gate green")
     ap.add_argument("--max-step-skew-frac", type=float, default=None,
                     metavar="FRAC",
                     help="gate the MAX sustained straggler lag, in step "
@@ -1084,7 +1165,8 @@ def main(argv=None):
                      args.max_heartbeat_miss_frac, args.max_gang_restarts,
                      args.max_data_corrupt_frac, args.max_replay_batches,
                      args.max_step_skew_frac, args.max_gang_resizes,
-                     args.max_shed_frac, args.max_p99_ms)
+                     args.max_shed_frac, args.max_p99_ms,
+                     args.max_lock_wait_frac)
     if args.diff:
         print(diff(*args.diff))
         return 0

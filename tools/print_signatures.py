@@ -33,6 +33,9 @@ MODULES = [
     "paddle_tpu.faults",
     "paddle_tpu.resilience",
     "paddle_tpu.core.analysis",
+    # named lock registry + contention telemetry (ISSUE 13): the
+    # concurrency lint's runtime half is public contract
+    "paddle_tpu.core.locks",
     # static resource planner (ISSUE 12): liveness peak-HBM + cost model
     "paddle_tpu.core.resource_plan",
     # the distributed observability surface (ISSUE 8): the monitor's
