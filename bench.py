@@ -441,10 +441,39 @@ def bench_pipeline(batch_size=128, steps=24, max_inflight=4, log_period=8,
             "max_inflight": max_inflight, "log_period": log_period}
 
 
-def bench_serve(requests=400, clients=6, buckets=(1, 2, 4, 8),
+# The serve bench's timed window must dwarf a CPython gen2 GC pause: at
+# the old default of 400 requests the window was ~0.15 s, ONE collection
+# landing inside it (steered by import order, nothing else) read as a
+# ~20% rps regression and burned a PR-12 bisect.  Gen2 is frozen around
+# the windows below AND the window length is asserted, so the bench
+# physically cannot report a pause as a regression again.
+MIN_SERVE_WINDOW_S = 1.0
+
+
+class _gc_quiesced:
+    """Freeze the current heap out of gen2's reach and disable automatic
+    collection for the duration of a timed window; one explicit collect
+    on entry starts the window clean."""
+
+    def __enter__(self):
+        import gc
+
+        gc.collect()
+        gc.freeze()
+        gc.disable()
+        return self
+
+    def __exit__(self, *exc):
+        import gc
+
+        gc.enable()
+        gc.unfreeze()
+
+
+def bench_serve(requests=4000, clients=6, buckets=(1, 2, 4, 8),
                 max_queue=64, overload_clients=12, overload_queue=4,
                 overload_burst=6, overload_bursts=8, p99_gate_ms=2000.0,
-                metrics_path=None):
+                metrics_path=None, min_window_s=MIN_SERVE_WINDOW_S):
     """Closed-loop serving load generator (ISSUE 11): throughput vs tail
     latency through `paddle_tpu.serving.Server`, plus an OVERLOAD arm
     proving admission control keeps p99 bounded by shedding.
@@ -462,6 +491,11 @@ def bench_serve(requests=400, clients=6, buckets=(1, 2, 4, 8),
     response — the record reports the exact shed ledger and the p99 the
     survivors saw, gated against `p99_gate_ms` (unbounded queueing is
     what this arm would catch).
+
+    Timed-window hardening (ISSUE 14 satellite): both arms run with gen2
+    GC frozen+disabled (`_gc_quiesced`) and the baseline window must
+    clear `MIN_SERVE_WINDOW_S` — the PR-12 false ~20% regression was ONE
+    gen2 pause inside a ~0.15 s window at the old requests=400 default.
 
     Each arm gets its OWN metrics stream (`metrics_path` for baseline,
     `<metrics_path>.overload.jsonl` for the flood): the overload arm's
@@ -519,13 +553,21 @@ def bench_serve(requests=400, clients=6, buckets=(1, 2, 4, 8),
             rows = int(r.randint(1, 5))
             srv.infer("m", {"x": r.rand(rows, 64).astype("f4")})
 
-    t0 = _time.perf_counter()
     threads = [threading.Thread(target=client, args=(i,)) for i in range(clients)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = _time.perf_counter() - t0
+    with _gc_quiesced():
+        t0 = _time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = _time.perf_counter() - t0
+    # min_window_s=0 is for tier-1 SMOKES only (they test plumbing, not
+    # throughput); any measured round keeps the floor
+    assert wall >= min_window_s, (
+        f"serve bench timed window {wall*1e3:.0f} ms is shorter than the "
+        f"{min_window_s:.1f} s floor — a window this size is "
+        f"GC-pause-sized and its rps is noise; raise `requests` "
+        f"(currently {requests}) until the window clears the floor")
     lat = srv.latency_ms()
     base_stats = srv.stats()
     recompiles = monitor.counter("executor.recompile").value - rec0
@@ -566,12 +608,13 @@ def bench_serve(requests=400, clients=6, buckets=(1, 2, 4, 8),
 
     threads = [threading.Thread(target=flood, args=(i,))
                for i in range(overload_clients)]
-    t0 = _time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    ov_wall = _time.perf_counter() - t0
+    with _gc_quiesced():
+        t0 = _time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        ov_wall = _time.perf_counter() - t0
     ov_lat = ov.latency_ms()
     ov_stats = ov.stats()
     ov_logger.write_snapshot()  # before stop: gauges still armed
@@ -588,6 +631,8 @@ def bench_serve(requests=400, clients=6, buckets=(1, 2, 4, 8),
           f"({shed_frac:.2%}), p99 {ov_lat['p99']:.1f} ms", file=sys.stderr)
     return {"metric": "serving_closed_loop_rps", "value": round(rps, 2),
             "unit": "req/sec",
+            "window_s": round(wall, 3), "min_window_s": min_window_s,
+            "gc_frozen": True,
             "requests": requests, "clients": clients,
             "buckets": list(buckets), "max_queue": max_queue,
             "p50_ms": lat["p50"], "p99_ms": lat["p99"],
@@ -966,8 +1011,145 @@ def bench_chaos_dist(fault_spec, steps=12, n_procs=2, save_every=3,
     return rec
 
 
+def bench_chaos_integrity(fault_spec="rot_shard@1", steps=24, save_every=4,
+                          batch_size=64, n_procs=2, max_restarts=2):
+    """Silent-corruption chaos A/B (ISSUE 14).
+
+    rot_shard specs run single-process: train with periodic commits while
+    the injector flips a byte of the Nth COMMITTED checkpoint post-COMMIT,
+    then a fresh process resumes — the at-rest digests must reject the
+    rotted snapshot (`integrity.ckpt_rejected`), the walk-back lands one
+    earlier, and the resumed run must end bit-identical to a resume from
+    a pristine tree.  The record reports the walk-back ledger and the
+    resume-time overhead of paying one extra restore.
+
+    flip_bit specs route to a 2-process gang on the integrity worker
+    (FLAGS_integrity_check_period armed): the live digests must diverge,
+    the vote must name the flipped rank, the gang restarts from the
+    newest quarantine-clean checkpoint, and the final params must be
+    bit-identical to an uninterrupted gang — detection + restart + replay
+    overhead as a number."""
+    import os
+    import tempfile
+
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor
+    from paddle_tpu.faults import FaultInjector, parse_fault_spec
+
+    kinds = {f.kind for f in parse_fault_spec(fault_spec)}
+    if "flip_bit" in kinds:
+        from paddle_tpu.launch import run_gang
+
+        worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "tests", "dist_worker_integrity.py")
+        env = {"RUN_STEPS": str(steps), "SAVE_EVERY": str(save_every),
+               "INTEGRITY_PERIOD": "2", "PT_STEP_SLEEP": "0.02",
+               "FLAGS_dist_heartbeat_interval_s": "0.1",
+               "FLAGS_dist_heartbeat_miss_factor": "30",
+               "FLAGS_dist_watchdog_timeout_s": "60"}
+
+        def one(spec, restarts):
+            root = tempfile.mkdtemp(prefix="pt-chaos-integrity-")
+            e = dict(env)
+            if spec:
+                e["FLAGS_fault_spec"] = spec
+            t0 = _time.perf_counter()
+            res = run_gang([sys.executable, worker], n_procs,
+                           checkpoint_root=root, extra_env=e,
+                           max_restarts=restarts, timeout=540)
+            return res, _time.perf_counter() - t0
+
+        clean_res, clean_wall = one(None, 0)
+        assert clean_res.ok, "clean gang run failed; chaos numbers " \
+                             "meaningless"
+        chaos_res, chaos_wall = one(fault_spec, max_restarts)
+        clean_shas = [r["params_sha"] for r in _gang_results(clean_res)]
+        chaos_shas = [r["params_sha"] for r in _gang_results(chaos_res)]
+        # the verdict is printed by the DETECTING incarnation, whose
+        # workers exit classified without a RESULT line — harvest it
+        # from the full per-incarnation stderr history
+        import re as _re
+
+        named = set()
+        for inc in chaos_res.history:
+            for _code, _out, err in inc:
+                for m in _re.finditer(
+                        r"INTEGRITY_FAILURE corrupt_ranks=\[([\d, ]*)\]",
+                        err or ""):
+                    named.update(int(x) for x in m.group(1).split(",")
+                                 if x.strip())
+        named = sorted(named)
+        parity = bool(chaos_res.ok and clean_shas and chaos_shas
+                      and len(set(clean_shas + chaos_shas)) == 1)
+        print(f"chaos-integrity: flip_bit detected "
+              f"(corrupt rank(s) {named}), {chaos_res.restarts} gang "
+              f"restart(s), parity={parity}", file=sys.stderr)
+        return {"metric": "chaos_integrity_flip_bit",
+                "value": round(chaos_wall - clean_wall, 3),
+                "unit": "sec_recovery_overhead",
+                "fault_spec": fault_spec, "corrupt_ranks_named": named,
+                "gang_restarts": chaos_res.restarts,
+                "bit_parity": parity, "steps": steps}
+
+    # rot_shard: single-process commit-rot-resume A/B
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data("x", [32], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h = fluid.layers.fc(x, 64, act="relu")
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(h, 1), y))
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    startup.random_seed = main_p.random_seed = 7
+    rng = np.random.RandomState(0)
+    feeds = []
+    for _ in range(steps):
+        xv = rng.rand(batch_size, 32).astype("f4")
+        feeds.append({"x": xv, "y": xv.sum(1, keepdims=True)})
+
+    def train(root, injector, resume, n):
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        scope = fluid.Scope()
+        if not resume:
+            exe.run(startup, scope=scope)
+        cm = fluid.CheckpointManager(root, program=main_p, scope=scope,
+                                     save_every_steps=save_every)
+        t0 = _time.perf_counter()
+        stats = fluid.resilient_train_loop(
+            exe, main_p, lambda: list(feeds), [loss], scope=scope,
+            checkpoint_manager=cm, resume=resume, injector=injector,
+            max_inflight=1, max_steps=n)
+        from paddle_tpu import integrity as _integ
+
+        return stats, _time.perf_counter() - t0, _integ.state_digest(scope)
+
+    half = steps // 2
+    monitor.enable()
+    root_a = tempfile.mkdtemp(prefix="pt-rot-clean-")
+    root_b = tempfile.mkdtemp(prefix="pt-rot-chaos-")
+    train(root_a, None, False, half)
+    train(root_b, FaultInjector(fault_spec), False, half)
+    rej0 = monitor.counter("integrity.ckpt_rejected").value
+    _, clean_wall, clean_sha = train(root_a, None, True, steps)
+    _, chaos_wall, chaos_sha = train(root_b, None, True, steps)
+    rejected = monitor.counter("integrity.ckpt_rejected").value - rej0
+    monitor.disable()
+    parity = bool(clean_sha == chaos_sha)
+    print(f"chaos-integrity: rot_shard rejected {rejected} checkpoint(s) "
+          f"on resume, walk-back overhead "
+          f"{chaos_wall - clean_wall:+.3f}s, parity={parity}",
+          file=sys.stderr)
+    return {"metric": "chaos_integrity_rot_shard",
+            "value": round(chaos_wall - clean_wall, 3),
+            "unit": "sec_walkback_overhead",
+            "fault_spec": fault_spec, "ckpt_rejected": int(rejected),
+            "bit_parity": parity, "steps": steps,
+            "survived": bool(rejected >= 1 and parity)}
+
+
 _DIST_FAULT_KINDS = ("kill_worker", "stall_worker")
 _DATA_FAULT_KINDS = ("corrupt_chunk", "truncated_file")
+_INTEGRITY_FAULT_KINDS = ("flip_bit", "rot_shard")
 
 
 def main():
@@ -1001,6 +1183,9 @@ def main():
         if fault_spec and any(k in fault_spec for k in _DIST_FAULT_KINDS):
             print(json.dumps(bench_chaos_dist(
                 fault_spec, elastic="--elastic" in sys.argv)))
+        elif fault_spec and any(k in fault_spec
+                                for k in _INTEGRITY_FAULT_KINDS):
+            print(json.dumps(bench_chaos_integrity(fault_spec)))
         elif fault_spec and any(k in fault_spec for k in _DATA_FAULT_KINDS):
             print(json.dumps(bench_chaos_data(fault_spec)))
         elif fault_spec:

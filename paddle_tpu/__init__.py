@@ -72,6 +72,7 @@ from .faults import FaultInjector  # noqa: F401
 from .resilience import (RetryPolicy, ResilienceStats,  # noqa: F401
                          resilient_train_loop)
 from . import dist_resilience  # noqa: F401  (heartbeats + collective watchdog)
+from . import integrity  # noqa: F401  (silent-corruption sentinel)
 from . import serving  # noqa: F401  (continuous-batching model server)
 # paddle_tpu.launch (the gang launcher) is deliberately NOT imported here:
 # `python -m paddle_tpu.launch` would re-execute an already-imported module

@@ -55,12 +55,20 @@ from typing import Optional
 
 from . import io as _io
 from .core.scope import RNG_STATE_VAR
+from .errors import IntegrityError as _IntegrityError
 from .monitor import MONITOR as _MON
 
 log = logging.getLogger("paddle_tpu.checkpoint")
 
 COMMITTED_MARKER = "COMMITTED"
 DIST_MARKER = "DIST"
+# integrity quarantine (ISSUE 14): a checkpoint whose step postdates a
+# detected corruption window may have COMMITTED the corruption — its
+# at-rest digests verify (they hash what was saved), so the only safe
+# treatment is an explicit marker restore refuses, exactly like an
+# uncommitted distributed save.  Written by `reject_unsafe` when the
+# live digest sentinel's verdict names a safe_step.
+INTEGRITY_REJECTED_MARKER = "INTEGRITY_REJECTED"
 
 # per-rank artifacts a coordinated save leaves in the pending dir; the
 # ghost sweep removes any whose rank is beyond the committing world size
@@ -318,6 +326,58 @@ class CheckpointManager:
                      ">= %d from %s", removed, self.world_size, tmp)
         return removed
 
+    def reject_unsafe(self, max_safe_step: int) -> int:
+        """Quarantine every checkpoint — COMMITTED or still pending —
+        whose step postdates `max_safe_step` (the newest boundary the
+        integrity digests PROVE clean): such a snapshot may have
+        committed the corruption, and its content digests cannot tell —
+        they faithfully hash what was saved.
+
+        Pending `.tmp` dirs are quarantined too, and the marker is
+        retried across the commit rename (final, tmp, final): the rank
+        that detects the divergence at boundary K has already flushed
+        its OWN step-K shards at that very boundary, so a peer can
+        complete the commit of a poisoned checkpoint AFTER this rank
+        died — found the hard way when a restarted gang restored the
+        corrupt ckpt the committing peer renamed into place moments
+        after the quarantine scan.  A marker written into the shared
+        pending dir rides the rename; the ordered final→tmp→final
+        attempts close the rename race (the rename happens at most
+        once).  Idempotent and multi-writer safe; a LATER save that
+        legitimately reuses the step replaces the whole dir, marker
+        included, so post-recovery checkpoints are trusted again."""
+        marked = 0
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return 0
+        steps = {}
+        for name in names:
+            m = re.match(r"^ckpt-(\d+)(\.tmp)?$", name)
+            if m and int(m.group(1)) > max_safe_step:
+                steps.setdefault(int(m.group(1)), set()).add(name)
+        body = f"unsafe: newer than proven-clean step {max_safe_step}"
+        for step, found in sorted(steps.items()):
+            final = f"ckpt-{step:010d}"
+            # EVERY live name gets a marker — a reused step can exist as
+            # a committed final AND a pending tmp at once, and the tmp's
+            # commit would wholesale-replace the final (marker included);
+            # the trailing final attempt covers a tmp renamed mid-scan
+            for name in (*sorted(found), final):
+                d = os.path.join(self.root, name)
+                marker = os.path.join(d, INTEGRITY_REJECTED_MARKER)
+                try:
+                    if os.path.isdir(d) and not os.path.exists(marker):
+                        with open(marker, "w") as f:
+                            f.write(body)
+                        marked += 1
+                except OSError:
+                    continue  # renamed/rotated under us: next name
+        if marked:
+            log.warning("integrity: quarantined %d checkpoint(s) newer "
+                        "than proven-clean step %d", marked, max_safe_step)
+        return marked
+
     def saved_world(self, ckpt_dir: str) -> int:
         """World size that wrote `ckpt_dir` (the DIST marker; absent or
         unreadable = a single-process save)."""
@@ -376,6 +436,18 @@ class CheckpointManager:
                             "missing its COMMITTED marker); falling back to "
                             "the previous one", d)
                 continue
+            if os.path.exists(os.path.join(d, INTEGRITY_REJECTED_MARKER)):
+                # quarantined by the live digest sentinel: it may have
+                # committed corruption its own at-rest digests cannot see
+                _MON.counter("integrity.ckpt_rejected").inc()
+                _MON.record_step({
+                    "kind": "integrity_event", "action": "ckpt_rejected",
+                    "dir": d, "file": INTEGRITY_REJECTED_MARKER,
+                    "rank": self.rank})
+                log.warning("checkpoint %s is integrity-quarantined "
+                            "(committed inside a detected corruption "
+                            "window); falling back to the previous one", d)
+                continue
             try:
                 with open(os.path.join(d, "STEP")) as f:
                     step = int(f.read())
@@ -407,8 +479,25 @@ class CheckpointManager:
             except Exception as e:
                 errors.append((name, e))
                 _MON.counter("checkpoint.restore_skipped").inc()
-                log.warning("checkpoint %s is unreadable (%s: %s); falling "
-                            "back to the previous one", d, type(e).__name__, e)
+                if isinstance(e, _IntegrityError):
+                    # a flipped-yet-finite byte: the shards load cleanly
+                    # but the content digest disagrees — exactly as dead
+                    # as a truncated shard, and named so the operator can
+                    # scrub the tree (tools/scrub.py) instead of
+                    # wondering why the walk-back went one deeper
+                    _MON.counter("integrity.ckpt_rejected").inc()
+                    _MON.record_step({
+                        "kind": "integrity_event",
+                        "action": "ckpt_rejected", "dir": d,
+                        "file": getattr(e, "file", None), "step": step,
+                        "rank": self.rank})
+                    log.warning("checkpoint %s REJECTED by content digest "
+                                "(%s); falling back to the previous one",
+                                d, e)
+                else:
+                    log.warning("checkpoint %s is unreadable (%s: %s); "
+                                "falling back to the previous one", d,
+                                type(e).__name__, e)
                 continue
             self._step = step
             self.restored_world = saved_world

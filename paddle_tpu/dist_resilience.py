@@ -59,7 +59,8 @@ __all__ = ["HeartbeatConfig", "Heartbeat", "CollectiveWatchdog",
            "init_health", "shutdown_health", "active_watchdog",
            "active_heartbeat", "guard_blocking", "dump_stacks",
            "local_telemetry",
-           "EXIT_PEER_FAILURE", "EXIT_COLLECTIVE_TIMEOUT"]
+           "EXIT_PEER_FAILURE", "EXIT_COLLECTIVE_TIMEOUT",
+           "EXIT_INTEGRITY"]
 
 import json
 import os
@@ -79,6 +80,10 @@ from .monitor import MONITOR as _MON
 # can tell a classified resilience death from a crash.
 EXIT_PEER_FAILURE = 43
 EXIT_COLLECTIVE_TIMEOUT = 44
+# the live digest sentinel (paddle_tpu/integrity.py) found replicated
+# state diverging: the rank exits for a gang restart that resumes from
+# the newest integrity-quarantine-clean checkpoint
+EXIT_INTEGRITY = 45
 
 
 @dataclass
@@ -280,6 +285,17 @@ def local_telemetry() -> dict:
         float(_MON.gauge("pipeline.last_step_wall_s").value)
     if t_step:
         tel["t_step_s"] = round(t_step, 6)
+    # integrity sentinel (ISSUE 14): the latest completed state-digest
+    # epoch rides every beat, so peers can compare replicated-state
+    # content without any extra collective
+    try:
+        from . import integrity as _integrity
+
+        dig = _integrity.current_payload()
+        if dig is not None:
+            tel["dig"] = dig
+    except Exception:
+        pass
     try:
         hbm = _MON.gauge("memory.device_bytes_in_use").read()
         if hbm == hbm:  # not NaN (XLA:CPU exposes no memory_stats)
@@ -363,7 +379,12 @@ class Heartbeat:
             _MON.counter("dist.heartbeat.sent").inc()
             self.observe()
             try:
-                self._straggler_check()
+                # ONE observation-table snapshot per beat, shared by both
+                # checks (telemetry() dict-copies every payload — incl.
+                # the digest windows — under the table lock)
+                tel = self.telemetry()
+                self._straggler_check(tel)
+                self._integrity_check(tel)
             except Exception:
                 pass  # telemetry must never kill the liveness thread
 
@@ -440,7 +461,7 @@ class Heartbeat:
         out[self.rank] = dict(mine) if mine else {}
         return out
 
-    def _straggler_check(self):
+    def _straggler_check(self, tel=None):
         """Name a slow-but-ALIVE rank before any watchdog fires.
 
         Signal: the dispatch-attempt counter each beat carries
@@ -467,7 +488,8 @@ class Heartbeat:
             return
         from .flags import flag as _flag
 
-        tel = self.telemetry()
+        if tel is None:
+            tel = self.telemetry()
         with self._lock:
             dead = set(self._reported_dead)
         steps = {r: t.get("step") for r, t in tel.items()
@@ -505,6 +527,25 @@ class Heartbeat:
             "behind_s": round(behind_s, 3) if behind_s else None,
             "telemetry": tel.get(laggard),
         })
+
+    def _integrity_check(self, tel=None):
+        """Compare the state-digest payloads riding the beats (ISSUE 14):
+        replicated dp state must agree bit-exactly across ranks.  The
+        comparison, vote, and verdict latch live in
+        `paddle_tpu.integrity.observe_gang`; this thread only feeds it
+        the observation table — the corrupt rank's TRAINING thread is
+        what raises (a beat thread must never kill the process)."""
+        if self.world < 2:
+            return
+        from . import integrity as _integrity
+
+        if tel is None:
+            tel = self.telemetry()
+        if not any(isinstance(t, dict) and "dig" in t
+                   for t in tel.values()):
+            return
+        _integrity.observe_gang(tel, world=self.world,
+                                observer_rank=self.rank)
 
     def dead_peers(self) -> List[int]:
         ages = self.observe()
@@ -795,8 +836,12 @@ def guard_blocking(fn: Callable, what: str = "collective"):
 def exit_code_for(exc: BaseException) -> int:
     """Map a classified distributed failure to the exit code the gang
     launcher keys restart decisions on."""
+    from .errors import IntegrityError
+
     if isinstance(exc, PeerFailureError):
         return EXIT_PEER_FAILURE
     if isinstance(exc, CollectiveTimeoutError):
         return EXIT_COLLECTIVE_TIMEOUT
+    if isinstance(exc, IntegrityError):
+        return EXIT_INTEGRITY
     return 1

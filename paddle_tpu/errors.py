@@ -31,6 +31,15 @@ routes on:
     CheckpointError       a checkpoint that must not be loaded as asked
                           (world-size mismatch without elastic opt-in,
                           inconsistent rank cursors) — never retried
+    IntegrityError        wrong-but-FINITE state (paddle_tpu/integrity.py):
+                          a live cross-rank digest divergence named a
+                          corrupt rank, or an at-rest sha256 in a
+                          checkpoint/model manifest failed verification.
+                          Recoverable when a clean COMMITTED checkpoint
+                          predates the corruption window — the resilient
+                          loop rolls back (restore + exact RNG/cursor
+                          rewind) instead of training forward on corrupt
+                          state; otherwise terminal
     ServingError          the serving runtime (paddle_tpu/serving/)
                           refused or failed a request/control action on
                           purpose: admission control shed it, its deadline
@@ -64,7 +73,7 @@ from __future__ import annotations
 __all__ = ["TrainingError", "DataError", "NumericError",
            "TransientDeviceError", "PreemptionError", "FatalError",
            "CheckpointError", "ServingError", "ResourceError",
-           "LockTimeoutError",
+           "LockTimeoutError", "IntegrityError",
            "DistributedError", "PeerFailureError", "CollectiveTimeoutError",
            "classify", "attach_context", "get_context"]
 
@@ -198,6 +207,54 @@ class CheckpointError(TrainingError):
         super().__init__(message, **kw)
         self.saved_world = saved_world
         self.current_world = current_world
+
+
+class IntegrityError(TrainingError):
+    """Silent data corruption made loud (paddle_tpu/integrity.py): state
+    that is wrong but FINITE, which no NaN guard, CRC, or structure check
+    can see.  Two sources:
+
+      * a LIVE digest divergence — replicated dp state stopped agreeing
+        bit-exactly across ranks.  `corrupt_ranks` names the voted
+        offender(s) (`attributed=False` when the vote tied and the value
+        plausibility tiebreak could not break it — e.g. a low-mantissa
+        flip on a 2-rank gang), and `safe_step` is the newest step the
+        digests PROVE clean: the resilient loop's rollback must restore a
+        checkpoint at or before it (a later checkpoint may have committed
+        the corruption);
+      * an AT-REST digest mismatch — a file named by a checkpoint or
+        inference-model manifest no longer hashes to its recorded sha256
+        (`file` / `expected` / `actual`).  Restore walks back past it,
+        publish quarantines it.
+
+    Recoverable via rollback when a clean committed checkpoint exists;
+    never "retried" in place — the in-memory (or on-disk) state itself is
+    poison."""
+
+    def __init__(self, message: str, *, corrupt_ranks=None,
+                 attributed: bool = True, safe_step: Optional[int] = None,
+                 file: Optional[str] = None, expected: Optional[str] = None,
+                 actual: Optional[str] = None, **kw):
+        kw.setdefault("phase", "integrity")
+        super().__init__(message, **kw)
+        self.corrupt_ranks = list(corrupt_ranks or [])
+        self.attributed = bool(attributed)
+        self.safe_step = safe_step
+        self.file = file
+        self.expected = expected
+        self.actual = actual
+
+    def __str__(self):
+        base = super().__str__()
+        ctx = []
+        if self.corrupt_ranks:
+            ctx.append(f"corrupt_ranks={self.corrupt_ranks}"
+                       + ("" if self.attributed else " (unattributed)"))
+        if self.safe_step is not None:
+            ctx.append(f"safe_step={self.safe_step}")
+        if self.file:
+            ctx.append(f"file={self.file}")
+        return f"{base} [{', '.join(ctx)}]" if ctx else base
 
 
 class ServingError(TrainingError):

@@ -58,8 +58,30 @@ entry drops a `fired-...` marker there at its firing point — written
 BEFORE the SIGKILL lands — and every later incarnation treats marked
 entries as already spent.
 
+silent-corruption entries (ISSUE 14) plant wrong-but-FINITE state — the
+class every NaN guard, CRC, and structure check waves through, which
+only the integrity sentinel (paddle_tpu/integrity.py) can catch:
+
+    flip_bit@S[:RANK]     at the dispatch boundary of train step S (the
+                          feed/snapshot boundary — `on_state`, called by
+                          resilient_train_loop with the scope), flip one
+                          exponent-region bit of one element of the
+                          LARGEST float state var: the value stays finite
+                          but wildly implausible, the live cross-rank
+                          digests diverge, and the divergence vote must
+                          name RANK.  Without :RANK it fires in every
+                          process that reaches step S (the
+                          single-process form)
+    rot_shard@N           flip a payload byte of one shard file of the
+                          Nth COMMITTED checkpoint (0-based commit
+                          ordinal; `on_commit`, called post-COMMIT) —
+                          restore's walk-back must reject the rotted
+                          checkpoint by digest and the publish ladder
+                          must quarantine it
+
     e.g.  FLAGS_fault_spec="bad_batch@2;nan@5;device@7:RESOURCE_EXHAUSTED;preempt@11"
           FLAGS_fault_spec="kill_worker@3:1;stall_worker@6:0:0.2"
+          FLAGS_fault_spec="flip_bit@5:1;rot_shard@0"
 
 `seed` only feeds the poison-value RNG; firing points are exact indices.
 The hooks (`on_batch`, `on_feed`, `on_dispatch`) are called by
@@ -85,8 +107,11 @@ from .monitor import MONITOR as _MON
 
 _KINDS = ("bad_batch", "nan", "device", "preempt",
           "kill_worker", "stall_worker",
-          "corrupt_chunk", "truncated_file")
+          "corrupt_chunk", "truncated_file",
+          "flip_bit", "rot_shard")
 # entries that only fire in the worker whose rank matches their arg
+# (flip_bit is rank-gated too, but its rank is OPTIONAL — handled via
+# target_rank, which answers None for the rankless single-process form)
 _RANKED_KINDS = ("kill_worker", "stall_worker")
 # on-disk data faults (ISSUE 5): mutate RecordIO files handed to
 # `on_files` — corrupt_chunk@N flips a payload byte of the Nth chunk
@@ -95,8 +120,11 @@ _RANKED_KINDS = ("kill_worker", "stall_worker")
 _FILE_KINDS = ("corrupt_chunk", "truncated_file")
 # entries whose firing must survive a gang restart: a restarted worker
 # replays the failed step (and re-opens its files), so without the
-# PADDLE_FAULT_STATE_DIR ledger the same fault would fire forever
-_LEDGER_KINDS = _RANKED_KINDS + _FILE_KINDS
+# PADDLE_FAULT_STATE_DIR ledger the same fault would fire forever.
+# flip_bit replays too (the restart restores PRE-flip state and replays
+# step S); rot_shard's marker doubles as the cross-rank mutex — every
+# rank observes the commit, exactly one may mutate the shard
+_LEDGER_KINDS = _RANKED_KINDS + _FILE_KINDS + ("flip_bit", "rot_shard")
 
 
 @dataclass
@@ -112,7 +140,10 @@ class Fault:
 
     @property
     def target_rank(self) -> Optional[int]:
-        """Worker rank a ranked entry targets (None for per-process kinds)."""
+        """Worker rank a ranked entry targets (None for per-process kinds
+        and for the rankless flip_bit@S form)."""
+        if self.kind == "flip_bit":
+            return int(self.arg) if self.arg else None
         if self.kind not in _RANKED_KINDS or not self.arg:
             return None
         return int(self.arg.split(":", 1)[0])
@@ -158,6 +189,14 @@ def parse_fault_spec(spec: str) -> List[Fault]:
             if not ok:
                 raise ValueError(f"fault spec entry {entry!r}: want "
                                  f"stall_worker@STEP:RANK:SECONDS")
+        elif kind == "flip_bit":
+            if arg is not None and not arg.isdigit():
+                raise ValueError(f"fault spec entry {entry!r}: want "
+                                 f"flip_bit@STEP or flip_bit@STEP:RANK")
+        elif kind == "rot_shard":
+            if arg is not None:
+                raise ValueError(f"fault spec entry {entry!r}: want "
+                                 f"rot_shard@COMMIT_INDEX (no extra arg)")
         faults.append(f)
     return faults
 
@@ -214,6 +253,8 @@ class FaultInjector:
             os.environ.get("PADDLE_TRAINER_ID", "0"))
         # once-per-gang ledger for ranked entries (survives gang restarts)
         self.state_dir = os.environ.get("PADDLE_FAULT_STATE_DIR")
+        # rot_shard@N counts COMMITTED checkpoints this injector observed
+        self._commits = 0
 
     @staticmethod
     def from_flags() -> Optional["FaultInjector"]:
@@ -252,8 +293,8 @@ class FaultInjector:
     def _take(self, kind: str, at: int) -> Optional[Fault]:
         for f in self.faults:
             if f.kind == kind and f.at == at and not f.fired:
-                if (f.kind in _RANKED_KINDS
-                        and f.target_rank != self.rank):
+                tr = f.target_rank
+                if tr is not None and tr != self.rank:
                     continue  # another worker's fault: stays pending here
                 marker = self._ranked_marker(f)
                 if marker is not None:
@@ -325,6 +366,114 @@ class FaultInjector:
             raise ValueError(f"nan@{step}: feed has no floating-point array "
                              f"to poison (names: {sorted(feed)})")
         return feed
+
+    def on_state(self, step: int, scope):
+        """Called at the dispatch boundary of train step `step` with the
+        live scope (resilient_train_loop's feed/snapshot boundary — the
+        same consistent cut the state snapshots and integrity digests
+        use); applies a scheduled flip_bit by XOR-ing one exponent-region
+        bit of one seeded element of the LARGEST float state var.  The
+        result is deliberately finite — the point is a value every
+        NaN/Inf guard waves through and only a content digest can see."""
+        if self._take("flip_bit", step) is None:
+            return
+        # deterministic victim: the LARGEST float var (big tensors are
+        # where real SDC lands, and a zero-initialized bias would make a
+        # fault too quiet to attribute), name-ordered tiebreak
+        floats = []
+        for name in sorted(scope.local_var_names()):
+            v = scope.find_var(name)
+            try:
+                a = np.asarray(v)
+            except Exception:
+                continue
+            if a.dtype.kind == "f" and a.size \
+                    and a.dtype.itemsize in (2, 4, 8):
+                floats.append((-a.size, name, a))
+        floats.sort(key=lambda t: (t[0], t[1]))
+        for _neg, name, a in floats:
+            a = a.copy()
+            flat = a.reshape(-1)
+            idx = self._rng.randrange(flat.size)
+            bits = flat.view({2: np.uint16, 4: np.uint32,
+                              8: np.uint64}[a.dtype.itemsize])
+            width = a.dtype.itemsize * 8
+            # top exponent bit first (0.02 -> ~1e36: finite, loud for the
+            # plausibility tiebreak); walk down if a flip would produce
+            # NaN/Inf — the fault must stay FINITE or the NaN guard would
+            # catch it and the test would prove nothing
+            for b in range(width - 2, width - 8, -1):
+                old = bits[idx]
+                bits[idx] = old ^ type(bits[idx])(1 << b)
+                if np.isfinite(flat[idx]):
+                    break
+                bits[idx] = old
+            else:
+                flat[idx] = flat.dtype.type(
+                    {16: 6e4, 32: 3e38, 64: 1e300}[width])
+            print(f"faults: flip_bit@{step} firing on {name!r}[{idx}] "
+                  f"(rank {self.rank})", file=sys.stderr, flush=True)
+            scope.set_var(name, a)
+            return
+        raise ValueError(f"flip_bit@{step}: scope has no float state var "
+                         f"to corrupt")
+
+    def on_commit(self, ckpt_dir: Optional[str]):
+        """Called with each checkpoint directory the moment its COMMIT
+        lands (resilient_train_loop's flush path; tests/bench call it
+        directly); applies a pending rot_shard@N when this is the Nth
+        commit this injector (or, with the fault ledger armed, this
+        GANG) observed.  The ledger marker is created with O_EXCL before
+        mutating, so exactly one rank of a coordinated save rots the
+        shard and a restarted gang never re-rots.  Returns `ckpt_dir`
+        for chaining."""
+        idx = self._commits
+        self._commits += 1
+        for f in self.faults:
+            if f.kind != "rot_shard" or f.at != idx or f.fired:
+                continue
+            marker = self._ranked_marker(f)
+            if marker is not None and os.path.exists(marker):
+                f.fired = True  # spent in an earlier gang incarnation
+                continue
+            if ckpt_dir is None or not os.path.isdir(ckpt_dir):
+                # a non-committing rank of a coordinated save: the dir
+                # may not have been renamed into place yet.  The ordinal
+                # was counted (every rank sees the same save sequence);
+                # the committing rank performs the mutation.
+                continue
+            if marker is not None:
+                os.makedirs(self.state_dir, exist_ok=True)
+                try:
+                    with open(marker, "x") as fh:
+                        fh.write(str(os.getpid()))
+                except FileExistsError:
+                    f.fired = True  # another rank won the mutation
+                    continue
+            if self._rot_one_shard(ckpt_dir, f):
+                f.fired = True
+                _MON.counter("faults.rot_shard").inc()
+        return ckpt_dir
+
+    def _rot_one_shard(self, ckpt_dir: str, f: Fault) -> bool:
+        """Flip one payload byte of the first shard file (sorted order)."""
+        shards = sorted(n for n in os.listdir(ckpt_dir)
+                        if n.endswith(".npy"))
+        if not shards:
+            return False
+        path = os.path.join(ckpt_dir, shards[0])
+        size = os.path.getsize(path)
+        if size == 0:
+            return False
+        with open(path, "r+b") as fh:
+            fh.seek(size // 2)
+            b = fh.read(1)
+            fh.seek(size // 2)
+            fh.write(bytes([b[0] ^ 0xFF]))
+        print(f"faults: rot_shard@{f.at} firing on {path} "
+              f"(byte {size // 2} flipped post-COMMIT)",
+              file=sys.stderr, flush=True)
+        return True
 
     def on_dispatch(self, step: int):
         """Called just before train step `step` is dispatched; raises the

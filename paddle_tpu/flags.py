@@ -236,6 +236,29 @@ DEFINE_string("FLAGS_serving_buckets", "1,2,4,8,16,32",
               "model load (or in the publisher's pre-swap compile lane) "
               "and steady-state serving must keep executor.recompile "
               "flat (perf_report --check's recompile gate)")
+DEFINE_int("FLAGS_integrity_check_period", 0,
+           "live silent-corruption sentinel (paddle_tpu/integrity.py): "
+           "every PERIOD steps the full parameter + optimizer state is "
+           "content-digested, amortized chunk-wise so each step hashes "
+           "only ~1/PERIOD of the bytes.  In multi-worker gangs the "
+           "digest rides the heartbeat telemetry payload and replicated "
+           "dp state must agree bit-exactly across ranks — a divergence "
+           "majority-votes the corrupt rank, dumps the flight recorder, "
+           "and raises a classified errors.IntegrityError that the "
+           "resilient loop recovers from via checkpoint rollback.  0 "
+           "(default) disables live digesting entirely: the training "
+           "loop pays literally nothing")
+DEFINE_bool("FLAGS_integrity_verify_load", True,
+            "verify the per-file sha256 + byte-length stamps that "
+            "io.save/save_sharded record in their manifests whenever a "
+            "checkpoint or model directory is loaded (restore, "
+            "load_sharded, load_vars, the serving publish ladder): a "
+            "mismatch raises a classified errors.IntegrityError naming "
+            "the file instead of silently serving rotted bytes.  "
+            "Manifests written before the digests existed (no sha256 "
+            "field) load unchecked.  Off trusts the disk — the escape "
+            "hatch when re-reading every shard for hashing is too "
+            "expensive for a given restore path")
 DEFINE_bool("FLAGS_lock_telemetry", False,
             "per-lock contention telemetry for every named framework lock "
             "(paddle_tpu/core/locks.py): lock.<name>.acquires/contended/"

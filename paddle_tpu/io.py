@@ -18,11 +18,20 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from . import integrity as _integrity
 from .core.program import Program, Variable, default_main_program
 from .core.scope import Scope, global_scope
 
 MODEL_FILENAME = "__model__.json"
 MANIFEST = "__manifest__.json"
+
+
+def _verify_on_load() -> bool:
+    """At-rest integrity (paddle_tpu/integrity.py): whether load paths
+    re-hash manifest-stamped files before use."""
+    from .flags import flag
+
+    return bool(flag("FLAGS_integrity_verify_load"))
 
 
 def _persistables(program: Program) -> List[Variable]:
@@ -40,7 +49,11 @@ def save_vars(dirname: str, var_names: Sequence[str], scope: Optional[Scope] = N
         arr = np.asarray(v)
         fname = name.replace("/", "%2F") + ".npy"
         np.save(os.path.join(dirname, fname), arr)
-        saved.append({"name": name, "file": fname, "shape": list(arr.shape), "dtype": str(arr.dtype)})
+        entry = {"name": name, "file": fname, "shape": list(arr.shape), "dtype": str(arr.dtype)}
+        # content stamp: a flipped-yet-finite byte in this file must fail
+        # the load, not serve (paddle_tpu/integrity.py)
+        entry.update(_integrity.stamp_file(os.path.join(dirname, fname)))
+        saved.append(entry)
     with open(os.path.join(dirname, MANIFEST), "w") as f:
         json.dump({"vars": saved}, f, indent=1)
     return saved
@@ -63,7 +76,11 @@ def save_params(executor, dirname: str, main_program: Optional[Program] = None,
 
 
 def load_vars(dirname: str, var_names: Optional[Sequence[str]] = None,
-              scope: Optional[Scope] = None):
+              scope: Optional[Scope] = None,
+              verify: Optional[bool] = None):
+    """`verify=None` follows FLAGS_integrity_verify_load; pass False when
+    the caller JUST verified the directory's digests itself (the publish
+    fast-reject) — re-hashing every file twice per load is pure waste."""
     scope = scope or global_scope()
     with open(os.path.join(dirname, MANIFEST)) as f:
         manifest = json.load(f)
@@ -74,9 +91,14 @@ def load_vars(dirname: str, var_names: Optional[Sequence[str]] = None,
         with open(qpath) as f:
             qman = json.load(f).get("weights", {})
     loaded = []
+    verify = _verify_on_load() if verify is None else bool(verify)
     for entry in manifest["vars"]:
         if want is not None and entry["name"] not in want:
             continue
+        if verify:
+            _integrity.verify_file_entry(dirname, entry["file"],
+                                         entry.get("sha256"),
+                                         entry.get("bytes"))
         arr = np.load(os.path.join(dirname, entry["file"]))
         if entry["name"] in qman and arr.dtype == np.int8:
             # int8 storage -> dequantized floats (quantized inference model)
@@ -185,13 +207,19 @@ def save_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
             vals_f = f"{safe}.vals.p{proc}s0.npy"
             np.save(os.path.join(dirname, rows_f), rows)
             stored_as = _save_array(os.path.join(dirname, vals_f), vals)
+            rstamp = _integrity.stamp_file(os.path.join(dirname, rows_f))
+            vstamp = _integrity.stamp_file(os.path.join(dirname, vals_f))
             entries.append({"name": name, "selected_rows": True,
                             "height": int(v.height),
                             "global_shape": list(v.shape),
                             "dtype": str(vals.dtype), "spec": None,
                             "shards": [{"rows_file": rows_f,
                                         "values_file": vals_f,
-                                        "stored_as": stored_as}]})
+                                        "stored_as": stored_as,
+                                        "rows_sha256": rstamp["sha256"],
+                                        "rows_bytes": rstamp["bytes"],
+                                        "values_sha256": vstamp["sha256"],
+                                        "values_bytes": vstamp["bytes"]}]})
             continue
         shards_meta = []
         spec = None
@@ -210,7 +238,8 @@ def save_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
                 seen.add(key)
                 fname = f"{safe}.p{proc}s{i}.npy"
                 stored_as = _save_array(os.path.join(dirname, fname), np.asarray(shard.data))
-                shards_meta.append({"file": fname, "index": idx, "stored_as": stored_as})
+                shards_meta.append({"file": fname, "index": idx, "stored_as": stored_as,
+                                    **_integrity.stamp_file(os.path.join(dirname, fname))})
             gshape = list(v.shape)
             dtype = str(v.dtype)
         else:
@@ -218,7 +247,8 @@ def save_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
             fname = f"{safe}.p{proc}s0.npy"
             stored_as = _save_array(os.path.join(dirname, fname), arr)
             shards_meta.append({"file": fname, "index": _norm_index(
-                tuple(slice(0, d) for d in arr.shape), arr.shape), "stored_as": stored_as})
+                tuple(slice(0, d) for d in arr.shape), arr.shape), "stored_as": stored_as,
+                **_integrity.stamp_file(os.path.join(dirname, fname))})
             gshape = list(arr.shape)
             dtype = str(arr.dtype)
         entries.append({"name": name, "global_shape": gshape, "dtype": dtype,
@@ -232,7 +262,8 @@ def save_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
 
 def load_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
                  scope: Optional[Scope] = None, mesh=None,
-                 row_shard: Optional[tuple] = None):
+                 row_shard: Optional[tuple] = None,
+                 verify: Optional[bool] = None):
     """Restore a sharded checkpoint.  With `mesh`, every var that recorded a
     PartitionSpec is rebuilt via jax.make_array_from_callback — each device
     reads exactly its slice from the shard files (memmapped, no full-array
@@ -279,6 +310,7 @@ def load_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
                     tgt["shards"].append(sh)
     want = set(var_names) if var_names is not None else None
     loaded = []
+    verify = _verify_on_load() if verify is None else bool(verify)
     for entry in manifest["vars"]:
         name = entry["name"]
         if want is not None and name not in want:
@@ -291,6 +323,13 @@ def load_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
             height = int(entry["height"])
             slabs = []
             for sh in entry["shards"]:
+                if verify:
+                    _integrity.verify_file_entry(
+                        dirname, sh["rows_file"], sh.get("rows_sha256"),
+                        sh.get("rows_bytes"))
+                    _integrity.verify_file_entry(
+                        dirname, sh["values_file"],
+                        sh.get("values_sha256"), sh.get("values_bytes"))
                 r = np.load(os.path.join(dirname, sh["rows_file"]))
                 v = _loaded_view(
                     np.load(os.path.join(dirname, sh["values_file"])),
@@ -304,6 +343,13 @@ def load_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
             loaded.append(name)
             continue
         shape = tuple(entry["global_shape"])
+        if verify:
+            # hash every shard BEFORE handing out memmapped views: the
+            # region reader must never assemble rotted bytes
+            for sh in entry["shards"]:
+                _integrity.verify_file_entry(dirname, sh["file"],
+                                             sh.get("sha256"),
+                                             sh.get("bytes"))
         mms = [(sh["index"], _loaded_view(
                     np.load(os.path.join(dirname, sh["file"]), mmap_mode="r"),
                     sh.get("stored_as")))
@@ -418,12 +464,14 @@ def save_inference_model(
     return target_names
 
 
-def load_inference_model(dirname: str, executor, scope: Optional[Scope] = None):
-    """Returns (program, feed_names, fetch_names); params land in scope."""
+def load_inference_model(dirname: str, executor, scope: Optional[Scope] = None,
+                         verify: Optional[bool] = None):
+    """Returns (program, feed_names, fetch_names); params land in scope.
+    `verify` forwards to load_vars' digest check."""
     with open(os.path.join(dirname, MODEL_FILENAME)) as f:
         doc = json.load(f)
     program = Program.from_dict(doc)
-    load_vars(dirname, None, scope)
+    load_vars(dirname, None, scope, verify=verify)
     return program, doc["feed_names"], doc["fetch_names"]
 
 
@@ -485,6 +533,19 @@ def save_quantized_inference_model(
             np.save(os.path.join(dirname, fname), q)
             qrec[wname] = {"scale": scale_arr.tolist(), "axis": axis,
                            "bits": weight_bits, "dtype": str(w.dtype)}
+        if qrec:
+            # the int8 payloads just overwrote files save_vars stamped as
+            # floats — re-stamp them or the model fails its own digests
+            mpath = os.path.join(dirname, MANIFEST)
+            with open(mpath) as f:
+                man = json.load(f)
+            overwritten = {w.replace("/", "%2F") + ".npy" for w in qrec}
+            for entry in man["vars"]:
+                if entry["file"] in overwritten:
+                    entry.update(_integrity.stamp_file(
+                        os.path.join(dirname, entry["file"])))
+            with open(mpath, "w") as f:
+                json.dump(man, f, indent=1)
         with open(os.path.join(dirname, QUANT_MANIFEST), "w") as f:
             json.dump({"weights": qrec,
                        "activations": manifest["activations"]}, f, indent=1)

@@ -90,7 +90,11 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # include real restartable cases (a raw SIGKILL, a bootstrap lost to
 # machine load) and the once-per-gang fault ledger / max_restarts budget
 # bound the damage of relaunching a deterministic crasher.
-_CLASSIFIED_EXITS = (43, 44)  # EXIT_PEER_FAILURE, EXIT_COLLECTIVE_TIMEOUT
+# EXIT_PEER_FAILURE, EXIT_COLLECTIVE_TIMEOUT, EXIT_INTEGRITY: all three
+# are ranks REACTING to a condition the gang restart recovers from (a
+# dead peer, a wedged collective, detected silent corruption) — not lost
+# capacity, so the elastic supervisor relaunches them at full size
+_CLASSIFIED_EXITS = (43, 44, 45)
 
 
 def allocate_port_block(n: int, tries: int = 64,

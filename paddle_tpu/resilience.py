@@ -18,6 +18,15 @@ into one loop that survives the four real failure classes of
                           usual cause)
     PreemptionError       flush one checkpoint with resume info and
                           return gracefully (`stats.preempted`)
+    IntegrityError        silent corruption made loud (ISSUE 14): the
+                          live digest sentinel (armed under
+                          FLAGS_integrity_check_period, see
+                          paddle_tpu/integrity.py) found replicated
+                          state diverging across ranks — restore the
+                          newest COMMITTED checkpoint at or before the
+                          verdict's `safe_step` with exact RNG/cursor
+                          rewind, exactly the rollback machinery, never
+                          training forward on corrupt state
     anything else         re-raised untouched
 
 Correctness under async dispatch: `run_async` writes a step's (still in
@@ -82,8 +91,8 @@ import numpy as np
 from . import errors as _errors
 from . import io as _io
 from . import pipeline as _pipeline
-from .errors import (DataError, NumericError, PreemptionError,
-                     TrainingError, TransientDeviceError)
+from .errors import (DataError, IntegrityError, NumericError,
+                     PreemptionError, TrainingError, TransientDeviceError)
 from .monitor import MONITOR as _MON
 
 RESUME_FILE = "RESUME.json"
@@ -291,6 +300,23 @@ def resilient_train_loop(
     if cm is not None and cm.scope is None:
         cm.scope = scope
 
+    # silent-corruption sentinel (ISSUE 14): amortized content digests
+    # over the whole training state, published for the gang heartbeat to
+    # carry.  Period 0 (the default) arms NOTHING — the hot path pays one
+    # `is None` branch, the same contract as the fault injector.
+    digester = None
+    from .flags import flag as _flag
+
+    _integrity_period = int(_flag("FLAGS_integrity_check_period"))
+    if _integrity_period > 0:
+        from . import integrity as _integrity_mod
+
+        _rank = getattr(cm, "rank", None)
+        if _rank is None:
+            _rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        digester = _integrity_mod.arm_live_digests(
+            scope, period=_integrity_period, rank=_rank)
+
     stats = ResilienceStats()
     eff_inflight = max_inflight
     window = max_inflight + 2
@@ -449,7 +475,10 @@ def resilient_train_loop(
             info["stream_state"] = _io.pack_stream_state(st)
         name = resume_sidecar_name(getattr(cm, "rank", 0),
                                    getattr(cm, "world_size", 1))
-        return cm.save(step=step, sidecars={name: json.dumps(info)})
+        out = cm.save(step=step, sidecars={name: json.dumps(info)})
+        if injector is not None:
+            injector.on_commit(out)  # rot_shard@N fires post-COMMIT
+        return out
 
     def _read_resume(step: int) -> dict:
         """The RESUME sidecar of the checkpoint that actually restored
@@ -497,6 +526,15 @@ def resilient_train_loop(
         if (cm is not None and cm.save_every_steps and step > 0
                 and step % cm.save_every_steps == 0 and cm._step != step):
             _flush_checkpoint(step)
+        if injector is not None:
+            # flip_bit strikes AFTER the flush: the classic silent-
+            # corruption timeline is a clean committed checkpoint, then
+            # a flipped bit, then steps training on poison
+            injector.on_state(step, scope)
+        if digester is not None:
+            # raises a latched divergence verdict as IntegrityError, and
+            # digests the chunk due at this boundary
+            digester.on_step(step)
         if snapshots_on:
             with _MON.span("resilience.snapshot", step=step):
                 snaps[step] = _snapshot_scope(scope)
@@ -635,6 +673,69 @@ def resilient_train_loop(
             _reraise(ce, e)
         step = ce.step if ce.step is not None else \
             _errors.get_context(e).get("step")
+        if isinstance(ce, IntegrityError):
+            # wrong-but-finite state: the in-memory params are poison and
+            # no retry fixes them — restore the newest COMMITTED
+            # checkpoint the digests PROVE clean (safe_step: a later one
+            # may have committed the corruption) and rewind the data
+            # stream to match.  Shares the rollback budget: both are
+            # whole-timeline rewinds.
+            if cm is None or factory is None:
+                _reraise(ce, e)
+            # safe_step is the ONLY trustworthy bound: a verdict without
+            # one means no epoch ever agreed bit-exactly before the
+            # divergence, so nothing on disk is provably clean — falling
+            # back to the failing step would restore (or leave
+            # unquarantined) a checkpoint that may hold the corruption.
+            # Re-raise terminally rather than guess (docs/robustness.md
+            # "What is NOT covered").
+            bound = ce.safe_step
+            if bound is None:
+                _reraise(ce, e)
+            # quarantine first, in EVERY path: a checkpoint committed
+            # after the proven-clean boundary may hold the corruption,
+            # and its at-rest digests cannot tell — they hash what was
+            # saved.  Idempotent, so every rank of a gang can do it.
+            cm.reject_unsafe(bound)
+            if getattr(cm, "world_size", 1) > 1:
+                # a gang CANNOT roll back per-rank in-process: ranks
+                # latch the verdict at different beats, and one rank
+                # rewinding to step R while a peer blocks inside step
+                # K's collective pairs mismatched allreduces (or wedges
+                # the gang outright).  The existing rollback machinery
+                # for gangs IS the gang restart (PR 4): re-raise
+                # classified — the worker exits EXIT_INTEGRITY, peers
+                # classify off its tombstone, and the relaunched gang
+                # resumes from the newest NON-quarantined checkpoint,
+                # bit-identical to an uninterrupted run.
+                _reraise(ce, e)
+            if stats.rollbacks >= policy.max_rollbacks:
+                _reraise(ce, e)
+            with _MON.span("resilience.recover", action="integrity_rollback",
+                           step=step):
+                restored = cm.restore(scope=scope, max_step=bound)
+                if restored is None:
+                    _reraise(ce, e)  # no clean checkpoint predates it
+                info = _read_resume(restored)
+                bi = step_batch.get(restored)
+                if bi is None:  # checkpoint predates this process
+                    bi = int(info.get("next_batch",
+                                      restored + stats.skipped_batches))
+                sst = info.get("stream_state")
+                _rewind_source_to(
+                    bi, _io.unpack_stream_state(sst) if sst else None)
+            snaps.clear()
+            if digester is not None:
+                digester.reset()  # new generation: the old timeline died
+            stats.rollbacks += 1
+            _MON.counter("resilience.rollbacks").inc()
+            _MON.counter("integrity.rollbacks").inc()
+            _event("rollback", "IntegrityError", step=step,
+                   restored_step=restored,
+                   corrupt_ranks=ce.corrupt_ranks,
+                   attributed=ce.attributed)
+            start_step = restored
+            return "continue"
         if isinstance(ce, NumericError):
             if nan_mode == "raise" or step is None:
                 _reraise(ce, e)
@@ -672,6 +773,8 @@ def resilient_train_loop(
                 _rewind_source_to(
                     bi, _io.unpack_stream_state(sst) if sst else None)
             snaps.clear()
+            if digester is not None:
+                digester.reset()
             stats.rollbacks += 1
             _MON.counter("resilience.rollbacks").inc()
             _event("rollback", "NumericError", step=step,
@@ -792,6 +895,10 @@ def resilient_train_loop(
         stats.wall_s = time.perf_counter() - t0
         if installed:
             _signal.signal(_signal.SIGTERM, prev_handler)
+        if digester is not None:
+            from . import integrity as _integrity_mod
+
+            _integrity_mod.disarm_live_digests(digester)
         if nan_check_prev is not None:
             from .flags import set_flags
 

@@ -15,17 +15,21 @@ verification ladder:
 
   1. commit integrity — a distributed checkpoint without its COMMITTED
      marker (or a `.tmp` pending dir) is torn by definition;
-  2. manifest/shard integrity — the manifest must parse and every shard
+  2. content digests (ISSUE 14) — every manifest-stamped file re-hashes
+     to its recorded sha256 + byte length BEFORE anything stages: a
+     flipped-yet-finite byte quarantines in milliseconds, never paying
+     the smoke/warm ladder to find out;
+  3. manifest/shard integrity — the manifest must parse and every shard
      it names must load fully (a truncated .npy raises, never serves);
-  3. program verification — `core/analysis.check_program` (structural)
+  4. program verification — `core/analysis.check_program` (structural)
      over the staged program with the model's feed/fetch targets;
-  4. weight health — any non-finite value in a staged float weight
+  5. weight health — any non-finite value in a staged float weight
      rejects (a NaN weight WILL poison every request);
-  5. golden-input smoke inference — the staged predictor must produce
+  6. golden-input smoke inference — the staged predictor must produce
      finite outputs on a golden batch (caller-provided, or synthesized
      from the program's feed specs), and match `golden_expect` when the
      caller pins one;
-  6. pre-swap compile lane — the serving buckets are warmed on the
+  7. pre-swap compile lane — the serving buckets are warmed on the
      STAGED version, so the post-swap steady state never compiles
      inline.
 
@@ -44,6 +48,7 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 
+from .. import integrity as _integrity
 from ..checkpoint_manager import COMMITTED_MARKER, DIST_MARKER, CheckpointManager
 from ..core.analysis import check_program
 from ..core.scope import Scope
@@ -98,17 +103,20 @@ def _stage(registry: ModelRegistry, current: ModelVersion, src: str,
     feed_names, fetch_names, scope).  Any load failure (truncated shard,
     bad manifest JSON, missing param) raises — callers reject."""
     staged = Scope()
+    # verify=False: the digest fast-reject rung just re-hashed every
+    # manifest-stamped file in `src` — hashing a multi-GB snapshot twice
+    # per publish would double the I/O cost of the ladder for nothing
     if kind == "inference":
         program, feed_names, fetch_names = _io.load_inference_model(
-            src, registry.executor, scope=staged)
+            src, registry.executor, scope=staged, verify=False)
         return program, feed_names, fetch_names, staged
     # weights-only reload: the program (and its feed/fetch contract) come
     # from the version currently serving
     params = [v.name for v in _io._persistables(current.program)]
     if kind == "checkpoint":
-        _io.load_sharded(src, var_names=params, scope=staged)
+        _io.load_sharded(src, var_names=params, scope=staged, verify=False)
     else:
-        _io.load_vars(src, var_names=params, scope=staged)
+        _io.load_vars(src, var_names=params, scope=staged, verify=False)
     return (current.program, current.feed_names, current.fetch_names, staged)
 
 
@@ -165,6 +173,18 @@ def _publish_ladder(registry, name, src, golden_feeds, golden_expect,
             kind = verify_snapshot_dir(src)
         except ValueError as e:
             _reject(registry, name, src, f"integrity: {e}")
+        # digest fast-reject (ISSUE 14): re-hash every manifest-stamped
+        # file BEFORE staging — a rotted snapshot quarantines in
+        # milliseconds instead of paying the stage/verify/smoke/warm
+        # ladder to discover the same thing (and a rot the load path
+        # happens not to materialize, e.g. an unreferenced shard, still
+        # rejects)
+        try:
+            with _MON.span("serving.publish_digest_check", model=name):
+                _integrity.verify_manifest_digests(src)
+        except Exception as e:
+            _reject(registry, name, src,
+                    f"integrity: manifest digest check failed ({e})")
         try:
             program, feed_names, fetch_names, staged = _stage(
                 registry, active, src, kind)

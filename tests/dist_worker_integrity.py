@@ -1,0 +1,187 @@
+"""Integrity-sentinel gang worker (ISSUE 14): the chaos-suite worker for
+the flip_bit silent-corruption matrix.
+
+The run drives `resilient_train_loop` over a checkpointable stream with
+`FLAGS_integrity_check_period` armed, so every rank's heartbeat carries
+its amortized state-digest epochs.  A `flip_bit@S:RANK` fault plants a
+wrong-but-FINITE value in rank RANK's parameters at the dispatch
+boundary of step S — no NaN guard, CRC, or structure check can see it;
+only the cross-rank digest comparison can.  The contract this worker
+exists to prove:
+
+  * the divergence is DETECTED (integrity.divergences > 0 on every
+    observer) and the vote NAMES the flipped rank (the exponent-bit flip
+    makes the corrupt chunk's max |value| astronomically implausible —
+    the 2-rank tiebreak);
+  * the corrupt timeline is DISCARDED: checkpoints newer than the
+    proven-clean boundary are quarantined (INTEGRITY_REJECTED), every
+    rank exits classified (EXIT_INTEGRITY=45 from the flagged rank's own
+    raise; 43 from peers that classify off its tombstone), and the
+    relaunched gang resumes from the newest clean checkpoint;
+  * the replay is EXACT: the flip is ledger-spent (fires once per gang),
+    so the restarted run ends bit-identical to an uninterrupted one —
+    the params_sha on the RESULT line is the parity probe.
+
+Batches for step S derive from the step index alone (same contract as
+dist_worker_resilient.py) so any restore-and-replay consumes exactly the
+batches an uninterrupted run would.
+"""
+import json
+import os
+import sys
+import time
+
+# must be set before jax import
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_xla_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        _xla_flags + " --xla_force_host_platform_device_count=1").strip()
+
+import numpy as np  # noqa: E402
+
+GBS = int(os.environ.get("GLOBAL_BS", "16"))
+
+
+class CountingBase:
+    """Checkpointable base stream of global sample ids [0, n)."""
+
+    def __init__(self, n: int):
+        self.n = int(n)
+        self._next = 0
+
+    def state_dict(self):
+        return {"pos": self._next}
+
+    def load_state_dict(self, state):
+        self._next = int(state["pos"])
+
+    def __call__(self):
+        i = self._next
+        self._next = 0
+        while i < self.n:
+            self._next = i + 1
+            yield i
+            i += 1
+            self._next = i
+
+
+def sample(i: int):
+    rng = np.random.RandomState(70000 + i)
+    x = rng.rand(8).astype("f4")
+    y = np.array([x.sum() * 0.5], "f4")
+    return x, y
+
+
+def build_model():
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 92
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [8], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        pred = fluid.layers.fc(x, 1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+    return main, startup, loss
+
+
+def main():
+    import paddle_tpu as fluid
+    from paddle_tpu import dist_resilience as dres
+    from paddle_tpu import integrity, monitor
+    from paddle_tpu import reader as R
+    from paddle_tpu.errors import DistributedError, IntegrityError
+    from paddle_tpu.fleet import fleet
+
+    run_steps = int(os.environ.get("RUN_STEPS", "24"))
+    save_every = int(os.environ.get("SAVE_EVERY", "4"))
+    period = int(os.environ.get("INTEGRITY_PERIOD", "2"))
+    step_sleep = float(os.environ.get("PT_STEP_SLEEP", "0.02"))
+    ckpt_root = os.environ.get("PADDLE_CHECKPOINT_ROOT")
+    restart_num = int(os.environ.get("PADDLE_RESTART_NUM", "0"))
+    total = run_steps * GBS
+
+    fluid.set_flags({"FLAGS_integrity_check_period": period})
+    monitor.enable()  # the test reads the integrity counters
+
+    t0 = time.perf_counter()
+    verdict_ranks = []
+    try:
+        fleet.init()
+        rank, world = fleet.worker_index(), fleet.worker_num()
+        per = GBS // world
+        assert per * world == GBS
+
+        main_p, startup, loss = build_model()
+        compiled = fleet.main_program(main_p) if world > 1 else main_p
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup, scope=scope)
+
+        def make_feed(ids):
+            xs, ys = zip(*(sample(i) for i in ids))
+            return {"x": np.stack(xs), "y": np.stack(ys)}
+
+        def make_loader():
+            base = CountingBase(total)
+            return R.map_readers(
+                make_feed, R.batch(R.shard(base, rank, world), per,
+                                   drop_last=True))
+
+        cm = fluid.CheckpointManager(
+            ckpt_root, program=main_p, scope=scope, rank=rank,
+            world_size=world, mesh=fleet.mesh if world > 1 else None,
+            save_every_steps=save_every, commit_timeout_s=30)
+
+        def on_logged(step, vals):
+            if step_sleep:
+                # beats must interleave with steps: detection latency is
+                # measured in beat intervals, and a run that finishes
+                # before the divergent epoch's beats cross would prove
+                # nothing
+                time.sleep(step_sleep)
+
+        try:
+            stats = fluid.resilient_train_loop(
+                exe, compiled, make_loader, [loss], scope=scope,
+                checkpoint_manager=cm, resume=restart_num > 0,
+                max_inflight=1, log_period=1, on_logged=on_logged,
+                max_steps=run_steps)
+        except IntegrityError as e:
+            # the gang path: quarantine already happened inside the loop,
+            # this rank exits classified for the supervisor's restart
+            verdict_ranks = list(e.corrupt_ranks)
+            print(f"INTEGRITY_FAILURE corrupt_ranks={e.corrupt_ranks} "
+                  f"attributed={e.attributed} safe_step={e.safe_step}",
+                  file=sys.stderr, flush=True)
+            dres.shutdown_health(mark_down=True)
+            os._exit(dres.EXIT_INTEGRITY)
+    except DistributedError as e:
+        print(f"DIST_FAILURE {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+        dres.shutdown_health(mark_down=True)
+        os._exit(dres.exit_code_for(e))
+
+    counters = monitor.get_monitor().counter_values()
+    events = [r for r in monitor.step_records()
+              if r.get("kind") == "integrity_event"]
+    for r in events:
+        if r.get("action") == "divergence":
+            verdict_ranks = list(r.get("corrupt_ranks", []))
+    print("RESULT " + json.dumps({
+        "rank": rank, "world": world, "restart_num": restart_num,
+        "steps_total": stats.steps,
+        "rollbacks": stats.rollbacks,
+        "wall_s": round(time.perf_counter() - t0, 4),
+        "divergences": int(counters.get("integrity.divergences", 0)),
+        "digest_epochs": int(counters.get("integrity.digests", 0)),
+        "ckpt_rejected": int(counters.get("integrity.ckpt_rejected", 0)),
+        "corrupt_ranks": verdict_ranks,
+        "params_sha": integrity.state_digest(scope)}), flush=True)
+    dres.shutdown_health()
+
+
+if __name__ == "__main__":
+    main()

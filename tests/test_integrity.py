@@ -1,0 +1,706 @@
+"""Silent-corruption sentinel (ISSUE 14): one planted defect per
+detector class.
+
+The whole point of paddle_tpu/integrity.py is that a flipped-yet-FINITE
+value passes every pre-existing guard — no NaN check, CRC, structure
+verifier, or load exception sees it.  Each test here plants exactly that
+class of defect and asserts the matching detector names it:
+
+  * at-rest: manifest sha256 round-trip (dense + SelectedRows shards),
+    a rotted shard failing the load with the FILE named, restore's
+    walk-back rejecting a digest-mismatched checkpoint with an
+    `integrity.ckpt_rejected` event;
+  * live: the amortized digest's per-step byte budget, the majority
+    vote + agreed-baseline plausibility tiebreak, a latched divergence
+    verdict driving the resilient loop's rollback bit-identically;
+  * quarantine: `reject_unsafe` marking committed AND pending dirs
+    (the commit-rename race a real gang run found);
+  * fault specs: flip_bit rank gating + finiteness, rot_shard's
+    once-per-gang ledger replay safety;
+  * tools: scrub --check on a clean tree and on each rot class,
+    perf_report --max-integrity-mismatches (zero-evidence-fails);
+  * the 2-process chaos matrix: flip_bit on a real gang names the
+    corrupt rank, quarantines the poisoned checkpoints, and recovers
+    bit-identical to an uninterrupted baseline.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import integrity, io, layers, monitor
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.core.selected_rows import SelectedRows
+from paddle_tpu.errors import IntegrityError
+from paddle_tpu.faults import FaultInjector
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _rot(path, offset=None):
+    """Flip one byte of a file in place (finite rot, not truncation)."""
+    size = os.path.getsize(path)
+    off = size // 2 if offset is None else offset
+    with open(path, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+@pytest.fixture
+def mon():
+    monitor.reset()
+    monitor.enable()
+    integrity.disarm_live_digests()  # fresh gang-observation state
+    yield monitor
+    integrity.disarm_live_digests()
+    monitor.reset()
+    monitor.disable()
+
+
+# ---- at-rest digests -------------------------------------------------------
+
+def test_manifest_digest_roundtrip_incl_selected_rows(tmp_path):
+    d = str(tmp_path / "ck")
+    s = Scope()
+    s.set_var("w", np.arange(12, dtype="f4").reshape(3, 4))
+    s.set_var("tbl", SelectedRows(np.array([1, 5]),
+                                  np.ones((2, 3), "f4"), 10))
+    io.save_sharded(d, var_names=["w", "tbl"], scope=s, process_index=0)
+    # every file is stamped and verifies
+    assert integrity.verify_manifest_digests(d) == 3  # w + rows + vals
+    s2 = Scope()
+    io.load_sharded(d, scope=s2)
+    np.testing.assert_array_equal(np.asarray(s2.find_var("w")),
+                                  np.asarray(s.find_var("w")))
+    tbl = s2.find_var("tbl")
+    np.testing.assert_array_equal(np.asarray(tbl.rows), [1, 5])
+    # plain save_vars stamps too
+    d2 = str(tmp_path / "vars")
+    io.save_vars(d2, ["w"], scope=s)
+    assert integrity.verify_manifest_digests(d2) == 1
+
+
+def test_rotted_shard_fails_load_naming_the_file(tmp_path):
+    d = str(tmp_path / "ck")
+    s = Scope()
+    s.set_var("w", np.arange(64, dtype="f4"))
+    io.save_sharded(d, var_names=["w"], scope=s, process_index=0)
+    victim = next(f for f in sorted(os.listdir(d)) if f.endswith(".npy"))
+    _rot(os.path.join(d, victim))
+    with pytest.raises(IntegrityError) as ei:
+        io.load_sharded(d, scope=Scope())
+    assert ei.value.file == victim
+    assert ei.value.expected and ei.value.actual
+    # escape hatch: verification off loads the rotted bytes (the
+    # historical behavior, explicitly opted into)
+    fluid.set_flags({"FLAGS_integrity_verify_load": False})
+    try:
+        io.load_sharded(d, scope=Scope())
+    finally:
+        fluid.set_flags({"FLAGS_integrity_verify_load": True})
+
+
+def test_rotted_selected_rows_values_fail_load(tmp_path):
+    d = str(tmp_path / "ck")
+    s = Scope()
+    s.set_var("tbl", SelectedRows(np.arange(4), np.ones((4, 8), "f4"), 16))
+    io.save_sharded(d, var_names=["tbl"], scope=s, process_index=0)
+    victim = next(f for f in sorted(os.listdir(d)) if ".vals." in f)
+    _rot(os.path.join(d, victim))
+    with pytest.raises(IntegrityError) as ei:
+        io.load_sharded(d, scope=Scope())
+    assert ei.value.file == victim
+
+
+def _tiny_program():
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 13
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, loss
+
+
+def test_restore_walkback_rejects_digest_mismatched_checkpoint(tmp_path, mon):
+    main, startup, _ = _tiny_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    cm = fluid.CheckpointManager(str(tmp_path / "root"), program=main,
+                                 scope=scope)
+    cm.save(step=2)
+    w = scope.find_var("fc_0.w_0")
+    scope.set_var("fc_0.w_0", np.asarray(w) + 1.0)
+    newest = cm.save(step=4)
+    # a flipped finite byte in the newest checkpoint: loads cleanly
+    # without digests — today it MUST be rejected and the walk-back must
+    # land one earlier, naming the file in an integrity_event
+    victim = next(f for f in sorted(os.listdir(newest))
+                  if f.startswith("fc_0.w_0") and f.endswith(".npy"))
+    _rot(os.path.join(newest, victim))
+    restored = cm.restore(scope=scope)
+    assert restored == 2
+    assert monitor.counter("integrity.ckpt_rejected").value == 1
+    evs = [r for r in monitor.step_records()
+           if r.get("kind") == "integrity_event"
+           and r.get("action") == "ckpt_rejected"]
+    assert evs and evs[0]["file"] == victim
+    np.testing.assert_array_equal(np.asarray(scope.find_var("fc_0.w_0")),
+                                  np.asarray(w))
+
+
+def test_reject_unsafe_quarantines_committed_and_pending(tmp_path, mon):
+    from paddle_tpu.checkpoint_manager import INTEGRITY_REJECTED_MARKER
+
+    main, startup, _ = _tiny_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    root = str(tmp_path / "root")
+    cm = fluid.CheckpointManager(root, program=main, scope=scope)
+    cm.save(step=2)
+    cm.save(step=4)
+    # a shared pending dir mid-commit (the rename race a real gang hit:
+    # the detecting rank's own step-6 shards were already flushed, so a
+    # peer could commit the poisoned dir AFTER this rank exited)
+    pending = os.path.join(root, "ckpt-0000000006.tmp")
+    os.makedirs(pending)
+    assert cm.reject_unsafe(3) == 2  # ckpt-4 and the pending 6
+    assert os.path.exists(os.path.join(root, "ckpt-0000000004",
+                                       INTEGRITY_REJECTED_MARKER))
+    assert os.path.exists(os.path.join(pending, INTEGRITY_REJECTED_MARKER))
+    assert cm.restore(scope=scope) == 2
+    assert monitor.counter("integrity.ckpt_rejected").value >= 1
+    # a later save that reuses the step replaces the dir wholesale:
+    # post-recovery checkpoints are trusted again
+    cm.save(step=4)
+    assert not os.path.exists(os.path.join(root, "ckpt-0000000004",
+                                           INTEGRITY_REJECTED_MARKER))
+    assert cm.restore(scope=scope) == 4
+
+
+# ---- live digests ----------------------------------------------------------
+
+def test_amortized_digest_overhead_budget(mon):
+    period = 4
+    s = Scope()
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        s.set_var(f"v{i}", rng.rand(64, 64).astype("f4"))
+    total = sum(np.asarray(s.find_var(f"v{i}")).nbytes for i in range(8))
+    d = integrity.StateDigester(s, period=period)
+    c = monitor.counter("integrity.digest_bytes")
+    per_step = []
+    for step in range(period):
+        before = c.value
+        payload = d.on_step(step)
+        per_step.append(c.value - before)
+    # amortization contract: no single step hashes more than the worst
+    # chunk (~total/period), and one full period covers every byte once
+    assert max(per_step) <= d.max_step_digest_bytes()
+    assert max(per_step) <= total // period + max(
+        np.asarray(s.find_var(f"v{i}")).nbytes for i in range(8))
+    assert sum(per_step) == total
+    assert payload is not None and payload["e"] == 0
+    assert monitor.counter("integrity.digests").value == 1
+    # the composite equals a fresh full digest only chunk-wise — but the
+    # SAME state digested twice must agree bit-exactly
+    d2 = integrity.StateDigester(s, period=period)
+    for step in range(period):
+        p2 = d2.on_step(step)
+    assert p2["d"] == payload["d"] and p2["c"] == payload["c"]
+
+
+def test_disabled_sentinel_costs_nothing(mon):
+    # FLAGS_integrity_check_period=0 (default): the resilient loop arms
+    # no digester and no integrity counter ever moves
+    main, startup, loss = _tiny_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    rng = np.random.RandomState(0)
+    feeds = [{"x": rng.rand(4, 4).astype("f4"),
+              "y": rng.rand(4, 1).astype("f4")} for _ in range(4)]
+    fluid.resilient_train_loop(exe, main, lambda: list(feeds), [loss],
+                               scope=scope, max_inflight=1)
+    counters = monitor.get_monitor().counter_values()
+    assert not any(k.startswith("integrity.") and v
+                   for k, v in counters.items()), counters
+    assert integrity.current_payload() is None
+
+
+def test_observe_gang_majority_vote_names_minority(mon):
+    def pay(d, chunks, amax, step=3):
+        return {"g": 0, "e": 1, "step": step, "p": 2, "n": 2,
+                "d": d, "c": chunks, "amax": amax}
+
+    tel = {0: {"dig": [pay("aaaa", ["x1", "y1"], [1.0, 1.0])]},
+           1: {"dig": [pay("bbbb", ["x2", "y1"], [1.0, 1.0])]},
+           2: {"dig": [pay("aaaa", ["x1", "y1"], [1.0, 1.0])]}}
+    v = integrity.observe_gang(tel, world=3, observer_rank=0)
+    assert v is not None
+    assert v["corrupt_ranks"] == [1] and v["attributed"]
+    assert v["chunk"] == 0
+    assert monitor.counter("integrity.divergences").value == 1
+    evs = [r for r in monitor.step_records()
+           if r.get("kind") == "integrity_event"
+           and r.get("action") == "divergence"]
+    assert evs and evs[0]["corrupt_ranks"] == [1]
+
+
+def test_observe_gang_tiebreak_against_agreed_baseline(mon):
+    def pay(e, d, chunks, amax, step):
+        return {"g": 0, "e": e, "step": step, "p": 2, "n": 2,
+                "d": d, "c": chunks, "amax": amax}
+
+    # epoch 0 agrees at amax ~1 (the baseline both ranks signed off on);
+    # epoch 1 diverges with rank 1's chunk-0 amax at 1e37 — an
+    # exponent-bit flip.  2 ranks cannot majority-vote; the baseline
+    # jump names rank 1.
+    tel = {0: {"dig": [pay(0, "eq", ["c0", "c1"], [1.0, 1.0], 1),
+                       pay(1, "aaaa", ["x1", "y1"], [1.1, 1.0], 3)]},
+           1: {"dig": [pay(0, "eq", ["c0", "c1"], [1.0, 1.0], 1),
+                       pay(1, "bbbb", ["x2", "y1"], [1e37, 1.0], 3)]}}
+    v = integrity.observe_gang(tel, world=2, observer_rank=0)
+    assert v is not None
+    assert v["corrupt_ranks"] == [1] and v["attributed"]
+    # safe_step: the divergent chunk's digest point in the agreed epoch
+    assert v["safe_step"] == 0 * 2 + 0
+    # a tie with NO implausible jump stays unattributed (a low-mantissa
+    # flip on a 2-rank gang is detected but not nameable)
+    integrity.disarm_live_digests()
+    monitor.reset()
+    monitor.enable()
+    tel2 = {0: {"dig": [pay(0, "eq", ["c0", "c1"], [1.0, 1.0], 1),
+                        pay(1, "aaaa", ["x1", "y1"], [1.0, 1.0], 3)]},
+            1: {"dig": [pay(0, "eq", ["c0", "c1"], [1.0, 1.0], 1),
+                        pay(1, "bbbb", ["x2", "y1"], [1.0001, 1.0], 3)]}}
+    v2 = integrity.observe_gang(tel2, world=2, observer_rank=0)
+    assert v2 is not None and not v2["attributed"]
+    assert v2["corrupt_ranks"] == [0, 1]
+
+
+def test_divergence_verdict_drives_bit_identical_rollback(tmp_path, mon):
+    """The single-process harness for the loop plumbing: a manufactured
+    verdict latched mid-run must roll the resilient loop back to a
+    checkpoint at or before safe_step and end bit-identical to an
+    uninterrupted run (the gang-scale version lives in
+    test_gang_flip_bit below)."""
+    fluid.set_flags({"FLAGS_integrity_check_period": 2})
+    try:
+        main, startup, loss = _tiny_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        rng0 = np.random.RandomState(7)
+        feeds = [{"x": rng0.rand(8, 4).astype("f4"),
+                  "y": rng0.rand(8, 1).astype("f4")} for _ in range(16)]
+
+        def run(root, poison):
+            scope = fluid.Scope()
+            exe.run(startup, scope=scope)
+            cm = fluid.CheckpointManager(root, program=main, scope=scope,
+                                         save_every_steps=4)
+            fired = [False]
+
+            def on_logged(step, vals):
+                if poison and step == 9 and not fired[0]:
+                    fired[0] = True
+                    integrity.flag_divergence(
+                        {"g": 0, "e": 4, "step": 9, "corrupt_ranks": [0],
+                         "attributed": True, "chunk": 0, "safe_step": 8,
+                         "digests": {0: "aa", 1: "bb"}})
+            stats = fluid.resilient_train_loop(
+                exe, main, lambda: list(feeds), [loss], scope=scope,
+                checkpoint_manager=cm, max_inflight=1,
+                on_logged=on_logged, max_steps=16)
+            return stats, integrity.state_digest(scope)
+
+        _, base_sha = run(str(tmp_path / "clean"), poison=False)
+        stats, sha = run(str(tmp_path / "poisoned"), poison=True)
+        assert stats.rollbacks == 1
+        assert monitor.counter("integrity.rollbacks").value == 1
+        assert sha == base_sha
+        evs = [r for r in monitor.step_records()
+               if r.get("kind") == "resilience_event"
+               and r.get("action") == "rollback"
+               and r.get("class") == "IntegrityError"]
+        assert evs and evs[0]["corrupt_ranks"] == [0]
+    finally:
+        fluid.set_flags({"FLAGS_integrity_check_period": 0})
+
+
+def test_payload_chunk_detail_capped_for_beat_transport(mon, monkeypatch):
+    """Past _DETAIL_CHUNK_CAP chunks the payload drops per-chunk detail
+    (beats ride single UDP datagrams and send() swallows EMSGSIZE — an
+    unbounded payload would silently read as the rank going stale) but
+    keeps the overall digest + overall amax: detection and the
+    plausibility tiebreak still work, only chunk attribution degrades."""
+    monkeypatch.setattr(integrity, "_DETAIL_CHUNK_CAP", 2)
+    s = Scope()
+    for i in range(4):
+        s.set_var(f"v{i}", np.full((4,), float(i + 1), "f4"))
+    d = integrity.StateDigester(s, period=4)
+    for step in range(4):
+        payload = d.on_step(step)
+    assert payload is not None
+    assert "c" not in payload and "amax" not in payload
+    assert payload["amax_all"] == 4.0
+    # chunkless payloads still vote: overall-amax jump vs the agreed
+    # baseline names the corrupt rank
+    def pay(e, dig, amax_all, step):
+        return {"g": 0, "e": e, "step": step, "p": 4, "n": 4,
+                "d": dig, "amax_all": amax_all}
+
+    tel = {0: {"dig": [pay(0, "eq", 1.0, 3), pay(1, "aaaa", 1.0, 7)]},
+           1: {"dig": [pay(0, "eq", 1.0, 3), pay(1, "bbbb", 1e30, 7)]}}
+    v = integrity.observe_gang(tel, world=2, observer_rank=0)
+    assert v is not None and v["corrupt_ranks"] == [1] and v["attributed"]
+    assert v["chunk"] is None
+    assert v["safe_step"] == 0  # degrades to the agreed epoch's start
+
+
+def test_verdict_without_safe_step_is_terminal(tmp_path, mon):
+    """No epoch ever agreed before the divergence => nothing on disk is
+    provably clean; the loop must re-raise instead of restoring a
+    checkpoint that may hold the corruption (docs: 'rather than
+    guessing')."""
+    fluid.set_flags({"FLAGS_integrity_check_period": 2})
+    try:
+        main, startup, loss = _tiny_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        cm = fluid.CheckpointManager(str(tmp_path / "r"), program=main,
+                                     scope=scope, save_every_steps=4)
+        rng = np.random.RandomState(0)
+        feeds = [{"x": rng.rand(4, 4).astype("f4"),
+                  "y": rng.rand(4, 1).astype("f4")} for _ in range(12)]
+        fired = [False]
+
+        def on_logged(step, vals):
+            if step == 6 and not fired[0]:
+                fired[0] = True
+                integrity.flag_divergence(
+                    {"g": 0, "e": 3, "step": 6, "corrupt_ranks": [0],
+                     "attributed": False, "chunk": None,
+                     "safe_step": None, "digests": {0: "aa", 1: "bb"}})
+        with pytest.raises(IntegrityError):
+            fluid.resilient_train_loop(
+                exe, main, lambda: list(feeds), [loss], scope=scope,
+                checkpoint_manager=cm, max_inflight=1,
+                on_logged=on_logged, max_steps=12)
+    finally:
+        fluid.set_flags({"FLAGS_integrity_check_period": 0})
+
+
+# ---- fault specs -----------------------------------------------------------
+
+def test_flip_bit_is_finite_and_rank_gated():
+    s = Scope()
+    s.set_var("b", np.zeros(1, "f4"))
+    s.set_var("w", (np.random.RandomState(0).rand(16).astype("f4") - 0.5))
+    before = np.asarray(s.find_var("w")).copy()
+    # rank-gated: a non-matching rank leaves the state untouched
+    inj = FaultInjector("flip_bit@3:1", rank=0)
+    inj.on_state(3, s)
+    np.testing.assert_array_equal(np.asarray(s.find_var("w")), before)
+    assert inj.pending()
+    # the matching rank flips ONE element of the LARGEST float var to a
+    # wrong-but-FINITE value (the class every NaN guard waves through)
+    inj = FaultInjector("flip_bit@3:1", rank=1)
+    inj.on_state(3, s)
+    after = np.asarray(s.find_var("w"))
+    assert np.isfinite(after).all()
+    diff = np.nonzero(after != before)[0]
+    assert len(diff) == 1
+    assert not inj.pending()
+    inj.on_state(3, s)  # fires once
+
+
+def test_rot_shard_ledger_replay_safety(tmp_path, monkeypatch):
+    """rot_shard fires once per GANG: the ledger marker is created with
+    O_EXCL before mutating, so a restarted incarnation (which replays
+    the same commits) never re-rots, and two ranks observing the same
+    commit race to exactly one mutation."""
+    state = tmp_path / "faults"
+    state.mkdir()
+    monkeypatch.setenv("PADDLE_FAULT_STATE_DIR", str(state))
+    ck = tmp_path / "ckpt-0000000002"
+    ck.mkdir()
+    np.save(str(ck / "w.p0s0.npy"), np.arange(32, dtype="f4"))
+    pristine = open(str(ck / "w.p0s0.npy"), "rb").read()
+
+    inj = FaultInjector("rot_shard@1")
+    inj.on_commit(str(ck))           # commit 0: not the target
+    assert open(str(ck / "w.p0s0.npy"), "rb").read() == pristine
+    inj.on_commit(str(ck))           # commit 1: rots
+    rotted = open(str(ck / "w.p0s0.npy"), "rb").read()
+    assert rotted != pristine
+    # a restarted incarnation replays the same commit sequence: the
+    # ledger marker marks the entry spent, nothing re-rots
+    inj2 = FaultInjector("rot_shard@1")
+    inj2.on_commit(str(ck))
+    inj2.on_commit(str(ck))
+    assert open(str(ck / "w.p0s0.npy"), "rb").read() == rotted
+    assert [f.kind for f in inj2.fired()] == ["rot_shard"]
+
+
+def test_rot_shard_then_resume_walks_back_bit_identical(tmp_path, mon):
+    """The rot_shard chaos closure, single-process: a committed-then-
+    rotted checkpoint is rejected by digest on resume, the walk-back
+    lands one earlier, and the resumed run ends bit-identical to a
+    resume from a pristine tree."""
+    main, startup, loss = _tiny_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng0 = np.random.RandomState(3)
+    feeds = [{"x": rng0.rand(8, 4).astype("f4"),
+              "y": rng0.rand(8, 1).astype("f4")} for _ in range(12)]
+
+    def first_half(root, injector):
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        cm = fluid.CheckpointManager(root, program=main, scope=scope,
+                                     save_every_steps=3)
+        # 8 steps with save_every=3: commits land at the step-3 and
+        # step-6 boundaries (a boundary only flushes when a later step
+        # dispatches, so the run must outlive the second commit)
+        fluid.resilient_train_loop(
+            exe, main, lambda: list(feeds), [loss], scope=scope,
+            checkpoint_manager=cm, injector=injector, max_inflight=1,
+            max_steps=8)
+
+    def resume(root):
+        scope = fluid.Scope()
+        cm = fluid.CheckpointManager(root, program=main, scope=scope,
+                                     save_every_steps=3)
+        fluid.resilient_train_loop(
+            exe, main, lambda: list(feeds), [loss], scope=scope,
+            checkpoint_manager=cm, resume=True, max_inflight=1,
+            max_steps=12)
+        return integrity.state_digest(scope)
+
+    clean_root = str(tmp_path / "clean")
+    rot_root = str(tmp_path / "rot")
+    first_half(clean_root, None)
+    # rot the SECOND commit (step 6) post-COMMIT; the resume must reject
+    # it and restore step 3 instead
+    first_half(rot_root, FaultInjector("rot_shard@1"))
+    rej0 = monitor.counter("integrity.ckpt_rejected").value
+    base_sha = resume(clean_root)
+    sha = resume(rot_root)
+    assert monitor.counter("integrity.ckpt_rejected").value == rej0 + 1
+    assert sha == base_sha
+
+
+# ---- publish fast-reject ---------------------------------------------------
+
+def test_publish_digest_fast_reject_quarantines_before_staging(tmp_path, mon):
+    from paddle_tpu import serving
+    from paddle_tpu.errors import ServingError
+
+    main, startup = fluid.Program(), fluid.Program()
+    startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        out = layers.fc(x, 2, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    good = str(tmp_path / "good")
+    io.save_inference_model(good, ["x"], [out], exe, main, scope)
+    bad = str(tmp_path / "bad")
+    scope.set_var("fc_0.w_0", np.asarray(scope.find_var("fc_0.w_0")) * 2)
+    io.save_inference_model(bad, ["x"], [out], exe, main, scope)
+    victim = next(f for f in sorted(os.listdir(bad))
+                  if f.endswith(".npy"))
+    _rot(os.path.join(bad, victim))
+
+    registry = serving.ModelRegistry(place=fluid.CPUPlace())
+    registry.load("m", good)
+    xv = np.ones((1, 4), "f4")
+    before = registry.acquire("m").run({"x": xv})[0]
+    with pytest.raises(ServingError) as ei:
+        serving.publish(registry, "m", bad)
+    assert ei.value.reason == "publish_rejected"
+    assert "manifest digest check failed" in str(ei.value)
+    # the reject fired BEFORE the staging/smoke ladder: no staged scope,
+    # no golden-smoke span was ever opened for the bad source
+    spans = monitor.get_monitor().span_stats()
+    assert "serving.publish_digest_check" in spans
+    # old model keeps serving bit-identically
+    np.testing.assert_array_equal(
+        np.asarray(registry.acquire("m").run({"x": xv})[0]),
+        np.asarray(before))
+    # quarantined: the repeat publish rejects fast
+    with pytest.raises(ServingError):
+        serving.publish(registry, "m", bad)
+
+
+# ---- tools: scrub + perf_report gate ---------------------------------------
+
+def _run_tool(tool, *args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", tool), *args],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=300)
+
+
+def test_scrub_check_clean_tree_and_each_rot_class(tmp_path):
+    from paddle_tpu import recordio
+
+    root = str(tmp_path / "tree")
+    d = os.path.join(root, "ckpt-0000000002")
+    s = Scope()
+    s.set_var("w", np.arange(64, dtype="f4"))
+    io.save_sharded(d, var_names=["w"], scope=s, process_index=0)
+    rio = os.path.join(root, "data.rio")
+    with recordio.Writer(rio, max_chunk_records=4) as w:
+        for i in range(16):
+            w.write(b"payload-%d" % i * 4)
+    r = _run_tool("scrub.py", "--check", root)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "CHECK OK" in r.stdout
+
+    # rot class 1: flipped shard byte
+    victim = next(f for f in sorted(os.listdir(d)) if f.endswith(".npy"))
+    _rot(os.path.join(d, victim))
+    r = _run_tool("scrub.py", "--check", root)
+    assert r.returncode == 1 and "digest_mismatch" in r.stdout
+    _rot(os.path.join(d, victim))  # un-rot (xor is its own inverse)
+
+    # rot class 2: truncation (bytes mismatch)
+    p = os.path.join(d, victim)
+    payload = open(p, "rb").read()
+    open(p, "wb").write(payload[:-8])
+    r = _run_tool("scrub.py", "--check", root)
+    assert r.returncode == 1 and "bytes_mismatch" in r.stdout
+    open(p, "wb").write(payload)
+
+    # rot class 3: a file the manifest names going missing
+    os.rename(p, p + ".gone")
+    r = _run_tool("scrub.py", "--check", root)
+    assert r.returncode == 1 and "missing_file" in r.stdout
+    os.rename(p + ".gone", p)
+
+    # rot class 4: CRC-failed RecordIO chunk (the existing native path)
+    from paddle_tpu.faults import _mutate_chunk
+
+    assert _mutate_chunk([rio], 1, truncate=False)
+    r = _run_tool("scrub.py", "--check", root)
+    assert r.returncode == 1 and "corrupt_chunks" in r.stdout
+
+    # rot class 5: a torn manifest is a finding, not a crash — and it
+    # must not mask the other findings in the same tree
+    with open(os.path.join(d, "__sharded_manifest__.json"), "w") as f:
+        f.write('{"vars": [{"name": "tor')
+    r = _run_tool("scrub.py", "--check", root)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "manifest_error" in r.stdout
+    assert "corrupt_chunks" in r.stdout  # the walk survived past it
+
+
+def test_perf_report_integrity_gate(tmp_path):
+    # zero evidence must FAIL the gate
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text(json.dumps({"kind": "step"}) + "\n")
+    r = _run_tool("perf_report.py", "--check", str(empty),
+                  "--max-integrity-mismatches", "0")
+    assert r.returncode == 1 and "no integrity evidence" in r.stdout
+    # counters-only evidence, clean: gate holds
+    ok = tmp_path / "ok.jsonl"
+    ok.write_text(json.dumps(
+        {"counters": {"integrity.digests": 5,
+                      "integrity.files_verified": 3}}) + "\n")
+    r = _run_tool("perf_report.py", "--check", str(ok),
+                  "--max-integrity-mismatches", "0")
+    assert r.returncode == 0, r.stdout
+    assert "integrity mismatches 0" in r.stdout
+    # a divergence event past the budget fails, naming the action
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("\n".join([
+        json.dumps({"kind": "integrity_event", "action": "divergence",
+                    "corrupt_ranks": [1]}),
+        json.dumps({"counters": {"integrity.divergences": 1}}),
+    ]) + "\n")
+    r = _run_tool("perf_report.py", "--check", str(bad),
+                  "--max-integrity-mismatches", "0")
+    assert r.returncode == 1 and "integrity mismatch" in r.stdout
+
+
+# ---- the 2-process chaos matrix --------------------------------------------
+
+GANG_ENV = {
+    "RUN_STEPS": "24", "SAVE_EVERY": "2", "INTEGRITY_PERIOD": "2",
+    "PT_STEP_SLEEP": "0.05",
+    "FLAGS_dist_heartbeat_interval_s": "0.1",
+    "FLAGS_dist_heartbeat_miss_factor": "40",
+    "FLAGS_dist_watchdog_timeout_s": "60",
+    "FLAGS_dist_bootstrap_timeout_s": "120",
+}
+INTEGRITY_WORKER = os.path.join(HERE, "dist_worker_integrity.py")
+
+
+def _gang(tmp_path, tag, fault_spec=None, max_restarts=0):
+    from paddle_tpu.launch import run_gang
+
+    env = dict(GANG_ENV)
+    if fault_spec:
+        env["FLAGS_fault_spec"] = fault_spec
+    return run_gang([sys.executable, INTEGRITY_WORKER], 2,
+                    checkpoint_root=str(tmp_path / tag), extra_env=env,
+                    max_restarts=max_restarts, timeout=240)
+
+
+def _results(res):
+    out = {}
+    for rank, (code, o, _e) in enumerate(res.workers):
+        for line in (o or "").splitlines():
+            if line.startswith("RESULT "):
+                out[rank] = json.loads(line[len("RESULT "):])
+    return out
+
+
+def test_gang_flip_bit_names_rank_and_recovers_bit_identical(tmp_path):
+    """The acceptance pin: a flipped-yet-finite bit on rank 1 of a real
+    2-process gang (a) diverges the live digests and the vote NAMES rank
+    1, (b) quarantines every checkpoint the corruption could have
+    reached, (c) restarts the gang, and (d) ends bit-identical to an
+    uninterrupted baseline — the corruption leaves NO trace in the final
+    model."""
+    clean = _gang(tmp_path, "clean")
+    assert clean.ok, clean.incidents
+    base = _results(clean)
+    assert len(set(r["params_sha"] for r in base.values())) == 1
+    base_sha = base[0]["params_sha"]
+    assert base[0]["digest_epochs"] > 0  # the sentinel actually ran
+
+    chaos = _gang(tmp_path, "chaos", fault_spec="flip_bit@5:1",
+                  max_restarts=2)
+    assert chaos.ok, chaos.incidents
+    assert chaos.restarts >= 1
+    # SOME rank exits EXIT_INTEGRITY (45) on its own verdict — whichever
+    # beat thread latches first; the OTHER rank follows as a classified
+    # peer reaction (43) or is torn down by the coordination runtime.
+    # The verdict itself is symmetric (computed from the same beat
+    # payloads), so whoever raises, it must name rank 1 as corrupt.
+    codes = {d["returncode"] for inc in chaos.incidents
+             for d in inc["dead"]}
+    assert 45 in codes, chaos.incidents
+    all_stderr = "\n".join(e or "" for inc in chaos.history
+                           for (_c, _o, e) in inc)
+    assert "corrupt_ranks=[1]" in all_stderr
+    assert "attributed=True" in all_stderr
+    # quarantine + bit-identical recovery
+    out = _results(chaos)
+    assert all(r["ckpt_rejected"] >= 1 for r in out.values()), out
+    shas = {r["params_sha"] for r in out.values()}
+    assert shas == {base_sha}, (shas, base_sha)

@@ -96,6 +96,17 @@
         failure message names the worst locks so the fix starts at the
         right critical section.
 
+    python tools/perf_report.py --check metrics.jsonl --max-integrity-mismatches 0
+        Gate silent-corruption detections (paddle_tpu/integrity.py):
+        live cross-rank digest divergences + at-rest file digest
+        mismatches (integrity_event records, integrity.* counter
+        fallback).  Walk-back ckpt_rejected events are the downstream
+        consequence of a detection that already counted — rendered, not
+        double-billed.  0 asserts the run saw NO corruption at all; a
+        chaos round budgets exactly its injected count.  A file with no
+        integrity evidence FAILS the gate — zero evidence must not gate
+        green.
+
     python tools/perf_report.py --check-bench BENCH_rNN.json
         Ratcheted bench-round gate (ISSUE 7): analytic MFU must clear the
         MFU_FLOORS landed with the last accepted round (resnet50's floor
@@ -224,6 +235,26 @@ def render(path: str) -> str:
                 for r in sevents]
         if rows:
             parts.append(_fmt_table(rows, ["action", "model", "detail"]))
+
+    ievents = [s for s in records if s.get("kind") == "integrity_event"]
+    icounters = {n: v for n, v in snap.get("counters", {}).items()
+                 if n.startswith("integrity.")}
+    if ievents or icounters:
+        rows = [(r.get("action", "?"),
+                 r.get("corrupt_ranks", r.get("rank", "")),
+                 r.get("safe_step", r.get("step", "")),
+                 r.get("file", r.get("dir", r.get("digests", ""))))
+                for r in ievents]
+        parts.append(
+            f"\n## integrity ({len(ievents)} events, "
+            f"digest epochs {icounters.get('integrity.digests', 0)}, "
+            f"files verified "
+            f"{icounters.get('integrity.files_verified', 0)}, "
+            f"mismatches {icounters.get('integrity.file_mismatches', 0)}"
+            f"+{icounters.get('integrity.divergences', 0)} div, "
+            f"rollbacks {icounters.get('integrity.rollbacks', 0)})\n"
+            + (_fmt_table(rows, ["action", "ranks", "step", "detail"])
+               if rows else "(counters only)"))
 
     revents = [s for s in records if s.get("kind") == "resilience_event"]
     if revents:
@@ -408,6 +439,42 @@ def serving_p99_ms(lines):
     return lats[min(int(0.99 * len(lats)), len(lats) - 1)]
 
 
+def _has_integrity_evidence(lines):
+    """True when the file carries ANY integrity signal: integrity_event
+    records or integrity.* counters/gauges in a snapshot.  The integrity
+    gate fails on a file with none — a run that never armed the sentinel
+    (FLAGS_integrity_check_period=0, no digested manifests touched) must
+    not gate green (the zero-evidence-fails convention)."""
+    if any(r.get("kind") == "integrity_event" for r in lines):
+        return True
+    return bool(_latest_counters(lines, "integrity.")
+                or _latest_gauges(lines, "integrity."))
+
+
+# PRIMARY detections only: a walk-back ckpt_rejected is the downstream
+# CONSEQUENCE of a file mismatch (its event already counted) or of a
+# divergence's quarantine markers — counting it too would double-bill
+# one injected rot (one rotted checkpoint = one file_mismatch event AND
+# one ckpt_rejected event); it still renders in the integrity section.
+INTEGRITY_MISMATCH_ACTIONS = ("divergence", "file_mismatch")
+
+
+def integrity_mismatches(lines):
+    """Silent-corruption detections: integrity_event records (live
+    digest divergences + at-rest file digest mismatches), falling back
+    to the integrity.* counter snapshot when the event lines were
+    rotated away.  0 on healthy hardware + storage; anything else is
+    real rot the sentinel caught — budget it explicitly (a chaos round
+    expects exactly its injected count)."""
+    n = sum(1 for r in lines if r.get("kind") == "integrity_event"
+            and r.get("action") in INTEGRITY_MISMATCH_ACTIONS)
+    if n:
+        return n
+    c = _latest_counters(lines, "integrity.")
+    return int(c.get("integrity.divergences", 0)
+               + c.get("integrity.file_mismatches", 0))
+
+
 def _has_lock_evidence(lines):
     """True when the file carries named-lock telemetry (lock.* counters
     from FLAGS_lock_telemetry, paddle_tpu/core/locks.py).  The lock gate
@@ -490,7 +557,8 @@ def check(path: str, steady_after: int = 2,
           max_gang_resizes: int = None,
           max_shed_frac: float = None,
           max_p99_ms: float = None,
-          max_lock_wait_frac: float = None) -> int:
+          max_lock_wait_frac: float = None,
+          max_integrity_mismatches: int = None) -> int:
     """Return 0 when the metrics file is healthy, 1 otherwise (printed
     diagnosis either way).  Made for CI/bench scripts:
 
@@ -522,7 +590,8 @@ def check(path: str, steady_after: int = 2,
                        or max_gang_resizes is not None
                        or max_shed_frac is not None
                        or max_p99_ms is not None
-                       or max_lock_wait_frac is not None) \
+                       or max_lock_wait_frac is not None
+                       or max_integrity_mismatches is not None) \
         and max_host_blocked_frac is None and max_retry_frac is None
     if not steps and not dist_gates_only:
         print(f"perf_report --check: {path} contains no step records "
@@ -719,6 +788,34 @@ def check(path: str, steady_after: int = 2,
             else:
                 print(f"perf_report --check: lock wait fraction "
                       f"{frac:.4f} <= {max_lock_wait_frac}")
+    if max_integrity_mismatches is not None:
+        if not _has_integrity_evidence(lines):
+            failures.append(
+                f"--max-integrity-mismatches given but {path} carries no "
+                f"integrity evidence (no integrity_event records and no "
+                f"integrity.* counters/gauges in any snapshot) — was the "
+                f"sentinel armed (FLAGS_integrity_check_period > 0) and "
+                f"a snapshot written?  (zero evidence must not gate "
+                f"green)")
+        else:
+            n = integrity_mismatches(lines)
+            if n > max_integrity_mismatches:
+                where = sorted({r.get("action") for r in lines
+                                if r.get("kind") == "integrity_event"
+                                and r.get("action")
+                                in INTEGRITY_MISMATCH_ACTIONS})
+                failures.append(
+                    f"{n} integrity mismatch(es) exceed the "
+                    f"--max-integrity-mismatches="
+                    f"{max_integrity_mismatches} gate "
+                    f"({where or 'counters only'}) — the sentinel caught "
+                    f"real silent corruption beyond what the fault "
+                    f"schedule explains; scrub the checkpoint tree "
+                    f"(tools/scrub.py) and check the host's memory/disk "
+                    f"health")
+            else:
+                print(f"perf_report --check: integrity mismatches {n} "
+                      f"<= {max_integrity_mismatches}")
     if max_replay_batches is not None:
         n = replayed_batches(lines)
         if n > max_replay_batches:
@@ -1139,6 +1236,16 @@ def main(argv=None):
                          "(paddle_tpu/core/locks.py).  Fails on a file "
                          "with no lock telemetry at all — zero evidence "
                          "must not gate green")
+    ap.add_argument("--max-integrity-mismatches", type=int, default=None,
+                    metavar="N",
+                    help="gate silent-corruption detections at <= N: "
+                         "integrity_event records (live digest "
+                         "divergences + at-rest file mismatches; "
+                         "walk-back ckpt_rejected echoes render but "
+                         "don't double-bill) with integrity.* counter "
+                         "fallback (paddle_tpu/integrity.py).  Fails on "
+                         "a file with no integrity evidence at all — "
+                         "zero evidence must not gate green")
     ap.add_argument("--max-step-skew-frac", type=float, default=None,
                     metavar="FRAC",
                     help="gate the MAX sustained straggler lag, in step "
@@ -1166,7 +1273,8 @@ def main(argv=None):
                      args.max_data_corrupt_frac, args.max_replay_batches,
                      args.max_step_skew_frac, args.max_gang_resizes,
                      args.max_shed_frac, args.max_p99_ms,
-                     args.max_lock_wait_frac)
+                     args.max_lock_wait_frac,
+                     args.max_integrity_mismatches)
     if args.diff:
         print(diff(*args.diff))
         return 0

@@ -46,6 +46,7 @@ MODULES = [
     "paddle_tpu.dist_resilience",
     # elastic N->M resume (ISSUE 9): the cursor-repartition module
     "paddle_tpu.elastic",
+    "paddle_tpu.integrity",
     # serving runtime (ISSUE 11): batching server, model registry,
     # verified hot reload
     "paddle_tpu.serving",

@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Scrub checkpoint trees and inference-model dirs for silent corruption
+(paddle_tpu/integrity.py — ISSUE 14).
+
+    python tools/scrub.py ROOT [ROOT2 ...]
+        Walk each root, find every checkpoint / inference-model directory
+        (anything carrying a __manifest__.json, __sharded_manifest__.json,
+        or __model__.json) plus every RecordIO file (identified by chunk
+        magic, not extension), and render a findings table: re-hash every
+        manifest-stamped file against its recorded sha256 + byte length,
+        flag files a manifest names but the disk lost, and run the native
+        CRC scanner over the RecordIO chunks.
+
+    python tools/scrub.py --check ROOT [...]
+        CI gate (same shape as program_lint/concurrency_lint --check):
+        exit 1 on any error-class finding — digest_mismatch,
+        bytes_mismatch, missing_file, manifest_error, corrupt RecordIO
+        chunks.  Warnings (undigested legacy manifest entries,
+        uncommitted pending dirs the restore walk-back already refuses)
+        never fail the gate.  Wired into tier-1 via
+        tests/test_integrity.py, so a clean tree stays provably clean.
+
+This is the OFFLINE half of the corruption defense: the live digests
+catch in-memory rot between checkpoints, the load-path verification
+catches rot at restore/publish time, and the scrub finds it while the
+data merely sits — before any restore has to discover it the hard way.
+
+Exit codes: 0 clean (warnings allowed), 1 error findings.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import struct
+import sys
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RECORDIO_MAGIC = 0x01020304
+
+# error classes fail --check; anything else renders as a warning
+ERROR_CLASSES = ("digest_mismatch", "bytes_mismatch", "missing_file",
+                 "manifest_error", "corrupt_chunks")
+
+
+def _fmt_table(rows, headers):
+    widths = [max(len(str(r[i])) for r in rows + [headers])
+              for i in range(len(headers))]
+    out = ["  ".join(str(h).ljust(w) for h, w in zip(headers, widths))]
+    for r in rows:
+        out.append("  ".join(str(c).ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+def is_recordio(path: str) -> bool:
+    """RecordIO files are identified by their chunk-header magic, not by
+    extension — dataset files are named whatever the producer liked."""
+    try:
+        with open(path, "rb") as f:
+            head = f.read(4)
+    except OSError:
+        return False
+    return len(head) == 4 and struct.unpack("<I", head)[0] == RECORDIO_MAGIC
+
+
+def _is_snapshot_dir(d: str) -> bool:
+    from paddle_tpu import io as _io
+
+    return any(os.path.exists(os.path.join(d, m))
+               for m in (_io.MANIFEST, _io.SHARDED_MANIFEST,
+                         _io.MODEL_FILENAME))
+
+
+def _count_chunks(path: str) -> int:
+    """Framed chunks in a RecordIO file (header walk, tolerant of a
+    broken tail — the same framing faults._mutate_chunk navigates)."""
+    n = 0
+    with open(path, "rb") as f:
+        data = f.read()
+    off = 0
+    while off + 20 <= len(data):
+        magic, _nrecs = struct.unpack_from("<II", data, off)
+        (plen,) = struct.unpack_from("<Q", data, off + 8)
+        if magic != RECORDIO_MAGIC or off + 20 + plen > len(data):
+            break
+        n += 1
+        off += 20 + int(plen)
+    return n
+
+
+def scan_recordio(path: str):
+    """(records, chunks, corrupt_chunks) via the native tolerant scanner
+    — the same CRC path production reads take, not a reimplementation.
+    The per-run corrupt budget is parked out of the way for the scan (a
+    scrub COUNTS corruption, it does not spend a training run's budget)
+    and restored after."""
+    from paddle_tpu import recordio
+    from paddle_tpu.flags import get_flags, set_flags
+
+    prev = get_flags("FLAGS_data_corrupt_budget")["FLAGS_data_corrupt_budget"]
+    set_flags({"FLAGS_data_corrupt_budget": 1 << 30})
+    try:
+        recordio.reset_corrupt_spent()
+        sc = recordio.Scanner(path, tolerant=True)
+        records = sum(1 for _ in sc)
+        # the scanner closes itself at exhaustion; the property reports
+        # the settled count
+        corrupt = int(sc.corrupt_chunks)
+        return records, _count_chunks(path), corrupt
+    finally:
+        set_flags({"FLAGS_data_corrupt_budget": prev})
+        recordio.reset_corrupt_spent()
+
+
+def scan_roots(roots):
+    """Walk the roots; returns (findings, stats).  A finding is
+    (where, class, detail); stats counts what was covered so the report
+    can say "clean" with a denominator instead of a shrug."""
+    from paddle_tpu import integrity
+    from paddle_tpu.checkpoint_manager import COMMITTED_MARKER, DIST_MARKER
+
+    findings = []
+    stats = {"dirs": 0, "files_hashed": 0, "recordio_files": 0,
+             "recordio_chunks": 0}
+    for root in roots:
+        if os.path.isfile(root):
+            candidates = [root]
+            walk = []
+        else:
+            walk = sorted(os.walk(root))
+            candidates = []
+        for dirpath, _dirnames, filenames in walk:
+            if _is_snapshot_dir(dirpath):
+                stats["dirs"] += 1
+                if dirpath.rstrip(os.sep).endswith(".tmp"):
+                    findings.append((dirpath, "pending_tmp",
+                                     "uncommitted pending dir (restore "
+                                     "already refuses it)"))
+                elif (os.path.exists(os.path.join(dirpath, DIST_MARKER))
+                      and not os.path.exists(
+                          os.path.join(dirpath, COMMITTED_MARKER))):
+                    findings.append((dirpath, "uncommitted",
+                                     "distributed save without COMMITTED "
+                                     "marker (torn commit)"))
+                dir_findings = integrity.scan_snapshot_dir(dirpath)
+                for f in dir_findings:
+                    findings.append((os.path.join(dirpath, f["file"])
+                                     if f["class"] != "manifest_error"
+                                     else f["file"],
+                                     f["class"], f["detail"]))
+                # count entries only when the manifests parsed — a torn
+                # manifest is already a manifest_error finding, and
+                # re-walking it here would crash the whole scan (one
+                # rotted manifest must never mask every other root)
+                if not any(f["class"] == "manifest_error"
+                           for f in dir_findings):
+                    try:
+                        stats["files_hashed"] += sum(
+                            1 for _ in
+                            integrity._manifest_file_entries(dirpath))
+                    except Exception:
+                        pass
+            candidates.extend(os.path.join(dirpath, fn)
+                              for fn in sorted(filenames))
+        for path in candidates:
+            if not is_recordio(path):
+                continue
+            stats["recordio_files"] += 1
+            try:
+                _records, chunks, corrupt = scan_recordio(path)
+            except Exception as e:
+                findings.append((path, "corrupt_chunks",
+                                 f"scan died: {type(e).__name__}: {e}"))
+                continue
+            stats["recordio_chunks"] += chunks
+            if corrupt:
+                findings.append((path, "corrupt_chunks",
+                                 f"{corrupt} CRC-failed chunk(s) of "
+                                 f"{chunks}"))
+    return findings, stats
+
+
+def render(roots):
+    findings, stats = scan_roots(roots)
+    errors = [f for f in findings if f[1] in ERROR_CLASSES]
+    parts = [f"# scrub  roots={list(roots)}  snapshot dirs={stats['dirs']}  "
+             f"files hashed={stats['files_hashed']}  "
+             f"recordio files={stats['recordio_files']} "
+             f"(chunks {stats['recordio_chunks']})"]
+    if findings:
+        parts.append("\n## findings\n" + _fmt_table(
+            [(w, c, d) for w, c, d in findings],
+            ["where", "class", "detail"]))
+    else:
+        parts.append("\nno findings — tree is clean")
+    return "\n".join(parts), findings, len(errors)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("roots", nargs="+",
+                    help="checkpoint roots / model dirs / dataset dirs "
+                         "(or single RecordIO files) to scrub")
+    ap.add_argument("--check", action="store_true",
+                    help="CI gate: exit 1 on any error-class finding "
+                         f"({', '.join(ERROR_CLASSES)})")
+    args = ap.parse_args(argv)
+
+    text, findings, n_errors = render(args.roots)
+    print(text)
+    if args.check:
+        if n_errors:
+            print(f"\nCHECK FAILED: {n_errors} error finding(s)")
+            return 1
+        warn = len(findings) - n_errors
+        print(f"\nCHECK OK: 0 errors"
+              + (f" ({warn} warning(s))" if warn else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
