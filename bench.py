@@ -9,7 +9,10 @@ the serial-vs-overlapped loop A/B (paddle_tpu.pipeline.train_loop +
 Executor.run_async) and prints its own JSON line with both rates and
 host-blocked fractions.  `--chaos` runs the resilient loop under a fixed
 injected fault schedule (paddle_tpu.faults) and reports throughput plus
-the recovery ledger — the robustness overhead as a number.  With a
+the recovery ledger — the robustness overhead as a number; a storage
+spec (enospc@S / ro_fs@S / eio@N / slow_io@N:MS) routes to the
+storage-fault A/B, reporting the degraded-window length, recovery
+overhead, and the bit-identical-parity bit.  With a
 distributed spec (kill_worker@S:RANK), `--elastic` adds the ISSUE-9 arm:
 the same kill under elastic supervision (shrink to N-1, grow back),
 reporting resize overhead and post-resize throughput next to the
@@ -830,6 +833,108 @@ def bench_chaos_data(fault_spec="corrupt_chunk@2", steps=32, batch_size=64,
             "batch_size": batch_size, "chunk_records": chunk_records}
 
 
+def bench_chaos_storage(fault_spec="enospc@12", steps=36, batch_size=256,
+                        save_every=6, max_inflight=3):
+    """Storage-fault A/B (ISSUE 15): the same seeded MLP trained under
+    `resilient_train_loop` with periodic checkpoints twice — once on
+    healthy storage, once with the fault injector failing the io.py choke
+    point (`enospc@S` / `ro_fs@S` / `eio@N` / `slow_io@N:MS`).  Reports
+    both rates, the DEGRADED WINDOW (steps training ran past its last
+    committed checkpoint while the store failed), the recovery overhead
+    (retries + skipped save rounds as wall-clock), and the parity bit:
+    storage faults drop no batches, so the chaos run's end-state params
+    must be BIT-IDENTICAL to the clean run's — surviving the store is
+    free of training-semantics cost by construction, and this proves it."""
+    import tempfile
+
+    import paddle_tpu as fluid
+    from paddle_tpu import monitor
+    from paddle_tpu.checkpoint_manager import CheckpointManager
+    # parity via the integrity module's full-state content digest — ONE
+    # digest definition shared with the sentinel, not another hand-rolled
+    # scope hash that could silently drift from it
+    from paddle_tpu.integrity import state_digest as digest
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = fluid.layers.data("x", [64], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h = fluid.layers.fc(x, 256, act="relu")
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(h, 1), y))
+        fluid.optimizer.Adam(1e-3).minimize(loss)
+    startup.random_seed = main_p.random_seed = 7
+    rng = np.random.RandomState(0)
+    feeds = []
+    for _ in range(steps):
+        xv = rng.rand(batch_size, 64).astype("f4")
+        feeds.append({"x": xv, "y": xv.sum(1, keepdims=True)})
+
+    def run(spec):
+        exe = fluid.Executor(fluid.TPUPlace(0))
+        scope = fluid.Scope()
+        exe.run(startup, scope=scope)
+        cm = CheckpointManager(tempfile.mkdtemp(prefix="pt-chaos-storage-"),
+                               program=main_p, scope=scope,
+                               save_every_steps=save_every)
+        t0 = _time.perf_counter()
+        stats = fluid.resilient_train_loop(
+            exe, main_p, lambda: list(feeds), [loss], scope=scope,
+            injector=fluid.FaultInjector(spec) if spec else None,
+            checkpoint_manager=cm,
+            policy=fluid.RetryPolicy(backoff_base_s=0.0),
+            max_inflight=max_inflight, log_period=8)
+        return stats, _time.perf_counter() - t0, cm, digest(scope)
+
+    run(None)  # warmup/compile outside both timing windows
+    monitor.enable()
+    clean_stats, clean_wall, _, clean_sha = run(None)
+    monitor.reset()  # the storage ledger must count the chaos run only
+    chaos_stats, chaos_wall, cm, chaos_sha = run(fault_spec)
+    counters = monitor.get_monitor().counter_values()
+    degraded = [r for r in monitor.step_records()
+                if r.get("kind") == "resilience_event"
+                and r.get("action") in ("storage_degraded",
+                                        "ckpt_round_skipped")]
+    recovered = [r for r in monitor.step_records()
+                 if r.get("kind") == "resilience_event"
+                 and r.get("action") == "storage_recovered"]
+    monitor.disable()
+    clean_sps = clean_stats.steps / clean_wall
+    chaos_sps = chaos_stats.steps / chaos_wall if chaos_wall else 0.0
+    # degraded window: first failed save round -> the recovering commit
+    # (steps of training that ran with no durable checkpoint behind them)
+    window = 0
+    if degraded:
+        end = recovered[0]["at_step"] if recovered \
+            else chaos_stats.steps
+        window = int(end - degraded[0]["at_step"]
+                     + degraded[0].get("lag_steps", 0))
+    parity = bool(chaos_sha == clean_sha)
+    print(f"chaos-storage: clean {clean_sps:.1f} steps/s, faulted "
+          f"{chaos_sps:.1f} steps/s ({len(degraded)} degraded round(s), "
+          f"window {window} steps, recovered={bool(recovered)}, "
+          f"parity={parity})", file=sys.stderr)
+    return {"metric": "chaos_storage_train_steps_per_sec",
+            "value": round(chaos_sps, 2), "unit": "steps/sec",
+            "clean_steps_per_sec": round(clean_sps, 2),
+            "storage_overhead": round(1.0 - chaos_sps / clean_sps, 4)
+            if clean_sps else 0.0,
+            "fault_spec": fault_spec, "steps": chaos_stats.steps,
+            "survived": bool(chaos_stats.steps == steps),
+            "degraded_rounds": len(degraded),
+            "degraded_window_steps": window,
+            "recovered": bool(recovered),
+            "save_retries": int(counters.get(
+                "resilience.ckpt_save_retries", 0)),
+            "storage_errors": int(counters.get(
+                "resilience.ckpt_storage_errors", 0)),
+            "committed_saves": int(counters.get("checkpoint.saves", 0)),
+            "parity": parity,
+            "batch_size": batch_size, "save_every": save_every,
+            "max_inflight": max_inflight}
+
+
 def bench_overlap(steps=16, n_procs=2, bucket_mb=4.0, batch_size=256,
                   width=1024, depth=4):
     """2-process backward-overlapped gradient all-reduce A/B (ISSUE 7):
@@ -1150,6 +1255,7 @@ def bench_chaos_integrity(fault_spec="rot_shard@1", steps=24, save_every=4,
 _DIST_FAULT_KINDS = ("kill_worker", "stall_worker")
 _DATA_FAULT_KINDS = ("corrupt_chunk", "truncated_file")
 _INTEGRITY_FAULT_KINDS = ("flip_bit", "rot_shard")
+_STORAGE_FAULT_KINDS = ("enospc", "eio@", "slow_io", "ro_fs")
 
 
 def main():
@@ -1188,6 +1294,9 @@ def main():
             print(json.dumps(bench_chaos_integrity(fault_spec)))
         elif fault_spec and any(k in fault_spec for k in _DATA_FAULT_KINDS):
             print(json.dumps(bench_chaos_data(fault_spec)))
+        elif fault_spec and any(k in fault_spec
+                                for k in _STORAGE_FAULT_KINDS):
+            print(json.dumps(bench_chaos_storage(fault_spec)))
         elif fault_spec:
             print(json.dumps(bench_chaos(fault_spec=fault_spec)))
         else:

@@ -25,6 +25,24 @@ crashed after its own shard landed can never leave a mixed-step
 directory that a restarted gang would happily load: either every rank's
 step N state is there, or the walk falls back to step N-k.
 
+Storage-fault resilience (ISSUE 15): `save` no longer dies on a failing
+store.  Transient storage errors (ENOSPC/EIO/EAGAIN/ETIMEDOUT, classified
+via `errors.StorageError` off the io.py choke point) are retried with the
+seeded-backoff `RetryPolicy`; terminal ones (EROFS/EACCES) skip straight
+past the retries.  A save that still cannot commit tries
+`FLAGS_ckpt_fallback_dir` (single-process managers; `restore` merges both
+roots) and then enters DEGRADED MODE: `save` returns None, training
+continues, the `resilience.ckpt_lag_steps` gauge and a `storage_degraded`
+event go loud, and `FLAGS_max_ckpt_lag_steps` bounds how long unprotected
+training may run before the lag converts to a terminal classified
+StorageError.  The next successful commit clears the latch
+(`storage_recovered` event, `resilience.ckpt_recovered` counter).  In a
+coordinated gang a rank whose shard write fails publishes a
+`SHARD_SKIP.p<rank>` marker instead of wedging rank 0's commit wait:
+rank 0 abandons the round gang-wide (`ckpt_round_skipped`) and every rank
+keeps training — one rank's full disk skips a checkpoint period, it does
+not burn a gang restart.
+
 Elastic N->M resume (ISSUE 9): every checkpoint records the world size
 that wrote it (the `DIST` marker; absent = 1).  `restore` compares it
 against the restoring manager's `world_size` — a mismatch on the default
@@ -45,6 +63,7 @@ sweep).
 """
 from __future__ import annotations
 
+import contextlib as _contextlib
 import logging
 import os
 import re
@@ -62,6 +81,21 @@ log = logging.getLogger("paddle_tpu.checkpoint")
 
 COMMITTED_MARKER = "COMMITTED"
 DIST_MARKER = "DIST"
+# storage degraded mode (ISSUE 15): a rank of a coordinated save whose
+# shard write failed its storage retries publishes this marker (raw
+# open, deliberately OUTSIDE the fault-injectable io choke point — it is
+# a tiny protocol signal, not checkpoint data) so rank 0 skips the round
+# gang-wide instead of waiting out the commit timeout
+SKIP_MARKER_PREFIX = "SHARD_SKIP.p"
+
+
+class _CommitSkipped(Exception):
+    """Internal: rank 0's shard wait found a peer's SHARD_SKIP marker —
+    the round is abandoned gang-wide (degraded mode), not failed."""
+
+    def __init__(self, ranks):
+        super().__init__(f"rank(s) {ranks} skipped the round")
+        self.ranks = list(ranks)
 # integrity quarantine (ISSUE 14): a checkpoint whose step postdates a
 # detected corruption window may have COMMITTED the corruption — its
 # at-rest digests verify (they hash what was saved), so the only safe
@@ -75,6 +109,7 @@ INTEGRITY_REJECTED_MARKER = "INTEGRITY_REJECTED"
 # (debris of a LARGER dead incarnation reusing the same step)
 _RANK_ARTIFACTS = (
     re.compile(r"^SHARD_DONE\.p(\d+)$"),
+    re.compile(r"^SHARD_SKIP\.p(\d+)$"),
     re.compile(r"^__sharded_manifest__\.p(\d+)\.json$"),
     re.compile(r"^RESUME\.p(\d+)\.json$"),
     re.compile(r"\.p(\d+)s\d+\.npy$"),
@@ -95,7 +130,8 @@ class CheckpointManager:
     def __init__(self, root: str, program=None, scope=None, keep: int = 3,
                  save_every_steps: int = 0, mesh=None,
                  rank: int = 0, world_size: int = 1,
-                 commit_timeout_s: float = 60.0, elastic: bool = False):
+                 commit_timeout_s: float = 60.0, elastic: bool = False,
+                 retry_policy=None, fallback_dir: Optional[str] = None):
         self.root = root
         self.program = program
         self.scope = scope
@@ -108,6 +144,21 @@ class CheckpointManager:
         # elastic=True opts restore into N->M re-sharding when the saved
         # world size differs from ours; the default raises instead
         self.elastic = bool(elastic)
+        # storage resilience (ISSUE 15): transient-save retry budget +
+        # backoff schedule (resilience.RetryPolicy; None = defaults, and
+        # resilient_train_loop shares its own policy in), the optional
+        # secondary root (None = FLAGS_ckpt_fallback_dir at save time),
+        # and the degraded-mode latch + lag ledger
+        self.retry_policy = retry_policy
+        self._fallback_dir = fallback_dir
+        self.degraded = False
+        self.ckpt_lag_steps = 0
+        # monitor-independent ledger (multi-process workers report these
+        # without a logger attached): failed/skipped save rounds and
+        # degraded->recovered transitions
+        self.storage_rounds_skipped = 0
+        self.storage_recoveries = 0
+        self._last_commit_step: Optional[int] = None
         # set by restore(): the world size that WROTE the restored
         # checkpoint and its directory — the resilience layer keys its
         # stream-cursor repartition on a mismatch with world_size
@@ -119,9 +170,26 @@ class CheckpointManager:
         self._deferred_signal = None
         os.makedirs(root, exist_ok=True)
 
+    @property
+    def fallback_dir(self) -> Optional[str]:
+        """The secondary checkpoint root tried when the primary store
+        fails (ctor arg wins, else FLAGS_ckpt_fallback_dir, else None)."""
+        if self._fallback_dir:
+            return self._fallback_dir
+        from .flags import flag
+
+        return flag("FLAGS_ckpt_fallback_dir") or None
+
+    def _policy(self):
+        if self.retry_policy is None:
+            from .resilience import RetryPolicy
+
+            self.retry_policy = RetryPolicy()
+        return self.retry_policy
+
     # -- saving ------------------------------------------------------------
-    def _dir(self, step: int) -> str:
-        return os.path.join(self.root, f"ckpt-{step:010d}")
+    def _dir(self, step: int, root: Optional[str] = None) -> str:
+        return os.path.join(root or self.root, f"ckpt-{step:010d}")
 
     def _var_names(self, scope):
         """Persistables plus the RNG key when the scope holds one, so a
@@ -155,33 +223,21 @@ class CheckpointManager:
         leaves an uncommitted `.tmp` dir that `restore` never considers,
         so no restarted worker can resume from a step its peers don't
         have.  Coordinated sidecar names must be rank-unique (the caller
-        namespaces them) — every rank writes its own before its marker."""
+        namespaces them) — every rank writes its own before its marker.
+
+        Storage faults (ISSUE 15) no longer propagate: transients are
+        retried per `retry_policy`, terminal ones fall through to the
+        fallback dir (single-process), and a save that still cannot
+        commit returns None with the manager in DEGRADED MODE (see the
+        module docstring for the full contract).  Non-storage failures
+        (peer death, commit timeout) raise exactly as before."""
         step = self._step if step is None else step
-        final = self._dir(step)
-        tmp = final + ".tmp"
         self._saving = True
         try:
             with _MON.span("checkpoint.save", step=step, rank=self.rank):
-                if self.world_size > 1:
-                    self._save_coordinated(tmp, final, step, sidecars)
-                else:
-                    if os.path.exists(tmp):
-                        shutil.rmtree(tmp)
-                    _io.save_sharded(tmp, var_names=self._var_names(self.scope),
-                                     scope=self.scope, program=self.program)
-                    for name, body in (sidecars or {}).items():
-                        with open(os.path.join(tmp, name), "w") as f:
-                            f.write(body)
-                    with open(os.path.join(tmp, "STEP"), "w") as f:
-                        f.write(str(step))
-                    with open(os.path.join(tmp, COMMITTED_MARKER), "w") as f:
-                        f.write(str(step))
-                    if os.path.exists(final):
-                        shutil.rmtree(final)
-                    os.rename(tmp, final)
-                    self._rotate()
-                    self._gc_stale_tmp(step)
-            _MON.counter("checkpoint.saves").inc()
+                out = self._save_resilient(step, sidecars)
+            if out is not None:
+                _MON.counter("checkpoint.saves").inc()
         finally:
             self._saving = False
             deferred = self._deferred_signal
@@ -190,7 +246,183 @@ class CheckpointManager:
                 # replay the preemption notice whether or not this save
                 # committed — a failed save must not swallow a SIGTERM
                 self._on_preempt(*deferred)
+        return out
+
+    def _save_resilient(self, step: int, sidecars=None) -> Optional[str]:
+        """One save round under the storage-resilience ladder: primary
+        (with transient retries) -> fallback dir -> degraded mode.
+        Returns the committed dir, or None when the round was skipped
+        (degraded).  Raises non-storage failures untouched, and a
+        terminal StorageError when the degraded lag exceeds
+        FLAGS_max_ckpt_lag_steps."""
+        from .errors import StorageError, classify
+
+        policy = self._policy()
+        attempt = 0
+        cause = None
+        while True:
+            try:
+                return self._save_once(step, sidecars, self.root)
+            except _CommitSkipped as e:
+                # a peer's (or our own) SHARD_SKIP: the round is abandoned
+                # gang-wide — no retry (the skipping rank already spent
+                # its own retries), no fallback (coordinated saves share
+                # one dir)
+                _MON.counter("resilience.ckpt_round_skipped").inc()
+                log.warning("checkpoint step %d: round skipped gang-wide "
+                            "(%s)", step, e)
+                return self._enter_degraded(step, e,
+                                            action="ckpt_round_skipped")
+            except Exception as e:
+                ce = classify(e)
+                if not isinstance(ce, StorageError):
+                    raise
+                cause = ce
+                _MON.counter("resilience.ckpt_storage_errors").inc()
+                if ce.transient and attempt < policy.max_storage_retries:
+                    delay = policy.backoff_s(attempt)
+                    attempt += 1
+                    _MON.counter("resilience.ckpt_save_retries").inc()
+                    log.warning(
+                        "checkpoint step %d: transient storage failure "
+                        "(%s); retry %d/%d in %.3fs", step, ce, attempt,
+                        policy.max_storage_retries, delay)
+                    if delay > 0:
+                        with _MON.span("resilience.ckpt_save_backoff",
+                                       attempt=attempt):
+                            time.sleep(delay)
+                    continue
+                break
+        # retries exhausted (or terminal errno): coordinated ranks tell
+        # rank 0 to skip the round; single-process managers try the
+        # fallback store before degrading
+        if self.world_size > 1:
+            self._publish_skip(step)
+            return self._enter_degraded(step, cause)
+        fb = self.fallback_dir
+        if fb:
+            try:
+                os.makedirs(fb, exist_ok=True)
+                # the fallback dir models a DIFFERENT device: injected
+                # primary-store faults must not follow the save there
+                with _io.fault_exempt(fb):
+                    out = self._save_once(step, sidecars, fb)
+                _MON.counter("resilience.ckpt_fallback_saves").inc()
+                _MON.record_step({
+                    "kind": "resilience_event", "action": "ckpt_fallback",
+                    "class": "StorageError", "at_step": step, "dir": out,
+                    "rank": self.rank})
+                log.warning("checkpoint step %d: primary root failed (%s); "
+                            "committed to fallback %s", step, cause, out)
+                return out
+            except Exception as e:
+                ce = classify(e)
+                if not isinstance(ce, StorageError):
+                    raise
+                log.warning("checkpoint step %d: fallback dir failed too "
+                            "(%s)", step, ce)
+                cause = ce
+        return self._enter_degraded(step, cause)
+
+    def _save_once(self, step: int, sidecars, root: str) -> str:
+        """One commit attempt into `root` (the historical save body)."""
+        final = self._dir(step, root)
+        tmp = final + ".tmp"
+        if self.world_size > 1:
+            self._save_coordinated(tmp, final, step, sidecars)
+            return final
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        _io.save_sharded(tmp, var_names=self._var_names(self.scope),
+                         scope=self.scope, program=self.program)
+        for name, body in (sidecars or {}).items():
+            _io.atomic_write(os.path.join(tmp, name), body)
+        _io.atomic_write(os.path.join(tmp, "STEP"), str(step))
+        _io.atomic_write(os.path.join(tmp, COMMITTED_MARKER), str(step))
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+        self._note_commit(step)
+        self._rotate(root)
+        self._gc_stale_tmp(step, root)
         return final
+
+    def _publish_skip(self, step: int):
+        """Best-effort SHARD_SKIP marker into the shared pending dir so
+        rank 0 skips the round instead of waiting out commit_timeout_s.
+        Raw open, outside the io choke point: the marker is a protocol
+        signal about the failure, and on a genuinely dead store its own
+        write may fail too — then rank 0's wait times out classified,
+        exactly the pre-existing behavior."""
+        tmp = self._dir(step) + ".tmp"
+        try:
+            os.makedirs(tmp, exist_ok=True)
+            with open(os.path.join(
+                    tmp, f"{SKIP_MARKER_PREFIX}{self.rank}"), "w") as f:
+                f.write(str(step))
+        except OSError as e:
+            log.warning("checkpoint step %d: could not publish SHARD_SKIP "
+                        "(%s); rank 0 will time the round out", step, e)
+
+    def _enter_degraded(self, step: int, cause=None,
+                        action: str = "storage_degraded") -> None:
+        """Latch degraded mode for one failed save round: training
+        continues, the lag gauge and event go loud, and the bounded-lag
+        conversion keeps unprotected training finite.  Returns None (what
+        `save` hands back for a skipped round)."""
+        from .errors import StorageError
+        from .flags import flag
+
+        last = self._last_commit_step
+        lag = max(0, step - (last if last is not None else 0))
+        first = not self.degraded
+        self.degraded = True
+        self.storage_rounds_skipped += 1
+        self.ckpt_lag_steps = lag
+        _MON.gauge("resilience.ckpt_lag_steps").set(lag)
+        if first:
+            _MON.counter("resilience.storage_degraded").inc()
+        _MON.record_step({
+            "kind": "resilience_event", "action": action,
+            "class": "StorageError", "at_step": step, "lag_steps": lag,
+            "last_commit_step": last, "rank": self.rank,
+            "cause": str(cause) if cause is not None else None})
+        log.warning(
+            "checkpoint step %d: save failed (%s) — DEGRADED MODE: "
+            "training continues UNPROTECTED, %d step(s) past the last "
+            "committed checkpoint (%s)", step, cause, lag,
+            last if last is not None else "none")
+        bound = int(flag("FLAGS_max_ckpt_lag_steps"))
+        if bound > 0 and lag > bound:
+            since = (f"since the step-{last} commit" if last is not None
+                     else "since the start of the run (nothing ever "
+                          "committed)")
+            err = StorageError(
+                f"checkpoint lag of {lag} step(s) exceeds "
+                f"FLAGS_max_ckpt_lag_steps={bound}: the store has been "
+                f"failing {since} and unprotected training may not "
+                f"continue — fix the store (or widen the bound)",
+                transient=False, op="write", step=step)
+            err.__cause__ = cause
+            raise err
+        return None
+
+    def _note_commit(self, step: int):
+        """Successful-commit bookkeeping: reset the lag ledger and clear
+        the degraded latch (recovery goes as loud as the failure did)."""
+        self._last_commit_step = step
+        self.ckpt_lag_steps = 0
+        _MON.gauge("resilience.ckpt_lag_steps").set(0)
+        if self.degraded:
+            self.degraded = False
+            self.storage_recoveries += 1
+            _MON.counter("resilience.ckpt_recovered").inc()
+            _MON.record_step({
+                "kind": "resilience_event", "action": "storage_recovered",
+                "class": "StorageError", "at_step": step,
+                "rank": self.rank})
+            log.info("checkpoint step %d: storage recovered — degraded "
+                     "mode cleared", step)
 
     def _save_coordinated(self, tmp: str, final: str, step: int,
                           sidecars=None):
@@ -198,21 +430,28 @@ class CheckpointManager:
         # writing into it (the launcher clears stale .tmp debris between
         # gang incarnations instead)
         os.makedirs(tmp, exist_ok=True)
+        # clear OUR stale SHARD_SKIP from a previous round of this step
+        # (a restart replays the step): this round gets a fresh verdict
+        try:
+            os.remove(os.path.join(tmp, f"{SKIP_MARKER_PREFIX}{self.rank}"))
+        except OSError:
+            pass
         _io.save_sharded(tmp, var_names=self._var_names(self.scope),
                          scope=self.scope, program=self.program,
                          process_index=self.rank)
         for name, body in (sidecars or {}).items():
-            with open(os.path.join(tmp, name), "w") as f:
-                f.write(body)
-        with open(os.path.join(tmp, DIST_MARKER), "w") as f:
-            f.write(str(self.world_size))
-        done = os.path.join(tmp, f"SHARD_DONE.p{self.rank}")
-        with open(done + ".tmp", "w") as f:
-            f.write(str(step))
-        os.replace(done + ".tmp", done)  # marker lands whole or not at all
+            _io.atomic_write(os.path.join(tmp, name), body)
+        _io.atomic_write(os.path.join(tmp, DIST_MARKER),
+                         str(self.world_size))
+        # marker lands whole or not at all (atomic_write renames into place)
+        _io.atomic_write(os.path.join(tmp, f"SHARD_DONE.p{self.rank}"),
+                         str(step))
         if self.rank != 0:
             # commit is rank 0's job; peers proceed — the checkpoint only
-            # matters at restart, and an uncommitted one is invisible there
+            # matters at restart, and an uncommitted one is invisible
+            # there.  This rank's own store worked, which is what ITS
+            # degraded latch tracks (rank 0 owns the gang-wide verdict).
+            self._note_commit(step)
             return
         self._wait_for_shards(tmp, step)
         # ghost sweep BEFORE the commit marker: a pending dir reused at
@@ -222,14 +461,13 @@ class CheckpointManager:
         # sizes in one checkpoint (the manifest merge at load would stitch
         # in ghost shards with divergent values).
         self._sweep_ghost_ranks(tmp)
-        with open(os.path.join(tmp, "STEP"), "w") as f:
-            f.write(str(step))
-        with open(os.path.join(tmp, COMMITTED_MARKER), "w") as f:
-            f.write(str(step))
+        _io.atomic_write(os.path.join(tmp, "STEP"), str(step))
+        _io.atomic_write(os.path.join(tmp, COMMITTED_MARKER), str(step))
         if os.path.exists(final):
             shutil.rmtree(final)
         os.rename(tmp, final)
         _MON.counter("checkpoint.commits").inc()
+        self._note_commit(step)
         self._rotate()
         self._gc_stale_tmp(step)
 
@@ -237,14 +475,26 @@ class CheckpointManager:
         """Rank 0's bounded rendezvous: every rank's SHARD_DONE marker for
         THIS step, or a classified raise.  Heartbeat-aware — a peer that
         died mid-save surfaces as PeerFailureError immediately instead of
-        burning the whole commit timeout."""
+        burning the whole commit timeout.  A peer that could not WRITE its
+        shards (storage fault, not death) publishes SHARD_SKIP instead,
+        which abandons the round gang-wide (`_CommitSkipped`) — degraded
+        mode, not a classified failure."""
         from .dist_resilience import active_heartbeat
         from .errors import CollectiveTimeoutError, PeerFailureError
 
         deadline = time.monotonic() + self.commit_timeout_s
         while True:
             missing = []
+            skipped = []
             for r in range(self.world_size):
+                skip = os.path.join(tmp, f"{SKIP_MARKER_PREFIX}{r}")
+                try:
+                    with open(skip) as f:
+                        if int(f.read().strip() or -1) == step:
+                            skipped.append(r)
+                            continue
+                except (OSError, ValueError):
+                    pass
                 marker = os.path.join(tmp, f"SHARD_DONE.p{r}")
                 try:
                     with open(marker) as f:
@@ -253,6 +503,8 @@ class CheckpointManager:
                     ok = False
                 if not ok:  # absent, unreadable, or a stale ghost's step
                     missing.append(r)
+            if skipped:
+                raise _CommitSkipped(skipped)
             if not missing:
                 return
             hb = active_heartbeat()
@@ -273,20 +525,23 @@ class CheckpointManager:
                     collective="checkpoint.commit", step=step)
             time.sleep(0.05)
 
-    def _rotate(self):
-        ckpts = self.checkpoints()
+    def _rotate(self, root: Optional[str] = None):
+        root = root or self.root
+        ckpts = self.checkpoints(root)
         for d in ckpts[:-self.keep] if self.keep > 0 else []:
-            shutil.rmtree(os.path.join(self.root, d), ignore_errors=True)
+            shutil.rmtree(os.path.join(root, d), ignore_errors=True)
 
     # -- checkpoint GC (ISSUE 9) -------------------------------------------
-    def _gc_stale_tmp(self, committed_step: int) -> int:
+    def _gc_stale_tmp(self, committed_step: int,
+                      root: Optional[str] = None) -> int:
         """Sweep uncommitted pending dirs at or below the just-committed
         step: debris of dead incarnations (a gang killed mid-save leaves
         its `.tmp` behind, and repeated restarts accumulate one per
         failed save).  Pending dirs for LATER steps are left alone — a
         peer may legitimately be writing one right now."""
         removed = 0
-        for name in os.listdir(self.root):
+        root = root or self.root
+        for name in os.listdir(root):
             if not (name.startswith("ckpt-") and name.endswith(".tmp")):
                 continue
             try:
@@ -294,7 +549,7 @@ class CheckpointManager:
             except ValueError:
                 continue
             if step <= committed_step:
-                shutil.rmtree(os.path.join(self.root, name),
+                shutil.rmtree(os.path.join(root, name),
                               ignore_errors=True)
                 removed += 1
         if removed:
@@ -345,34 +600,47 @@ class CheckpointManager:
         attempts close the rename race (the rename happens at most
         once).  Idempotent and multi-writer safe; a LATER save that
         legitimately reuses the step replaces the whole dir, marker
-        included, so post-recovery checkpoints are trusted again."""
+        included, so post-recovery checkpoints are trusted again.
+
+        Scans the PRIMARY root and (when configured) the FALLBACK dir:
+        a degraded-window save that committed to the fallback store is
+        just as reachable by `restore`'s merged walk, so a poisoned one
+        must carry the marker too — otherwise the quarantine would be
+        bypassed by exactly the checkpoints written while storage (and
+        possibly the host) was at its least healthy."""
         marked = 0
-        try:
-            names = os.listdir(self.root)
-        except OSError:
-            return 0
-        steps = {}
-        for name in names:
-            m = re.match(r"^ckpt-(\d+)(\.tmp)?$", name)
-            if m and int(m.group(1)) > max_safe_step:
-                steps.setdefault(int(m.group(1)), set()).add(name)
+        roots = [self.root]
+        fb = self.fallback_dir
+        if fb and os.path.abspath(fb) != os.path.abspath(self.root):
+            roots.append(fb)
         body = f"unsafe: newer than proven-clean step {max_safe_step}"
-        for step, found in sorted(steps.items()):
-            final = f"ckpt-{step:010d}"
-            # EVERY live name gets a marker — a reused step can exist as
-            # a committed final AND a pending tmp at once, and the tmp's
-            # commit would wholesale-replace the final (marker included);
-            # the trailing final attempt covers a tmp renamed mid-scan
-            for name in (*sorted(found), final):
-                d = os.path.join(self.root, name)
-                marker = os.path.join(d, INTEGRITY_REJECTED_MARKER)
-                try:
-                    if os.path.isdir(d) and not os.path.exists(marker):
-                        with open(marker, "w") as f:
-                            f.write(body)
-                        marked += 1
-                except OSError:
-                    continue  # renamed/rotated under us: next name
+        for root in roots:
+            try:
+                names = os.listdir(root)
+            except OSError:
+                continue
+            steps = {}
+            for name in names:
+                m = re.match(r"^ckpt-(\d+)(\.tmp)?$", name)
+                if m and int(m.group(1)) > max_safe_step:
+                    steps.setdefault(int(m.group(1)), set()).add(name)
+            for step, found in sorted(steps.items()):
+                final = f"ckpt-{step:010d}"
+                # EVERY live name gets a marker — a reused step can exist
+                # as a committed final AND a pending tmp at once, and the
+                # tmp's commit would wholesale-replace the final (marker
+                # included); the trailing final attempt covers a tmp
+                # renamed mid-scan
+                for name in (*sorted(found), final):
+                    d = os.path.join(root, name)
+                    marker = os.path.join(d, INTEGRITY_REJECTED_MARKER)
+                    try:
+                        if os.path.isdir(d) and not os.path.exists(marker):
+                            with open(marker, "w") as f:
+                                f.write(body)
+                            marked += 1
+                    except OSError:
+                        continue  # renamed/rotated under us: next name
         if marked:
             log.warning("integrity: quarantined %d checkpoint(s) newer "
                         "than proven-clean step %d", marked, max_safe_step)
@@ -387,13 +655,37 @@ class CheckpointManager:
         except (OSError, ValueError):
             return 1
 
-    def checkpoints(self):
-        return sorted(d for d in os.listdir(self.root)
+    def checkpoints(self, root: Optional[str] = None):
+        """Committed checkpoint names under `root` (default: the primary
+        root).  An unlistable PRIMARY root raises — a restore that
+        silently saw [] on a transiently-down store would restart
+        training from scratch and abandon all committed progress; dying
+        loudly lets the gang supervisor retry until the store is back."""
+        return sorted(d for d in os.listdir(root or self.root)
                       if d.startswith("ckpt-") and not d.endswith(".tmp"))
 
+    def _candidates(self):
+        """[(name, root)] of every committed checkpoint dir across the
+        primary root and (when configured) the fallback dir, sorted by
+        step — the restore walk iterates it newest-first.  On a step
+        present in both roots the PRIMARY copy sorts newer (it is the
+        store of record; the fallback copy of the same step was a
+        redundant earlier commit).  Only the OPTIONAL fallback root may
+        be unlistable without consequence (never configured to exist, or
+        its device is gone — the primary copies still restore)."""
+        out = [(n, self.root) for n in self.checkpoints()]
+        fb = self.fallback_dir
+        if fb and os.path.abspath(fb) != os.path.abspath(self.root):
+            try:
+                out.extend((n, fb) for n in self.checkpoints(fb))
+            except OSError:
+                pass
+        out.sort(key=lambda t: (t[0], t[1] == self.root))
+        return out
+
     def latest(self) -> Optional[str]:
-        c = self.checkpoints()
-        return os.path.join(self.root, c[-1]) if c else None
+        c = self._candidates()
+        return os.path.join(c[-1][1], c[-1][0]) if c else None
 
     def restore(self, scope=None, mesh=None,
                 max_step: Optional[int] = None,
@@ -421,10 +713,10 @@ class CheckpointManager:
         from .errors import CheckpointError
 
         elastic = self.elastic if elastic is None else bool(elastic)
-        ckpts = self.checkpoints()
+        ckpts = self._candidates()
         errors = []
-        for name in reversed(ckpts):
-            d = os.path.join(self.root, name)
+        for name, base in reversed(ckpts):
+            d = os.path.join(base, name)
             # a distributed checkpoint without its rank-0 COMMITTED marker
             # is a mixed-step landmine: some ranks' shards are step N,
             # others never arrived.  Skip it outright — the walk continues
@@ -472,7 +764,9 @@ class CheckpointManager:
             try:
                 with _MON.span("checkpoint.restore", step=step,
                                saved_world=saved_world,
-                               world=self.world_size):
+                               world=self.world_size), \
+                        _io.fault_exempt(base) if base != self.root \
+                        else _contextlib.nullcontext():
                     _io.load_sharded(d, scope=scope or self.scope,
                                      mesh=mesh or self.mesh,
                                      row_shard=(self.rank, self.world_size))
@@ -502,6 +796,9 @@ class CheckpointManager:
             self._step = step
             self.restored_world = saved_world
             self.last_restored_dir = d
+            # the restored checkpoint is a durable point: degraded-lag
+            # accounting (and a later bounded-lag verdict) measure from it
+            self._last_commit_step = step
             if saved_world != self.world_size:
                 _MON.counter("checkpoint.elastic_restores").inc()
                 _MON.record_step({

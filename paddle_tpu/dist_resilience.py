@@ -129,13 +129,25 @@ class _FileTransport:
         return os.path.join(self.root, f"hb-{rank}")
 
     def send(self, seq: int, payload: Optional[dict] = None):
-        tmp = self._path(self.rank) + ".tmp"
-        with open(tmp, "w") as f:
-            if payload:
-                f.write(json.dumps({"seq": seq, "tel": payload}))
-            else:
-                f.write(str(seq))
-        os.replace(tmp, self._path(self.rank))
+        # through the io.py storage choke point (ISSUE 15): a full disk
+        # under the heartbeat dir now raises OSError to the beat loop —
+        # which counts it LOUDLY and keeps beating — instead of being the
+        # invisible write failure that made a live rank read as dead.
+        # fsync=False: a beat is worthless the moment the next one lands,
+        # and 2+ fsyncs/sec/rank on a shared filesystem is pure churn.
+        # fault_exempt: INJECTED storage faults must not hit beats — the
+        # beat thread writes on its own clock, so op-indexed specs would
+        # count a timing-dependent stream (breaking "firing points are
+        # exact indices") and a step-window ro_fs would fake the target
+        # rank's death instead of exercising degraded mode.  REAL
+        # OSErrors (and test hooks installed directly via
+        # io.set_io_fault_hook) still reach the loud path above.
+        from . import io as _io
+
+        body = json.dumps({"seq": seq, "tel": payload}) if payload \
+            else str(seq)
+        with _io.fault_exempt(self.root):
+            _io.atomic_write(self._path(self.rank), body, fsync=False)
 
     def poll(self) -> Dict[int, tuple]:
         """{peer rank: (latest sequence seen, telemetry payload or None)}
@@ -172,7 +184,10 @@ class _FileTransport:
             with open(os.path.join(self.root, f"DOWN-{self.rank}"), "w") as f:
                 f.write(str(os.getpid()))
         except OSError:
-            pass
+            # best-effort by design (peers fall back to staleness), but
+            # no longer silent: a full disk eating tombstones is the same
+            # storage failure the beat loop counts
+            _MON.counter("dist.heartbeat.send_errors").inc()
 
     def close(self):
         pass
@@ -353,12 +368,23 @@ class Heartbeat:
         self._straggler: Optional[tuple] = None
         self._straggler_seen = 0
         self._straggler_reported: Optional[int] = None
+        # consecutive beat-write failures (storage under the heartbeat
+        # dir failing, ISSUE 15) — loud on transition, never fatal
+        self._send_fail_streak = 0
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "Heartbeat":
         if self._thread is not None:
             return self
-        self.transport.send(self._seq)  # beat 0 before anything can block
+        try:
+            self.transport.send(self._seq)  # beat 0 before anything blocks
+        except OSError as e:
+            # the beat LOOP absorbs storage failures; beat 0 must too — a
+            # full disk at arm time should degrade liveness, not kill init
+            _MON.counter("dist.heartbeat.send_errors").inc()
+            print(f"dist_resilience: rank {self.rank} beat 0 write failed "
+                  f"({e}); the beat thread keeps trying",
+                  file=sys.stderr, flush=True)
         self._thread = threading.Thread(target=self._loop,
                                         name="pt-heartbeat", daemon=True)
         self._thread.start()
@@ -375,7 +401,37 @@ class Heartbeat:
             # straggler check compares it against peers' equally-stale
             # beat payloads (a LIVE local read vs stale peers fakes
             # sps*interval steps of lag on any fast-stepping gang)
-            self.transport.send(self._seq, payload)
+            try:
+                self.transport.send(self._seq, payload)
+            except OSError as e:
+                # storage under the heartbeat dir failed (full disk, EIO
+                # on the shared mount).  This used to be swallowed —
+                # peers then read a LIVE rank as dead and burned a gang
+                # restart on a disk hiccup.  Now: loud counter + event on
+                # each streak transition, and the beat thread keeps
+                # running (the next beat may land; liveness must never
+                # die of a transient write failure).
+                self._send_fail_streak += 1
+                _MON.counter("dist.heartbeat.send_errors").inc()
+                if self._send_fail_streak == 1:
+                    _MON.record_step({
+                        "kind": "dist_event",
+                        "action": "heartbeat_send_failed",
+                        "rank": self.rank, "seq": self._seq,
+                        "error": f"{type(e).__name__}: {e}"})
+                    print(f"dist_resilience: rank {self.rank} heartbeat "
+                          f"write FAILED ({e}) — peers may read this rank "
+                          f"as dead if the store stays down",
+                          file=sys.stderr, flush=True)
+                self.observe()
+                continue
+            if self._send_fail_streak:
+                _MON.record_step({
+                    "kind": "dist_event",
+                    "action": "heartbeat_send_recovered",
+                    "rank": self.rank, "seq": self._seq,
+                    "failed_beats": self._send_fail_streak})
+                self._send_fail_streak = 0
             _MON.counter("dist.heartbeat.sent").inc()
             self.observe()
             try:

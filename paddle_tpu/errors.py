@@ -31,6 +31,17 @@ routes on:
     CheckpointError       a checkpoint that must not be loaded as asked
                           (world-size mismatch without elastic opt-in,
                           inconsistent rank cursors) — never retried
+    StorageError          the storage layer itself failed an I/O operation
+                          (phase="storage", routed through the io.py choke
+                          point): TRANSIENT errnos (ENOSPC/EIO/EAGAIN/
+                          ETIMEDOUT — a filling disk, a flaky NFS mount, a
+                          throttled object store) are retried with seeded
+                          backoff and, for checkpoints, degrade to
+                          lag-bounded unprotected training instead of
+                          killing the worker; TERMINAL errnos (EROFS/
+                          EACCES) skip straight to the fallback dir /
+                          degraded mode — no retry changes a read-only
+                          mount
     IntegrityError        wrong-but-FINITE state (paddle_tpu/integrity.py):
                           a live cross-rank digest divergence named a
                           corrupt rank, or an at-rest sha256 in a
@@ -73,11 +84,22 @@ from __future__ import annotations
 __all__ = ["TrainingError", "DataError", "NumericError",
            "TransientDeviceError", "PreemptionError", "FatalError",
            "CheckpointError", "ServingError", "ResourceError",
-           "LockTimeoutError", "IntegrityError",
+           "LockTimeoutError", "IntegrityError", "StorageError",
            "DistributedError", "PeerFailureError", "CollectiveTimeoutError",
-           "classify", "attach_context", "get_context"]
+           "classify", "attach_context", "get_context",
+           "TRANSIENT_STORAGE_ERRNOS", "TERMINAL_STORAGE_ERRNOS"]
 
+import errno as _errno
 from typing import Optional
+
+# The storage-failure split (ISSUE 15).  Transient: the next attempt may
+# not see it (space is being freed, the mount is flapping, the store is
+# throttling).  Terminal: retrying cannot help — the filesystem is
+# read-only or the credentials are wrong; only a different destination
+# (FLAGS_ckpt_fallback_dir) or an operator can.
+TRANSIENT_STORAGE_ERRNOS = (_errno.ENOSPC, _errno.EIO, _errno.EAGAIN,
+                            _errno.ETIMEDOUT)
+TERMINAL_STORAGE_ERRNOS = (_errno.EROFS, _errno.EACCES)
 
 
 class TrainingError(RuntimeError):
@@ -209,6 +231,51 @@ class CheckpointError(TrainingError):
         self.current_world = current_world
 
 
+class StorageError(TrainingError):
+    """The storage layer failed an I/O operation (phase="storage" — every
+    checkpoint/manifest/sidecar/model-store byte crosses the `paddle_tpu.
+    io` choke point, which stamps the breadcrumb).  The `transient` bit is
+    the routing decision the whole resilience tier keys on:
+
+      * transient (ENOSPC, EIO, EAGAIN, ETIMEDOUT): retried with seeded
+        backoff (`RetryPolicy.max_storage_retries`); a checkpoint save
+        that exhausts its retries enters DEGRADED MODE — training
+        continues, `resilience.ckpt_lag_steps` rises, and a bounded lag
+        (`FLAGS_max_ckpt_lag_steps`) converts to this error re-raised
+        terminal, so unprotected training cannot run forever;
+      * terminal (EROFS, EACCES): retries are skipped — the fallback dir
+        (`FLAGS_ckpt_fallback_dir`) is tried, then degraded mode.
+
+    `op` is "read"/"write", `path` the failing file, `errno` the OS code
+    (mirrors OSError).  A transient publish-source failure retries WITHOUT
+    quarantining the snapshot (serving/publisher.py) — flaky I/O is not
+    evidence of rot."""
+
+    def __init__(self, message: str, *, op: Optional[str] = None,
+                 path: Optional[str] = None, errno: Optional[int] = None,
+                 transient: Optional[bool] = None, **kw):
+        kw.setdefault("phase", "storage")
+        super().__init__(message, **kw)
+        self.op = op
+        self.path = path
+        self.errno = errno
+        if transient is None:
+            transient = errno in TRANSIENT_STORAGE_ERRNOS
+        self.transient = bool(transient)
+
+    def __str__(self):
+        base = super().__str__()
+        ctx = []
+        if self.op:
+            ctx.append(f"op={self.op}")
+        if self.errno is not None:
+            ctx.append(f"errno={_errno.errorcode.get(self.errno, self.errno)}")
+        ctx.append("transient" if self.transient else "terminal")
+        if self.path:
+            ctx.append(f"path={self.path}")
+        return f"{base} [{', '.join(ctx)}]"
+
+
 class IntegrityError(TrainingError):
     """Silent data corruption made loud (paddle_tpu/integrity.py): state
     that is wrong but FINITE, which no NaN guard, CRC, or structure check
@@ -280,6 +347,12 @@ class ServingError(TrainingError):
                                    NaN weights, golden-smoke failure) and
                                    was quarantined — the old model keeps
                                    serving
+        reason="publish_io"        transient STORE I/O (EIO/timeout while
+                                   hashing or staging) exhausted the
+                                   publish retry budget — the snapshot is
+                                   NOT quarantined (flaky I/O is not
+                                   evidence of rot); retry when the store
+                                   settles
         reason="hbm_budget"        loading the model would exceed the HBM
                                    budget and eviction could not free
                                    enough
@@ -421,6 +494,20 @@ def classify(exc: BaseException, wrap_unknown: bool = False) -> BaseException:
             if code in msg:
                 kw.pop("phase", None)
                 return _wrap(TransientDeviceError, code=code, phase="device")
+    # Storage-layer failures (ISSUE 15): an OSError that crossed the io.py
+    # choke point carries phase="storage" and maps by errno onto the
+    # transient/terminal split.  Checked BEFORE the loader breadcrumb so a
+    # checkpoint read failing inside a producer thread stays a storage
+    # failure; a bare OSError with a storage errno and NO phase breadcrumb
+    # maps too (below, AFTER the loader check — an EIO while producing a
+    # data batch is the data layer's problem, handled by its own budget).
+    _eno = getattr(exc, "errno", None) if isinstance(exc, OSError) else None
+    _storage_errno = _eno in TRANSIENT_STORAGE_ERRNOS \
+        or _eno in TERMINAL_STORAGE_ERRNOS
+    if _storage_errno and ctx.get("phase") == "storage":
+        kw.pop("phase", None)
+        return _wrap(StorageError, errno=_eno,
+                     path=getattr(exc, "filename", None), phase="storage")
     # Producer-thread breadcrumb: the loader marks exceptions raised while
     # producing a batch, whatever their type (user generator bugs raise as
     # themselves but recovery treats them as data failures).  "feed" is the
@@ -428,6 +515,10 @@ def classify(exc: BaseException, wrap_unknown: bool = False) -> BaseException:
     # non-finite feed is a data failure caught before lowering.
     if ctx.get("phase") in ("loader", "feed"):
         return _wrap(DataError)
+    if _storage_errno and ctx.get("phase") is None:
+        kw.pop("phase", None)
+        return _wrap(StorageError, errno=_eno,
+                     path=getattr(exc, "filename", None), phase="storage")
     # The NaN/Inf guard's historical RuntimeError message.
     if isinstance(exc, (RuntimeError, FloatingPointError)) and "NaN/Inf" in msg:
         return _wrap(NumericError)

@@ -79,9 +79,42 @@ only the integrity sentinel (paddle_tpu/integrity.py) can catch:
                           checkpoint by digest and the publish ladder
                           must quarantine it
 
+storage entries (ISSUE 15) fire inside the I/O choke point every
+checkpoint/manifest/sidecar/model-store byte routes through
+(`io.atomic_write` / `io.open_for_read`; `arm_io()` registers this
+injector as the hook, `disarm_io()` removes it —
+`resilient_train_loop` arms automatically).  Step-window kinds track
+the current train step via `on_dispatch`/`set_step`; op-indexed kinds
+count choke-point operations.  Two exemptions keep injection
+deterministic: paths under `FLAGS_ckpt_fallback_dir` (the fallback dir
+models a different device, so a full primary disk must not also break
+it) and heartbeat-transport beats (the beat thread writes on its own
+clock — counting it would make op indices timing-dependent, and
+failing it would fake the rank's death instead of exercising degraded
+mode; real heartbeat-store failures still go loud via
+`dist.heartbeat.send_errors`):
+
+    enospc@S[:RANK]       every WRITE during train step S raises
+                          OSError(ENOSPC) — the save at step S fails
+                          all its retries, the next period's succeeds
+                          (the transient-full-disk window).  With :RANK
+                          only that worker's writes fail
+    ro_fs@S[:RANK]        every WRITE from step S ONWARD raises
+                          OSError(EROFS) — the terminal read-only-mount
+                          class that must skip retries and go straight
+                          to the fallback dir / degraded mode
+    eio@N[:PATH_GLOB]     the Nth (0-based) choke-point operation (read
+                          or write) whose path fnmatches PATH_GLOB
+                          (default *) raises OSError(EIO), exactly once
+                          — the one-shot flaky read a retry survives
+    slow_io@N:MS          the Nth choke-point operation sleeps MS
+                          milliseconds first (storage latency spike),
+                          then proceeds
+
     e.g.  FLAGS_fault_spec="bad_batch@2;nan@5;device@7:RESOURCE_EXHAUSTED;preempt@11"
           FLAGS_fault_spec="kill_worker@3:1;stall_worker@6:0:0.2"
           FLAGS_fault_spec="flip_bit@5:1;rot_shard@0"
+          FLAGS_fault_spec="enospc@4:1;eio@0:*__manifest__*;slow_io@2:250"
 
 `seed` only feeds the poison-value RNG; firing points are exact indices.
 The hooks (`on_batch`, `on_feed`, `on_dispatch`) are called by
@@ -92,6 +125,8 @@ from __future__ import annotations
 
 __all__ = ["Fault", "FaultInjector", "parse_fault_spec"]
 
+import errno as _errno
+import fnmatch
 import os
 import random
 import signal
@@ -108,11 +143,21 @@ from .monitor import MONITOR as _MON
 _KINDS = ("bad_batch", "nan", "device", "preempt",
           "kill_worker", "stall_worker",
           "corrupt_chunk", "truncated_file",
-          "flip_bit", "rot_shard")
+          "flip_bit", "rot_shard",
+          "enospc", "eio", "slow_io", "ro_fs")
 # entries that only fire in the worker whose rank matches their arg
 # (flip_bit is rank-gated too, but its rank is OPTIONAL — handled via
 # target_rank, which answers None for the rankless single-process form)
 _RANKED_KINDS = ("kill_worker", "stall_worker")
+# storage faults (ISSUE 15): fire inside the io.py choke point via the
+# on_io hook.  enospc/ro_fs are step-WINDOW kinds (active while the
+# tracked train step is at/past their index — a save's whole retry
+# sequence at step S fails, the next period's save succeeds); eio/
+# slow_io are op-INDEXED one-shots (the Nth matching choke-point
+# operation).  enospc/ro_fs take an optional rank like flip_bit
+_STORAGE_KINDS = ("enospc", "eio", "slow_io", "ro_fs")
+_STORAGE_ERRNO = {"enospc": _errno.ENOSPC, "eio": _errno.EIO,
+                  "ro_fs": _errno.EROFS}
 # on-disk data faults (ISSUE 5): mutate RecordIO files handed to
 # `on_files` — corrupt_chunk@N flips a payload byte of the Nth chunk
 # (CRC catches it), truncated_file@N cuts the file mid-payload of the
@@ -123,8 +168,12 @@ _FILE_KINDS = ("corrupt_chunk", "truncated_file")
 # PADDLE_FAULT_STATE_DIR ledger the same fault would fire forever.
 # flip_bit replays too (the restart restores PRE-flip state and replays
 # step S); rot_shard's marker doubles as the cross-rank mutex — every
-# rank observes the commit, exactly one may mutate the shard
-_LEDGER_KINDS = _RANKED_KINDS + _FILE_KINDS + ("flip_bit", "rot_shard")
+# rank observes the commit, exactly one may mutate the shard.  Storage
+# entries replay for the same reason: a restarted gang replays the step
+# whose failed save triggered the restart, and a fault that re-fires
+# forever would starve the run of checkpoints
+_LEDGER_KINDS = _RANKED_KINDS + _FILE_KINDS \
+    + ("flip_bit", "rot_shard") + _STORAGE_KINDS
 
 
 @dataclass
@@ -133,6 +182,12 @@ class Fault:
     at: int
     arg: Optional[str] = None
     fired: bool = False
+    # op-indexed storage entries count their matching choke-point
+    # operations here; `exhausted` marks an entry spent by a previous
+    # gang incarnation's ledger marker (inactive forever, unlike a
+    # step-window entry that stays active while its step lasts)
+    seen: int = 0
+    exhausted: bool = False
 
     def __str__(self):
         s = f"{self.kind}@{self.at}"
@@ -141,8 +196,8 @@ class Fault:
     @property
     def target_rank(self) -> Optional[int]:
         """Worker rank a ranked entry targets (None for per-process kinds
-        and for the rankless flip_bit@S form)."""
-        if self.kind == "flip_bit":
+        and for the rankless flip_bit@S / enospc@S / ro_fs@S forms)."""
+        if self.kind in ("flip_bit", "enospc", "ro_fs"):
             return int(self.arg) if self.arg else None
         if self.kind not in _RANKED_KINDS or not self.arg:
             return None
@@ -152,6 +207,11 @@ class Fault:
     def stall_s(self) -> float:
         assert self.kind == "stall_worker"
         return float(self.arg.split(":", 1)[1])
+
+    @property
+    def slow_ms(self) -> float:
+        assert self.kind == "slow_io"
+        return float(self.arg)
 
 
 def parse_fault_spec(spec: str) -> List[Fault]:
@@ -197,6 +257,18 @@ def parse_fault_spec(spec: str) -> List[Fault]:
             if arg is not None:
                 raise ValueError(f"fault spec entry {entry!r}: want "
                                  f"rot_shard@COMMIT_INDEX (no extra arg)")
+        elif kind in ("enospc", "ro_fs"):
+            if arg is not None and not arg.isdigit():
+                raise ValueError(f"fault spec entry {entry!r}: want "
+                                 f"{kind}@STEP or {kind}@STEP:RANK")
+        elif kind == "slow_io":
+            try:
+                ok = arg is not None and float(arg) >= 0
+            except ValueError:
+                ok = False
+            if not ok:
+                raise ValueError(f"fault spec entry {entry!r}: want "
+                                 f"slow_io@OP_INDEX:MILLISECONDS")
         faults.append(f)
     return faults
 
@@ -255,6 +327,23 @@ class FaultInjector:
         self.state_dir = os.environ.get("PADDLE_FAULT_STATE_DIR")
         # rot_shard@N counts COMMITTED checkpoints this injector observed
         self._commits = 0
+        # storage faults: the train step the loop is currently inside
+        # (on_dispatch/set_step maintain it; -1 = no step dispatched yet,
+        # so step-window entries stay dormant outside a training loop
+        # until a test pins the step explicitly) and the io.py hook state
+        self._step = -1
+        self._io_prev_hook = None
+        self._io_armed = False
+        # serializes Fault.seen/fired mutation: the hook can fire from
+        # more than one thread (training saves, a server's publish) and
+        # an unsynchronized read-modify-write could double-fire or skip
+        # a one-shot op-indexed entry.  Claim-only critical section —
+        # ledger I/O, prints, sleeps, and the raise all happen after
+        # release (blocking work never runs under a framework lock)
+        from .core import locks as _locks
+
+        self._io_lock = _locks.named_lock("faults.io", rank=48)
+        self._storage = [f for f in self.faults if f.kind in _STORAGE_KINDS]
 
     @staticmethod
     def from_flags() -> Optional["FaultInjector"]:
@@ -268,7 +357,10 @@ class FaultInjector:
     def reset(self):
         for f in self.faults:
             f.fired = False
+            f.seen = 0
+            f.exhausted = False
         self._rng = random.Random(self.seed)
+        self._step = -1
         return self
 
     def pending(self) -> List[Fault]:
@@ -288,7 +380,9 @@ class FaultInjector:
     def _ranked_marker(self, f: Fault) -> Optional[str]:
         if self.state_dir is None or f.kind not in _LEDGER_KINDS:
             return None
-        return os.path.join(self.state_dir, f"fired-{f.kind}@{f.at}-{f.arg}")
+        # eio globs may carry path separators; the marker is a flat file
+        arg = str(f.arg).replace(os.sep, "%2F")
+        return os.path.join(self.state_dir, f"fired-{f.kind}@{f.at}-{arg}")
 
     def _take(self, kind: str, at: int) -> Optional[Fault]:
         for f in self.faults:
@@ -475,12 +569,138 @@ class FaultInjector:
               file=sys.stderr, flush=True)
         return True
 
+    # -- storage faults (ISSUE 15) -----------------------------------------
+    def arm_io(self) -> "FaultInjector":
+        """Register this injector as the io.py choke-point fault hook so
+        enospc/eio/slow_io/ro_fs entries can fire on real checkpoint/
+        manifest/model-store I/O.  Idempotent; `disarm_io` restores the
+        previous hook.  `resilient_train_loop` arms/disarms automatically
+        around its run."""
+        if not self._io_armed:
+            from . import io as _io
+
+            self._io_prev_hook = _io.set_io_fault_hook(self.on_io)
+            self._io_armed = True
+        return self
+
+    def disarm_io(self):
+        if self._io_armed:
+            from . import io as _io
+
+            _io.set_io_fault_hook(self._io_prev_hook)
+            self._io_prev_hook = None
+            self._io_armed = False
+
+    def set_step(self, step: int):
+        """Pin the train step the step-window storage entries compare
+        against (`on_dispatch` calls this; tests driving CheckpointManager
+        directly call it by hand)."""
+        self._step = int(step)
+
+    def _spend_ledgered(self, f: Fault) -> bool:
+        """True when a previous gang incarnation already fired `f` (ledger
+        marker present) — the entry goes inactive; otherwise the marker is
+        written (plain open: the ledger dir is not storage under test) and
+        the caller fires."""
+        marker = self._ranked_marker(f)
+        if marker is None:
+            return False
+        if os.path.exists(marker):
+            f.fired = True
+            f.exhausted = True
+            return True
+        os.makedirs(self.state_dir, exist_ok=True)
+        with open(marker, "w") as fh:
+            fh.write(str(os.getpid()))
+        return False
+
+    def on_io(self, op: str, path: str):
+        """The io.py choke-point hook: applies any armed storage fault to
+        this operation.  `op` is "read" or "write".  Raises plain OSError
+        with the real errno — the io layer stamps phase="storage" and
+        errors.classify maps it onto StorageError, exactly the path a real
+        disk failure takes.  Paths under FLAGS_ckpt_fallback_dir are
+        exempt (the fallback models a different device)."""
+        live = [f for f in self._storage if not f.exhausted]
+        if not live:
+            return
+        from . import io as _io
+        from .flags import flag as _flag
+
+        exempt = list(_io.fault_exempt_prefixes())
+        fb = _flag("FLAGS_ckpt_fallback_dir")
+        if fb:
+            exempt.append(os.path.abspath(fb))
+        if exempt:
+            ap = os.path.abspath(path)
+            for pfx in exempt:
+                if ap == pfx or ap.startswith(pfx + os.sep):
+                    return
+        # CLAIM under the lock (pure bookkeeping: op-index counters and
+        # the first-fire latch, so concurrent threads can never double-
+        # fire or skip a one-shot), then FIRE outside it — the ledger's
+        # file I/O, the stderr print, the slow_io sleep, and the raise
+        # are all blocking work that must not serialize other threads'
+        # I/O through a held framework lock.
+        hits = []  # (fault, first_fire)
+        with self._io_lock:
+            for f in live:
+                if f.kind in ("slow_io", "eio"):
+                    if f.kind == "eio" and \
+                            not fnmatch.fnmatch(path, f.arg or "*"):
+                        continue
+                    idx, f.seen = f.seen, f.seen + 1
+                    if idx == f.at:
+                        f.fired = True
+                        hits.append((f, True))  # op index unique: one claimant
+                    continue
+                # step-window kinds: enospc (step == at), ro_fs (step >= at)
+                if op != "write":
+                    continue
+                tr = f.target_rank
+                if tr is not None and tr != self.rank:
+                    continue
+                if self._step < 0:
+                    continue
+                active = (self._step == f.at if f.kind == "enospc"
+                          else self._step >= f.at)
+                if active:
+                    first, f.fired = not f.fired, True
+                    hits.append((f, first))
+        sleep_ms = 0.0
+        err = None
+        for f, first in hits:
+            if first and self._spend_ledgered(f):
+                continue  # spent by an earlier gang incarnation
+            if f.kind == "slow_io":
+                _MON.counter("faults.slow_io").inc()
+                print(f"faults: slow_io@{f.at} firing on {path} "
+                      f"({f.slow_ms}ms)", file=sys.stderr, flush=True)
+                sleep_ms += f.slow_ms
+                continue
+            if first:
+                _MON.counter(f"faults.{f.kind}").inc()
+                at = (f"op {f.at}" if f.kind == "eio"
+                      else f"step {self._step}")
+                print(f"faults: {f} firing at {at} on {path} "
+                      f"(rank {self.rank})", file=sys.stderr, flush=True)
+            err = OSError(_STORAGE_ERRNO[f.kind],
+                          f"injected {f.kind.upper().replace('_', '-')} "
+                          f"(fault {f})", path)
+        if sleep_ms:
+            time.sleep(sleep_ms / 1e3)
+        if err is not None:
+            raise err
+
     def on_dispatch(self, step: int):
         """Called just before train step `step` is dispatched; raises the
         scheduled transient device error, delivers a real SIGTERM (the
         preemption notice), hard-kills this worker (SIGKILL — no cleanup,
         no tombstone: peers must detect the death by heartbeat staleness),
-        or stalls it to fake a straggler."""
+        or stalls it to fake a straggler.  Also advances the storage
+        faults' step tracker (enospc/ro_fs windows follow the train
+        step)."""
+        self.set_step(step)
         f = self._take("device", step)
         if f is not None:
             code = f.arg or "UNAVAILABLE"

@@ -259,6 +259,30 @@ DEFINE_bool("FLAGS_integrity_verify_load", True,
             "field) load unchecked.  Off trusts the disk — the escape "
             "hatch when re-reading every shard for hashing is too "
             "expensive for a given restore path")
+DEFINE_string("FLAGS_ckpt_fallback_dir", "",
+              "secondary checkpoint destination (a DIFFERENT filesystem — "
+              "local scratch, a second mount) tried when a save to the "
+              "primary root fails its storage retries or hits a terminal "
+              "EROFS/EACCES (paddle_tpu/checkpoint_manager.py).  A "
+              "fallback commit clears degraded mode like a primary one, "
+              "and restore() merges both roots' checkpoints into one "
+              "newest-first walk.  Single-process managers only "
+              "(coordinated gang saves need every rank on one shared "
+              "dir).  Empty (default) = no fallback: a failed save "
+              "enters degraded mode directly.  The fault injector "
+              "exempts paths under this dir — it models a different "
+              "device, so an injected ENOSPC/EROFS on the primary must "
+              "not also break it")
+DEFINE_int("FLAGS_max_ckpt_lag_steps", 0,
+           "degraded-mode bound (paddle_tpu/checkpoint_manager.py): the "
+           "maximum number of steps training may run past its last "
+           "COMMITTED checkpoint while storage is failing.  Saves past "
+           "the bound raise a terminal classified errors.StorageError "
+           "instead of degrading further — unprotected training cannot "
+           "run forever on a dead store.  0 (default) = unbounded "
+           "degraded mode (the resilience.ckpt_lag_steps gauge and "
+           "storage_degraded events still go loud; gate them with "
+           "perf_report --check --max-ckpt-lag-steps)")
 DEFINE_bool("FLAGS_lock_telemetry", False,
             "per-lock contention telemetry for every named framework lock "
             "(paddle_tpu/core/locks.py): lock.<name>.acquires/contended/"

@@ -105,10 +105,16 @@ _DETAIL_CHUNK_CAP = 64
 # ---- file / manifest digests (at-rest integrity) ---------------------------
 
 def file_sha256(path: str, chunk: int = 1 << 20):
-    """(hex sha256, byte length) of a file, streamed."""
+    """(hex sha256, byte length) of a file, streamed.  Reads through the
+    io.py storage choke point (ISSUE 15), so a flaky store fails digest
+    verification with a classified transient StorageError — retried by
+    the publisher, walked past by restore — instead of masquerading as
+    rot."""
+    from . import io as _io
+
     h = hashlib.sha256()
     n = 0
-    with open(path, "rb") as f:
+    with _io.open_for_read(path, "rb") as f:
         while True:
             b = f.read(chunk)
             if not b:
@@ -147,6 +153,18 @@ def verify_file_entry(dirname: str, fname: str,
     try:
         sha, n = file_sha256(path)
     except OSError as e:
+        from .errors import (TERMINAL_STORAGE_ERRNOS,
+                             TRANSIENT_STORAGE_ERRNOS)
+
+        eno = getattr(e, "errno", None)
+        if eno in TRANSIENT_STORAGE_ERRNOS or eno in TERMINAL_STORAGE_ERRNOS:
+            # a failing READ (EIO, timeout, permission flap) is a STORAGE
+            # verdict, not evidence of rot: no mismatch counter, no
+            # IntegrityError — re-raise as-is (phase="storage" already
+            # attached by the io choke point) so the publisher
+            # retries/classifies without quarantining and restore's
+            # walk-back treats it like any other unreadable checkpoint
+            raise
         _mismatch(f"unreadable: {type(e).__name__}")
         raise IntegrityError(
             f"manifest names {fname!r} but it cannot be read "
@@ -215,9 +233,10 @@ def verify_manifest_digests(dirname: str) -> int:
 def scan_snapshot_dir(dirname: str) -> List[dict]:
     """Non-raising audit of one checkpoint / model directory: every
     finding as {"file", "class", "detail"}.  Classes: digest_mismatch,
-    bytes_mismatch, missing_file, manifest_error (errors) and undigested
-    (warning — a pre-digest manifest entry nothing can verify).  The
-    scrub tool and tests share this walk with the raising loaders."""
+    bytes_mismatch, missing_file, unreadable_file, manifest_error
+    (errors) and undigested (warning — a pre-digest manifest entry
+    nothing can verify).  The scrub tool and tests share this walk with
+    the raising loaders."""
     findings = []
     try:
         entries = list(_manifest_file_entries(dirname))
@@ -235,7 +254,15 @@ def scan_snapshot_dir(dirname: str) -> List[dict]:
                              "detail": f"{src} carries no sha256 "
                                        f"(pre-digest manifest)"})
             continue
-        got_sha, got_n = file_sha256(path)
+        try:
+            got_sha, got_n = file_sha256(path)
+        except OSError as e:
+            # EACCES/EIO mid-scan is a FINDING, not a crash: one
+            # unreadable file must never mask every other root's verdict
+            # (tools/scrub.py gates on the unreadable_file class)
+            findings.append({"file": fname, "class": "unreadable_file",
+                             "detail": f"{type(e).__name__}: {e}"})
+            continue
         if nbytes is not None and got_n != int(nbytes):
             findings.append({"file": fname, "class": "bytes_mismatch",
                              "detail": f"{got_n} bytes, manifest says "

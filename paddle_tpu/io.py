@@ -26,6 +26,149 @@ MODEL_FILENAME = "__model__.json"
 MANIFEST = "__manifest__.json"
 
 
+# --- storage choke point (ISSUE 15) -----------------------------------------
+# Every checkpoint / manifest / sidecar / model-store byte goes through
+# `atomic_write` / `save_array` (writes) and `open_for_read` / `load_array`
+# (reads).  One choke point buys three things at once: a single patchable
+# seam for deterministic storage-fault injection (paddle_tpu/faults.py
+# enospc/eio/slow_io/ro_fs specs register a hook here), a uniform
+# tmp+fsync+rename discipline (previously each writer hand-rolled its own,
+# some skipping the fsync, some the rename — a torn manifest next to an
+# intact shard was possible), and a consistent classification breadcrumb:
+# any OSError crossing this seam carries phase="storage", which
+# errors.classify maps onto StorageError (transient ENOSPC/EIO/EAGAIN/
+# ETIMEDOUT vs terminal EROFS/EACCES).
+
+_IO_FAULT_HOOK = None  # callable(op: "read"|"write", path) -> None; may raise
+# path prefixes the fault hook must leave alone (the checkpoint fallback
+# dir models a DIFFERENT device — an injected full/read-only primary
+# must not also break it).  FLAGS_ckpt_fallback_dir is exempt implicitly
+# (faults.py checks the flag); ctor-arg fallback dirs register here via
+# the `fault_exempt` context manager around their operations.
+_FAULT_EXEMPT: List[str] = []
+
+
+class fault_exempt:
+    """Context manager: operations on paths under `prefix` are exempt
+    from fault injection for the duration (re-entrant; prefix compared
+    absolute)."""
+
+    def __init__(self, prefix: str):
+        self._p = os.path.abspath(prefix)
+
+    def __enter__(self):
+        _FAULT_EXEMPT.append(self._p)
+        return self
+
+    def __exit__(self, *exc):
+        _FAULT_EXEMPT.remove(self._p)
+        return False
+
+
+def fault_exempt_prefixes():
+    return tuple(_FAULT_EXEMPT)
+
+
+def set_io_fault_hook(hook):
+    """Install (or, with None, remove) the storage-fault hook every shim
+    operation consults; returns the previous hook so callers can restore
+    it.  The hook may raise OSError (the fault) or sleep (slow storage) —
+    it runs BEFORE the real I/O, so an injected failure never leaves a
+    half-written file the real fault would not have left."""
+    global _IO_FAULT_HOOK
+    prev, _IO_FAULT_HOOK = _IO_FAULT_HOOK, hook
+    return prev
+
+
+def _storage_ctx(e: BaseException) -> BaseException:
+    from .errors import attach_context
+
+    return attach_context(e, phase="storage")
+
+
+def _gate(op: str, path: str):
+    hook = _IO_FAULT_HOOK
+    if hook is not None:
+        try:
+            hook(op, path)
+        except OSError as e:
+            raise _storage_ctx(e)
+
+
+def _atomic_commit(path: str, mode: str, write_cb, fsync: bool = True):
+    """ONE copy of the commit discipline every choke-point write shares:
+    write via `write_cb(f)` to a WRITER-unique temp name (pid-suffixed —
+    coordinated gang saves share one pending dir, and two ranks writing
+    the same rank-agnostic marker through one temp name would race each
+    other's rename), optionally fsync, then atomically rename into place;
+    on failure remove the torn temp and re-raise classified.  The file
+    exists whole or not at all, never torn."""
+    tmp = f"{path}.{os.getpid()}.tmp~"
+    try:
+        with open(tmp, mode) as f:
+            write_cb(f)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except OSError as e:
+        try:
+            os.remove(tmp)  # never leave a torn temp for a later glob
+        except OSError:
+            pass
+        raise _storage_ctx(e)
+
+
+def atomic_write(path: str, data, *, fsync: bool = True):
+    """THE write discipline for small control-plane files (manifests,
+    markers, sidecars) — see `_atomic_commit`.  `fsync=False` is for
+    high-frequency best-effort writers (heartbeat beats) where
+    durability past a crash buys nothing."""
+    _gate("write", path)
+    mode = "wb" if isinstance(data, (bytes, bytearray)) else "w"
+    _atomic_commit(path, mode, lambda f: f.write(data), fsync=fsync)
+
+
+def save_array(path: str, arr) -> Optional[str]:
+    """Atomic .npy write through the choke point; returns the `stored_as`
+    tag (bfloat16 and other ml_dtypes don't round-trip through np.load's
+    mmap, so they are stored as a same-width uint view and reinterpreted
+    on load)."""
+    _gate("write", path)
+    arr = np.asarray(arr)
+    stored_as = None
+    if arr.dtype.kind == "V" or str(arr.dtype) == "bfloat16":
+        arr = arr.view(np.uint16)
+        stored_as = "bfloat16_as_uint16"
+    _atomic_commit(path, "wb", lambda f: np.save(f, arr))
+    return stored_as
+
+
+def open_for_read(path: str, mode: str = "rb"):
+    """THE read seam: every manifest/sidecar/marker read routes here so a
+    flaky store (EIO, EACCES) surfaces as a classified storage failure at
+    one choke point instead of a raw open() scattered per caller."""
+    _gate("read", path)
+    try:
+        return open(path, mode)
+    except OSError as e:
+        raise _storage_ctx(e)
+
+
+def load_array(path: str, mmap_mode=None):
+    """np.load through the read seam (shard payload reads)."""
+    _gate("read", path)
+    try:
+        return np.load(path, mmap_mode=mmap_mode)
+    except OSError as e:
+        raise _storage_ctx(e)
+
+
+def read_json(path: str):
+    with open_for_read(path, "r") as f:
+        return json.load(f)
+
+
 def _verify_on_load() -> bool:
     """At-rest integrity (paddle_tpu/integrity.py): whether load paths
     re-hash manifest-stamped files before use."""
@@ -48,14 +191,14 @@ def save_vars(dirname: str, var_names: Sequence[str], scope: Optional[Scope] = N
             raise KeyError(f"save_vars: {name!r} not found in scope")
         arr = np.asarray(v)
         fname = name.replace("/", "%2F") + ".npy"
-        np.save(os.path.join(dirname, fname), arr)
+        save_array(os.path.join(dirname, fname), arr)
         entry = {"name": name, "file": fname, "shape": list(arr.shape), "dtype": str(arr.dtype)}
         # content stamp: a flipped-yet-finite byte in this file must fail
         # the load, not serve (paddle_tpu/integrity.py)
         entry.update(_integrity.stamp_file(os.path.join(dirname, fname)))
         saved.append(entry)
-    with open(os.path.join(dirname, MANIFEST), "w") as f:
-        json.dump({"vars": saved}, f, indent=1)
+    atomic_write(os.path.join(dirname, MANIFEST),
+                 json.dumps({"vars": saved}, indent=1))
     return saved
 
 
@@ -82,14 +225,12 @@ def load_vars(dirname: str, var_names: Optional[Sequence[str]] = None,
     the caller JUST verified the directory's digests itself (the publish
     fast-reject) — re-hashing every file twice per load is pure waste."""
     scope = scope or global_scope()
-    with open(os.path.join(dirname, MANIFEST)) as f:
-        manifest = json.load(f)
+    manifest = read_json(os.path.join(dirname, MANIFEST))
     want = set(var_names) if var_names is not None else None
     qman = {}
     qpath = os.path.join(dirname, QUANT_MANIFEST)
     if os.path.exists(qpath):
-        with open(qpath) as f:
-            qman = json.load(f).get("weights", {})
+        qman = read_json(qpath).get("weights", {})
     loaded = []
     verify = _verify_on_load() if verify is None else bool(verify)
     for entry in manifest["vars"]:
@@ -99,7 +240,7 @@ def load_vars(dirname: str, var_names: Optional[Sequence[str]] = None,
             _integrity.verify_file_entry(dirname, entry["file"],
                                          entry.get("sha256"),
                                          entry.get("bytes"))
-        arr = np.load(os.path.join(dirname, entry["file"]))
+        arr = load_array(os.path.join(dirname, entry["file"]))
         if entry["name"] in qman and arr.dtype == np.int8:
             # int8 storage -> dequantized floats (quantized inference model)
             rec = qman[entry["name"]]
@@ -148,14 +289,9 @@ def _norm_index(index, shape):
 
 
 def _save_array(path, arr):
-    """bfloat16 (and other ml_dtypes) don't round-trip through np.load's
-    mmap; store them as a same-width uint view and reinterpret on load."""
-    arr = np.asarray(arr)
-    if arr.dtype.kind == "V" or str(arr.dtype) == "bfloat16":
-        np.save(path, arr.view(np.uint16))
-        return "bfloat16_as_uint16"
-    np.save(path, arr)
-    return None
+    """Shard payload write: the atomic choke-point discipline plus the
+    bfloat16-as-uint16 storage convention (see `save_array`)."""
+    return save_array(path, arr)
 
 
 def _loaded_view(mm, stored_as):
@@ -205,7 +341,7 @@ def save_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
             vals = np.asarray(v.values)
             rows_f = f"{safe}.rows.p{proc}s0.npy"
             vals_f = f"{safe}.vals.p{proc}s0.npy"
-            np.save(os.path.join(dirname, rows_f), rows)
+            save_array(os.path.join(dirname, rows_f), rows)
             stored_as = _save_array(os.path.join(dirname, vals_f), vals)
             rstamp = _integrity.stamp_file(os.path.join(dirname, rows_f))
             vstamp = _integrity.stamp_file(os.path.join(dirname, vals_f))
@@ -255,8 +391,8 @@ def save_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
                         "spec": spec, "shards": shards_meta})
     # one manifest per process; process 0's carries the authoritative copy
     mname = SHARDED_MANIFEST if proc == 0 else f"__sharded_manifest__.p{proc}.json"
-    with open(os.path.join(dirname, mname), "w") as f:
-        json.dump({"vars": entries, "process": proc}, f, indent=1)
+    atomic_write(os.path.join(dirname, mname),
+                 json.dumps({"vars": entries, "process": proc}, indent=1))
     return [e["name"] for e in entries]
 
 
@@ -283,13 +419,11 @@ def load_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
     import glob as _glob
 
     scope = scope or global_scope()
-    with open(os.path.join(dirname, SHARDED_MANIFEST)) as f:
-        manifest = json.load(f)
+    manifest = read_json(os.path.join(dirname, SHARDED_MANIFEST))
     # multi-host save: merge every process's shard lists into proc-0's view
     by_name = {e["name"]: e for e in manifest["vars"]}
     for extra in sorted(_glob.glob(os.path.join(dirname, "__sharded_manifest__.p*.json"))):
-        with open(extra) as f:
-            m2 = json.load(f)
+        m2 = read_json(extra)
         for e in m2["vars"]:
             tgt = by_name.get(e["name"])
             if tgt is None:
@@ -330,9 +464,9 @@ def load_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
                     _integrity.verify_file_entry(
                         dirname, sh["values_file"],
                         sh.get("values_sha256"), sh.get("values_bytes"))
-                r = np.load(os.path.join(dirname, sh["rows_file"]))
+                r = load_array(os.path.join(dirname, sh["rows_file"]))
                 v = _loaded_view(
-                    np.load(os.path.join(dirname, sh["values_file"])),
+                    load_array(os.path.join(dirname, sh["values_file"])),
                     sh.get("stored_as"))
                 slabs.append((r, v))
             rows, vals = consolidate_selected_rows(slabs, height)
@@ -351,7 +485,8 @@ def load_sharded(dirname: str, var_names: Optional[Sequence[str]] = None,
                                              sh.get("sha256"),
                                              sh.get("bytes"))
         mms = [(sh["index"], _loaded_view(
-                    np.load(os.path.join(dirname, sh["file"]), mmap_mode="r"),
+                    load_array(os.path.join(dirname, sh["file"]),
+                               mmap_mode="r"),
                     sh.get("stored_as")))
                for sh in entry["shards"]]
 
@@ -456,8 +591,7 @@ def save_inference_model(
     doc = inference.to_dict()
     doc["feed_names"] = list(feeded_var_names)
     doc["fetch_names"] = target_names
-    with open(os.path.join(dirname, MODEL_FILENAME), "w") as f:
-        json.dump(doc, f)
+    atomic_write(os.path.join(dirname, MODEL_FILENAME), json.dumps(doc))
 
     param_names = [v.name for v in _persistables(inference) if v.name in used]
     save_vars(dirname, param_names, scope)
@@ -468,8 +602,7 @@ def load_inference_model(dirname: str, executor, scope: Optional[Scope] = None,
                          verify: Optional[bool] = None):
     """Returns (program, feed_names, fetch_names); params land in scope.
     `verify` forwards to load_vars' digest check."""
-    with open(os.path.join(dirname, MODEL_FILENAME)) as f:
-        doc = json.load(f)
+    doc = read_json(os.path.join(dirname, MODEL_FILENAME))
     program = Program.from_dict(doc)
     load_vars(dirname, None, scope, verify=verify)
     return program, doc["feed_names"], doc["fetch_names"]
@@ -530,25 +663,24 @@ def save_quantized_inference_model(
             q = np.clip(np.round(w / scale_arr.reshape(shp) * qmax),
                         -qmax - 1, qmax).astype(np.int8)
             fname = wname.replace("/", "%2F") + ".npy"
-            np.save(os.path.join(dirname, fname), q)
+            save_array(os.path.join(dirname, fname), q)
             qrec[wname] = {"scale": scale_arr.tolist(), "axis": axis,
                            "bits": weight_bits, "dtype": str(w.dtype)}
         if qrec:
             # the int8 payloads just overwrote files save_vars stamped as
             # floats — re-stamp them or the model fails its own digests
             mpath = os.path.join(dirname, MANIFEST)
-            with open(mpath) as f:
-                man = json.load(f)
+            man = read_json(mpath)
             overwritten = {w.replace("/", "%2F") + ".npy" for w in qrec}
             for entry in man["vars"]:
                 if entry["file"] in overwritten:
                     entry.update(_integrity.stamp_file(
                         os.path.join(dirname, entry["file"])))
-            with open(mpath, "w") as f:
-                json.dump(man, f, indent=1)
-        with open(os.path.join(dirname, QUANT_MANIFEST), "w") as f:
-            json.dump({"weights": qrec,
-                       "activations": manifest["activations"]}, f, indent=1)
+            atomic_write(mpath, json.dumps(man, indent=1))
+        atomic_write(os.path.join(dirname, QUANT_MANIFEST),
+                     json.dumps({"weights": qrec,
+                                 "activations": manifest["activations"]},
+                                indent=1))
         return fetch
     finally:
         # undo the in-place int8 snap: the live float model keeps serving

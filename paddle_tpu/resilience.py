@@ -18,6 +18,14 @@ into one loop that survives the four real failure classes of
                           usual cause)
     PreemptionError       flush one checkpoint with resume info and
                           return gracefully (`stats.preempted`)
+    StorageError          the store itself failed (ISSUE 15): checkpoint
+                          saves retry transients with the same seeded
+                          backoff, then DEGRADE (save returns None,
+                          `resilience.ckpt_lag_steps` goes loud, the
+                          bounded lag converts to a terminal error)
+                          instead of killing the worker — handled inside
+                          CheckpointManager.save, so the loop only sees
+                          the terminal lag-bound conversion
     IntegrityError        silent corruption made loud (ISSUE 14): the
                           live digest sentinel (armed under
                           FLAGS_integrity_check_period, see
@@ -122,6 +130,10 @@ class RetryPolicy:
     max_skipped_steps: int = 4
     max_rollbacks: int = 2
     max_device_retries: int = 3
+    # transient-storage retries PER SAVE ROUND (CheckpointManager.save,
+    # ISSUE 15) — exhausting them enters degraded mode rather than
+    # re-raising, so this budget is per attempt sequence, not per run
+    max_storage_retries: int = 3
     backoff_base_s: float = 0.05
     backoff_factor: float = 2.0
     backoff_jitter: float = 0.1
@@ -299,6 +311,14 @@ def resilient_train_loop(
         scope = global_scope()
     if cm is not None and cm.scope is None:
         cm.scope = scope
+    if cm is not None and getattr(cm, "retry_policy", None) is None:
+        # one backoff schedule for the whole loop: the manager's storage
+        # retries follow the same seeded policy as the device retries
+        cm.retry_policy = policy
+    if injector is not None:
+        # storage faults (ISSUE 15) fire inside the io.py choke point;
+        # arming is idempotent and disarmed in the finally below
+        injector.arm_io()
 
     # silent-corruption sentinel (ISSUE 14): amortized content digests
     # over the whole training state, published for the gang heartbeat to
@@ -459,13 +479,17 @@ def resilient_train_loop(
             yield feed
             step += 1
 
-    def _flush_checkpoint(step: int) -> str:
+    def _flush_checkpoint(step: int) -> Optional[str]:
         """Dispatch-boundary save: scope == state after `step` steps (the
         save's host copies block on anything still in flight).  RESUME.json
         records where the data stream stands — and, for a checkpointable
         source, its pickled stream state, so resume is an O(1) seek
         instead of a replay.  Written as a `save(sidecars=...)` so the
-        snapshot and its cursor commit atomically."""
+        snapshot and its cursor commit atomically.
+
+        Returns None when the save round was skipped (storage degraded
+        mode, ISSUE 15): training continues unprotected, the manager's
+        lag gauge is loud, and the next period retries."""
         cm._step = step
         nb = step_batch.get(step, consumed)
         info = {"step": step, "next_batch": nb,
@@ -476,7 +500,7 @@ def resilient_train_loop(
         name = resume_sidecar_name(getattr(cm, "rank", 0),
                                    getattr(cm, "world_size", 1))
         out = cm.save(step=step, sidecars={name: json.dumps(info)})
-        if injector is not None:
+        if injector is not None and out is not None:
             injector.on_commit(out)  # rot_shard@N fires post-COMMIT
         return out
 
@@ -895,6 +919,8 @@ def resilient_train_loop(
         stats.wall_s = time.perf_counter() - t0
         if installed:
             _signal.signal(_signal.SIGTERM, prev_handler)
+        if injector is not None:
+            injector.disarm_io()
         if digester is not None:
             from . import integrity as _integrity_mod
 

@@ -33,17 +33,28 @@ verification ladder:
      STAGED version, so the post-swap steady state never compiles
      inline.
 
-Any failure QUARANTINES the snapshot: the source dir lands in the
-registry's quarantine set (repeat publishes reject fast), a
+Any CONTENT failure QUARANTINES the snapshot: the source dir lands in
+the registry's quarantine set (repeat publishes reject fast), a
 `serving.publish_rejected` event + counter record what and why, and a
 classified `ServingError(reason="publish_rejected")` raises — while the
 OLD version keeps serving untouched.  On success the swap is atomic
 (registry lock), in-flight batches finish on the version they acquired,
 and the previous version is retained for instant `rollback()`.
+
+Transient STORE I/O is not a content failure (ISSUE 15): an EIO/timeout
+while hashing or staging the snapshot says nothing about its bytes — a
+flaky NFS read must never permanently poison a good snapshot.  Rungs
+that touch the store (digest fast-reject, staging) classify their
+failures through `errors.StorageError`: a transient one retries the
+whole ladder with seeded backoff (`serving.publish_retries` counter,
+`publish_io_retry` events), and exhausting the retries raises
+`ServingError(reason="publish_io")` with NO quarantine — the next
+publish attempt of the same source starts clean.
 """
 from __future__ import annotations
 
 import os
+import time
 from typing import Dict, Optional, Sequence
 
 import numpy as np
@@ -52,13 +63,54 @@ from .. import integrity as _integrity
 from ..checkpoint_manager import COMMITTED_MARKER, DIST_MARKER, CheckpointManager
 from ..core.analysis import check_program
 from ..core.scope import Scope
-from ..errors import ServingError
+from ..errors import ServingError, StorageError, classify
 from ..inference import Predictor
 from ..monitor import MONITOR as _MON
 from .. import io as _io
 from .registry import ModelRegistry, ModelVersion, synthetic_feeds
 
 __all__ = ["publish", "rollback", "verify_snapshot_dir"]
+
+# transient-store-I/O retry budget per publish() call (the ladder is
+# idempotent up to the swap, so re-running it whole is safe and keeps
+# the rung code straight-line)
+PUBLISH_IO_ATTEMPTS = 3
+
+
+class _RetryableStoreIO(Exception):
+    """Internal: a ladder rung hit transient store I/O — retry the
+    ladder, do NOT quarantine."""
+
+
+def _store_io_failure(e: BaseException) -> Optional[StorageError]:
+    """The StorageError behind `e` (transient OR terminal), walking the
+    cause chain (verify/stage helpers may wrap the raw OSError), else
+    None.  Either flavor is a verdict about the STORE, not the snapshot
+    — neither may quarantine."""
+    seen = set()
+    cur: Optional[BaseException] = e
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        ce = classify(cur)
+        if isinstance(ce, StorageError):
+            return ce
+        cur = cur.__cause__ or cur.__context__
+    return None
+
+
+def _fail_publish_io(name: str, src: str, cause, attempts: int):
+    """Classified store-I/O publish failure: loud, NO quarantine — the
+    snapshot may be fine, the store is not."""
+    _MON.counter("serving.publish_io_failed").inc()
+    _MON.record_step({
+        "kind": "serving_event", "action": "publish_io_failed",
+        "model": name, "src": src, "attempts": attempts,
+        "detail": str(cause)})
+    raise ServingError(
+        f"publish of {src!r} into model {name!r} failed on store I/O "
+        f"after {attempts} attempt(s) ({cause}); NOT quarantined — the "
+        f"snapshot may be fine, the store is not",
+        reason="publish_io", model=name) from cause
 
 
 def _reject(registry: ModelRegistry, name: str, src: str, detail: str):
@@ -149,9 +201,27 @@ def publish(registry: ModelRegistry, name: str, src,
             registry._publish_cv.wait(0.1)
         registry._publishing.add(name)
     try:
-        return _publish_ladder(registry, name, src, golden_feeds,
-                               golden_expect, golden_rtol, golden_atol,
-                               warm_buckets)
+        # transient store I/O retries the whole ladder (idempotent up to
+        # the swap); content defects quarantine inside the ladder as ever
+        attempt = 0
+        while True:
+            try:
+                return _publish_ladder(registry, name, src, golden_feeds,
+                                       golden_expect, golden_rtol,
+                                       golden_atol, warm_buckets)
+            except _RetryableStoreIO as e:
+                cause = e.__cause__
+                attempt += 1
+                if attempt >= PUBLISH_IO_ATTEMPTS:
+                    _fail_publish_io(name, src, cause, attempt)
+                _MON.counter("serving.publish_retries").inc()
+                _MON.record_step({
+                    "kind": "serving_event", "action": "publish_io_retry",
+                    "model": name, "src": src, "attempt": attempt,
+                    "detail": str(cause)})
+                from ..resilience import RetryPolicy
+
+                time.sleep(RetryPolicy().backoff_s(attempt - 1))
     finally:
         with registry._publish_cv:
             registry._publishing.discard(name)
@@ -183,12 +253,25 @@ def _publish_ladder(registry, name, src, golden_feeds, golden_expect,
             with _MON.span("serving.publish_digest_check", model=name):
                 _integrity.verify_manifest_digests(src)
         except Exception as e:
+            se = _store_io_failure(e)
+            if se is not None and se.transient:
+                raise _RetryableStoreIO(str(e)) from e
+            if se is not None:
+                # terminal store I/O (EACCES/EROFS): retrying is useless,
+                # but quarantining would record a content verdict no
+                # content check made — classified failure, clean slate
+                _fail_publish_io(name, src, se, attempts=1)
             _reject(registry, name, src,
                     f"integrity: manifest digest check failed ({e})")
         try:
             program, feed_names, fetch_names, staged = _stage(
                 registry, active, src, kind)
         except Exception as e:
+            se = _store_io_failure(e)
+            if se is not None and se.transient:
+                raise _RetryableStoreIO(str(e)) from e
+            if se is not None:
+                _fail_publish_io(name, src, se, attempts=1)
             _reject(registry, name, src,
                     f"staging failed ({type(e).__name__}: {e})")
         # program verification (core/analysis): the staged program must

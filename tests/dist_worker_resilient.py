@@ -116,7 +116,12 @@ def main():
             cm = fluid.CheckpointManager(
                 ckpt_root, program=main_p, scope=scope, rank=rank,
                 world_size=world, mesh=fleet.mesh if world > 1 else None,
-                commit_timeout_s=30)
+                commit_timeout_s=30,
+                retry_policy=fluid.RetryPolicy(backoff_base_s=0.01))
+        if injector is not None:
+            # storage faults (enospc@S:RANK etc.) fire inside the io.py
+            # choke point the coordinated saves write through
+            injector.arm_io()
 
         start = 0
         restored = None
@@ -156,6 +161,9 @@ def main():
     print("RESULT " + json.dumps({
         "rank": rank, "world": world, "restart_num": restart_num,
         "start_step": start, "steps_run": len(losses), "losses": losses,
+        "ckpt_rounds_skipped": cm.storage_rounds_skipped if cm else 0,
+        "ckpt_recoveries": cm.storage_recoveries if cm else 0,
+        "ckpt_degraded": bool(cm.degraded) if cm else False,
         "params_sha": params_digest(scope)}), flush=True)
     if logger is not None:
         logger.write_snapshot()

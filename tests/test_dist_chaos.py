@@ -165,3 +165,61 @@ def test_gang_restart_resumes_bit_identical(tmp_path):
                and r.get("action") == "peer_failure" for r in lines)
     assert any(r.get("kind") == "dist_event"
                and r.get("action") == "heartbeat_miss" for r in lines)
+
+def test_enospc_at_commit_skips_round_then_recovers_and_resumes(tmp_path):
+    """Storage-fault acceptance (ISSUE 15): `enospc@3:1` fails rank 1's
+    shard writes at the step-4 commit boundary.  The contract:
+
+      * NO worker exit and NO watchdog wedge — rank 1 publishes
+        SHARD_SKIP, rank 0 abandons the round gang-wide
+        (ckpt_rounds_skipped == 1 on both ranks), training continues;
+      * checkpointing RECOVERS when the fault window passes (the step-6
+        commit lands, ckpt_recoveries == 1, degraded latch clear);
+      * a hard kill + gang restart AFTER recovery resumes from the
+        recovered checkpoint, bit-identical to an uninterrupted run —
+        the degraded window left no scar in training semantics."""
+    ref, _ = _run(tmp_path, "storage_ref", max_restarts=1)
+    assert ref.ok, ref.workers
+    ref_out = _results(ref)
+
+    chaos, root = _run(tmp_path, "storage_chaos",
+                       fault_spec="enospc@3:1;kill_worker@7:1",
+                       max_restarts=3)
+    assert chaos.ok, chaos.workers
+    assert chaos.restarts >= 1
+    _kill_incident(chaos)  # the injected death really happened
+    out = _results(chaos)
+    # the enospc round was skipped, not fatal: ckpt-4 never committed,
+    # the recovering step-6 commit did, and the restart resumed from it
+    ckpts = sorted(d for d in os.listdir(root) if d.startswith("ckpt-")
+                   and not d.endswith(".tmp"))
+    assert "ckpt-0000000004" not in ckpts, ckpts
+    assert "ckpt-0000000006" in ckpts, ckpts
+    assert out[0]["start_step"] == out[1]["start_step"] == 6
+    # bit-identical to the uninterrupted reference
+    assert out[0]["params_sha"] == out[1]["params_sha"]
+    assert out[0]["params_sha"] == ref_out[0]["params_sha"], (
+        "storage-chaos run diverged from the uninterrupted reference")
+    assert out[0]["losses"] == ref_out[0]["losses"][6:]
+    # the final incarnation saw a clean store (the fault ledger spent the
+    # entry in incarnation 0): no degraded rounds after the restart
+    assert not out[0]["ckpt_degraded"] and not out[1]["ckpt_degraded"]
+
+
+def test_enospc_round_skip_without_restart(tmp_path):
+    """The pure degraded-window half (no kill): one gang run straight
+    through an enospc commit window — both ranks count exactly one
+    skipped round and one recovery, nobody dies, end state agrees."""
+    res, root = _run(tmp_path, "storage_skip", fault_spec="enospc@3:1",
+                     max_restarts=0)
+    assert res.ok, res.workers
+    assert res.incarnations == 1 and res.restarts == 0
+    out = _results(res)
+    for r in (0, 1):
+        assert out[r]["ckpt_rounds_skipped"] == 1, out[r]
+        assert out[r]["ckpt_recoveries"] == 1, out[r]
+        assert not out[r]["ckpt_degraded"]
+    assert out[0]["params_sha"] == out[1]["params_sha"]
+    ckpts = sorted(d for d in os.listdir(root) if d.startswith("ckpt-")
+                   and not d.endswith(".tmp"))
+    assert "ckpt-0000000004" not in ckpts and "ckpt-0000000006" in ckpts

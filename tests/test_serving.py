@@ -318,6 +318,110 @@ def test_publish_truncated_shard_rejected(tmp_path, mon):
         srv.stop()
 
 
+def test_publish_transient_eio_retries_without_quarantine(tmp_path, mon):
+    """ISSUE 15 regression: a one-shot EIO while reading the publish
+    source is STORE flakiness, not snapshot rot — the ladder retries with
+    backoff (`serving.publish_retries`), the publish SUCCEEDS, and the
+    source is never quarantined.  Before this, one flaky NFS read
+    permanently poisoned a perfectly good snapshot."""
+    from paddle_tpu.faults import FaultInjector
+
+    srv, _ = _server(tmp_path, buckets=(2,))
+    try:
+        v2 = _save_model(str(tmp_path / "v2_flaky"), w_scale=2.0)
+        inj = FaultInjector("eio@0:*v2_flaky*").arm_io()
+        try:
+            srv.publish("m", v2)
+        finally:
+            inj.disarm_io()
+        # the retry ladder fired exactly once and the swap landed
+        assert monitor.counter("serving.publish_retries").value == 1
+        assert monitor.counter("serving.publish_rejected").value == 0
+        assert os.path.realpath(v2) not in srv.registry.quarantined
+        xv = np.ones((1, D_IN), "f4")
+        np.testing.assert_allclose(srv.infer("m", {"x": xv})[0],
+                                   _expected(xv, 2.0), rtol=1e-5)
+        retries = [r for r in monitor.step_records()
+                   if r.get("kind") == "serving_event"
+                   and r.get("action") == "publish_io_retry"]
+        assert len(retries) == 1 and retries[0]["model"] == "m"
+    finally:
+        srv.stop()
+
+
+def test_publish_persistent_io_fails_classified_without_quarantine(
+        tmp_path, mon):
+    """Store I/O that never settles exhausts the retry budget and raises
+    ServingError(reason="publish_io") — still NO quarantine (the snapshot
+    may be fine; the store is not), and the old version keeps serving."""
+    from paddle_tpu.serving.publisher import PUBLISH_IO_ATTEMPTS
+
+    srv, _ = _server(tmp_path, buckets=(2,))
+    try:
+        v2 = _save_model(str(tmp_path / "v2_dead"), w_scale=2.0)
+        import errno as _errno
+
+        from paddle_tpu import io as pio
+
+        def hook(op, path):
+            if "v2_dead" in path:
+                raise OSError(_errno.EIO, "store down", path)
+
+        xv = np.ones((1, D_IN), "f4")
+        before = srv.infer("m", {"x": xv})[0]
+        pio.set_io_fault_hook(hook)
+        try:
+            with pytest.raises(ServingError) as ei:
+                srv.publish("m", v2)
+        finally:
+            pio.set_io_fault_hook(None)
+        assert ei.value.reason == "publish_io"
+        assert os.path.realpath(v2) not in srv.registry.quarantined
+        assert monitor.counter("serving.publish_retries").value == \
+            PUBLISH_IO_ATTEMPTS - 1
+        np.testing.assert_array_equal(srv.infer("m", {"x": xv})[0], before)
+        # the store settles -> the SAME source now publishes (nothing was
+        # poisoned by the outage)
+        srv.publish("m", v2)
+        np.testing.assert_allclose(srv.infer("m", {"x": xv})[0],
+                                   _expected(xv, 2.0), rtol=1e-5)
+    finally:
+        srv.stop()
+
+
+def test_publish_terminal_io_fails_classified_without_quarantine(
+        tmp_path, mon):
+    """A terminal store failure (EACCES — root-squash flap, bad mount
+    perms) skips the retries but must STILL not quarantine: it is a
+    verdict about the store, and no content check ever ran."""
+    srv, _ = _server(tmp_path, buckets=(2,))
+    try:
+        v2 = _save_model(str(tmp_path / "v2_noperm"), w_scale=2.0)
+        import errno as _errno
+
+        from paddle_tpu import io as pio
+
+        def hook(op, path):
+            if "v2_noperm" in path:
+                raise OSError(_errno.EACCES, "permission denied", path)
+
+        pio.set_io_fault_hook(hook)
+        try:
+            with pytest.raises(ServingError) as ei:
+                srv.publish("m", v2)
+        finally:
+            pio.set_io_fault_hook(None)
+        assert ei.value.reason == "publish_io"
+        assert os.path.realpath(v2) not in srv.registry.quarantined
+        # terminal: failed on the FIRST attempt, no retry, no mismatch
+        assert monitor.counter("serving.publish_retries").value == 0
+        assert monitor.counter("integrity.file_mismatches").value == 0
+        # permissions fixed -> the same source publishes clean
+        srv.publish("m", v2)
+    finally:
+        srv.stop()
+
+
 def test_publish_bad_manifest_rejected(tmp_path, mon):
     srv, _ = _server(tmp_path, buckets=(2,))
     try:

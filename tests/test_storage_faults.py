@@ -1,0 +1,465 @@
+"""Storage-fault chaos matrix (ISSUE 15): the storage layer itself —
+not its contents — fails, and the run must survive per contract.
+
+Covered here, all CPU-only/deterministic (tier-1):
+
+  * spec grammar + classification: enospc/eio/slow_io/ro_fs parse, and
+    OSErrors crossing the io.py choke point classify onto
+    errors.StorageError with the transient/terminal split;
+  * the io.py choke point: atomic tmp+fsync+rename discipline, the
+    patchable fault hook, fallback-dir exemption;
+  * CheckpointManager under fire: transient ENOSPC retries then enters
+    DEGRADED MODE (save returns None, lag gauge + events loud) and
+    recovers on the next period; terminal EROFS skips retries and lands
+    in FLAGS_ckpt_fallback_dir; FLAGS_max_ckpt_lag_steps converts
+    unbounded degradation to a terminal classified error;
+  * resilient_train_loop end-to-end: an enospc save round costs NOTHING
+    in training semantics — end-state params bit-identical to a clean
+    run;
+  * restore / scrub: an unreadable file (EIO mid-hash) walks back to the
+    previous checkpoint / lands as an `unreadable_file` finding instead
+    of raising out of the scan;
+  * heartbeat-dir-on-full-disk: beat write failures go LOUD
+    (dist.heartbeat.send_errors + heartbeat_send_failed event) and the
+    beat thread survives — a full disk no longer reads as the rank dying;
+  * perf_report --check --max-ckpt-lag-steps: pass, fail, and the
+    zero-evidence-fails convention.
+"""
+import errno
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import io as pio
+from paddle_tpu import monitor
+from paddle_tpu.checkpoint_manager import CheckpointManager
+from paddle_tpu.errors import (DataError, StorageError, attach_context,
+                               classify)
+from paddle_tpu.faults import FaultInjector, parse_fault_spec
+
+# backoff-free policy: chaos tests must not sleep
+FAST = dict(backoff_base_s=0.0)
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+@pytest.fixture
+def mon():
+    monitor.reset()
+    monitor.enable()
+    yield monitor
+    monitor.disable()
+    monitor.reset()
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_hook():
+    yield
+    # a test that failed mid-arm must not poison the rest of the suite
+    pio.set_io_fault_hook(None)
+
+
+def _build(seed=11):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [4], dtype="float32")
+        y = fluid.layers.data("y", [1], dtype="float32")
+        h = fluid.layers.fc(x, 8, act="relu")
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(fluid.layers.fc(h, 1), y))
+        fluid.optimizer.Adam(1e-2).minimize(loss)
+    startup.random_seed = main.random_seed = seed
+    return main, startup, loss
+
+
+def _feeds(n, batch=8):
+    rng = np.random.RandomState(0)
+    out = []
+    for _ in range(n):
+        xv = rng.rand(batch, 4).astype("f4")
+        out.append({"x": xv, "y": xv.sum(1, keepdims=True)})
+    return out
+
+
+def _scope_for(startup):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    return exe, scope
+
+
+def _cm(root, main, scope, **kw):
+    kw.setdefault("retry_policy", fluid.RetryPolicy(**FAST))
+    return CheckpointManager(str(root), program=main, scope=scope, **kw)
+
+
+# --- grammar + classification ------------------------------------------------
+
+def test_storage_spec_grammar():
+    fs = parse_fault_spec("enospc@4:1;eio@0:*man*;slow_io@2:250;ro_fs@3")
+    assert [f.kind for f in fs] == ["enospc", "eio", "slow_io", "ro_fs"]
+    assert fs[0].target_rank == 1 and fs[3].target_rank is None
+    assert fs[1].arg == "*man*" and fs[2].slow_ms == 250.0
+    for bad in ("slow_io@2", "slow_io@2:fast", "enospc@1:r0", "ro_fs@2:x"):
+        with pytest.raises(ValueError):
+            parse_fault_spec(bad)
+
+
+def test_classify_storage_errnos():
+    for eno in (errno.ENOSPC, errno.EIO, errno.EAGAIN, errno.ETIMEDOUT):
+        ce = classify(OSError(eno, "boom"))
+        assert isinstance(ce, StorageError) and ce.transient, (eno, ce)
+        assert ce.phase == "storage"
+    for eno in (errno.EROFS, errno.EACCES):
+        ce = classify(OSError(eno, "boom"))
+        assert isinstance(ce, StorageError) and not ce.transient, (eno, ce)
+    # a random OSError is NOT a storage failure
+    assert not isinstance(classify(OSError(errno.ENOENT, "x")), StorageError)
+
+
+def test_classify_loader_phase_beats_bare_storage_errno():
+    """An EIO raised while PRODUCING a batch is the data layer's problem
+    (its corrupt budget owns it) — only the storage breadcrumb or a bare
+    errno maps to StorageError."""
+    e = attach_context(OSError(errno.EIO, "read failed"), phase="loader")
+    assert isinstance(classify(e), DataError)
+    e2 = attach_context(OSError(errno.EIO, "read failed"), phase="storage")
+    assert isinstance(classify(e2), StorageError)
+
+
+# --- the io.py choke point ---------------------------------------------------
+
+def test_atomic_write_discipline(tmp_path):
+    p = str(tmp_path / "f.json")
+    pio.atomic_write(p, '{"a": 1}')
+    assert json.load(open(p)) == {"a": 1}
+    # no temp debris
+    assert [n for n in os.listdir(tmp_path) if "tmp~" in n] == []
+    # a hook failure leaves the OLD content intact and no debris
+    pio.set_io_fault_hook(lambda op, path: (_ for _ in ()).throw(
+        OSError(errno.ENOSPC, "full")))
+    try:
+        with pytest.raises(OSError):
+            pio.atomic_write(p, '{"a": 2}')
+    finally:
+        pio.set_io_fault_hook(None)
+    assert json.load(open(p)) == {"a": 1}
+    assert [n for n in os.listdir(tmp_path) if "tmp~" in n] == []
+
+
+def test_eio_one_shot_on_read_path(tmp_path, mon):
+    """eio@0:GLOB fails the first matching read ONCE — the retry sees
+    clean bytes (the flaky-NFS read every storage stack must survive)."""
+    p = str(tmp_path / "x.txt")
+    pio.atomic_write(p, "hello")
+    inj = FaultInjector("eio@0:*x.txt").arm_io()
+    try:
+        with pytest.raises(OSError) as ei:
+            pio.open_for_read(p)
+        assert ei.value.errno == errno.EIO
+        ce = classify(ei.value)
+        assert isinstance(ce, StorageError) and ce.transient
+        with pio.open_for_read(p) as f:
+            assert f.read() == b"hello"
+    finally:
+        inj.disarm_io()
+    assert monitor.counter("faults.eio").value == 1
+    assert all(f.fired for f in inj.faults)
+
+
+def test_slow_io_delays_once(tmp_path, mon):
+    p = str(tmp_path / "y.txt")
+    pio.atomic_write(p, "z")
+    inj = FaultInjector("slow_io@0:30").arm_io()
+    try:
+        t0 = time.perf_counter()
+        with pio.open_for_read(p) as f:
+            f.read()
+        slow = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        with pio.open_for_read(p) as f:
+            f.read()
+        fast = time.perf_counter() - t0
+    finally:
+        inj.disarm_io()
+    assert monitor.counter("faults.slow_io").value == 1
+    assert slow >= 0.03 and fast < slow
+
+
+# --- CheckpointManager degraded mode -----------------------------------------
+
+def test_enospc_save_retries_then_degrades_then_recovers(tmp_path, mon):
+    main, startup, _ = _build()
+    _, scope = _scope_for(startup)
+    cm = _cm(tmp_path, main, scope)
+    inj = FaultInjector("enospc@4").arm_io()
+    try:
+        inj.set_step(2)
+        assert cm.save(step=2) is not None
+        inj.set_step(4)
+        assert cm.save(step=4) is None  # degraded, NOT an exception
+        assert cm.degraded and cm.ckpt_lag_steps == 2
+        inj.set_step(6)
+        out = cm.save(step=6)
+        assert out is not None and not cm.degraded
+    finally:
+        inj.disarm_io()
+    # exact ledger: one fault, the full retry budget, one degraded entry,
+    # one recovery, and the lag gauge back at 0
+    assert monitor.counter("faults.enospc").value == 1
+    assert monitor.counter("resilience.ckpt_save_retries").value == \
+        fluid.RetryPolicy().max_storage_retries
+    assert monitor.counter("resilience.storage_degraded").value == 1
+    assert monitor.counter("resilience.ckpt_recovered").value == 1
+    assert monitor.gauge("resilience.ckpt_lag_steps").value == 0
+    actions = [r["action"] for r in monitor.step_records()
+               if r.get("kind") == "resilience_event"]
+    assert actions == ["storage_degraded", "storage_recovered"]
+    # the degraded round left no committed ckpt-4; restore takes 6
+    assert cm.restore(scope=scope) == 6
+
+
+def test_ro_fs_skips_retries_and_uses_fallback_dir(tmp_path, mon):
+    main, startup, _ = _build()
+    _, scope = _scope_for(startup)
+    fb = str(tmp_path / "fallback")
+    cm = _cm(tmp_path / "primary", main, scope, fallback_dir=fb)
+    inj = FaultInjector("ro_fs@1").arm_io()
+    try:
+        inj.set_step(1)
+        out = cm.save(step=1)
+    finally:
+        inj.disarm_io()
+    # terminal errno: committed to the fallback store, zero retries spent
+    assert out is not None and out.startswith(fb)
+    assert not cm.degraded
+    assert monitor.counter("resilience.ckpt_save_retries").value == 0
+    assert monitor.counter("resilience.ckpt_fallback_saves").value == 1
+    # restore merges both roots
+    assert cm.restore(scope=scope) == 1
+    assert cm.last_restored_dir.startswith(fb)
+
+
+def test_max_ckpt_lag_converts_to_terminal_error(tmp_path, mon):
+    main, startup, _ = _build()
+    _, scope = _scope_for(startup)
+    cm = _cm(tmp_path, main, scope)
+    fluid.set_flags({"FLAGS_max_ckpt_lag_steps": 3})
+    inj = FaultInjector("ro_fs@0").arm_io()
+    try:
+        inj.set_step(0)
+        assert cm.save(step=0) is None  # lag 0: degraded, within bound
+        inj.set_step(5)
+        with pytest.raises(StorageError) as ei:
+            cm.save(step=5)
+        assert not ei.value.transient
+        assert "FLAGS_max_ckpt_lag_steps" in str(ei.value)
+    finally:
+        inj.disarm_io()
+        fluid.set_flags({"FLAGS_max_ckpt_lag_steps": 0})
+
+
+def test_resilient_loop_survives_enospc_with_parity(tmp_path, mon):
+    """The tentpole acceptance (single-process half): an ENOSPC window at
+    a save boundary costs a checkpoint period, never the run — training
+    continues through the degraded window, checkpointing recovers when
+    the fault clears, and the end state is BIT-IDENTICAL to a fault-free
+    run (storage faults drop no batches)."""
+    main, startup, loss = _build()
+    feeds = _feeds(12)
+
+    def run(spec, root):
+        exe, scope = _scope_for(startup)
+        cm = _cm(root, main, scope, save_every_steps=3)
+        stats = fluid.resilient_train_loop(
+            exe, main, lambda: list(feeds), [loss], scope=scope,
+            injector=FaultInjector(spec) if spec else None,
+            checkpoint_manager=cm, policy=fluid.RetryPolicy(**FAST),
+            max_inflight=3)
+        return stats, scope, cm
+
+    stats, scope, cm = run("enospc@6", tmp_path / "chaos")
+    assert stats.steps == 12
+    assert monitor.counter("resilience.storage_degraded").value == 1
+    assert monitor.counter("resilience.ckpt_recovered").value == 1
+    # the faulted period's checkpoint is missing, the next one committed
+    assert "ckpt-0000000006" not in cm.checkpoints()
+    assert "ckpt-0000000009" in cm.checkpoints()
+    monitor.disable()
+    _, ref_scope, _ = run(None, tmp_path / "clean")
+    for n in ref_scope.local_var_names():
+        np.testing.assert_array_equal(
+            np.asarray(scope.find_var(n)), np.asarray(ref_scope.find_var(n)),
+            err_msg=f"storage chaos diverged state var {n}")
+    # the injector hook was disarmed on loop exit
+    assert pio._IO_FAULT_HOOK is None
+
+
+def test_reject_unsafe_covers_fallback_dir(tmp_path, mon):
+    """Integrity quarantine (reject_unsafe) must reach fallback-dir
+    checkpoints too: restore's merged walk reaches them, so a poisoned
+    one written during a degraded window would otherwise bypass the
+    quarantine entirely."""
+    main, startup, _ = _build()
+    _, scope = _scope_for(startup)
+    fb = str(tmp_path / "fallback")
+    cm = _cm(tmp_path / "primary", main, scope, fallback_dir=fb)
+    cm.save(step=2)                      # primary, clean era
+    inj = FaultInjector("ro_fs@4").arm_io()
+    try:
+        inj.set_step(4)
+        out = cm.save(step=4)            # lands in the fallback store
+    finally:
+        inj.disarm_io()
+    assert out is not None and out.startswith(fb)
+    assert cm.reject_unsafe(3) >= 1      # step-4 fallback ckpt quarantined
+    assert cm.restore(scope=scope) == 2  # NOT the poisoned fallback copy
+    assert monitor.counter("integrity.ckpt_rejected").value >= 1
+
+
+# --- restore walk-back + scrub on unreadable files ---------------------------
+
+def test_restore_walks_back_past_unreadable_checkpoint(tmp_path, mon):
+    main, startup, _ = _build()
+    _, scope = _scope_for(startup)
+    cm = _cm(tmp_path, main, scope)
+    cm.save(step=2)
+    cm.save(step=4)
+    # every read of the NEWEST checkpoint's shards dies with EIO (a bad
+    # sector under ckpt-4): the walk must land on ckpt-2, not raise
+    bad = os.path.join(str(tmp_path), "ckpt-0000000004")
+
+    def hook(op, path):
+        if op == "read" and path.startswith(bad) and path.endswith(".npy"):
+            raise OSError(errno.EIO, "bad sector", path)
+
+    pio.set_io_fault_hook(hook)
+    try:
+        assert cm.restore(scope=scope) == 2
+    finally:
+        pio.set_io_fault_hook(None)
+    assert monitor.counter("checkpoint.restore_skipped").value >= 1
+
+
+def test_scrub_reports_unreadable_file_as_finding(tmp_path, mon):
+    from paddle_tpu import integrity
+
+    main, startup, _ = _build()
+    _, scope = _scope_for(startup)
+    cm = _cm(tmp_path, main, scope)
+    out = cm.save(step=1)
+    victim = sorted(n for n in os.listdir(out) if n.endswith(".npy"))[0]
+
+    def hook(op, path):
+        if path.endswith(victim):
+            raise OSError(errno.EACCES, "permission denied", path)
+
+    pio.set_io_fault_hook(hook)
+    try:
+        findings = integrity.scan_snapshot_dir(out)
+    finally:
+        pio.set_io_fault_hook(None)
+    classes = {f["class"] for f in findings}
+    assert "unreadable_file" in classes, findings
+    # ...and the scrub CLI gates on it
+    sys.path.insert(0, TOOLS)
+    try:
+        import scrub
+
+        assert "unreadable_file" in scrub.ERROR_CLASSES
+        pio.set_io_fault_hook(hook)
+        try:
+            assert scrub.main(["--check", str(out)]) == 1
+        finally:
+            pio.set_io_fault_hook(None)
+        assert scrub.main(["--check", str(out)]) == 0
+    finally:
+        sys.path.remove(TOOLS)
+
+
+# --- heartbeat dir on a full disk --------------------------------------------
+
+def test_heartbeat_write_failure_is_loud_and_nonfatal(tmp_path, mon):
+    """A full disk under PADDLE_HEARTBEAT_DIR used to kill the beat
+    thread silently — peers then read a LIVE rank as dead and burned a
+    gang restart on a disk hiccup.  Now: dist.heartbeat.send_errors +
+    a heartbeat_send_failed event, the thread survives, and beats resume
+    when the store clears."""
+    from paddle_tpu.dist_resilience import Heartbeat, HeartbeatConfig
+
+    hb_dir = str(tmp_path / "hb")
+    cfg = HeartbeatConfig(interval_s=0.05, miss_factor=100.0)
+    hb = Heartbeat(0, 2, hb_dir=hb_dir, config=cfg, telemetry_fn=dict)
+    full = {"on": False}
+
+    def hook(op, path):
+        if full["on"] and f"{os.sep}hb-" in path:
+            raise OSError(errno.ENOSPC, "disk full", path)
+
+    pio.set_io_fault_hook(hook)
+    try:
+        hb.start()
+        deadline = time.monotonic() + 5.0
+        while monitor.counter("dist.heartbeat.sent").value < 2:
+            assert time.monotonic() < deadline, "no clean beats"
+            time.sleep(0.02)
+        full["on"] = True
+        while monitor.counter("dist.heartbeat.send_errors").value < 2:
+            assert time.monotonic() < deadline, "write failures not counted"
+            time.sleep(0.02)
+        assert hb._thread.is_alive()
+        full["on"] = False
+        base = monitor.counter("dist.heartbeat.sent").value
+        while monitor.counter("dist.heartbeat.sent").value < base + 2:
+            assert time.monotonic() < deadline, "beats did not resume"
+            time.sleep(0.02)
+    finally:
+        pio.set_io_fault_hook(None)
+        hb.stop()
+    events = [r["action"] for r in monitor.step_records()
+              if r.get("kind") == "dist_event"]
+    assert "heartbeat_send_failed" in events
+    assert "heartbeat_send_recovered" in events
+
+
+# --- the perf_report gate ----------------------------------------------------
+
+def _write_metrics(path, records, counters=None, gauges=None):
+    with open(path, "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+        f.write(json.dumps({"counters": counters or {},
+                            "gauges": gauges or {}}) + "\n")
+
+
+def test_perf_report_ckpt_lag_gate(tmp_path):
+    sys.path.insert(0, TOOLS)
+    try:
+        import perf_report
+
+        ok = str(tmp_path / "ok.jsonl")
+        _write_metrics(ok, [
+            {"kind": "resilience_event", "action": "storage_degraded",
+             "lag_steps": 3, "at_step": 6},
+            {"kind": "resilience_event", "action": "storage_recovered",
+             "at_step": 9},
+        ], counters={"checkpoint.saves": 3})
+        assert perf_report.check(ok, max_ckpt_lag_steps=5) == 0
+        assert perf_report.check(ok, max_ckpt_lag_steps=2) == 1
+        # healthy run: gauge/counters only, lag 0
+        clean = str(tmp_path / "clean.jsonl")
+        _write_metrics(clean, [], counters={"checkpoint.saves": 4},
+                       gauges={"resilience.ckpt_lag_steps": 0})
+        assert perf_report.check(clean, max_ckpt_lag_steps=0) == 0
+        # zero evidence must not gate green
+        empty = str(tmp_path / "none.jsonl")
+        _write_metrics(empty, [{"kind": "step", "recompiles_total": 0}])
+        assert perf_report.check(empty, max_ckpt_lag_steps=0) == 1
+    finally:
+        sys.path.remove(TOOLS)

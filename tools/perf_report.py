@@ -267,10 +267,75 @@ def render(path: str) -> str:
         parts.append(f"\n## resilience ({len(revents)} events, "
                      f"recovery fraction {frac:.3f})\n"
                      + _fmt_table(rows, ["action", "class", "at", "detail"]))
+
+    sevs = [s for s in records if s.get("kind") == "resilience_event"
+            and s.get("action") in STORAGE_ACTIONS]
+    scnt = {n: v for n, v in snap.get("counters", {}).items()
+            if n.startswith("checkpoint.")
+            or n.startswith("resilience.ckpt")
+            or n in ("resilience.storage_degraded",
+                     "serving.publish_retries")}
+    if sevs or any(scnt.values()):
+        g = snap.get("gauges", {})
+        parts.append(
+            f"\n## storage ({len(sevs)} events, "
+            f"saves {scnt.get('checkpoint.saves', 0)}, "
+            f"save retries {scnt.get('resilience.ckpt_save_retries', 0)}, "
+            f"degraded entries "
+            f"{scnt.get('resilience.storage_degraded', 0)}, "
+            f"recoveries {scnt.get('resilience.ckpt_recovered', 0)}, "
+            f"fallback saves "
+            f"{scnt.get('resilience.ckpt_fallback_saves', 0)}, "
+            f"publish retries {scnt.get('serving.publish_retries', 0)}, "
+            f"ckpt lag {g.get('resilience.ckpt_lag_steps', 0)} steps)"
+            + ("\n" + _fmt_table(
+                [(r.get("action", "?"), r.get("at_step", ""),
+                  r.get("lag_steps", ""), r.get("cause", r.get("dir", "")))
+                 for r in sevs],
+                ["action", "at_step", "lag", "detail"]) if sevs else ""))
     return "\n".join(parts)
 
 
 RECOVERY_ACTIONS = ("skip_batch", "skip_step", "retry", "rollback")
+
+# storage-resilience events (ISSUE 15, paddle_tpu/checkpoint_manager.py):
+# each degraded/skipped round carries the lag it left training unprotected
+# for — the number --max-ckpt-lag-steps gates
+STORAGE_ACTIONS = ("storage_degraded", "ckpt_round_skipped",
+                   "storage_recovered", "ckpt_fallback")
+
+
+def _has_storage_evidence(lines):
+    """True when the file carries ANY checkpoint-storage signal: storage
+    resilience_event records, checkpoint.* counters, or the
+    resilience.ckpt_lag_steps gauge in a snapshot.  The lag gate fails on
+    a file with none — a run that never checkpointed (or never logged)
+    must not gate green (the zero-evidence-fails convention, PR 8/10/13)."""
+    if any(r.get("kind") == "resilience_event"
+           and r.get("action") in STORAGE_ACTIONS for r in lines):
+        return True
+    if _latest_counters(lines, "checkpoint."):
+        return True
+    g = _latest_gauges(lines, "resilience.")
+    return "resilience.ckpt_lag_steps" in g
+
+
+def ckpt_lag_steps(lines):
+    """The worst checkpoint lag the run saw: max lag_steps over
+    storage_degraded / ckpt_round_skipped resilience events, falling back
+    to the resilience.ckpt_lag_steps gauge in the newest snapshot (which
+    reads 0 after recovery — the events are the durable evidence).  0 on
+    healthy storage: every save committed, no step ran unprotected."""
+    lags = [float(r.get("lag_steps", 0) or 0) for r in lines
+            if r.get("kind") == "resilience_event"
+            and r.get("action") in ("storage_degraded", "ckpt_round_skipped")]
+    if lags:
+        return max(lags)
+    g = _latest_gauges(lines, "resilience.")
+    try:
+        return float(g.get("resilience.ckpt_lag_steps", 0.0) or 0.0)
+    except (TypeError, ValueError):
+        return 0.0
 
 
 def retry_fraction(records):
@@ -558,7 +623,8 @@ def check(path: str, steady_after: int = 2,
           max_shed_frac: float = None,
           max_p99_ms: float = None,
           max_lock_wait_frac: float = None,
-          max_integrity_mismatches: int = None) -> int:
+          max_integrity_mismatches: int = None,
+          max_ckpt_lag_steps: float = None) -> int:
     """Return 0 when the metrics file is healthy, 1 otherwise (printed
     diagnosis either way).  Made for CI/bench scripts:
 
@@ -591,7 +657,8 @@ def check(path: str, steady_after: int = 2,
                        or max_shed_frac is not None
                        or max_p99_ms is not None
                        or max_lock_wait_frac is not None
-                       or max_integrity_mismatches is not None) \
+                       or max_integrity_mismatches is not None
+                       or max_ckpt_lag_steps is not None) \
         and max_host_blocked_frac is None and max_retry_frac is None
     if not steps and not dist_gates_only:
         print(f"perf_report --check: {path} contains no step records "
@@ -816,6 +883,34 @@ def check(path: str, steady_after: int = 2,
             else:
                 print(f"perf_report --check: integrity mismatches {n} "
                       f"<= {max_integrity_mismatches}")
+    if max_ckpt_lag_steps is not None:
+        if not _has_storage_evidence(lines):
+            failures.append(
+                f"--max-ckpt-lag-steps given but {path} carries no "
+                f"checkpoint-storage evidence (no storage resilience "
+                f"events, no checkpoint.* counters, no "
+                f"resilience.ckpt_lag_steps gauge in any snapshot) — was "
+                f"a CheckpointManager attached and a snapshot written?  "
+                f"(zero evidence must not gate green)")
+        else:
+            lag = ckpt_lag_steps(lines)
+            if lag > max_ckpt_lag_steps:
+                rounds = sum(1 for r in lines
+                             if r.get("kind") == "resilience_event"
+                             and r.get("action") in ("storage_degraded",
+                                                     "ckpt_round_skipped"))
+                failures.append(
+                    f"checkpoint lag of {lag:g} step(s) exceeds the "
+                    f"--max-ckpt-lag-steps={max_ckpt_lag_steps} gate "
+                    f"({rounds} degraded/skipped save round(s)) — "
+                    f"training ran unprotected past the budget while "
+                    f"storage failed; check resilience.ckpt_storage_"
+                    f"errors, the storage_degraded events' causes, and "
+                    f"the store itself (full disk, read-only mount, "
+                    f"flaky NFS)")
+            else:
+                print(f"perf_report --check: checkpoint lag {lag:g} <= "
+                      f"{max_ckpt_lag_steps} steps")
     if max_replay_batches is not None:
         n = replayed_batches(lines)
         if n > max_replay_batches:
@@ -1246,6 +1341,18 @@ def main(argv=None):
                          "fallback (paddle_tpu/integrity.py).  Fails on "
                          "a file with no integrity evidence at all — "
                          "zero evidence must not gate green")
+    ap.add_argument("--max-ckpt-lag-steps", type=float, default=None,
+                    metavar="N",
+                    help="gate the worst checkpoint lag — steps training "
+                         "ran past its last committed checkpoint while "
+                         "storage failed (storage_degraded / "
+                         "ckpt_round_skipped resilience events, "
+                         "resilience.ckpt_lag_steps gauge fallback; "
+                         "paddle_tpu/checkpoint_manager.py degraded "
+                         "mode) — at <= N.  0 asserts every save round "
+                         "committed.  Fails on a file with no "
+                         "checkpoint-storage evidence at all — zero "
+                         "evidence must not gate green")
     ap.add_argument("--max-step-skew-frac", type=float, default=None,
                     metavar="FRAC",
                     help="gate the MAX sustained straggler lag, in step "
@@ -1274,7 +1381,8 @@ def main(argv=None):
                      args.max_step_skew_frac, args.max_gang_resizes,
                      args.max_shed_frac, args.max_p99_ms,
                      args.max_lock_wait_frac,
-                     args.max_integrity_mismatches)
+                     args.max_integrity_mismatches,
+                     args.max_ckpt_lag_steps)
     if args.diff:
         print(diff(*args.diff))
         return 0

@@ -14,7 +14,8 @@
     python tools/scrub.py --check ROOT [...]
         CI gate (same shape as program_lint/concurrency_lint --check):
         exit 1 on any error-class finding — digest_mismatch,
-        bytes_mismatch, missing_file, manifest_error, corrupt RecordIO
+        bytes_mismatch, missing_file, unreadable_file (EACCES/EIO
+        mid-scan, ISSUE 15), manifest_error, corrupt RecordIO
         chunks.  Warnings (undigested legacy manifest entries,
         uncommitted pending dirs the restore walk-back already refuses)
         never fail the gate.  Wired into tier-1 via
@@ -39,9 +40,11 @@ if __name__ == "__main__":
 
 RECORDIO_MAGIC = 0x01020304
 
-# error classes fail --check; anything else renders as a warning
+# error classes fail --check; anything else renders as a warning.
+# unreadable_file (EACCES/EIO mid-scan, ISSUE 15) is an error: a file
+# the scrub cannot hash is a file a restore cannot trust
 ERROR_CLASSES = ("digest_mismatch", "bytes_mismatch", "missing_file",
-                 "manifest_error", "corrupt_chunks")
+                 "unreadable_file", "manifest_error", "corrupt_chunks")
 
 
 def _fmt_table(rows, headers):
