@@ -573,6 +573,9 @@ def bench_serve(requests=4000, clients=6, buckets=(1, 2, 4, 8),
         f"(currently {requests}) until the window clears the floor")
     lat = srv.latency_ms()
     base_stats = srv.stats()
+    # per-bucket queue/pad/compute attribution (ISSUE 16): read before
+    # stop() like the stats — the record embeds where the latency went
+    base_attr = srv.bucket_attribution()
     recompiles = monitor.counter("executor.recompile").value - rec0
     misses = monitor.counter("executor.cache_miss").value - miss0
     # snapshot BEFORE stop(): stop releases the server's lazy p50/p99
@@ -620,6 +623,7 @@ def bench_serve(requests=4000, clients=6, buckets=(1, 2, 4, 8),
         ov_wall = _time.perf_counter() - t0
     ov_lat = ov.latency_ms()
     ov_stats = ov.stats()
+    ov_attr = ov.bucket_attribution()
     ov_logger.write_snapshot()  # before stop: gauges still armed
     monitor.detach_logger(ov_logger)
     ov.stop()
@@ -646,6 +650,12 @@ def bench_serve(requests=4000, clients=6, buckets=(1, 2, 4, 8),
                     base_stats["rows"] + base_stats["padded_rows"], 1), 4),
             "recompiles_steady": recompiles,
             "cache_misses_steady": misses,
+            # latency/pad attribution + SLO burn (ISSUE 16): queue-wait
+            # share of completed requests' wall time, per-bucket ledger
+            # (JSON keys are strings), and the windowed SLO accounting
+            "queue_wait_frac": base_stats["queue_wait_frac"],
+            "slo": base_stats["slo"],
+            "bucket_attribution": {str(b): a for b, a in base_attr.items()},
             "overload": {
                 "offered": offered[0], "completed": ov_stats["completed"],
                 "shed": shed[0], "shed_frac": round(shed_frac, 4),
@@ -653,6 +663,10 @@ def bench_serve(requests=4000, clients=6, buckets=(1, 2, 4, 8),
                 "p99_bounded": bool(ov_lat["p99"] <= p99_gate_ms),
                 "p99_gate_ms": p99_gate_ms, "queue_bound": overload_queue,
                 "req_per_sec": round((offered[0] - shed[0]) / ov_wall, 2),
+                "queue_wait_frac": ov_stats["queue_wait_frac"],
+                "slo": ov_stats["slo"],
+                "bucket_attribution": {str(b): a
+                                       for b, a in ov_attr.items()},
                 "metrics_path": ov_metrics,
             },
             "metrics_path": metrics_path}

@@ -365,11 +365,16 @@ class ServingError(TrainingError):
     operator-facing.  `model` names the model involved, when any."""
 
     def __init__(self, message: str, *, reason: Optional[str] = None,
-                 model: Optional[str] = None, **kw):
+                 model: Optional[str] = None,
+                 trace_id: Optional[str] = None, **kw):
         kw.setdefault("phase", "serving")
         super().__init__(message, **kw)
         self.reason = reason
         self.model = model
+        # the request-flight trace id (serving/tracing.py) when the monitor
+        # was on: the error a CLIENT caught names the exact trace
+        # `serve_trace --request <id>` renders.  None with the monitor off.
+        self.trace_id = trace_id
 
     def __str__(self):
         base = super().__str__()
@@ -378,6 +383,8 @@ class ServingError(TrainingError):
             ctx.append(f"reason={self.reason}")
         if self.model:
             ctx.append(f"model={self.model}")
+        if self.trace_id:
+            ctx.append(f"trace={self.trace_id}")
         return f"{base} [{', '.join(ctx)}]" if ctx else base
 
 

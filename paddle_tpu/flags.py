@@ -228,6 +228,16 @@ DEFINE_float("FLAGS_serving_hbm_budget_mb", 0.0,
              "'hbm_budget') — never OOMs the chip mid-request.  Live "
              "usage rides the monitor/memstats gauges.  0 (default) = "
              "unlimited")
+DEFINE_float("FLAGS_serving_slo_target", 0.99,
+             "serving SLO good-fraction target the burn-rate gauges are "
+             "computed against (paddle_tpu/serving/server.py): a request "
+             "is GOOD when it completes within its deadline (no deadline "
+             "= completing at all); burn_rate = bad_frac / (1 - target), "
+             "so serving.slo_burn_rate > 1.0 means the server is "
+             "spending its error budget faster than the SLO allows.  "
+             "Sheds, timeouts, errors, and late completions all burn; "
+             "admission-door rejections (bad_request/oversize/"
+             "model_missing) are not SLO traffic")
 DEFINE_string("FLAGS_serving_buckets", "1,2,4,8,16,32",
               "comma-separated pad-to-bucket batch sizes the serving "
               "runtime compiles (paddle_tpu/serving/batcher.py): a "

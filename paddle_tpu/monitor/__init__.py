@@ -28,8 +28,8 @@ See docs/observability.md.
 """
 from __future__ import annotations
 
-from .core import (Counter, FLIGHT_RECORDER_CAP, Gauge, Monitor,  # noqa: F401
-                   NULL_SPAN, Span)
+from .core import (Counter, EXEMPLAR_CAP, FLIGHT_RECORDER_CAP,  # noqa: F401
+                   Gauge, Monitor, NULL_SPAN, Span, TRACE_RING_CAP)
 from . import exporters as _exp
 from .exporters import (MonitorLogger, escape_label_value,  # noqa: F401
                         prometheus_text, summary_table)
@@ -37,9 +37,11 @@ from .memstats import register_memory_gauges
 
 __all__ = [
     "Counter", "Gauge", "Monitor", "MonitorLogger", "Span", "NULL_SPAN",
-    "FLIGHT_RECORDER_CAP", "MONITOR", "get_monitor", "enable", "disable",
+    "FLIGHT_RECORDER_CAP", "TRACE_RING_CAP", "EXEMPLAR_CAP", "MONITOR",
+    "get_monitor", "enable", "disable",
     "is_enabled", "reset", "span", "observe", "counter", "gauge",
-    "record_step", "step_records", "set_lane", "attach_logger",
+    "record_step", "step_records", "record_trace", "request_traces",
+    "record_exemplar", "exemplars", "set_lane", "attach_logger",
     "detach_logger", "export_prometheus", "export_json", "json_snapshot",
     "export_chrome_trace", "merge_chrome_traces", "summary",
     "prometheus_text", "escape_label_value", "arm_flight_recorder",
@@ -93,6 +95,25 @@ def record_step(record: dict):
 
 def step_records():
     return MONITOR.step_records()
+
+
+def record_trace(record: dict):
+    """Append a closed per-request span tree (serving/tracing.py) to the
+    bounded trace ring + the step/JSONL streams (ISSUE 16)."""
+    return MONITOR.record_trace(record)
+
+
+def request_traces():
+    return MONITOR.request_traces()
+
+
+def record_exemplar(record: dict):
+    """Retain a slow/bad-request trace in the black box's exemplar ring."""
+    return MONITOR.record_exemplar(record)
+
+
+def exemplars():
+    return MONITOR.exemplars()
 
 
 def set_lane(lane: int, name=None):

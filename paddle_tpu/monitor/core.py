@@ -42,6 +42,15 @@ STEP_CAP = 50_000
 # Flight-recorder ring depth: the "last N steps before it died" a crash
 # black box carries (per record class: step records and span events).
 FLIGHT_RECORDER_CAP = 256
+# Request-flight trace ring (ISSUE 16): the newest N closed per-request
+# span trees (serving/tracing.py) kept live for the Chrome-trace request
+# lanes and `tools/serve_trace.py` — same bounded-ring discipline as the
+# flight recorder, appends riding the registry lock.
+TRACE_RING_CAP = 1024
+# Slow/bad-request exemplar ring: full traces of deadline misses, sheds,
+# and errors, retained past the trace ring's churn so a post-mortem black
+# box still carries the episodes that actually burned the SLO.
+EXEMPLAR_CAP = 64
 
 
 class _NullSpan:
@@ -173,6 +182,10 @@ class Monitor:
         # buffers above keep the oldest), dumped as a black box on crash
         self._bb_steps: deque = deque(maxlen=FLIGHT_RECORDER_CAP)
         self._bb_events: deque = deque(maxlen=FLIGHT_RECORDER_CAP)
+        # request-flight traces (ISSUE 16): newest-N closed span trees,
+        # plus the slow/bad exemplars the black box keeps past ring churn
+        self._traces: deque = deque(maxlen=TRACE_RING_CAP)
+        self._exemplars: deque = deque(maxlen=EXEMPLAR_CAP)
         self._bb_path: Optional[str] = None
         self._bb_rank = 0
         self._bb_dumped: Optional[str] = None
@@ -205,6 +218,8 @@ class Monitor:
             self._steps.clear()
             self._bb_steps.clear()
             self._bb_events.clear()
+            self._traces.clear()
+            self._exemplars.clear()
             # a reset starts a fresh run: the one-shot dump latch re-opens
             # (the armed path survives — re-arm to change it)
             self._bb_dumped = None
@@ -333,6 +348,41 @@ class Monitor:
         with self._lock:
             return list(self._steps)
 
+    # -- request-flight traces (ISSUE 16) ----------------------------------
+    def record_trace(self, record: dict):
+        """Append one CLOSED per-request span tree (a `serving_trace`
+        record from serving/tracing.py) to the bounded trace ring, and
+        fan it through `record_step` so it rides the JSONL stream, the
+        step buffer, and the flight-recorder ring like every other
+        record kind.  One branch when disabled."""
+        if not self.enabled:
+            return
+        record = dict(record)
+        record.setdefault("kind", "serving_trace")
+        with self._lock:
+            self._traces.append(record)
+        self.record_step(record)
+
+    def request_traces(self) -> List[dict]:
+        """The newest TRACE_RING_CAP closed request traces (exporters
+        render them as Chrome-trace request lanes)."""
+        with self._lock:
+            return list(self._traces)
+
+    def record_exemplar(self, record: dict):
+        """Retain a slow/bad-request trace (deadline miss, shed, error,
+        rejected publish) in the exemplar ring the black box carries —
+        these must survive the trace ring's churn so a post-mortem still
+        shows the episodes that burned the SLO."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._exemplars.append(dict(record))
+
+    def exemplars(self) -> List[dict]:
+        with self._lock:
+            return list(self._exemplars)
+
     # -- flight recorder ---------------------------------------------------
     def arm_flight_recorder(self, path: str, rank: int = 0) -> "Monitor":
         """Name the black-box destination (`BLACKBOX.p<rank>.json` under a
@@ -352,6 +402,7 @@ class Monitor:
         died."""
         with self._lock:
             steps = list(self._bb_steps)
+            exemplars = list(self._exemplars)
             events = [
                 {"name": n, "ts": ts, "dur_s": dur, "tid": tid,
                  "depth": depth,
@@ -367,7 +418,8 @@ class Monitor:
                 "rank": self._bb_rank, "pid": os.getpid(),
                 "ts": time.time(), "lane": self.lane,
                 "lane_name": self.lane_name, "steps": steps,
-                "events": events, "counters": self.counter_values(),
+                "events": events, "exemplars": exemplars,
+                "counters": self.counter_values(),
                 "gauges": gauges}
 
     def dump_blackbox(self, reason: str = "manual",

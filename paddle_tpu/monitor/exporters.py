@@ -113,6 +113,40 @@ def export_json(mon, path: str, include_steps: bool = True) -> str:
     return path
 
 
+def request_trace_events(mon, pid: Optional[int] = None) -> list:
+    """Render the monitor's request-flight traces (ISSUE 16, the bounded
+    ring serving/tracing.py fills) as Chrome-trace ASYNC lanes: one
+    b/e pair per span, correlated by the request's trace id.  Async
+    events get their own per-id track in perfetto/chrome://tracing, so
+    merging these with the per-rank span lanes (merge_chrome_traces)
+    shows a request from submit to respond ABOVE the executor spans that
+    served it."""
+    pid = mon.lane if pid is None else pid
+    events = []
+    for tr in getattr(mon, "request_traces", list)() or ():
+        rid = str(tr.get("trace_id", "?"))
+        t0_us = float(tr.get("ts", 0.0) or 0.0) * 1e6
+        spans = tr.get("spans") or ()
+        for i, sp in enumerate(spans):
+            ts = t0_us + float(sp.get("t_ms", 0.0) or 0.0) * 1e3
+            b = {"name": f"req.{sp.get('name', '?')}", "ph": "b",
+                 "cat": "request", "id": rid, "pid": pid, "tid": 0,
+                 "ts": ts}
+            if i == 0:
+                b["args"] = {"trace_id": rid,
+                             "model": str(tr.get("model", "")),
+                             "outcome": str(tr.get("outcome", "")),
+                             "reason": str(tr.get("reason", "")),
+                             "bucket": str(tr.get("bucket", "")),
+                             "pad_rows": str(tr.get("pad_rows", ""))}
+            events.append(b)
+            events.append({"name": b["name"], "ph": "e", "cat": "request",
+                           "id": rid, "pid": pid, "tid": 0,
+                           "ts": ts + float(sp.get("dur_ms", 0.0) or 0.0)
+                           * 1e3})
+    return events
+
+
 def chrome_trace_events(mon, pid: Optional[int] = None,
                         process_name: Optional[str] = None) -> list:
     pid = mon.lane if pid is None else pid
@@ -124,18 +158,21 @@ def chrome_trace_events(mon, pid: Optional[int] = None,
         if args:
             ev["args"] = {k: str(v) for k, v in args.items()}
         events.append(ev)
+    # request-flight lanes ride the same document so one export (and the
+    # trace_merge.py gang merge) carries spans AND requests
+    events.extend(request_trace_events(mon, pid))
     return events
 
 
 def export_chrome_trace(mon, path: str, pid: Optional[int] = None,
                         process_name: Optional[str] = None) -> int:
     """Write buffered span events as Chrome trace JSON; returns the number
-    of span events written (metadata rows excluded), matching the old
-    profiler.export_chrome_trace contract."""
+    of span events written (metadata rows and request-lane async events
+    excluded), matching the old profiler.export_chrome_trace contract."""
     events = chrome_trace_events(mon, pid, process_name)
     with open(path, "w") as f:
         json.dump({"traceEvents": events}, f)
-    return len(events) - 1
+    return sum(1 for e in events if e.get("ph") == "X")
 
 
 def merge_chrome_traces(named_paths, out_path: str) -> str:

@@ -20,25 +20,33 @@ Failure semantics ride `paddle_tpu.errors.ServingError` (reason codes:
 overload / timeout / oversize / publish_rejected / hbm_budget /
 model_missing / shutdown); metrics ride the monitor (serving.* counters
 and gauges, `serving_batch` / `serving_event` records) and are gated by
-`perf_report --check --max-shed-frac/--max-p99-ms`.  See
-docs/serving.md.
+`perf_report --check --max-shed-frac/--max-p99-ms`.  With the monitor
+enabled every request additionally carries a flight trace
+(`serving_trace` records; tracing.py) inspectable live with
+`tools/serve_trace.py`, plus SLO burn-rate and pad/queue attribution
+gauges (ISSUE 16).  See docs/serving.md and docs/observability.md.
 """
 from __future__ import annotations
 
-from .batcher import (DEFAULT_BUCKETS, bucket_for, coalesce,  # noqa: F401
-                      concat_feeds, pad_feeds, parse_buckets, split_rows,
-                      validate_feeds)
+from .batcher import (DEFAULT_BUCKETS, bucket_for, build_batch,  # noqa: F401
+                      coalesce, concat_feeds, pad_feeds, parse_buckets,
+                      split_rows, validate_feeds)
 from .publisher import publish, rollback, verify_snapshot_dir  # noqa: F401
 from .registry import (ModelRegistry, ModelVersion,  # noqa: F401
                        manifest_weight_bytes, plan_model_bytes,
                        synthetic_feeds)
 from .server import Future, Server  # noqa: F401
+from .tracing import (NULL_TRACE, RequestTrace, TRACE_PHASES,  # noqa: F401
+                      control_trace_id, maybe_trace)
 
 __all__ = [
     "DEFAULT_BUCKETS", "parse_buckets", "bucket_for", "pad_feeds",
     "concat_feeds", "split_rows", "coalesce", "validate_feeds",
+    "build_batch",
     "ModelRegistry", "ModelVersion", "synthetic_feeds",
     "manifest_weight_bytes", "plan_model_bytes",
     "publish", "rollback", "verify_snapshot_dir",
     "Server", "Future",
+    "RequestTrace", "NULL_TRACE", "maybe_trace", "control_trace_id",
+    "TRACE_PHASES",
 ]

@@ -31,7 +31,7 @@ from ..flags import flag as _flag
 
 __all__ = ["DEFAULT_BUCKETS", "parse_buckets", "bucket_for", "batch_rows",
            "validate_feeds", "pad_feeds", "concat_feeds", "split_rows",
-           "coalesce"]
+           "coalesce", "build_batch"]
 
 DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32)
 
@@ -156,6 +156,19 @@ def split_rows(outputs: Sequence[np.ndarray], offsets: Sequence[Tuple[int, int]]
                 vals.append(arr)
         out.append(vals)
     return out
+
+
+def build_batch(requests, buckets: Sequence[int]):
+    """Concat + bucket + pad one coalesced pick in a single step,
+    SURFACING the pad count instead of dropping it on the floor (ISSUE
+    16 satellite): returns `(padded_feeds, rows, bucket, pad_rows)` so
+    the server can attribute pad waste per bucket (`serving.pad_rows`
+    counter, `serving.bucket[N].pad_frac` gauges) and stamp it into each
+    member request's `batch_build` span."""
+    feeds = concat_feeds([r.feeds for r in requests])
+    rows = sum(r.rows for r in requests)
+    bucket = bucket_for(rows, buckets)
+    return pad_feeds(feeds, bucket), rows, bucket, bucket - rows
 
 
 def coalesce(requests, max_rows: int):

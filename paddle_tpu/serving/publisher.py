@@ -67,6 +67,7 @@ from ..errors import ServingError, StorageError, classify
 from ..inference import Predictor
 from ..monitor import MONITOR as _MON
 from .. import io as _io
+from . import tracing as _tr
 from .registry import ModelRegistry, ModelVersion, synthetic_feeds
 
 __all__ = ["publish", "rollback", "verify_snapshot_dir"]
@@ -98,30 +99,39 @@ def _store_io_failure(e: BaseException) -> Optional[StorageError]:
     return None
 
 
-def _fail_publish_io(name: str, src: str, cause, attempts: int):
+def _fail_publish_io(name: str, src: str, cause, attempts: int,
+                     trace_id=None):
     """Classified store-I/O publish failure: loud, NO quarantine — the
     snapshot may be fine, the store is not."""
     _MON.counter("serving.publish_io_failed").inc()
     _MON.record_step({
         "kind": "serving_event", "action": "publish_io_failed",
         "model": name, "src": src, "attempts": attempts,
-        "detail": str(cause)})
+        "detail": str(cause), "trace_id": trace_id})
     raise ServingError(
         f"publish of {src!r} into model {name!r} failed on store I/O "
         f"after {attempts} attempt(s) ({cause}); NOT quarantined — the "
         f"snapshot may be fine, the store is not",
-        reason="publish_io", model=name) from cause
+        reason="publish_io", model=name, trace_id=trace_id) from cause
 
 
-def _reject(registry: ModelRegistry, name: str, src: str, detail: str):
+def _reject(registry: ModelRegistry, name: str, src: str, trace_id,
+            detail: str):
     registry.quarantined.add(os.path.realpath(src))
     _MON.counter("serving.publish_rejected").inc()
     _MON.record_step({"kind": "serving_event", "action": "publish_rejected",
-                      "model": name, "src": src, "detail": detail})
+                      "model": name, "src": src, "detail": detail,
+                      "trace_id": trace_id})
+    # a rejected publish is exactly the kind of episode a post-mortem
+    # starts from: retain it in the black box's exemplar ring (ISSUE 16)
+    _MON.record_exemplar({"kind": "serving_trace", "trace_id": trace_id,
+                          "model": name, "outcome": "error",
+                          "reason": "publish_rejected", "src": src,
+                          "detail": detail})
     raise ServingError(
         f"publish of {src!r} into model {name!r} REJECTED and quarantined "
         f"({detail}); the previous version keeps serving",
-        reason="publish_rejected", model=name)
+        reason="publish_rejected", model=name, trace_id=trace_id)
 
 
 def verify_snapshot_dir(src: str) -> str:
@@ -200,6 +210,10 @@ def publish(registry: ModelRegistry, name: str, src,
         while name in registry._publishing:
             registry._publish_cv.wait(0.1)
         registry._publishing.add(name)
+    # one control trace id per publish EPISODE (retries included), so
+    # every event/rejection/retry of this reload is addressable on the
+    # same timeline as the requests it raced (serving/tracing.py)
+    ctl = _tr.control_trace_id("pub")
     try:
         # transient store I/O retries the whole ladder (idempotent up to
         # the swap); content defects quarantine inside the ladder as ever
@@ -208,17 +222,18 @@ def publish(registry: ModelRegistry, name: str, src,
             try:
                 return _publish_ladder(registry, name, src, golden_feeds,
                                        golden_expect, golden_rtol,
-                                       golden_atol, warm_buckets)
+                                       golden_atol, warm_buckets, ctl)
             except _RetryableStoreIO as e:
                 cause = e.__cause__
                 attempt += 1
                 if attempt >= PUBLISH_IO_ATTEMPTS:
-                    _fail_publish_io(name, src, cause, attempt)
+                    _fail_publish_io(name, src, cause, attempt,
+                                     trace_id=ctl)
                 _MON.counter("serving.publish_retries").inc()
                 _MON.record_step({
                     "kind": "serving_event", "action": "publish_io_retry",
                     "model": name, "src": src, "attempt": attempt,
-                    "detail": str(cause)})
+                    "detail": str(cause), "trace_id": ctl})
                 from ..resilience import RetryPolicy
 
                 time.sleep(RetryPolicy().backoff_s(attempt - 1))
@@ -229,20 +244,20 @@ def publish(registry: ModelRegistry, name: str, src,
 
 
 def _publish_ladder(registry, name, src, golden_feeds, golden_expect,
-                    golden_rtol, golden_atol, warm_buckets):
-    with _MON.span("serving.publish", model=name):
+                    golden_rtol, golden_atol, warm_buckets, ctl=None):
+    with _MON.span("serving.publish", model=name, trace_id=ctl):
         # publish reloads an EXISTING model (use registry.load for new
         # names); a missing target is the caller's error, not the
         # snapshot's, so it raises model_missing rather than quarantining
         active = registry.acquire(name)
         if os.path.realpath(src) in registry.quarantined:
-            _reject(registry, name, src,
+            _reject(registry, name, src, ctl,
                     "source already quarantined by an earlier rejected "
                     "publish")
         try:
             kind = verify_snapshot_dir(src)
         except ValueError as e:
-            _reject(registry, name, src, f"integrity: {e}")
+            _reject(registry, name, src, ctl, f"integrity: {e}")
         # digest fast-reject (ISSUE 14): re-hash every manifest-stamped
         # file BEFORE staging — a rotted snapshot quarantines in
         # milliseconds instead of paying the stage/verify/smoke/warm
@@ -260,8 +275,8 @@ def _publish_ladder(registry, name, src, golden_feeds, golden_expect,
                 # terminal store I/O (EACCES/EROFS): retrying is useless,
                 # but quarantining would record a content verdict no
                 # content check made — classified failure, clean slate
-                _fail_publish_io(name, src, se, attempts=1)
-            _reject(registry, name, src,
+                _fail_publish_io(name, src, se, attempts=1, trace_id=ctl)
+            _reject(registry, name, src, ctl,
                     f"integrity: manifest digest check failed ({e})")
         try:
             program, feed_names, fetch_names, staged = _stage(
@@ -271,8 +286,8 @@ def _publish_ladder(registry, name, src, golden_feeds, golden_expect,
             if se is not None and se.transient:
                 raise _RetryableStoreIO(str(e)) from e
             if se is not None:
-                _fail_publish_io(name, src, se, attempts=1)
-            _reject(registry, name, src,
+                _fail_publish_io(name, src, se, attempts=1, trace_id=ctl)
+            _reject(registry, name, src, ctl,
                     f"staging failed ({type(e).__name__}: {e})")
         # program verification (core/analysis): the staged program must
         # pass the structural verifier with the serving feed/fetch targets
@@ -280,12 +295,12 @@ def _publish_ladder(registry, name, src, golden_feeds, golden_expect,
             check_program(program, level="structural",
                           feed_names=feed_names, fetch_names=fetch_names)
         except Exception as e:
-            _reject(registry, name, src, f"program verification: {e}")
+            _reject(registry, name, src, ctl, f"program verification: {e}")
         # weight health: a non-finite weight poisons every request
         for vname in staged.local_var_names():
             arr = np.asarray(staged.find_var(vname))
             if arr.dtype.kind == "f" and not np.isfinite(arr).all():
-                _reject(registry, name, src,
+                _reject(registry, name, src, ctl,
                         f"non-finite values in staged weight {vname!r}")
         # golden-input smoke on the staged predictor (shared executor:
         # the smoke run is also the bucket-1-shaped compile)
@@ -299,27 +314,27 @@ def _publish_ladder(registry, name, src, golden_feeds, golden_expect,
         try:
             outs = predictor.run(feeds)
         except Exception as e:
-            _reject(registry, name, src,
+            _reject(registry, name, src, ctl,
                     f"golden smoke inference failed "
                     f"({type(e).__name__}: {e})")
         for fname, o in zip(fetch_names, outs):
             arr = np.asarray(o)
             if arr.dtype.kind == "f" and not np.isfinite(arr).all():
-                _reject(registry, name, src,
+                _reject(registry, name, src, ctl,
                         f"golden smoke produced non-finite {fname!r}")
         if golden_expect is not None:
             if len(golden_expect) != len(fetch_names):
                 # zip() would silently stop comparing at the shorter list,
                 # leaving trailing fetches unverified — that is a caller
                 # bug the ladder must not paper over
-                _reject(registry, name, src,
+                _reject(registry, name, src, ctl,
                         f"golden_expect carries {len(golden_expect)} "
                         f"entries but the model fetches "
                         f"{len(fetch_names)} ({fetch_names})")
             for fname, got, want in zip(fetch_names, outs, golden_expect):
                 if not np.allclose(np.asarray(got), np.asarray(want),
                                    rtol=golden_rtol, atol=golden_atol):
-                    _reject(registry, name, src,
+                    _reject(registry, name, src, ctl,
                             f"golden output {fname!r} drifted past "
                             f"rtol={golden_rtol}")
         version = ModelVersion(program, feed_names, fetch_names, staged,
@@ -333,7 +348,7 @@ def _publish_ladder(registry, name, src, golden_feeds, golden_expect,
                 with _MON.span("serving.warm", model=name, bucket=b):
                     predictor.run(synthetic_feeds(program, feed_names, b))
         except Exception as e:
-            _reject(registry, name, src,
+            _reject(registry, name, src, ctl,
                     f"pre-swap bucket warm failed "
                     f"({type(e).__name__}: {e})")
         prev = registry.publish_version(name, version)
@@ -341,7 +356,8 @@ def _publish_ladder(registry, name, src, golden_feeds, golden_expect,
         _MON.record_step({"kind": "serving_event", "action": "publish",
                           "model": name, "src": src,
                           "version": version.version,
-                          "prev_version": prev.version})
+                          "prev_version": prev.version,
+                          "trace_id": ctl})
     return version
 
 

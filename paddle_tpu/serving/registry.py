@@ -54,6 +54,7 @@ from ..errors import ServingError
 from ..flags import flag as _flag
 from ..inference import AnalysisConfig, Predictor
 from ..monitor import MONITOR as _MON
+from . import tracing as _tr
 from .. import io as _io
 
 __all__ = ["ModelVersion", "ModelRegistry", "synthetic_feeds",
@@ -453,5 +454,8 @@ class ModelRegistry:
                     reason="model_missing", model=name)
             m.active = older[-1]
             _MON.counter("serving.rollbacks").inc()
-            self._event("rollback", model=name, version=m.active.version)
+            # control trace id: the rollback episode is addressable on the
+            # request timeline (serve_trace) like a publish is
+            self._event("rollback", model=name, version=m.active.version,
+                        trace_id=_tr.control_trace_id("rb"))
             return m.active

@@ -1,0 +1,579 @@
+"""Request-flight tracing matrix (ISSUE 16).
+
+The contract under test, per docs/observability.md: with the monitor
+enabled every serving submit gets a trace id and a span tree (admission
+-> queue -> batch_build -> device -> fetch -> respond), EVERY terminal
+outcome — completed, shed, timeout, error, shutdown, and the
+admission-door rejections — closes its trace with the same stable
+reason code the raised ServingError carries, shed/timeout/error
+episodes land exemplars in the flight-recorder black box, pad waste and
+queue wait are attributed per bucket (counters + gauges + serving_batch
+stamps), SLO burn is accounted against the request deadlines, the
+Chrome-trace export grows per-request lanes, `tools/serve_trace.py`
+renders and gates the stream — and with the monitor DISABLED the whole
+layer is one branch returning a shared null singleton (the PR-8
+µs-scale hot-path contract).
+
+Everything runs on CPU (conftest pins JAX_PLATFORMS=cpu); tier-1.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, monitor, serving
+from paddle_tpu.errors import ServingError
+from paddle_tpu.monitor import EXEMPLAR_CAP, MONITOR, TRACE_RING_CAP
+from paddle_tpu.serving import tracing
+
+D_IN, D_OUT = 8, 4
+
+
+@pytest.fixture
+def mon():
+    monitor.reset()
+    monitor.enable()
+    yield monitor
+    monitor.disable()
+    monitor.reset()
+
+
+def _build_net():
+    from paddle_tpu.core import unique_name
+
+    with unique_name.guard():
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [D_IN], dtype="float32")
+            out = layers.fc(x, D_OUT, act=None)
+    return main, startup, out
+
+
+def _save_model(dirname, w_scale=1.0, poison_nan=False):
+    main, startup, out = _build_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    startup.random_seed = 3
+    exe.run(startup, scope=scope)
+    for v in main.list_vars():
+        if v.persistable:
+            arr = np.full(np.asarray(scope.find_var(v.name)).shape, w_scale,
+                          dtype="float32")
+            if poison_nan:
+                arr.flat[0] = np.nan
+            scope.set_var(v.name, arr)
+    fluid.io.save_inference_model(dirname, ["x"], [out], exe, main, scope)
+    return dirname
+
+
+def _server(tmp_path, name="m", buckets=(2, 4), w_scale=1.0, **kw):
+    d = _save_model(str(tmp_path / f"model_{name}_{w_scale}"), w_scale)
+    reg = serving.ModelRegistry(place=fluid.CPUPlace())
+    srv = serving.Server(reg, buckets=buckets, **kw)
+    srv.load_model(name, d, warm=kw.get("start", True))
+    return srv, d
+
+
+def _traces(outcome=None):
+    ts = monitor.request_traces()
+    if outcome is None:
+        return ts
+    return [t for t in ts if t.get("outcome") == outcome]
+
+
+# --------------------------------------------------------------------------
+# the span tree of a completed request
+# --------------------------------------------------------------------------
+
+def test_completed_trace_full_span_tree(tmp_path, mon):
+    srv, _ = _server(tmp_path, buckets=(2,))
+    try:
+        xv = np.ones((1, D_IN), "f4")
+        srv.infer("m", {"x": xv})
+    finally:
+        srv.stop()
+    (t,) = _traces("completed")
+    assert t["kind"] == "serving_trace"
+    assert t["trace_id"].startswith("r")
+    assert t["model"] == "m" and t["rows"] == 1
+    names = [s["name"] for s in t["spans"]]
+    assert names == list(tracing.TRACE_PHASES)  # the full canonical tree
+    # span arithmetic: contiguous, and the durations cover the total
+    total = sum(s["dur_ms"] for s in t["spans"])
+    assert total == pytest.approx(t["total_ms"], abs=0.01)
+    for prev, nxt in zip(t["spans"], t["spans"][1:]):
+        assert nxt["t_ms"] == pytest.approx(
+            prev["t_ms"] + prev["dur_ms"], abs=0.01)
+    # batch_build carried the pad attribution annotations
+    assert t["bucket"] == 2 and t["pad_rows"] == 1 and t["batch_rows"] == 1
+    assert t["lat_ms"] > 0 and t["slo_miss"] is False
+
+
+def test_serving_batch_record_stamped_with_attribution(tmp_path, mon):
+    srv, _ = _server(tmp_path, buckets=(2,))
+    try:
+        srv.infer("m", {"x": np.ones((1, D_IN), "f4")})
+    finally:
+        srv.stop()
+    (b,) = [r for r in monitor.step_records()
+            if r.get("kind") == "serving_batch"]
+    assert b["pad_rows"] == 1 and b["pad_frac"] == 0.5
+    assert 0.0 <= b["queue_wait_frac"] <= 1.0
+    assert b["queue_ms_mean"] >= 0 and b["queue_ms_max"] >= b["queue_ms_mean"]
+    for k in ("t_build_s", "t_infer_s", "t_fetch_s"):
+        assert b[k] >= 0
+    (t,) = _traces("completed")
+    assert b["trace_ids"] == [t["trace_id"]]
+
+
+# --------------------------------------------------------------------------
+# every terminal outcome closes a trace (the reconciliation satellite)
+# --------------------------------------------------------------------------
+
+def test_all_terminal_outcomes_close_traces(tmp_path, mon):
+    """One server driven through completed/shed/timeout/rejected/shutdown:
+    the trace stream reconciles with the ledger, outcome by outcome."""
+    srv, _ = _server(tmp_path, buckets=(2, 4), max_queue=2, start=False)
+    srv.registry.warm("m", (2, 4))
+    xv = np.ones((1, D_IN), "f4")
+    completed = srv.submit("m", {"x": xv})
+    doomed = srv.submit("m", {"x": xv}, deadline_ms=5)
+    with pytest.raises(ServingError) as shed_ei:
+        srv.submit("m", {"x": xv})  # queue bound = 2: shed
+    with pytest.raises(ServingError) as rej_ei:
+        srv.submit("nope", {"x": xv})  # unknown model: door rejection
+    time.sleep(0.08)  # deadline lapses while queued
+    srv.start()
+    (out,) = completed.result(timeout=30)
+    with pytest.raises(ServingError):
+        doomed.result(timeout=30)
+    # leave one queued and stop without workers draining it -> shutdown
+    srv.stop()
+    srv2, _ = _server(tmp_path, name="m2", buckets=(2,), start=False)
+    leftover = srv2.submit("m2", {"x": xv})
+    srv2.stop()
+    with pytest.raises(ServingError) as sd_ei:
+        leftover.result(timeout=5)
+    assert sd_ei.value.reason == "shutdown"
+
+    by = {}
+    for t in _traces():
+        by[t["outcome"]] = by.get(t["outcome"], 0) + 1
+    assert by == {"completed": 1, "shed": 1, "timeout": 1, "rejected": 1,
+                  "shutdown": 1}
+    # stable reason codes ride both the trace and the raised error, and
+    # the error names the trace
+    reasons = {t["outcome"]: t.get("reason") for t in _traces()}
+    assert reasons["shed"] == shed_ei.value.reason == "overload"
+    assert reasons["rejected"] == rej_ei.value.reason == "model_missing"
+    assert reasons["timeout"] == "timeout"
+    assert shed_ei.value.trace_id == next(
+        t["trace_id"] for t in _traces("shed"))
+    # ledger identity, trace side: in-ledger traces == requests admitted
+    in_ledger = [t for t in _traces() if t["outcome"] != "rejected"]
+    admitted = (srv.stats()["requests"] + srv2.stats()["requests"])
+    assert len(in_ledger) == admitted == 4
+    # early closes end on the phase that killed them
+    assert _traces("shed")[0]["spans"][-1]["name"] == "admission"
+    assert _traces("timeout")[0]["spans"][-1]["name"] == "batch_build"
+    assert _traces("shutdown")[0]["spans"][-1]["name"] == "queue"
+
+
+def test_error_path_closes_traces_classified(tmp_path, mon,
+                                             monkeypatch):
+    """A worker-side bomb (result splitting) fails the batch's futures
+    AND closes their traces as errors with a stable reason."""
+    srv, _ = _server(tmp_path, buckets=(2,))
+    try:
+        def bomb(*a, **k):
+            raise OSError("simulated result-split disaster")
+
+        monkeypatch.setattr("paddle_tpu.serving.batcher.split_rows", bomb)
+        with pytest.raises(Exception):
+            srv.infer("m", {"x": np.ones((1, D_IN), "f4")})
+    finally:
+        srv.stop()
+    (t,) = _traces("error")
+    assert t["spans"][-1]["name"] == "error"
+    assert t.get("reason")  # classified, not empty
+    assert srv.stats()["errors"] == 1
+
+
+# --------------------------------------------------------------------------
+# exemplars into the black box
+# --------------------------------------------------------------------------
+
+def test_shed_and_timeout_exemplars_in_blackbox(tmp_path, mon):
+    srv, _ = _server(tmp_path, buckets=(2,), max_queue=1, start=False)
+    srv.registry.warm("m", (2,))
+    xv = np.ones((1, D_IN), "f4")
+    doomed = srv.submit("m", {"x": xv}, deadline_ms=5)
+    with pytest.raises(ServingError):
+        srv.submit("m", {"x": xv})  # shed
+    time.sleep(0.08)
+    srv.start()
+    with pytest.raises(ServingError):
+        doomed.result(timeout=30)
+    srv.stop()
+    exes = monitor.blackbox_snapshot()["exemplars"]
+    outcomes = sorted(e["outcome"] for e in exes)
+    assert outcomes == ["shed", "timeout"]
+    assert all(e["kind"] == "serving_trace" for e in exes)
+
+
+# --------------------------------------------------------------------------
+# control-plane trace ids (publish / rollback mid-flight)
+# --------------------------------------------------------------------------
+
+def test_publish_and_rollback_carry_control_ids(tmp_path, mon):
+    srv, _ = _server(tmp_path, buckets=(2,))
+    try:
+        v2 = _save_model(str(tmp_path / "v2"), w_scale=2.0)
+        srv.publish("m", v2)
+        srv.rollback("m")
+        events = {r["action"]: r for r in monitor.step_records()
+                  if r.get("kind") == "serving_event"}
+        assert events["publish"]["trace_id"].startswith("pub-")
+        assert events["rollback"]["trace_id"].startswith("rb-")
+    finally:
+        srv.stop()
+
+
+def test_rejected_publish_mid_flight_traced_and_exemplared(tmp_path, mon):
+    """A publish rejected while requests flow: the rejection event and
+    the raised error share a pub- control id, an exemplar lands in the
+    black box, and traffic's own traces keep completing."""
+    srv, _ = _server(tmp_path, buckets=(2,))
+    try:
+        xv = np.ones((1, D_IN), "f4")
+        srv.infer("m", {"x": xv})
+        bad = _save_model(str(tmp_path / "bad"), w_scale=2.0,
+                          poison_nan=True)
+        with pytest.raises(ServingError) as ei:
+            srv.publish("m", bad)
+        assert ei.value.reason == "publish_rejected"
+        assert ei.value.trace_id.startswith("pub-")
+        (ev,) = [r for r in monitor.step_records()
+                 if r.get("kind") == "serving_event"
+                 and r.get("action") == "publish_rejected"]
+        assert ev["trace_id"] == ei.value.trace_id
+        exes = [e for e in monitor.blackbox_snapshot()["exemplars"]
+                if e.get("reason") == "publish_rejected"]
+        assert exes and exes[0]["trace_id"] == ei.value.trace_id
+        srv.infer("m", {"x": xv})  # old version serves on
+        assert len(_traces("completed")) == 2
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------------------------------
+# disabled-monitor zero-overhead guard (the PR-8 contract)
+# --------------------------------------------------------------------------
+
+def _per_call(fn, n):
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return (time.perf_counter() - t0) / n
+
+
+def test_disabled_monitor_null_trace_zero_overhead(tmp_path):
+    monitor.disable()
+    # one branch, one shared singleton — no per-request allocation
+    tr = tracing.maybe_trace(MONITOR, "m")
+    assert tr is tracing.NULL_TRACE
+    assert tr is tracing.maybe_trace(MONITOR, "other", deadline_ms=5.0)
+    assert tr.trace_id is None and tr.enabled is False
+    assert tr.close("completed") is None  # and closing records nothing
+    n = 20000
+    assert _per_call(lambda: tracing.maybe_trace(MONITOR, "m"), n) < 5e-6
+    assert _per_call(lambda: tr.phase("queue"), n) < 5e-6
+    # a disabled serving round produces NO traces and still serves
+    srv, _ = _server(tmp_path, buckets=(2,))
+    try:
+        xv = np.ones((1, D_IN), "f4")
+        (out,) = srv.infer("m", {"x": xv})
+        assert out.shape == (1, D_OUT)
+    finally:
+        srv.stop()
+    assert monitor.request_traces() == []
+    assert srv.stats()["requests"] == 1  # exact ledger even when dark
+
+
+# --------------------------------------------------------------------------
+# pad-waste + queue-wait attribution (counters, gauges, ledger)
+# --------------------------------------------------------------------------
+
+def test_pad_counter_and_bucket_pad_frac_gauge(tmp_path, mon):
+    srv, _ = _server(tmp_path, buckets=(2, 4))
+    try:
+        srv.infer("m", {"x": np.ones((1, D_IN), "f4")})  # bucket 2, pad 1
+        srv.infer("m", {"x": np.ones((3, D_IN), "f4")})  # bucket 4, pad 1
+        assert monitor.counter("serving.pad_rows").value == 2
+        assert monitor.gauge("serving.bucket[2].pad_frac").read() \
+            == pytest.approx(0.5)
+        assert monitor.gauge("serving.bucket[4].pad_frac").read() \
+            == pytest.approx(0.25)
+        assert 0.0 <= monitor.gauge("serving.queue_wait_frac").read() <= 1.0
+        attr = srv.bucket_attribution()
+        assert attr[2]["pad_rows"] == 1 and attr[4]["pad_rows"] == 1
+        assert attr[2]["requests"] == 1 and attr[4]["rows"] == 3
+        assert attr[4]["occupancy"] == pytest.approx(0.75)
+        assert 0.0 <= srv.queue_wait_frac() <= 1.0
+    finally:
+        srv.stop()
+
+
+def test_slo_burn_accounting(tmp_path, mon):
+    """Timeouts and sheds burn the SLO budget; on-time completions with
+    no deadline do not.  The windowed gauges agree with the ledger."""
+    srv, _ = _server(tmp_path, buckets=(2,), max_queue=1, start=False)
+    srv.registry.warm("m", (2,))
+    xv = np.ones((1, D_IN), "f4")
+    doomed = srv.submit("m", {"x": xv}, deadline_ms=5)
+    with pytest.raises(ServingError):
+        srv.submit("m", {"x": xv})  # shed -> slo_bad
+    time.sleep(0.08)
+    srv.start()
+    with pytest.raises(ServingError):
+        doomed.result(timeout=30)  # timeout -> slo_bad
+    srv.infer("m", {"x": xv})  # completed, no deadline -> slo_good
+    s = srv.stats()
+    assert s["slo"]["good"] == 1 and s["slo"]["bad"] == 2
+    assert s["slo"]["good"] + s["slo"]["bad"] == s["requests"]
+    assert s["slo"]["good_frac"] == pytest.approx(1.0 / 3.0, abs=1e-3)
+    # burn rate vs the default 0.99 target: 2/3 bad is ~66x the budget
+    assert s["slo"]["burn_rate"] == pytest.approx(
+        (2.0 / 3.0) / (1.0 - s["slo"]["target"]), rel=1e-3)
+    assert monitor.counter("serving.slo_bad").value == 2
+    assert monitor.counter("serving.slo_good").value == 1
+    assert monitor.gauge("serving.slo_good_frac").read() \
+        == pytest.approx(1.0 / 3.0, abs=1e-3)
+    assert monitor.gauge("serving.slo_burn_rate").read() > 1.0
+    srv.stop()
+
+
+# --------------------------------------------------------------------------
+# bounded rings (flight-recorder discipline)
+# --------------------------------------------------------------------------
+
+def test_trace_and_exemplar_rings_bounded(mon):
+    for i in range(TRACE_RING_CAP + 50):
+        monitor.record_trace({"trace_id": f"r{i}", "outcome": "completed",
+                              "spans": []})
+    assert len(monitor.request_traces()) == TRACE_RING_CAP
+    assert monitor.request_traces()[-1]["trace_id"] \
+        == f"r{TRACE_RING_CAP + 49}"
+    for i in range(EXEMPLAR_CAP + 20):
+        monitor.record_exemplar({"trace_id": f"e{i}"})
+    assert len(monitor.exemplars()) == EXEMPLAR_CAP
+    # reset clears both rings
+    monitor.reset()
+    assert monitor.request_traces() == [] and monitor.exemplars() == []
+
+
+def test_record_trace_disabled_is_noop():
+    monitor.disable()
+    monitor.reset()
+    monitor.record_trace({"trace_id": "r1", "outcome": "completed"})
+    monitor.record_exemplar({"trace_id": "r1"})
+    assert monitor.request_traces() == [] and monitor.exemplars() == []
+
+
+# --------------------------------------------------------------------------
+# RequestTrace unit behavior
+# --------------------------------------------------------------------------
+
+def test_request_trace_first_close_wins_and_phases_freeze():
+    tr = tracing.RequestTrace("m", rows=1)
+    tr.phase("admission").phase("queue")
+    rec = tr.close("timeout", reason="timeout", final="batch_build")
+    assert rec["outcome"] == "timeout"
+    assert [s["name"] for s in rec["spans"]] \
+        == ["admission", "queue", "batch_build"]
+    # the worker catch-all racing a deadline cancel: repeat close is None
+    assert tr.close("error", reason="boom") is None
+    tr.phase("device")  # frozen after close
+    assert len(tr.marks) == 3
+    # control ids are namespaced per prefix
+    assert tracing.control_trace_id("pub").startswith("pub-")
+    assert tracing.control_trace_id("rb").startswith("rb-")
+
+
+# --------------------------------------------------------------------------
+# Chrome-trace request lanes
+# --------------------------------------------------------------------------
+
+def test_chrome_trace_request_lanes(tmp_path, mon):
+    srv, _ = _server(tmp_path, buckets=(2,))
+    try:
+        srv.infer("m", {"x": np.ones((1, D_IN), "f4")})
+    finally:
+        srv.stop()
+    path = str(tmp_path / "trace.json")
+    n = monitor.export_chrome_trace(path)
+    assert n > 0
+    with open(path) as f:
+        events = json.load(f)["traceEvents"]
+    req = [e for e in events if e.get("cat") == "request"]
+    (t,) = _traces("completed")
+    begins = [e for e in req if e["ph"] == "b"]
+    ends = [e for e in req if e["ph"] == "e"]
+    assert len(begins) == len(ends) == len(t["spans"])
+    assert {e["id"] for e in req} == {t["trace_id"]}
+    assert {e["name"] for e in begins} \
+        == {f"req.{s['name']}" for s in t["spans"]}
+    # async lanes merge with per-rank traces through the existing path
+    merged = str(tmp_path / "merged.json")
+    monitor.merge_chrome_traces({"r0": path}, merged)
+    with open(merged) as f:
+        assert any(e.get("cat") == "request"
+                   for e in json.load(f)["traceEvents"])
+
+
+# --------------------------------------------------------------------------
+# serve_trace CLI
+# --------------------------------------------------------------------------
+
+def _run_round(tmp_path, mon):
+    """A small mixed round logged to JSONL: 3 completed + 1 shed."""
+    from paddle_tpu.monitor import MonitorLogger
+
+    path = str(tmp_path / "metrics.jsonl")
+    logger = monitor.attach_logger(MonitorLogger(path))
+    srv, _ = _server(tmp_path, buckets=(2,), max_queue=1, start=False)
+    srv.registry.warm("m", (2,))
+    xv = np.ones((1, D_IN), "f4")
+    first = srv.submit("m", {"x": xv})
+    with pytest.raises(ServingError):
+        srv.submit("m", {"x": xv})  # shed
+    srv.start()
+    first.result(timeout=30)
+    srv.infer("m", {"x": xv})
+    srv.infer("m", {"x": xv})
+    logger.write_snapshot()
+    monitor.detach_logger(logger)
+    srv.stop()
+    return path
+
+
+def test_serve_trace_cli_render_top_and_check(tmp_path, mon, capsys):
+    from tools import serve_trace
+
+    path = _run_round(tmp_path, mon)
+    assert serve_trace.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "completed" in out and "shed" in out
+    # span-tree render of a named trace
+    tid = _traces("completed")[0]["trace_id"]
+    assert serve_trace.main([path, "--request", tid]) == 0
+    out = capsys.readouterr().out
+    assert "device" in out and "queue" in out and tid in out
+    assert serve_trace.main([path, "--request", "r999999"]) == 1
+    capsys.readouterr()
+    # per-bucket live table
+    assert serve_trace.main([path, "--top"]) == 0
+    out = capsys.readouterr().out
+    assert "bucket" in out and "queue_frac" in out and "pad_frac" in out
+    assert serve_trace.main([path, "--slow", "2"]) == 0
+    capsys.readouterr()
+    # reconciliation + attribution gates pass on the round's own output
+    assert serve_trace.main([path, "--check", "--max-queue-wait-frac",
+                             "0.999", "--max-pad-frac", "0.9"]) == 0
+    capsys.readouterr()
+    # tight gates fail loudly (pad frac is exactly 0.5 here)
+    assert serve_trace.main([path, "--check", "--max-pad-frac",
+                             "0.1"]) == 1
+    capsys.readouterr()
+
+
+def test_serve_trace_check_zero_evidence_fails(tmp_path, capsys):
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text(json.dumps({"kind": "step", "step": 0}) + "\n")
+    from tools import serve_trace
+
+    assert serve_trace.main([str(empty), "--check"]) == 1
+    out = capsys.readouterr().out
+    assert "zero evidence" in out
+    assert serve_trace.main([str(tmp_path / "nope.jsonl"), "--check"]) == 1
+    capsys.readouterr()
+
+
+def test_serve_trace_check_catches_overcounting(tmp_path, capsys):
+    """A stream whose terminal traces exceed the requests counter (a
+    double-closed request) fails reconciliation; unterminated traces
+    fail too."""
+    path = tmp_path / "bad.jsonl"
+    snap = {"counters": {"serving.requests": 1, "serving.completed": 1},
+            "gauges": {}}
+    lines = [
+        {"kind": "serving_trace", "trace_id": "r1", "outcome": "completed",
+         "spans": []},
+        {"kind": "serving_trace", "trace_id": "r2", "outcome": "completed",
+         "spans": []},
+        snap,
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in lines))
+    from tools import serve_trace
+
+    assert serve_trace.main([str(path), "--check"]) == 1
+    assert "exceed" in capsys.readouterr().out
+    bad2 = tmp_path / "bad2.jsonl"
+    bad2.write_text(json.dumps(
+        {"kind": "serving_trace", "trace_id": "r1", "outcome": None,
+         "spans": []}) + "\n" + json.dumps(snap) + "\n")
+    assert serve_trace.main([str(bad2), "--check"]) == 1
+    assert "terminal outcome" in capsys.readouterr().out
+
+
+def test_serve_trace_cli_subprocess_smoke(tmp_path, mon):
+    """The tier-1 CLI smoke: `python tools/serve_trace.py --check` runs
+    standalone (sys.path bootstrap) against a real stream."""
+    path = _run_round(tmp_path, mon)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "serve_trace.py"),
+         path, "--check", "--max-queue-wait-frac", "0.999"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+
+
+# --------------------------------------------------------------------------
+# perf_report gate integration
+# --------------------------------------------------------------------------
+
+def test_perf_report_attribution_gates(tmp_path, mon):
+    from tools.perf_report import check
+
+    path = _run_round(tmp_path, mon)
+    assert check(path, max_queue_wait_frac=0.999, max_pad_frac=0.9) == 0
+    assert check(path, max_pad_frac=0.1) == 1  # 0.5 > 0.1: loud fail
+
+
+def test_perf_report_gates_counters_only_and_zero_evidence(tmp_path):
+    """The gates work on a counters/gauges-only snapshot file (no trace
+    records — gauge/counter fallbacks) and FAIL on a file with no
+    evidence at all."""
+    from tools.perf_report import check
+
+    path = str(tmp_path / "counters.jsonl")
+    snap = {"counters": {"serving.pad_rows": 30, "serving.rows": 70,
+                         "serving.requests": 10},
+            "gauges": {"serving.queue_wait_frac": 0.25}}
+    with open(path, "w") as f:
+        f.write(json.dumps(snap) + "\n")
+    assert check(path, max_queue_wait_frac=0.5, max_pad_frac=0.5) == 0
+    assert check(path, max_queue_wait_frac=0.1) == 1  # 0.25 > 0.1
+    assert check(path, max_pad_frac=0.2) == 1         # 0.3 > 0.2
+    bare = str(tmp_path / "bare.jsonl")
+    with open(bare, "w") as f:
+        f.write(json.dumps({"kind": "step", "step": 0,
+                            "recompiles_total": 0}) + "\n")
+    assert check(bare, max_queue_wait_frac=0.9) == 1
+    assert check(bare, max_pad_frac=0.9) == 1

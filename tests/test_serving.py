@@ -780,10 +780,30 @@ def test_bench_serve_smoke_and_gate(tmp_path):
     assert ov["shed"] > 0, "overload arm never shed — not an overload"
     assert ov["offered"] == ov["completed"] + ov["shed"]
     assert ov["p99_bounded"]
+    # the ISSUE-16 attribution embeds: queue/pad/compute per bucket, the
+    # completed-traffic queue-wait share, and the windowed SLO accounting
+    assert 0.0 <= rec["queue_wait_frac"] <= 1.0
+    assert rec["bucket_attribution"], "no per-bucket attribution ledger"
+    for b, a in rec["bucket_attribution"].items():
+        assert int(b) in rec["buckets"]
+        assert a["rows"] + a["pad_rows"] == a["batches"] * int(b)
+        assert 0.0 <= a["pad_frac"] <= 1.0
+        assert 0.0 <= a["queue_wait_frac"] <= 1.0
+    assert rec["slo"]["good"] + rec["slo"]["bad"] >= rec["requests"]
+    assert ov["slo"]["bad"] >= ov["shed"], "sheds must burn SLO budget"
     # per-arm streams: the baseline file holds the DOCUMENTED tight shed
     # gate (its traffic never sheds), the overload file holds the tail
-    # gate with its designed sheds budgeted loose
+    # gate with its designed sheds budgeted loose.  Both streams must
+    # clear the new attribution gates on the bench's own output — the
+    # loose bounds assert evidence + sane math, not a perf level
     assert check(rec["metrics_path"], max_shed_frac=0.0,
-                 max_p99_ms=ov["p99_gate_ms"]) == 0
+                 max_p99_ms=ov["p99_gate_ms"],
+                 max_queue_wait_frac=0.999, max_pad_frac=0.9) == 0
     assert check(ov["metrics_path"], max_shed_frac=1.0,
-                 max_p99_ms=ov["p99_gate_ms"]) == 0
+                 max_p99_ms=ov["p99_gate_ms"],
+                 max_queue_wait_frac=0.999, max_pad_frac=0.9) == 0
+    # and the trace-stream reconciliation CLI gates both streams too
+    from tools.serve_trace import check as trace_check
+    assert trace_check(rec["metrics_path"], max_queue_wait_frac=0.999,
+                       max_pad_frac=0.9) == 0
+    assert trace_check(ov["metrics_path"]) == 0

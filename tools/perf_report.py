@@ -221,7 +221,8 @@ def render(path: str) -> str:
 
     sbatches = [s for s in records if s.get("kind") == "serving_batch"]
     sevents = [s for s in records if s.get("kind") == "serving_event"]
-    if sbatches or sevents:
+    straces = [s for s in records if s.get("kind") == "serving_trace"]
+    if sbatches or sevents or straces:
         lines = records + [snap]  # snap's counters/gauges = newest state
         occ = [s.get("occupancy", 0.0) for s in sbatches]
         parts.append(
@@ -229,6 +230,12 @@ def render(path: str) -> str:
             f"events, shed frac {shed_fraction(lines):.4f}, "
             f"p99 {serving_p99_ms(lines):.1f} ms"
             + (f", mean occupancy {sum(occ)/len(occ):.3f}" if occ else "")
+            + (f", queue-wait frac {queue_wait_fraction(lines):.4f}"
+               if _has_queue_wait_evidence(lines) else "")
+            + (f", pad frac {pad_fraction(lines):.4f}"
+               if _has_pad_evidence(lines) else "")
+            + (f", {len(straces)} request traces — tools/serve_trace.py "
+               f"renders them" if straces else "")
             + ")")
         rows = [(r.get("action", "?"), r.get("model", ""),
                  r.get("reason", r.get("detail", r.get("rows", ""))))
@@ -504,6 +511,88 @@ def serving_p99_ms(lines):
     return lats[min(int(0.99 * len(lats)), len(lats) - 1)]
 
 
+def _has_queue_wait_evidence(lines):
+    """True when the file carries ANY queue-wait attribution signal:
+    serving_trace records (span trees carry the queue phase),
+    serving_batch records stamped with queue_wait_frac, or the
+    serving.queue_wait_frac gauge in a snapshot.  The queue-wait gate
+    fails on a file with none (zero-evidence-fails convention)."""
+    if any(r.get("kind") == "serving_trace" for r in lines):
+        return True
+    if any(r.get("kind") == "serving_batch" and "queue_wait_frac" in r
+           for r in lines):
+        return True
+    return "serving.queue_wait_frac" in _latest_gauges(lines, "serving.")
+
+
+def queue_wait_fraction(lines):
+    """Of all the wall time completed requests spent in the server, the
+    fraction spent QUEUED (waiting for a batch) rather than being built,
+    on device, or split — the latency-attribution number ISSUE 16's
+    tracing exists to produce.  High under overload by design; high at
+    modest load means batches are too slow or workers too few.
+    Preference order: serving_trace span trees (exact, per-request) ->
+    the serving.queue_wait_frac windowed gauge -> request-weighted
+    per-batch queue_wait_frac stamps on serving_batch records."""
+    q = tot = 0.0
+    for r in lines:
+        if r.get("kind") != "serving_trace" \
+                or r.get("outcome") != "completed":
+            continue
+        tot += float(r.get("total_ms", 0.0) or 0.0)
+        q += sum(float(s.get("dur_ms", 0.0) or 0.0)
+                 for s in r.get("spans", ()) if s.get("name") == "queue")
+    if tot > 0:
+        return q / tot
+    g = _latest_gauges(lines, "serving.")
+    try:
+        v = float(g.get("serving.queue_wait_frac", 0.0) or 0.0)
+    except (TypeError, ValueError):
+        v = 0.0
+    if v:
+        return v
+    pairs = [(float(r.get("queue_wait_frac", 0.0) or 0.0),
+              int(r.get("requests", 1) or 1))
+             for r in lines if r.get("kind") == "serving_batch"
+             and "queue_wait_frac" in r]
+    n = sum(w for _, w in pairs)
+    return sum(f * w for f, w in pairs) / n if n else 0.0
+
+
+def _has_pad_evidence(lines):
+    """True when the file carries ANY pad-waste signal: serving.pad_rows
+    / serving.padded_rows counters in a snapshot, or serving_batch
+    records (bucket + rows reconstruct the pad even on pre-ISSUE-16
+    files)."""
+    c = _latest_counters(lines, "serving.")
+    if "serving.pad_rows" in c or "serving.padded_rows" in c:
+        return True
+    return any(r.get("kind") == "serving_batch" for r in lines)
+
+
+def pad_fraction(lines):
+    """Pad rows per padded-batch row: the fraction of serving device
+    compute spent on rows no client asked for (pad-to-bucket waste).
+    From the newest counter snapshot (serving.pad_rows /
+    (serving.rows + serving.pad_rows)), falling back to summing
+    serving_batch records — where pre-ISSUE-16 files reconstruct
+    pad_rows as bucket - rows."""
+    c = _latest_counters(lines, "serving.")
+    pad = c.get("serving.pad_rows", c.get("serving.padded_rows", 0))
+    rows = c.get("serving.rows", 0)
+    if rows + pad:
+        return pad / (rows + pad)
+    pad = rows = 0
+    for r in lines:
+        if r.get("kind") != "serving_batch":
+            continue
+        b = int(r.get("bucket", 0) or 0)
+        rw = int(r.get("rows", 0) or 0)
+        pad += int(r.get("pad_rows", max(b - rw, 0)))
+        rows += rw
+    return pad / (rows + pad) if rows + pad else 0.0
+
+
 def _has_integrity_evidence(lines):
     """True when the file carries ANY integrity signal: integrity_event
     records or integrity.* counters/gauges in a snapshot.  The integrity
@@ -624,7 +713,9 @@ def check(path: str, steady_after: int = 2,
           max_p99_ms: float = None,
           max_lock_wait_frac: float = None,
           max_integrity_mismatches: int = None,
-          max_ckpt_lag_steps: float = None) -> int:
+          max_ckpt_lag_steps: float = None,
+          max_queue_wait_frac: float = None,
+          max_pad_frac: float = None) -> int:
     """Return 0 when the metrics file is healthy, 1 otherwise (printed
     diagnosis either way).  Made for CI/bench scripts:
 
@@ -658,7 +749,9 @@ def check(path: str, steady_after: int = 2,
                        or max_p99_ms is not None
                        or max_lock_wait_frac is not None
                        or max_integrity_mismatches is not None
-                       or max_ckpt_lag_steps is not None) \
+                       or max_ckpt_lag_steps is not None
+                       or max_queue_wait_frac is not None
+                       or max_pad_frac is not None) \
         and max_host_blocked_frac is None and max_retry_frac is None
     if not steps and not dist_gates_only:
         print(f"perf_report --check: {path} contains no step records "
@@ -828,6 +921,52 @@ def check(path: str, steady_after: int = 2,
         else:
             print(f"perf_report --check: serving p99 {p99:.1f} ms <= "
                   f"{max_p99_ms}")
+    if max_queue_wait_frac is not None:
+        if not _has_queue_wait_evidence(lines):
+            failures.append(
+                f"--max-queue-wait-frac given but {path} carries no "
+                f"queue-wait evidence (no serving_trace records, no "
+                f"queue_wait_frac-stamped serving_batch records, no "
+                f"serving.queue_wait_frac gauge in any snapshot) — was "
+                f"the monitor enabled on the serving run?  (zero "
+                f"evidence must not gate green)")
+        else:
+            frac = queue_wait_fraction(lines)
+            if frac > max_queue_wait_frac:
+                failures.append(
+                    f"serving queue-wait fraction {frac:.4f} exceeds the "
+                    f"--max-queue-wait-frac={max_queue_wait_frac} gate — "
+                    f"completed requests spent most of their latency "
+                    f"budget QUEUED, not computing; either offered load "
+                    f"sits past capacity (scale out, or let admission "
+                    f"control shed it) or batches got slower (check "
+                    f"serving_batch t_infer_s and serve_trace --top's "
+                    f"per-bucket queue column)")
+            else:
+                print(f"perf_report --check: serving queue-wait fraction "
+                      f"{frac:.4f} <= {max_queue_wait_frac}")
+    if max_pad_frac is not None:
+        if not _has_pad_evidence(lines):
+            failures.append(
+                f"--max-pad-frac given but {path} carries no pad-waste "
+                f"evidence (no serving_batch records and no "
+                f"serving.pad_rows/padded_rows counters in any snapshot) "
+                f"— was the monitor enabled on the serving run?  (zero "
+                f"evidence must not gate green)")
+        else:
+            frac = pad_fraction(lines)
+            if frac > max_pad_frac:
+                failures.append(
+                    f"serving pad fraction {frac:.4f} exceeds the "
+                    f"--max-pad-frac={max_pad_frac} gate — too much of "
+                    f"the device compute is pad rows no client asked "
+                    f"for; the bucket ladder is too coarse for the "
+                    f"traffic's batch-size mix (add intermediate "
+                    f"FLAGS_serving_buckets rungs; serve_trace --top "
+                    f"names the wasteful buckets)")
+            else:
+                print(f"perf_report --check: serving pad fraction "
+                      f"{frac:.4f} <= {max_pad_frac}")
     if max_lock_wait_frac is not None:
         if not _has_lock_evidence(lines):
             failures.append(
@@ -1353,6 +1492,26 @@ def main(argv=None):
                          "committed.  Fails on a file with no "
                          "checkpoint-storage evidence at all — zero "
                          "evidence must not gate green")
+    ap.add_argument("--max-queue-wait-frac", type=float, default=None,
+                    metavar="FRAC",
+                    help="gate serving latency attribution: the fraction "
+                         "of completed requests' wall time spent QUEUED "
+                         "(serving_trace span trees from the ISSUE-16 "
+                         "request tracing; serving.queue_wait_frac gauge "
+                         "and queue_wait_frac-stamped serving_batch "
+                         "records as fallbacks) at <= FRAC.  Fails on a "
+                         "file with no queue-wait evidence at all — zero "
+                         "evidence must not gate green")
+    ap.add_argument("--max-pad-frac", type=float, default=None,
+                    metavar="FRAC",
+                    help="gate pad-to-bucket waste: pad rows per "
+                         "padded-batch row (serving.pad_rows / "
+                         "(serving.rows + serving.pad_rows) counters, "
+                         "serving_batch bucket-vs-rows fallback) at <= "
+                         "FRAC — the device compute a serving round may "
+                         "spend on rows no client asked for.  Fails on a "
+                         "file with no pad evidence at all — zero "
+                         "evidence must not gate green")
     ap.add_argument("--max-step-skew-frac", type=float, default=None,
                     metavar="FRAC",
                     help="gate the MAX sustained straggler lag, in step "
@@ -1382,7 +1541,9 @@ def main(argv=None):
                      args.max_shed_frac, args.max_p99_ms,
                      args.max_lock_wait_frac,
                      args.max_integrity_mismatches,
-                     args.max_ckpt_lag_steps)
+                     args.max_ckpt_lag_steps,
+                     max_queue_wait_frac=args.max_queue_wait_frac,
+                     max_pad_frac=args.max_pad_frac)
     if args.diff:
         print(diff(*args.diff))
         return 0

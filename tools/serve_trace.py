@@ -1,0 +1,344 @@
+#!/usr/bin/env python
+"""Live inspection CLI for the serving request-flight traces (ISSUE 16).
+
+Input is a monitor JSONL metrics stream (MonitorLogger output) from a
+serving run with the monitor enabled: `serving_trace` records are the
+closed per-request span trees `paddle_tpu/serving/tracing.py` renders
+(admission -> queue -> batch_build -> device -> fetch -> respond, plus
+the shed/timeout/error/shutdown/rejected early closes), `serving_batch`
+/ `serving_event` records and counter snapshots ride along.
+
+    python tools/serve_trace.py metrics.jsonl
+        Outcome ledger + the most recent traces, one line each.
+
+    python tools/serve_trace.py metrics.jsonl --request r000042
+        Render one request's span tree: where its latency actually went.
+
+    python tools/serve_trace.py metrics.jsonl --top
+        Live-table view per model/bucket: traffic, p50/p99, queue-wait
+        fraction, pad waste — the "which bucket is burning the SLO"
+        table.  Falls back to serving_batch records on a stream whose
+        trace ring rotated away.
+
+    python tools/serve_trace.py metrics.jsonl --slow 5
+        The N slowest completed requests (the exemplars worth reading).
+
+    python tools/serve_trace.py metrics.jsonl --check \
+            [--max-queue-wait-frac F] [--max-pad-frac F]
+        CI gate: the trace stream must RECONCILE — every trace closed
+        with a terminal outcome, terminal request traces and counted
+        terminal outcomes both bounded by serving.requests (the server
+        ledger identity, seen from the trace side) — and, when given,
+        the queue-wait / pad-waste attribution gates must hold (same
+        math as perf_report --check; both FAIL on a file with no
+        evidence — the zero-evidence-fails convention).
+
+`perf_report --check` gates the same stream on counters; this tool is
+the per-request view: a failed gate there names a trace id to read here.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import perf_report as _pr  # noqa: E402  (stdlib-only; shares gate math)
+
+TERMINAL_OUTCOMES = ("completed", "shed", "timeout", "error", "shutdown",
+                     "rejected")
+# terminal outcomes that entered the server's `requests` ledger —
+# "rejected" covers admission-door refusals raised BEFORE the request
+# counted, so reconciliation excludes it
+LEDGER_OUTCOMES = ("completed", "shed", "timeout", "error", "shutdown")
+
+
+def load_lines(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def traces_of(lines):
+    return [r for r in lines if r.get("kind") == "serving_trace"]
+
+
+def _fmt_ms(v):
+    return f"{float(v):.3f}"
+
+
+def render_trace(t):
+    """One request's span tree, durations bar-scaled against the total."""
+    total = float(t.get("total_ms", 0.0) or 0.0)
+    head = (f"{t.get('trace_id', '?')}  model={t.get('model', '?')}  "
+            f"outcome={t.get('outcome', '?')}")
+    if t.get("reason"):
+        head += f" ({t['reason']})"
+    head += f"  total {_fmt_ms(total)} ms"
+    extras = [f"{k}={t[k]}" for k in ("rows", "bucket", "pad_rows",
+                                      "deadline_ms", "lat_ms", "late_ms")
+              if t.get(k) is not None]
+    if extras:
+        head += "  [" + " ".join(extras) + "]"
+    out = [head]
+    for s in t.get("spans", ()):
+        dur = float(s.get("dur_ms", 0.0) or 0.0)
+        frac = dur / total if total > 0 else 0.0
+        bar = "#" * max(int(frac * 40), 1 if dur > 0 else 0)
+        out.append(f"  {s.get('name', '?'):<12} {_fmt_ms(dur):>10} ms  "
+                   f"{frac * 100:5.1f}%  {bar}")
+    return "\n".join(out)
+
+
+def summary(lines, last_n=10):
+    ts = traces_of(lines)
+    by = {}
+    for t in ts:
+        key = (t.get("outcome", "?"), t.get("reason", ""))
+        by[key] = by.get(key, 0) + 1
+    out = [f"serve_trace: {len(ts)} trace(s)"]
+    for (outcome, reason), n in sorted(by.items()):
+        out.append(f"  {outcome}{f' ({reason})' if reason else '':<20} {n}")
+    c = _pr._latest_counters(lines, "serving.")
+    if c:
+        out.append(f"  counters: {c.get('serving.requests', 0):g} requests "
+                   f"= {c.get('serving.completed', 0):g} completed + "
+                   f"{c.get('serving.shed', 0):g} shed + "
+                   f"{c.get('serving.timeouts', 0):g} timeouts + "
+                   f"{c.get('serving.errors', 0):g} errors + "
+                   f"{c.get('serving.shutdowns', 0):g} shutdowns")
+    if ts:
+        out.append(f"\nmost recent {min(last_n, len(ts))}:")
+        for t in ts[-last_n:]:
+            out.append(
+                f"  {t.get('trace_id', '?'):<10} {t.get('model', '?'):<12} "
+                f"{t.get('outcome', '?'):<10} "
+                f"{_fmt_ms(t.get('total_ms', 0.0)):>10} ms"
+                + (f"  bucket={t['bucket']}" if t.get("bucket") else "")
+                + (f"  reason={t['reason']}" if t.get("reason") else ""))
+    return "\n".join(out)
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(int(q * len(sorted_vals)), len(sorted_vals) - 1)]
+
+
+def top_table(lines):
+    """Per model/bucket attribution: requests, p50/p99 total latency,
+    queue-wait fraction, pad fraction.  Exact from completed traces;
+    serving_batch fallback keeps the table usable after ring rotation."""
+    rows = {}
+    for t in traces_of(lines):
+        if t.get("outcome") != "completed":
+            continue
+        key = (t.get("model", "?"), t.get("bucket", "?"))
+        r = rows.setdefault(key, {"n": 0, "tot": [], "q": 0.0, "wall": 0.0,
+                                  "pad": 0, "rows": 0})
+        r["n"] += 1
+        total = float(t.get("total_ms", 0.0) or 0.0)
+        r["tot"].append(total)
+        r["wall"] += total
+        r["q"] += sum(float(s.get("dur_ms", 0.0) or 0.0)
+                      for s in t.get("spans", ())
+                      if s.get("name") == "queue")
+        r["pad"] += int(t.get("pad_rows", 0) or 0)
+        r["rows"] += int(t.get("batch_rows", t.get("rows", 0)) or 0)
+    src = "traces"
+    if not rows:
+        src = "serving_batch records"
+        for b in lines:
+            if b.get("kind") != "serving_batch":
+                continue
+            key = (b.get("model", "?"), b.get("bucket", "?"))
+            r = rows.setdefault(key, {"n": 0, "tot": [], "q": 0.0,
+                                      "wall": 0.0, "pad": 0, "rows": 0})
+            n = int(b.get("requests", 0) or 0)
+            r["n"] += n
+            lat = float(b.get("lat_ms_max", 0.0) or 0.0)
+            r["tot"].extend([lat] * max(n, 1))
+            wall = lat * max(n, 1)
+            r["wall"] += wall
+            r["q"] += float(b.get("queue_wait_frac", 0.0) or 0.0) * wall
+            bkt = int(b.get("bucket", 0) or 0)
+            rw = int(b.get("rows", 0) or 0)
+            r["pad"] += int(b.get("pad_rows", max(bkt - rw, 0)))
+            r["rows"] += rw
+    if not rows:
+        return "serve_trace --top: no completed traces or serving_batch " \
+               "records in the stream"
+    table = []
+    for (model, bucket), r in sorted(rows.items(),
+                                     key=lambda kv: -kv[1]["n"]):
+        tot = sorted(r["tot"])
+        denom = r["rows"] + r["pad"]
+        table.append((model, bucket, r["n"],
+                      _fmt_ms(_pct(tot, 0.50)), _fmt_ms(_pct(tot, 0.99)),
+                      f"{r['q'] / r['wall']:.3f}" if r["wall"] > 0
+                      else "0.000",
+                      f"{r['pad'] / denom:.3f}" if denom else "0.000"))
+    return (f"serve_trace --top (from {src}):\n"
+            + _pr._fmt_table(table, ["model", "bucket", "req", "p50_ms",
+                                     "p99_ms", "queue_frac", "pad_frac"]))
+
+
+def check(path, max_queue_wait_frac=None, max_pad_frac=None):
+    """Exit 0 when the trace stream reconciles (and the optional
+    attribution gates hold), 1 otherwise."""
+    try:
+        lines = load_lines(path)
+    except FileNotFoundError:
+        print(f"serve_trace --check: {path} does not exist "
+              f"(was a MonitorLogger attached?)")
+        return 1
+    except json.JSONDecodeError as e:
+        print(f"serve_trace --check: {path} is not valid JSONL: {e}")
+        return 1
+    ts = traces_of(lines)
+    c = _pr._latest_counters(lines, "serving.")
+    failures = []
+    if not ts and not c:
+        failures.append(
+            f"{path} carries no serving traces and no serving.* counters "
+            f"— was the monitor enabled on the serving run?  (zero "
+            f"evidence must not gate green)")
+    # 1. every trace must be CLOSED with a stable terminal outcome
+    bad = [t.get("trace_id", "?") for t in ts
+           if t.get("outcome") not in TERMINAL_OUTCOMES]
+    if bad:
+        failures.append(
+            f"{len(bad)} trace(s) carry no terminal outcome "
+            f"({bad[:5]}...) — a serving path closed a trace without an "
+            f"outcome, or never closed it")
+    # 2. ledger reconciliation, trace side: terminal request traces must
+    # not exceed requests admitted (traces may UNDERcount — the ring is
+    # bounded and a logger can attach late — but never overcount)
+    if c:
+        req = c.get("serving.requests", 0)
+        parts = sum(c.get(f"serving.{k}", 0) for k in
+                    ("completed", "shed", "timeouts", "errors",
+                     "shutdowns"))
+        if parts > req:
+            failures.append(
+                f"counter ledger does not reconcile: completed+shed+"
+                f"timeouts+errors+shutdowns = {parts:g} exceeds "
+                f"serving.requests = {req:g} — a terminal path "
+                f"double-counted")
+        n_ledger = sum(1 for t in ts
+                       if t.get("outcome") in LEDGER_OUTCOMES)
+        if n_ledger > req:
+            failures.append(
+                f"{n_ledger} ledger-outcome trace(s) exceed "
+                f"serving.requests = {req:g} — a request closed more "
+                f"than one trace")
+        print(f"serve_trace --check: {len(ts)} trace(s), "
+              f"{n_ledger} in-ledger vs {req:g} requests "
+              f"({parts:g} terminal outcomes counted)")
+    elif ts:
+        print(f"serve_trace --check: {len(ts)} trace(s), no counter "
+              f"snapshot to reconcile against")
+    if max_queue_wait_frac is not None:
+        if not _pr._has_queue_wait_evidence(lines):
+            failures.append(
+                f"--max-queue-wait-frac given but {path} carries no "
+                f"queue-wait evidence (zero evidence must not gate green)")
+        else:
+            frac = _pr.queue_wait_fraction(lines)
+            if frac > max_queue_wait_frac:
+                failures.append(
+                    f"queue-wait fraction {frac:.4f} exceeds "
+                    f"--max-queue-wait-frac={max_queue_wait_frac} — see "
+                    f"--top for the offending model/bucket")
+            else:
+                print(f"serve_trace --check: queue-wait fraction "
+                      f"{frac:.4f} <= {max_queue_wait_frac}")
+    if max_pad_frac is not None:
+        if not _pr._has_pad_evidence(lines):
+            failures.append(
+                f"--max-pad-frac given but {path} carries no pad "
+                f"evidence (zero evidence must not gate green)")
+        else:
+            frac = _pr.pad_fraction(lines)
+            if frac > max_pad_frac:
+                failures.append(
+                    f"pad fraction {frac:.4f} exceeds "
+                    f"--max-pad-frac={max_pad_frac} — the bucket ladder "
+                    f"is too coarse for the traffic (see --top)")
+            else:
+                print(f"serve_trace --check: pad fraction {frac:.4f} <= "
+                      f"{max_pad_frac}")
+    if failures:
+        for f_ in failures:
+            print(f"serve_trace --check: {f_}")
+        return 1
+    print("serve_trace --check: OK")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="inspect serving request-flight traces "
+                    "(serving_trace records in a monitor JSONL stream)")
+    ap.add_argument("path", help="metrics JSONL stream (MonitorLogger "
+                                 "output) from a serving run")
+    ap.add_argument("--request", metavar="TRACE_ID",
+                    help="render one request's span tree")
+    ap.add_argument("--top", action="store_true",
+                    help="per model/bucket attribution table")
+    ap.add_argument("--slow", type=int, metavar="N", default=None,
+                    help="render the N slowest completed requests")
+    ap.add_argument("--last", type=int, metavar="N", default=10,
+                    help="recent traces shown by the default summary")
+    ap.add_argument("--check", action="store_true",
+                    help="CI gate: trace-stream reconciliation (+ the "
+                         "attribution gates below when given)")
+    ap.add_argument("--max-queue-wait-frac", type=float, default=None,
+                    metavar="FRAC",
+                    help="with --check: gate the completed-request "
+                         "queue-wait fraction at <= FRAC")
+    ap.add_argument("--max-pad-frac", type=float, default=None,
+                    metavar="FRAC",
+                    help="with --check: gate pad rows per padded row at "
+                         "<= FRAC")
+    args = ap.parse_args(argv)
+    if args.check:
+        return check(args.path, args.max_queue_wait_frac, args.max_pad_frac)
+    try:
+        lines = load_lines(args.path)
+    except FileNotFoundError:
+        print(f"serve_trace: {args.path} does not exist")
+        return 1
+    except json.JSONDecodeError as e:
+        print(f"serve_trace: {args.path} is not valid JSONL: {e}")
+        return 1
+    if args.request:
+        hits = [t for t in traces_of(lines)
+                if t.get("trace_id") == args.request]
+        if not hits:
+            print(f"serve_trace: no trace {args.request!r} in {args.path} "
+                  f"(the ring is bounded — old traces rotate out)")
+            return 1
+        for t in hits:
+            print(render_trace(t))
+        return 0
+    if args.top:
+        print(top_table(lines))
+        return 0
+    if args.slow is not None:
+        done = sorted((t for t in traces_of(lines)
+                       if t.get("outcome") == "completed"),
+                      key=lambda t: -float(t.get("total_ms", 0.0) or 0.0))
+        if not done:
+            print("serve_trace: no completed traces in the stream")
+            return 1
+        for t in done[:args.slow]:
+            print(render_trace(t))
+            print()
+        return 0
+    print(summary(lines, last_n=args.last))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
