@@ -21,6 +21,11 @@ load generator (paddle_tpu.serving): throughput vs p50/p99 tail latency
 through the continuous-batching server plus an overload arm proving
 admission-control shedding keeps p99 bounded — its JSONL metrics stream
 is gated by `perf_report --check --max-shed-frac/--max-p99-ms`.
+`--serve --quant` runs the fp32-vs-quantized serving A/B instead
+(ISSUE 17): the int8/bf16 snapshot goes live through the full publish
+ladder (accuracy-parity gate included) and the record carries both
+arms' rps/p99, the HBM narrowing, and the parity ledger — gated by
+`perf_report --check --require-quant-parity`.
 
 vs_baseline: the reference published no numbers (BASELINE.md), so the
 absolute series is tracked across rounds; vs_baseline = this round's
@@ -444,6 +449,35 @@ def bench_pipeline(batch_size=128, steps=24, max_inflight=4, log_period=8,
             "max_inflight": max_inflight, "log_period": log_period}
 
 
+def _serve_roofline(model_dir, rows):
+    """The saved serving program's own static roofline at the `rows`-row
+    bucket (core/resource_plan.py over the inference graph): the
+    predicted-MFU denominator the serve record stamps
+    (`mfu_predicted_roofline`, same meaning as the train records') plus
+    the analytic per-row forward FLOPs the measured serving MFU is
+    computed from.  {} when planning fails — a plan bug must never block
+    a serve round."""
+    import os
+
+    try:
+        from paddle_tpu.core.program import Program
+        from paddle_tpu.core.resource_plan import plan_program
+        from paddle_tpu.serving.registry import synthetic_feed_shapes
+
+        with open(os.path.join(model_dir, "__model__.json")) as f:
+            doc = json.load(f)
+        program = Program.from_dict(doc)
+        shapes = synthetic_feed_shapes(program, doc.get("feed_names", []),
+                                       rows)
+        plan = plan_program(program, shapes, doc.get("fetch_names", []))
+        return {"mfu_predicted_roofline": round(plan.predicted_mfu, 4),
+                "flops_per_row_analytic": plan.flops_total / max(rows, 1)}
+    except Exception as e:  # pragma: no cover - defensive
+        print(f"bench: serve roofline prediction failed: {e!r}",
+              file=sys.stderr)
+        return {}
+
+
 # The serve bench's timed window must dwarf a CPython gen2 GC pause: at
 # the old default of 400 requests the window was ~0.15 s, ONE collection
 # landing inside it (steered by import order, nothing else) read as a
@@ -636,8 +670,21 @@ def bench_serve(requests=4000, clients=6, buckets=(1, 2, 4, 8),
           f"{lat['p99']:.1f} ms (recompiles {recompiles}); overload: "
           f"{ov_stats['completed']}/{offered[0]} served, {shed[0]} shed "
           f"({shed_frac:.2%}), p99 {ov_lat['p99']:.1f} ms", file=sys.stderr)
+    # measured-vs-predicted MFU stamps (ISSUE 17 satellite): the serving
+    # program's own static roofline is the denominator perf_report
+    # --check-bench prints measured MFU against — same contract as the
+    # train records, so serving gaps are named, not averaged away
+    import jax as _jax
+
+    roof = _serve_roofline(model_dir, max(buckets))
+    rows_per_sec = base_stats["rows"] / wall
+    mfu = (rows_per_sec * roof["flops_per_row_analytic"] / V5E_BF16_PEAK
+           if roof.get("flops_per_row_analytic") else None)
     return {"metric": "serving_closed_loop_rps", "value": round(rps, 2),
             "unit": "req/sec",
+            "device": _jax.default_backend(),
+            "mfu_bf16_analytic": round(mfu, 6) if mfu is not None else None,
+            "mfu_predicted_roofline": roof.get("mfu_predicted_roofline"),
             "window_s": round(wall, 3), "min_window_s": min_window_s,
             "gc_frozen": True,
             "requests": requests, "clients": clients,
@@ -669,6 +716,189 @@ def bench_serve(requests=4000, clients=6, buckets=(1, 2, 4, 8),
                                        for b, a in ov_attr.items()},
                 "metrics_path": ov_metrics,
             },
+            "metrics_path": metrics_path}
+
+
+def bench_serve_quant(requests=4000, clients=4, buckets=(1, 2, 4, 8),
+                      max_queue=64, serve_dtype="bfloat16", weight_bits=8,
+                      metrics_path=None, min_window_s=MIN_SERVE_WINDOW_S):
+    """fp32-vs-quantized serving A/B (ISSUE 17): the same model served
+    twice through the bucketed server — once from its fp32
+    save_inference_model dir, once from the int8
+    save_quantized_inference_model dir whose weights dequantize into
+    `serve_dtype` (bf16: half the resident weight HBM, int8-grid
+    numerics).  The quant arm goes live through the FULL publish ladder,
+    so the round exercises the accuracy-parity gate
+    (FLAGS_serving_quant_atol vs the fp32 parent's outputs) for real —
+    the record embeds the gate's own `quant_parity` event next to a
+    direct fp32-vs-quant output comparison (the parity ledger).
+
+    Honesty contract: rps/p99 are chip numbers ONLY on TPU.  Off-device
+    the record still lands — parity ledger, HBM narrowing, and precision
+    plumbing are platform-independent — but `throughput_claim` says
+    `parity_only_off_device` and no floor may ratchet from it.
+
+    The metrics stream starts AFTER the fp32 arm, so one file carries the
+    quant publish lane (its warm compiles are the paid-once head of the
+    stream), the `quant_parity` gate event, and the quant arm's
+    steady-state serving steps.  Gate it with BOTH serving gates::
+
+        python tools/perf_report.py --check <metrics_path> \\
+            --steady-after <gate_steady_after> --require-quant-parity
+
+    where `gate_steady_after` is embedded in the record (the measured
+    publish-lane step count plus margin): past it the recompile-flat
+    gate holds over the quant arm, which this bench also asserts
+    directly (`recompiles_steady` must be 0)."""
+    import os
+    import tempfile
+    import threading
+
+    import jax as _jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers, monitor, serving
+    from paddle_tpu.monitor import MonitorLogger
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = layers.data("x", [64], dtype="float32")
+        h = layers.fc(x, 128, act="relu")
+        out = layers.fc(h, 10, act="softmax")
+    startup.random_seed = 7
+    exe = fluid.Executor(fluid.TPUPlace(0))
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    root = tempfile.mkdtemp(prefix="pt-serve-quant-")
+    fp32_dir = os.path.join(root, "fp32")
+    quant_dir = os.path.join(root, "quant")
+    fluid.io.save_inference_model(fp32_dir, ["x"], [out], exe, main_p, scope)
+    fluid.io.save_quantized_inference_model(
+        quant_dir, ["x"], [out], exe, main_p, scope,
+        weight_bits=weight_bits, serve_dtype=serve_dtype)
+
+    if metrics_path is None:
+        metrics_path = os.path.join(root, "serve_quant_metrics.jsonl")
+    monitor.reset()
+    monitor.enable()
+    registry = serving.ModelRegistry(place=fluid.TPUPlace(0))
+
+    lock = threading.Lock()
+
+    def window(srv):
+        served = [0]
+
+        def client(seed):
+            r = np.random.RandomState(seed)
+            while True:
+                with lock:
+                    if served[0] >= requests:
+                        return
+                    served[0] += 1
+                rows = int(r.randint(1, 5))
+                srv.infer("m", {"x": r.rand(rows, 64).astype("f4")})
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        with _gc_quiesced():
+            t0 = _time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = _time.perf_counter() - t0
+        assert wall >= min_window_s, (
+            f"quant A/B timed window {wall*1e3:.0f} ms is shorter than "
+            f"the {min_window_s:.1f} s floor — GC-pause-sized windows "
+            f"are noise; raise `requests` (currently {requests})")
+        lat = srv.latency_ms()
+        return {"rps": round(requests / wall, 2), "window_s": round(wall, 3),
+                "p50_ms": lat["p50"], "p99_ms": lat["p99"]}
+
+    # -- fp32 arm ----------------------------------------------------------
+    srv = serving.Server(registry, buckets=buckets, max_queue=max_queue)
+    srv.load_model("m", fp32_dir)  # warms every bucket
+    fp32_info = registry.models()["m"]
+    # the parity ledger's reference outputs: a fixed feed through the
+    # fp32 version, re-run after the quant publish for the direct diff
+    ref_feed = {"x": np.random.RandomState(7).rand(4, 64).astype("f4")}
+    ref_out = np.asarray(registry.acquire("m").run(ref_feed)[0], np.float64)
+    fp32_arm = window(srv)
+    srv.stop()
+
+    # metrics stream starts here: publish compile lane + parity event +
+    # quant steady state, one file gateable per the docstring recipe
+    logger = monitor.attach_logger(MonitorLogger(metrics_path))
+    steps0 = monitor.counter("executor.steps").value
+
+    # -- quant publish: the verification ladder INCLUDING the parity gate --
+    atol = float(fluid.flags.flag("FLAGS_serving_quant_atol") or 0.0)
+    serving.publish(registry, "m", quant_dir, warm_buckets=buckets)
+    quant_info = registry.models()["m"]
+    gate_ev = [r for r in monitor.step_records()
+               if r.get("kind") == "serving_event"
+               and r.get("action") == "quant_parity"]
+    quant_out = np.asarray(registry.acquire("m").run(ref_feed)[0], np.float64)
+    max_diff = float(np.max(np.abs(quant_out - ref_out)))
+    # every step record before this point is publish-lane (warm compiles,
+    # golden smoke, the parity gate's reference run): the recompile-flat
+    # gate must start past them
+    publish_lane_steps = monitor.counter("executor.steps").value - steps0
+    rec0 = monitor.counter("executor.recompile").value
+
+    # -- quant arm (same registry: warm executable cache, same buckets) ----
+    srv = serving.Server(registry, buckets=buckets, max_queue=max_queue)
+    quant_arm = window(srv)
+    quant_recompiles = monitor.counter("executor.recompile").value - rec0
+    assert quant_recompiles == 0, (
+        f"quant arm compiled inline ({quant_recompiles} recompiles) — the "
+        f"publish ladder's pre-swap warm lane must leave every bucket "
+        f"shape compiled before the swap")
+    logger.write_snapshot()
+    monitor.detach_logger(logger)
+    srv.stop()
+    monitor.disable()
+
+    device = _jax.default_backend()
+    on_tpu = device == "tpu"
+    speedup = (quant_arm["rps"] / fp32_arm["rps"]
+               if fp32_arm["rps"] else 0.0)
+    hbm_sav = (1.0 - quant_info["bytes"] / fp32_info["bytes"]
+               if fp32_info["bytes"] else 0.0)
+    roof = _serve_roofline(fp32_dir, max(buckets))
+    parity = {
+        "max_abs_diff": max_diff, "atol": atol,
+        "within_atol": bool(max_diff <= atol),
+        "gate_event_recorded": bool(gate_ev),
+        "gate_max_abs_diff": gate_ev[-1]["max_abs_diff"] if gate_ev else None,
+    }
+    print(f"serve-quant: fp32 {fp32_arm['rps']:.0f} req/s p99 "
+          f"{fp32_arm['p99_ms']:.1f} ms ({fp32_info['bytes']/1e3:.1f} KB) "
+          f"-> {quant_info['precision']} {quant_arm['rps']:.0f} req/s p99 "
+          f"{quant_arm['p99_ms']:.1f} ms ({quant_info['bytes']/1e3:.1f} KB, "
+          f"x{speedup:.3f}); parity max|diff| {max_diff:.2e} <= atol "
+          f"{atol:g}: {parity['within_atol']} [device={device}]",
+          file=sys.stderr)
+    return {"metric": "serving_quant_ab_rps", "value": quant_arm["rps"],
+            "unit": "req/sec", "device": device,
+            "throughput_claim": ("measured_on_device" if on_tpu
+                                 else "parity_only_off_device"),
+            "quant_speedup": round(speedup, 4),
+            "quant_throughput_ge_fp32": bool(speedup >= 1.0),
+            "fp32": {**fp32_arm, "hbm_bytes": fp32_info["bytes"],
+                     "precision": fp32_info["precision"]},
+            "quant": {**quant_arm, "hbm_bytes": quant_info["bytes"],
+                      "precision": quant_info["precision"],
+                      "serve_dtype": serve_dtype,
+                      "weight_bits": weight_bits},
+            "hbm_savings_frac": round(hbm_sav, 4),
+            "parity": parity,
+            "mfu_predicted_roofline": roof.get("mfu_predicted_roofline"),
+            "recompiles_steady": quant_recompiles,
+            "publish_lane_steps": publish_lane_steps,
+            "gate_steady_after": publish_lane_steps + 2,
+            "requests": requests, "clients": clients,
+            "buckets": list(buckets), "max_queue": max_queue,
             "metrics_path": metrics_path}
 
 
@@ -1294,7 +1524,10 @@ def main():
         print(json.dumps(bench_overlap()))
         return
     if "--serve" in sys.argv:
-        print(json.dumps(bench_serve()))
+        if "--quant" in sys.argv:
+            print(json.dumps(bench_serve_quant()))
+        else:
+            print(json.dumps(bench_serve()))
         return
     if "--chaos" in sys.argv:
         # distributed entries route to the multi-worker gang bench, data

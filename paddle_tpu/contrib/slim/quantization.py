@@ -173,3 +173,63 @@ def convert_quant_model(program, scope=None, weight_bits: int = 8):
             weight_scales[wname] = {"scale": np.squeeze(scale), "axis": qaxis}
     return {"weights": weight_scales,
             "activations": {n: act_bits[n] for n in sorted(act_bits)}}
+
+
+# --- build-time shape/dtype inference + static cost --------------------------
+# (reference: fake_quantize_op.cc / fake_dequantize_op.cc InferShape.  The
+# fake-quant family lowers in ops/math_ops.py, but its planner visibility
+# belongs to slim: a QAT-instrumented program must pass program_lint's
+# coverage floor (1.0) and price in resource_plan just like the float parent,
+# otherwise every quantized program is invisible to both gates.)
+
+from ...core import analysis as _A
+from ...core import resource_plan as _RP
+
+_FAKE_QUANT_TYPES = ("fake_quantize_abs_max",
+                     "fake_quantize_moving_average_abs_max")
+
+
+def _infer_fake_quant(ctx):
+    xs = ctx.in_shape("X")
+    if xs is None:
+        return
+    ctx.set_out("Out", tuple(xs), ctx.in_dtype("X"))
+    ctx.set_out("OutScale", (1,), "float32")
+
+
+_A.register_rule(list(_FAKE_QUANT_TYPES), _infer_fake_quant)
+
+
+def _infer_fake_quant_channel(ctx):
+    xs = ctx.in_shape("X")
+    if xs is None:
+        return
+    ctx.set_out("Out", tuple(xs), ctx.in_dtype("X"))
+    axis = ctx.op.attr("quant_axis", 0)
+    if -len(xs) <= axis < len(xs):
+        ctx.set_out("OutScale", (xs[axis],), "float32")
+    else:
+        ctx.fail(f"quant_axis={axis} out of range for X{tuple(xs)}",
+                 var=ctx.op.input("X")[0])
+
+
+_A.register_rule(["fake_channel_wise_quantize_abs_max"],
+                 _infer_fake_quant_channel)
+
+
+def _infer_fake_dequant(ctx):
+    xs = ctx.in_shape("X")
+    if xs is None:
+        return
+    ctx.set_out("Out", tuple(xs), ctx.in_dtype("X"))
+
+
+_A.register_rule(["fake_dequantize_max_abs"], _infer_fake_dequant)
+
+# abs + max-reduce + round + rescale ~= 4 flops/elem; dequant is one
+# multiply-rescale.  Traffic is the plain elementwise stream (in + out).
+_RP.register_elementwise_cost("fake_quantize_abs_max",
+                              "fake_channel_wise_quantize_abs_max",
+                              "fake_quantize_moving_average_abs_max",
+                              flops_per_elem=4.0)
+_RP.register_elementwise_cost("fake_dequantize_max_abs", flops_per_elem=1.0)

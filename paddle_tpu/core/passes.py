@@ -334,6 +334,62 @@ def fuse_ln_residual(program, keep=()):
     program._bump()
 
 
+@register_pass("fuse_bias_act")
+def fuse_bias_act(program, keep=()):
+    """Merge `elementwise_add` -> `relu`/`gelu` pairs into
+    elementwise_add(fuse_act=<act>) (reference: the fc_fuse / conv+bias+act
+    family of ir passes; here the activation folds into the add so the
+    Pallas bias-act epilogue — or XLA's own fused maximum/erf chain —
+    applies it in the same pass over the activation, ISSUE-17 gap ranking's
+    top unfused elementwise pair).
+
+    Safe only when the add's Out is read by exactly that activation and
+    nowhere else (any other reader still needs the pre-activation value);
+    `keep` names fetch targets that must stay written."""
+    keep = set(keep)
+    for block, outside in zip(program.blocks, _outside_reads(program)):
+        readers = _reader_counts(block)
+        writes, reads = _rw_positions(block)
+        by_out = {}
+        for i, op in enumerate(block.ops):
+            if (op.type == "elementwise_add"
+                    and not op.attrs.get("fuse_act")
+                    and len(op.input("X")) == 1 and len(op.input("Y")) == 1):
+                by_out[op.output("Out")[0]] = (op, i)
+        kept = []
+        for i, op in enumerate(block.ops):
+            if op.type in ("relu", "gelu"):
+                src = op.input_arg_names[0]
+                add, add_i = by_out.get(src, (None, -1))
+                # by_out keeps the LAST add writing each Out name — it must
+                # also PRECEDE this activation (a later writer is a
+                # different def; pairing across it would miscompile)
+                if add is not None and add_i >= i:
+                    add = None
+                out_name = op.output("Out")[0] if add is not None else None
+                # snapshot semantics: fusing moves the write of Out from the
+                # activation's position up to the add's — any op between
+                # that reads Out (old value) or writes Out, or that writes
+                # the add's Out (so the activation never saw the add's
+                # value), makes the move observable
+                hazard = add is not None and (
+                    _accessed_between(writes, src, add_i, i)
+                    or _accessed_between(writes, out_name, add_i, i)
+                    or _accessed_between(reads, out_name, add_i, i))
+                if (add is not None and not hazard
+                        and readers.get(src, 0) == 1
+                        and src not in keep and src not in outside):
+                    v = block._find_var_recursive(src)
+                    if v is None or not v.persistable:
+                        # the add now writes the activation's output var
+                        add.outputs["Out"] = [op.output("Out")[0]]
+                        add.attrs["fuse_act"] = op.type
+                        continue
+            kept.append(op)
+        block.ops = kept
+    program._bump()
+
+
 @register_pass("prune_dead_ops")
 def prune_dead_ops(program, targets: Optional[Sequence[str]] = None):
     """Fetch-driven dead-op elimination as a standalone pass (the executor

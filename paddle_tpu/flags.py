@@ -191,7 +191,8 @@ DEFINE_float("FLAGS_dist_bootstrap_timeout_s", 120.0,
 DEFINE_bool("FLAGS_use_pallas", False,
             "route hot-kernel lowerings to the hand-fused Pallas TPU "
             "kernels (ops/pallas_kernels.py: LayerNorm+residual, BN "
-            "scale/shift/relu epilogue, row-slab Adam; ops/"
+            "scale/shift/relu epilogue, row-slab Adam, hard-label "
+            "softmax-cross-entropy, bias+relu/gelu epilogue; ops/"
             "pallas_attention.py SDPA keeps its own use_pallas_sdpa attr). "
             "OPT-IN: off (default) or a non-TPU backend keeps the XLA "
             "composite for every kernel.  Participates in the executor "
@@ -228,6 +229,16 @@ DEFINE_float("FLAGS_serving_hbm_budget_mb", 0.0,
              "'hbm_budget') — never OOMs the chip mid-request.  Live "
              "usage rides the monitor/memstats gauges.  0 (default) = "
              "unlimited")
+DEFINE_float("FLAGS_serving_quant_atol", 5e-2,
+             "accuracy-parity gate for publishing a QUANTIZED model over "
+             "its fp32 parent (paddle_tpu/serving/publisher.py): during "
+             "the golden smoke the staged low-precision snapshot's "
+             "outputs are compared elementwise against the ACTIVE "
+             "version's outputs on the same feeds; max |diff| past this "
+             "tolerance REJECTS + QUARANTINES the snapshot exactly like "
+             "NaN weights (the fp32 parent keeps serving bit-identically)."
+             "  Only applies when the staged dir carries a __quant__.json "
+             "manifest and an active version exists to compare against")
 DEFINE_float("FLAGS_serving_slo_target", 0.99,
              "serving SLO good-fraction target the burn-rate gauges are "
              "computed against (paddle_tpu/serving/server.py): a request "

@@ -621,6 +621,7 @@ def save_quantized_inference_model(
     main_program: Optional[Program] = None,
     scope: Optional[Scope] = None,
     weight_bits: int = 8,
+    serve_dtype: Optional[str] = None,
 ):
     """save_inference_model + int8 weight storage (reference:
     inference/api/mkldnn_quantizer.cc role — produce a deployable quantized
@@ -629,7 +630,12 @@ def save_quantized_inference_model(
     abs-max per-tensor weight scales).  Quantized params are stored as int8
     on disk with their scales in __quant__.json; load_inference_model
     dequantizes transparently, so the served program's numerics equal the
-    int8-representable weights exactly."""
+    int8-representable weights exactly.
+
+    `serve_dtype` sets the in-memory dtype the loader dequantizes INTO
+    (e.g. "bfloat16"): the quant manifest's per-weight "dtype" field is the
+    load_vars dequant target, so a bf16 serve_dtype halves resident weight
+    HBM versus the float32 original while keeping int8 grid numerics."""
     from .contrib.slim.quantization import convert_quant_model
     from .contrib.slim.quantization import post_training_quantize
 
@@ -665,7 +671,8 @@ def save_quantized_inference_model(
             fname = wname.replace("/", "%2F") + ".npy"
             save_array(os.path.join(dirname, fname), q)
             qrec[wname] = {"scale": scale_arr.tolist(), "axis": axis,
-                           "bits": weight_bits, "dtype": str(w.dtype)}
+                           "bits": weight_bits,
+                           "dtype": serve_dtype or str(w.dtype)}
         if qrec:
             # the int8 payloads just overwrote files save_vars stamped as
             # floats — re-stamp them or the model fails its own digests

@@ -345,18 +345,17 @@ def _batch_norm(ctx, op, ins):
     }
 
 
-def _ln_stats_consumed(ctx, op):
-    """True when this layer_norm's Mean/Variance outputs are read by any op
-    or fetched — the fused kernel does not materialize them, so a consumer
-    must keep the composite lowering.
+def _outputs_consumed(ctx, op, slots):
+    """True when any of `op`'s outputs in `slots` is read by any op or
+    fetched — a fused kernel that does not materialize those slots must
+    then yield to the composite lowering.
 
     The program-wide read-name set is memoized on the LoweringContext (one
-    scan per trace, not one per layer_norm — a deep transformer would
-    otherwise rescan every op per LN on every compile-cache miss).  An op
-    never reads its own Mean/Variance outputs (def-before-use), so the
-    union over ALL ops matches the per-op exclusion it replaces."""
-    names = {n for slot in ("Mean", "Variance")
-             for n in op.outputs.get(slot, [])}
+    scan per trace, not one per op — a deep transformer would otherwise
+    rescan every op per candidate on every compile-cache miss).  An op
+    never reads its own outputs (def-before-use), so the union over ALL
+    ops matches the per-op exclusion it replaces."""
+    names = {n for slot in slots for n in op.outputs.get(slot, [])}
     if not names:
         return False
     if names & set(getattr(ctx, "fetch_names", ()) or ()):
@@ -369,6 +368,12 @@ def _ln_stats_consumed(ctx, op):
                 read.update(o.input_arg_names)
         ctx._program_read_names = read
     return bool(names & read)
+
+
+def _ln_stats_consumed(ctx, op):
+    """True when this layer_norm's Mean/Variance outputs are read or
+    fetched — the fused kernel does not materialize them."""
+    return _outputs_consumed(ctx, op, ("Mean", "Variance"))
 
 
 @register_op("layer_norm")
@@ -475,6 +480,23 @@ def _softmax_with_cross_entropy(ctx, op, ins):
     fused pass over the logits."""
     logits = first(ins, "Logits")
     label = first(ins, "Label")
+    from .pallas_kernels import fused_softmax_xent, use_pallas
+
+    if (use_pallas(ctx) and not op.attr("soft_label", False)
+            and logits.ndim >= 2
+            and not _outputs_consumed(ctx, op, ("Softmax",))):
+        # one-VMEM-pass kernel (max + logsumexp + picked logit together;
+        # bwd recomputes the softmax flash-style).  The Softmax slot stays
+        # unset — safe because _outputs_consumed proved nothing reads or
+        # fetches it (a consumer keeps the composite below).
+        lab = label
+        if lab.ndim == logits.ndim and lab.shape[-1] == 1:
+            lab = lab[..., 0]
+        lead = logits.shape[:-1]
+        loss = fused_softmax_xent(
+            logits.reshape(-1, logits.shape[-1]), lab.reshape(-1),
+            int(op.attr("ignore_index", -100)))
+        return {"Loss": loss.reshape(lead + (1,))}
     m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
     shifted = (logits - m).astype(jnp.float32)
     sumexp = jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True)
@@ -1342,8 +1364,27 @@ _RP.register_elementwise_cost("softmax", "log_softmax", "sigmoid_cross_entropy_w
                               flops_per_elem=8.0)
 _RP.register_elementwise_cost("batch_norm", flops_per_elem=6.0)
 _RP.register_elementwise_cost("layer_norm", flops_per_elem=10.0)
-_RP.register_elementwise_cost("softmax_with_cross_entropy", "cross_entropy",
-                              flops_per_elem=8.0)
+_RP.register_elementwise_cost("cross_entropy", flops_per_elem=8.0)
+
+
+def _cost_softmax_ce(ctx):
+    """Fused logsumexp formulation (the lowering above, composite AND
+    Pallas kernel): the [N, V] logits stream ONCE plus the Label and the
+    [N, 1] Loss.  The [N, V] Softmax slot is DCE'd when unfetched, so the
+    default io_bytes would double-charge the dominant stream — the exact
+    miscosting the ISSUE-17 gap ranking exists to avoid."""
+    b = 0
+    for slot in ("Logits", "Label"):
+        n = ctx.in_name(slot)
+        if n is not None:
+            b += ctx.env.nbytes(n)
+    n = ctx.out_name("Loss")
+    if n is not None:
+        b += ctx.env.nbytes(n)
+    return 8.0 * ctx.in_elems("Logits"), float(b)
+
+
+_RP.register_cost(["softmax_with_cross_entropy"], _cost_softmax_ce)
 _RP.register_elementwise_cost("accuracy", "arg_max", "arg_min",
                               flops_per_elem=2.0)
 _RP.register_elementwise_cost("top_k", flops_per_elem=6.0)

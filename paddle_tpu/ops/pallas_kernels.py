@@ -20,6 +20,13 @@ badly (see docs/performance.md):
   * `fused_adam` — m/v/param in ONE pass over row slabs instead of the 5+
     HBM round-trips of the composite (m, v, sqrt, div, sub chains), with
     `input_output_aliases` pinning the update in place.
+  * `fused_softmax_xent` — hard-label softmax-cross-entropy (max, logsumexp
+    and the picked logit in one VMEM pass; backward recomputes the softmax
+    flash-style).  Named by the ISSUE-17 roofline gap ranking
+    (tools/resource_plan.py --gap-rank): the composite is pure HBM traffic.
+  * `fused_bias_act` — y = act(x + bias[D]) for relu/gelu, the FFN bias
+    epilogue (core/passes.py fuse_bias_act folds the add->act pair); the
+    composite's intermediate never round-trips through HBM.
 
 Every kernel is an OPT-IN lowering alternative behind `FLAGS_use_pallas`
 (ops/nn_ops.py, ops/optimizer_ops.py): platform != TPU or flag off falls
@@ -375,6 +382,204 @@ def fused_adam(p, g, m, v, lr_t, beta1, beta2, eps, interpret=False):
 
 
 # --------------------------------------------------------------------------
+# fused softmax + cross-entropy (hard labels)
+# --------------------------------------------------------------------------
+# ISSUE-17 gap ranking: softmax_with_cross_entropy is 100% traffic-bound in
+# every zoo program — the composite's max/exp-sum/pick chain streams the
+# [N, V] logits through HBM three times (plus the Softmax slot when XLA
+# fails to DCE it).  One VMEM pass computes max, logsumexp and the picked
+# logit together; backward recomputes softmax flash-style (nothing but the
+# logits and labels saved).
+
+
+def _sxe_fwd_kernel(ignore_index):
+    def kern(x_ref, l_ref, o_ref):
+        x = x_ref[...].astype(jnp.float32)
+        lab = l_ref[...].astype(jnp.int32)
+        m = jnp.max(x, axis=-1, keepdims=True)
+        lse = jnp.log(jnp.sum(jnp.exp(x - m), axis=-1, keepdims=True)) + m
+        iota = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
+        picked = jnp.sum(jnp.where(iota == lab[:, None], x, 0.0),
+                         axis=-1, keepdims=True)
+        loss = (lse - picked)[:, 0]
+        o_ref[...] = jnp.where(lab == ignore_index, 0.0, loss)
+
+    return kern
+
+
+def _sxe_bwd_kernel(ignore_index, out_dtype):
+    def kern(x_ref, l_ref, g_ref, dx_ref):
+        x = x_ref[...].astype(jnp.float32)
+        lab = l_ref[...].astype(jnp.int32)
+        m = jnp.max(x, axis=-1, keepdims=True)
+        e = jnp.exp(x - m)
+        sm = e / jnp.sum(e, axis=-1, keepdims=True)
+        iota = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
+        onehot = (iota == lab[:, None]).astype(jnp.float32)
+        g = g_ref[...][:, None]
+        dx = (sm - onehot) * g
+        dx = jnp.where((lab == ignore_index)[:, None], 0.0, dx)
+        dx_ref[...] = dx.astype(out_dtype)
+
+    return kern
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def fused_softmax_xent(logits, labels, ignore_index=-100, interpret=False):
+    """loss[i] = logsumexp(logits[i]) - logits[i, labels[i]] in ONE pass.
+
+    logits: [R, V]; labels: [R] integer.  Loss is f32 [R, 1] (matching the
+    composite lowering's dtype); rows whose label equals ignore_index get
+    zero loss and zero gradient.  The softmax is never materialized —
+    callers that consume the Softmax slot keep the composite."""
+    out, _ = _sxe_fwd(logits, labels, ignore_index, interpret)
+    return out
+
+
+def _sxe_fwd(logits, labels, ignore_index, interpret):
+    R, V = logits.shape
+    slab = _pick_slab(R, V * 4 * 3, 1)
+    row_spec = pl.BlockSpec((slab, V), lambda i: (i, 0))
+    lab_spec = pl.BlockSpec((slab,), lambda i: (i,))
+    loss = pl.pallas_call(
+        _sxe_fwd_kernel(int(ignore_index)),
+        grid=(R // slab,),
+        in_specs=[row_spec, lab_spec],
+        out_specs=lab_spec,
+        out_shape=jax.ShapeDtypeStruct((R,), jnp.float32),
+        interpret=interpret,
+    )(logits, labels.astype(jnp.int32))
+    return loss[:, None], (logits, labels)
+
+
+def _sxe_bwd(ignore_index, interpret, saved, g):
+    logits, labels = saved
+    R, V = logits.shape
+    g1 = g.reshape(R).astype(jnp.float32)
+    slab = _pick_slab(R, V * 4 * 4, 1)
+    row_spec = pl.BlockSpec((slab, V), lambda i: (i, 0))
+    lab_spec = pl.BlockSpec((slab,), lambda i: (i,))
+    dx = pl.pallas_call(
+        _sxe_bwd_kernel(int(ignore_index), logits.dtype),
+        grid=(R // slab,),
+        in_specs=[row_spec, lab_spec, lab_spec],
+        out_specs=row_spec,
+        out_shape=jax.ShapeDtypeStruct((R, V), logits.dtype),
+        interpret=interpret,
+    )(logits, labels.astype(jnp.int32), g1)
+    return dx, np.zeros(labels.shape, jax.dtypes.float0)
+
+
+fused_softmax_xent.defvjp(_sxe_fwd, _sxe_bwd)
+
+
+# --------------------------------------------------------------------------
+# fused bias + activation epilogue (the FFN bias-act of BERT)
+# --------------------------------------------------------------------------
+# ISSUE-17 gap ranking: elementwise_add + relu/gelu are pure traffic
+# (gap_frac 1.00) and together outrank every unfused compute op left in the
+# zoo — the composite writes act's input to HBM only for act to read it
+# straight back.  One pass applies bias and activation; backward recomputes
+# the pre-activation (only x and bias saved) and accumulates dbias across
+# row slabs like _ln_bwd_kernel's dscale.
+
+_BIAS_ACTS = ("relu", "gelu")
+
+
+def _act_fwd(z, act):
+    if act == "relu":
+        return jnp.maximum(z, 0.0)
+    # exact gelu (jax.nn.gelu approximate=False): z * Phi(z)
+    return 0.5 * z * (1.0 + jax.lax.erf(z * (2.0 ** -0.5)))
+
+
+def _act_grad(z, act):
+    if act == "relu":
+        return (z > 0.0).astype(jnp.float32)
+    phi = jnp.exp(-0.5 * z * z) * 0.3989422804014327  # N(0,1) pdf
+    return 0.5 * (1.0 + jax.lax.erf(z * (2.0 ** -0.5))) + z * phi
+
+
+def _bias_act_fwd_kernel(act):
+    def kern(x_ref, b_ref, o_ref):
+        z = x_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+        o_ref[...] = _act_fwd(z, act).astype(o_ref.dtype)
+
+    return kern
+
+
+def _bias_act_bwd_kernel(act, out_dtype):
+    def kern(x_ref, b_ref, g_ref, dx_ref, db_ref):
+        z = x_ref[...].astype(jnp.float32) + b_ref[...].astype(jnp.float32)
+        dz = g_ref[...].astype(jnp.float32) * _act_grad(z, act)
+        dx_ref[...] = dz.astype(out_dtype)
+        i = pl.program_id(0)
+        db = jnp.sum(dz, axis=0)
+
+        @pl.when(i == 0)
+        def _init():
+            db_ref[...] = db
+
+        @pl.when(i != 0)
+        def _acc():
+            db_ref[...] += db
+
+    return kern
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def fused_bias_act(x, bias, act="gelu", interpret=False):
+    """y = act(x + bias) with bias [D] broadcast over rows of x:[R, D].
+
+    act in ("relu", "gelu") — gelu is the exact erf form (matches
+    jax.nn.gelu(approximate=False), the lowering's composite).  Backward
+    recomputes the pre-activation; dbias accumulates across row slabs in
+    f32 (shared accumulator block, sequential TPU grid)."""
+    out, _ = _bias_act_fwd(x, bias, act, interpret)
+    return out
+
+
+def _bias_act_fwd(x, bias, act, interpret):
+    assert act in _BIAS_ACTS, act
+    R, D = x.shape
+    slab = _pick_slab(R, D * 4 * 2, 1)
+    row_spec = pl.BlockSpec((slab, D), lambda i: (i, 0))
+    vec_spec = pl.BlockSpec((D,), lambda i: (0,))
+    out = pl.pallas_call(
+        _bias_act_fwd_kernel(act),
+        grid=(R // slab,),
+        in_specs=[row_spec, vec_spec],
+        out_specs=row_spec,
+        out_shape=jax.ShapeDtypeStruct((R, D), x.dtype),
+        interpret=interpret,
+    )(x, bias)
+    return out, (x, bias)
+
+
+def _bias_act_bwd(act, interpret, saved, g):
+    x, bias = saved
+    R, D = x.shape
+    slab = _pick_slab(R, D * 4 * 3, 1)
+    row_spec = pl.BlockSpec((slab, D), lambda i: (i, 0))
+    vec_spec = pl.BlockSpec((D,), lambda i: (0,))
+    dx, db = pl.pallas_call(
+        _bias_act_bwd_kernel(act, x.dtype),
+        grid=(R // slab,),
+        in_specs=[row_spec, vec_spec, row_spec],
+        out_specs=[row_spec, vec_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, D), x.dtype),
+            jax.ShapeDtypeStruct((D,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, bias, g)
+    return dx, db.astype(bias.dtype)
+
+
+fused_bias_act.defvjp(_bias_act_fwd, _bias_act_bwd)
+
+
+# --------------------------------------------------------------------------
 # kernel registry (tools/opbench.py --fused, parity matrix tests, docs)
 # --------------------------------------------------------------------------
 
@@ -430,8 +635,81 @@ def _adam_reference(p, g, m, v, lr_t=1e-3, beta1=0.9, beta2=0.999, eps=1e-8):
     return p2, m2, v2
 
 
+def _sxe_example(dtype, rows=256, v=1024, rng_seed=0):
+    rng = np.random.RandomState(rng_seed)
+    logits = jnp.asarray(rng.randn(rows, v) * 2.0, dtype)
+    labels = jnp.asarray(rng.randint(0, v, size=rows), jnp.int32)
+    return (logits, labels)
+
+
+def _sxe_reference(logits, labels, ignore_index=-100):
+    """The composite lowering's fused-logsumexp formulation (nn_ops.py)."""
+    m = jax.lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
+    shifted = (logits - m).astype(jnp.float32)
+    lse = (jnp.log(jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True))
+           + m.astype(jnp.float32))
+    iota = jax.lax.broadcasted_iota(jnp.int32, logits.shape, logits.ndim - 1)
+    onehot = iota == labels[:, None]
+    picked = jnp.sum(jnp.where(onehot, logits, 0).astype(jnp.float32),
+                     axis=-1, keepdims=True)
+    loss = lse - picked
+    return jnp.where(labels[:, None] == ignore_index, 0.0, loss)
+
+
+def _bias_act_example(dtype, rows=512, d=1024, rng_seed=0):
+    rng = np.random.RandomState(rng_seed)
+    x = jnp.asarray(rng.randn(rows, d), dtype)
+    b = jnp.asarray(rng.randn(d) * 0.1, jnp.float32)
+    return (x, b)
+
+
+def _bias_act_reference(x, b, act="gelu"):
+    z = x.astype(jnp.float32) + b.astype(jnp.float32)
+    if act == "relu":
+        y = jnp.maximum(z, 0.0)
+    else:
+        y = jax.nn.gelu(z, approximate=False)
+    return y.astype(x.dtype)
+
+
+def _nbytes(a):
+    return int(a.size) * int(a.dtype.itemsize)
+
+
+def _ln_analytic(args):
+    x, res, scale, bias = args
+    streams = _nbytes(x) * (3 if res is not None else 2)
+    return 10.0 * x.size, float(streams + _nbytes(scale) + _nbytes(bias))
+
+
+def _epilogue_analytic(args):
+    x, mul, add = args
+    return 3.0 * x.size, float(2 * _nbytes(x) + _nbytes(mul) + _nbytes(add))
+
+
+def _adam_analytic(args):
+    p, g, m, v = args
+    io = 2 * (_nbytes(p) + _nbytes(m) + _nbytes(v)) + _nbytes(g)
+    return 10.0 * p.size, float(io)
+
+
+def _sxe_analytic(args):
+    logits, labels = args
+    return (8.0 * logits.size,
+            float(_nbytes(logits) + _nbytes(labels) + logits.shape[0] * 4))
+
+
+def _bias_act_analytic(args):
+    x, b = args
+    return 9.0 * x.size, float(2 * _nbytes(x) + _nbytes(b))
+
+
 # name -> {fused, reference, example, tol}: `fused`/`reference` take the
 # example tuple; tolerances are per-dtype (bf16 carries its 8-bit mantissa).
+# `analytic` maps the example args to (flops, hbm_bytes) from the same cost
+# model the planner prices the op with — tools/opbench.py --fused divides
+# the implied roofline time by the measured time (roofline_frac column) so
+# A/B wins are stated in the units the MFU floors ratchet in.
 FUSED_KERNELS: Dict[str, dict] = {
     "ln_residual": {
         "fused": lambda args, interpret=False: fused_ln_residual(
@@ -440,6 +718,7 @@ FUSED_KERNELS: Dict[str, dict] = {
         "example": _ln_example,
         "tol": {"float32": 2e-5, "bfloat16": 5e-2},
         "grad_argnums": (0, 1, 2, 3),
+        "analytic": _ln_analytic,
     },
     "bn_scale_shift_relu": {
         "fused": lambda args, interpret=False: bn_epilogue(
@@ -448,6 +727,7 @@ FUSED_KERNELS: Dict[str, dict] = {
         "example": _epilogue_example,
         "tol": {"float32": 2e-5, "bfloat16": 2e-2},
         "grad_argnums": (0, 1, 2),
+        "analytic": _epilogue_analytic,
     },
     "adam_slab": {
         "fused": lambda args, interpret=False: fused_adam(
@@ -457,6 +737,25 @@ FUSED_KERNELS: Dict[str, dict] = {
         "example": _adam_example,
         "tol": {"float32": 2e-6, "bfloat16": 1e-2},
         "grad_argnums": (),  # state update, not a differentiable layer
+        "analytic": _adam_analytic,
+    },
+    "softmax_xent": {
+        "fused": lambda args, interpret=False: fused_softmax_xent(
+            args[0], args[1], -100, interpret),
+        "reference": lambda args: _sxe_reference(*args),
+        "example": _sxe_example,
+        "tol": {"float32": 2e-5, "bfloat16": 5e-2},
+        "grad_argnums": (0,),  # labels are integral
+        "analytic": _sxe_analytic,
+    },
+    "bias_act": {
+        "fused": lambda args, interpret=False: fused_bias_act(
+            args[0], args[1], "gelu", interpret),
+        "reference": lambda args: _bias_act_reference(*args, act="gelu"),
+        "example": _bias_act_example,
+        "tol": {"float32": 2e-5, "bfloat16": 5e-2},
+        "grad_argnums": (0, 1),
+        "analytic": _bias_act_analytic,
     },
 }
 

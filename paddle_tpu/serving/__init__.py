@@ -33,8 +33,8 @@ from .batcher import (DEFAULT_BUCKETS, bucket_for, build_batch,  # noqa: F401
                       split_rows, validate_feeds)
 from .publisher import publish, rollback, verify_snapshot_dir  # noqa: F401
 from .registry import (ModelRegistry, ModelVersion,  # noqa: F401
-                       manifest_weight_bytes, plan_model_bytes,
-                       synthetic_feeds)
+                       manifest_weight_bytes, model_precision,
+                       plan_model_bytes, quant_manifest, synthetic_feeds)
 from .server import Future, Server  # noqa: F401
 from .tracing import (NULL_TRACE, RequestTrace, TRACE_PHASES,  # noqa: F401
                       control_trace_id, maybe_trace)
@@ -45,6 +45,7 @@ __all__ = [
     "build_batch",
     "ModelRegistry", "ModelVersion", "synthetic_feeds",
     "manifest_weight_bytes", "plan_model_bytes",
+    "quant_manifest", "model_precision",
     "publish", "rollback", "verify_snapshot_dir",
     "Server", "Future",
     "RequestTrace", "NULL_TRACE", "maybe_trace", "control_trace_id",

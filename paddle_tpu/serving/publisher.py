@@ -29,7 +29,13 @@ verification ladder:
      finite outputs on a golden batch (caller-provided, or synthesized
      from the program's feed specs), and match `golden_expect` when the
      caller pins one;
-  7. pre-swap compile lane — the serving buckets are warmed on the
+  7. quantized-snapshot accuracy parity (ISSUE 17) — a quant snapshot
+     (`__quant__.json` present) publishing over a parent with the same
+     feed/fetch contract must reproduce the ACTIVE version's outputs on
+     the same feeds within `FLAGS_serving_quant_atol`; quantization
+     drift past the gate is a content defect and rejects + quarantines
+     exactly like a NaN weight;
+  8. pre-swap compile lane — the serving buckets are warmed on the
      STAGED version, so the post-swap steady state never compiles
      inline.
 
@@ -64,11 +70,13 @@ from ..checkpoint_manager import COMMITTED_MARKER, DIST_MARKER, CheckpointManage
 from ..core.analysis import check_program
 from ..core.scope import Scope
 from ..errors import ServingError, StorageError, classify
+from ..flags import flag as _flag
 from ..inference import Predictor
 from ..monitor import MONITOR as _MON
 from .. import io as _io
 from . import tracing as _tr
-from .registry import ModelRegistry, ModelVersion, synthetic_feeds
+from .registry import (ModelRegistry, ModelVersion, quant_manifest,
+                       synthetic_feeds)
 
 __all__ = ["publish", "rollback", "verify_snapshot_dir"]
 
@@ -337,6 +345,43 @@ def _publish_ladder(registry, name, src, golden_feeds, golden_expect,
                     _reject(registry, name, src, ctl,
                             f"golden output {fname!r} drifted past "
                             f"rtol={golden_rtol}")
+        # quantized-snapshot accuracy parity: a quant dir publishing
+        # over a parent with the same feed/fetch contract must agree
+        # with the ACTIVE version's outputs on the same feeds within
+        # FLAGS_serving_quant_atol — quantization drift past the gate
+        # is a content defect, same rejection path as a NaN weight
+        if (quant_manifest(src) is not None
+                and active.feed_names == list(feed_names)
+                and active.fetch_names == list(fetch_names)):
+            atol = float(_flag("FLAGS_serving_quant_atol") or 0.0)
+            try:
+                ref = active.run(feeds)
+            except Exception:
+                # the parent cannot run these feeds (e.g. it is itself
+                # mid-replacement); nothing sound to gate against
+                ref = None
+            if ref is not None and atol > 0:
+                worst, worst_name = 0.0, None
+                for fname, got, want in zip(fetch_names, outs, ref):
+                    g = np.asarray(got, np.float64)
+                    w = np.asarray(want, np.float64)
+                    if g.shape != w.shape:
+                        _reject(registry, name, src, ctl,
+                                f"quant parity: output {fname!r} shape "
+                                f"{g.shape} != serving parent's {w.shape}")
+                    d = float(np.max(np.abs(g - w))) if g.size else 0.0
+                    if d > worst:
+                        worst, worst_name = d, fname
+                if worst > atol:
+                    _reject(registry, name, src, ctl,
+                            f"quant parity: output {worst_name!r} drifted "
+                            f"max|diff|={worst:.3e} past "
+                            f"FLAGS_serving_quant_atol={atol:g} vs the "
+                            f"serving parent's outputs")
+                _MON.record_step({
+                    "kind": "serving_event", "action": "quant_parity",
+                    "model": name, "src": src, "max_abs_diff": worst,
+                    "atol": atol, "trace_id": ctl})
         version = ModelVersion(program, feed_names, fetch_names, staged,
                                predictor, src=src)
         # pre-swap compile lane: warm the serving buckets on the STAGED
@@ -357,6 +402,7 @@ def _publish_ladder(registry, name, src, golden_feeds, golden_expect,
                           "model": name, "src": src,
                           "version": version.version,
                           "prev_version": prev.version,
+                          "precision": version.precision,
                           "trace_id": ctl})
     return version
 

@@ -58,7 +58,43 @@ from . import tracing as _tr
 from .. import io as _io
 
 __all__ = ["ModelVersion", "ModelRegistry", "synthetic_feeds",
-           "manifest_weight_bytes", "plan_model_bytes"]
+           "manifest_weight_bytes", "plan_model_bytes", "quant_manifest",
+           "model_precision"]
+
+
+def quant_manifest(model_dir: str) -> Optional[dict]:
+    """The dir's __quant__.json (io.save_quantized_inference_model
+    output) when it names at least one quantized weight, else None —
+    None for plain float models AND for unreadable manifests (the load
+    itself will fail loudly on the latter)."""
+    try:
+        with open(os.path.join(model_dir, _io.QUANT_MANIFEST)) as f:
+            q = json.load(f)
+        return q if q.get("weights") else None
+    except (OSError, ValueError):
+        return None
+
+
+def model_precision(model_dir: str) -> str:
+    """Serving-precision label for a model dir: "float32" for plain
+    models; quantized dirs yield "int<bits>-><serve dtype>" from the
+    quant manifest (e.g. "int8->bfloat16" — int8 grid numerics served
+    as resident bf16 weights).  Mixed records join with "/"."""
+    q = quant_manifest(model_dir)
+    if q is None:
+        return "float32"
+    recs = list(q["weights"].values())
+    bits = "/".join(str(b) for b in sorted(
+        {int(r.get("bits", 8)) for r in recs}))
+    dts = "/".join(sorted({str(r.get("dtype", "float32")) for r in recs}))
+    return f"int{bits}->{dts}"
+
+
+def _dtype_itemsize(name: str) -> int:
+    try:
+        return np.dtype(name or "float32").itemsize
+    except TypeError:
+        return 2  # bfloat16-class dtypes numpy can't name
 
 
 def synthetic_feed_shapes(program, feed_names: Sequence[str], rows: int
@@ -108,8 +144,12 @@ def plan_model_bytes(model_dir: str, rows: int) -> int:
     program at the `rows`-row bucket shape: weights + live activations +
     staged feeds (core/resource_plan.py), i.e. what serving that bucket
     actually holds resident — not manifest weight bytes alone.  Reads only
-    `__model__.json` (no weights touched).  0 when the program is
-    absent/unplannable; callers fall back to `manifest_weight_bytes`."""
+    `__model__.json` (no weights touched).  Quantized dirs credit the
+    weight narrowing: the plan prices weights at the program's dtype, but
+    load_vars dequantizes quant-manifest weights into their SERVE dtype
+    (e.g. bf16), so the plan estimate is reduced by the per-weight width
+    difference.  0 when the program is absent/unplannable; callers fall
+    back to `manifest_weight_bytes`."""
     try:
         with open(os.path.join(model_dir, _io.MODEL_FILENAME)) as f:
             doc = json.load(f)
@@ -120,7 +160,22 @@ def plan_model_bytes(model_dir: str, rows: int) -> int:
         feed_shapes = synthetic_feed_shapes(program, doc.get("feed_names", []),
                                             rows)
         plan = plan_program(program, feed_shapes, doc.get("fetch_names", []))
-        return int(plan.peak_bytes)
+        total = int(plan.peak_bytes)
+        qweights = (quant_manifest(model_dir) or {}).get("weights", {})
+        if qweights:
+            block = program.global_block()
+            for wname, rec in qweights.items():
+                try:
+                    var = block.var(wname)
+                except Exception:
+                    continue
+                elems = 1
+                for d in (var.shape or []):
+                    elems *= max(int(d), 1)
+                orig = np.dtype(as_np_dtype(var.dtype) or np.float32).itemsize
+                total -= elems * max(
+                    orig - _dtype_itemsize(rec.get("dtype", "float32")), 0)
+        return total
     except Exception:
         return 0
 
@@ -129,10 +184,14 @@ def manifest_weight_bytes(model_dir: str) -> int:
     """Pre-load HBM estimate from the model dir's manifest (shape x dtype
     per persistable) — the FALLBACK when the saved program cannot be
     planned (`plan_model_bytes`); activations and workspace are invisible
-    to it.  0 when the manifest is absent/unreadable (the load itself
-    will fail loudly later — and the registry counts the unbudgeted load,
-    see ModelRegistry.load)."""
+    to it.  Weights named by the dir's quant manifest are priced at their
+    SERVE dtype (load_vars dequantizes int8 payloads into the per-weight
+    "dtype" record), so a bf16-serving quantized model budgets at half
+    its fp32 parent's weight bytes.  0 when the manifest is
+    absent/unreadable (the load itself will fail loudly later — and the
+    registry counts the unbudgeted load, see ModelRegistry.load)."""
     total = 0
+    qweights = (quant_manifest(model_dir) or {}).get("weights", {})
     try:
         with open(os.path.join(model_dir, _io.MANIFEST)) as f:
             manifest = json.load(f)
@@ -140,11 +199,10 @@ def manifest_weight_bytes(model_dir: str) -> int:
             n = 1
             for d in entry.get("shape", []):
                 n *= max(int(d), 1)
-            try:
-                itemsize = np.dtype(entry.get("dtype", "float32")).itemsize
-            except TypeError:
-                itemsize = 2  # bfloat16-class dtypes numpy can't name
-            total += n * itemsize
+            qrec = qweights.get(entry.get("name"))
+            dtype = (qrec.get("dtype", "float32") if qrec
+                     else entry.get("dtype", "float32"))
+            total += n * _dtype_itemsize(dtype)
     except (OSError, ValueError, KeyError):
         return 0
     return total
@@ -157,7 +215,8 @@ class ModelVersion:
     _ids = iter(range(1, 1 << 62))
 
     def __init__(self, program, feed_names, fetch_names, scope: Scope,
-                 predictor: Predictor, src: str):
+                 predictor: Predictor, src: str,
+                 precision: Optional[str] = None):
         self.version = next(ModelVersion._ids)
         self.program = program
         self.feed_names = list(feed_names)
@@ -165,6 +224,11 @@ class ModelVersion:
         self.scope = scope
         self.predictor = predictor
         self.src = src
+        # serving precision from the source dir's quant manifest
+        # ("float32" / "int8->bfloat16" / ...), surfaced in load/publish
+        # events and models() so an operator can see which precision a
+        # version serves at
+        self.precision = precision or model_precision(src)
         self.created_ts = time.time()
         self.bytes = self._weight_bytes()
         # per-thread predictor clones: a Predictor serializes on its own
@@ -369,7 +433,8 @@ class ModelRegistry:
                 self._models[name] = entry
                 _MON.counter("serving.model_loads").inc()
                 self._event("load", model=name, version=version.version,
-                            bytes=version.bytes, src=model_dir)
+                            bytes=version.bytes, src=model_dir,
+                            precision=version.precision)
         try:
             if warm_buckets:
                 # outside the lock: warming compiles, and acquire() from
@@ -411,7 +476,8 @@ class ModelRegistry:
         with self._lock:
             return {n: {"version": m.active.version,
                         "versions": [v.version for v in m.versions],
-                        "bytes": m.active.bytes, "src": m.active.src}
+                        "bytes": m.active.bytes, "src": m.active.src,
+                        "precision": m.active.precision}
                     for n, m in self._models.items()}
 
     def unload(self, name: str):

@@ -264,6 +264,63 @@ def test_check_bench_overlap_record(tmp_path):
     assert _check(tmp_path, _round_doc(overlap=noparity)) == 1
 
 
+def _serving_round_doc(within_atol=True, gate_event=True):
+    serve = {"metric": "serving_closed_loop_rps", "value": 2091.0,
+             "device": "cpu", "mfu_bf16_analytic": 1e-06,
+             "mfu_predicted_roofline": 0.0096}
+    return {"metric": "serving_quant_ab_rps", "value": 2481.0,
+            "device": "cpu",
+            "throughput_claim": "parity_only_off_device",
+            "parity": {"max_abs_diff": 7.8e-4, "atol": 0.05,
+                       "within_atol": within_atol,
+                       "gate_event_recorded": gate_event},
+            "mfu_predicted_roofline": 0.0096,
+            "extra": {"models": {"serving_closed_loop": serve}}}
+
+
+def test_check_bench_serving_only_round(tmp_path, capsys):
+    """A round with only serving_* records skips the training MFU floors
+    (loudly) but still prints the measured-vs-predicted roofline line and
+    the off-device honesty NOTE, and enforces the quant parity ledger."""
+    assert _check(tmp_path, _serving_round_doc()) == 0
+    out = capsys.readouterr().out
+    assert "serving-only round" in out
+    assert "MFU floors skipped" in out
+    assert "no throughput or MFU floor may ratchet" in out
+    assert "quant parity ledger clean" in out
+    assert "vs static roofline" in out
+    assert "no bench record to hold its MFU floor" not in out
+
+
+def test_check_bench_serving_round_dirty_parity_fails(tmp_path, capsys):
+    assert _check(tmp_path, _serving_round_doc(within_atol=False)) == 1
+    assert "quant parity ledger DIRTY" in capsys.readouterr().out
+
+
+def test_check_bench_serving_round_ungated_quant_fails(tmp_path, capsys):
+    assert _check(tmp_path, _serving_round_doc(gate_event=False)) == 1
+    assert "no quant_parity event" in capsys.readouterr().out
+
+
+def test_check_bench_mixed_round_still_holds_floors(tmp_path):
+    """A serving record riding a training round must NOT flip the round
+    to serving-only — the training floors still hold (and still fail)."""
+    doc = _round_doc(resnet_mfu=0.12)
+    doc["extra"]["models"]["serving"] = _serving_round_doc()
+    assert _check(tmp_path, doc) == 1
+
+
+def test_bench_r06_serving_round_passes():
+    """The committed BENCH_r06.json is a serving-only parity round: it
+    must clear --check-bench as-is (floors skipped, ledger clean)."""
+    import os
+
+    from tools.perf_report import check_bench
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert check_bench(os.path.join(here, "BENCH_r06.json")) == 0
+
+
 def test_check_bench_reads_round_wrapper(tmp_path):
     doc = {"n": 9, "tail": "noise\n" + json.dumps(_round_doc()) + "\n"}
     assert _check(tmp_path, doc) == 0

@@ -54,7 +54,10 @@ def test_grad_parity_fp32(kernel):
     through the composite."""
     spec = pk.FUSED_KERNELS[kernel]
     args = spec["example"](jnp.float32)
-    live = [a for a in args if a is not None]
+    live = list(args)
+    # differentiate only grad_argnums (ORIGINAL positions — e.g. the
+    # softmax_xent labels are integral and excluded by the registry)
+    argnums = tuple(i for i in spec["grad_argnums"] if args[i] is not None)
 
     def loss(fn):
         def wrapped(*a):
@@ -63,9 +66,8 @@ def test_grad_parity_fp32(kernel):
         return wrapped
 
     gf = jax.grad(loss(lambda a: spec["fused"](a, interpret=True)),
-                  argnums=tuple(range(len(live))))(*live)
-    gr = jax.grad(loss(spec["reference"]),
-                  argnums=tuple(range(len(live))))(*live)
+                  argnums=argnums)(*live)
+    gr = jax.grad(loss(spec["reference"]), argnums=argnums)(*live)
     for i, (a, b) in enumerate(zip(gf, gr)):
         err = float(jnp.max(jnp.abs(a.astype(jnp.float32)
                                     - b.astype(jnp.float32))))
@@ -89,7 +91,8 @@ def test_grad_parity_multi_slab(kernel, monkeypatch):
     monkeypatch.setattr(pk, "_VMEM_BUDGET", 64 * 1024)
     spec = pk.FUSED_KERNELS[kernel]
     args = spec["example"](jnp.float32)
-    live = [a for a in args if a is not None]
+    live = list(args)
+    argnums = tuple(i for i in spec["grad_argnums"] if args[i] is not None)
 
     def loss(fn):
         def wrapped(*a):
@@ -98,9 +101,8 @@ def test_grad_parity_multi_slab(kernel, monkeypatch):
         return wrapped
 
     gf = jax.grad(loss(lambda a: spec["fused"](a, interpret=True)),
-                  argnums=tuple(range(len(live))))(*live)
-    gr = jax.grad(loss(spec["reference"]),
-                  argnums=tuple(range(len(live))))(*live)
+                  argnums=argnums)(*live)
+    gr = jax.grad(loss(spec["reference"]), argnums=argnums)(*live)
     for i, (a, b) in enumerate(zip(gf, gr)):
         err = float(jnp.max(jnp.abs(a.astype(jnp.float32)
                                     - b.astype(jnp.float32))))
@@ -403,6 +405,104 @@ def test_fuse_bn_relu_pass_skips_intervening_write():
     bn = [op for op in main.global_block().ops if op.type == "batch_norm"][0]
     assert not bn.attrs.get("fuse_relu")
     assert any(op.type == "relu" for op in main.global_block().ops)
+
+
+def test_fuse_bias_act_pass_parity():
+    """ISSUE 17: elementwise_add -> relu folds into one add(fuse_act) op
+    with identical numerics (the bias-act epilogue's graph-side half)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.passes import apply_pass
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [32], dtype="float32")
+        h = fluid.layers.scale(x, scale=0.5)
+        s = fluid.layers.elementwise_add(h, x)
+        r = fluid.layers.relu(s)
+        out = fluid.layers.mean(r)
+    feed = {"x": np.random.RandomState(0).randn(4, 32).astype("f4")}
+    base = _run(main, startup, feed, out.name)
+    apply_pass(main, "fuse_bias_act", keep=[out.name])
+    add = [op for op in main.global_block().ops
+           if op.type == "elementwise_add"][0]
+    assert add.attrs.get("fuse_act") == "relu"
+    assert not any(op.type == "relu" for op in main.global_block().ops)
+    np.testing.assert_array_equal(base, _run(main, startup, feed, out.name))
+
+
+def test_fuse_bias_act_pass_gelu_parity():
+    import paddle_tpu as fluid
+    from paddle_tpu.core.passes import apply_pass
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [32], dtype="float32")
+        s = fluid.layers.elementwise_add(fluid.layers.scale(x, scale=0.5), x)
+        out = fluid.layers.mean(fluid.layers.gelu(s))
+    feed = {"x": np.random.RandomState(1).randn(4, 32).astype("f4")}
+    base = _run(main, startup, feed, out.name)
+    apply_pass(main, "fuse_bias_act", keep=[out.name])
+    add = [op for op in main.global_block().ops
+           if op.type == "elementwise_add"][0]
+    assert add.attrs.get("fuse_act") == "gelu"
+    assert not any(op.type == "gelu" for op in main.global_block().ops)
+    np.testing.assert_array_equal(base, _run(main, startup, feed, out.name))
+
+
+def test_fuse_bias_act_pass_skips_multi_reader():
+    """An add whose output has a second reader must NOT fuse — the other
+    reader still needs the pre-activation value."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.passes import apply_pass
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [32], dtype="float32")
+        s = fluid.layers.elementwise_add(fluid.layers.scale(x, scale=0.5), x)
+        r = fluid.layers.relu(s)
+        out = fluid.layers.mean(r) + fluid.layers.mean(s)  # second reader
+    apply_pass(main, "fuse_bias_act", keep=[out.name])
+    assert any(op.type == "relu" for op in main.global_block().ops)
+    assert not any(op.attrs.get("fuse_act")
+                   for op in main.global_block().ops
+                   if op.type == "elementwise_add")
+
+
+def test_fuse_bias_act_pass_skips_fetched_add_out():
+    """A pre-activation sum that is itself a fetch target must stay
+    written."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.passes import apply_pass
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [32], dtype="float32")
+        s = fluid.layers.elementwise_add(fluid.layers.scale(x, scale=0.5), x)
+        fluid.layers.relu(s)
+    apply_pass(main, "fuse_bias_act", keep=[s.name])
+    assert any(op.type == "relu" for op in main.global_block().ops)
+
+
+def test_fuse_bias_act_pass_skips_intervening_write():
+    """An op between the add and the activation that overwrites the add's
+    Out means the activation never saw the add's value — fusing would
+    resurrect it."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.passes import apply_pass
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", [32], dtype="float32")
+        h = fluid.layers.scale(x, scale=0.5)
+        s = fluid.layers.elementwise_add(h, x)
+        fluid.layers.assign(fluid.layers.scale(x, scale=2.0), output=s)
+        r = fluid.layers.relu(s)
+        out = fluid.layers.mean(r)
+    apply_pass(main, "fuse_bias_act", keep=[out.name])
+    assert any(op.type == "relu" for op in main.global_block().ops)
+    assert not any(op.attrs.get("fuse_act")
+                   for op in main.global_block().ops
+                   if op.type == "elementwise_add")
 
 
 # --------------------------------------------------------------------------

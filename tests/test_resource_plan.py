@@ -462,6 +462,57 @@ def test_cli_bench_renders_predicted_vs_measured(tmp_path):
     assert "achieved_frac" in r.stdout and "0.86" in r.stdout
 
 
+def test_cli_gap_rank_check_tiny_zoo():
+    """ISSUE 17 tier-1 wiring: the gap ranking renders over the whole
+    zoo with every cost row covered by a real FLOPs/traffic rule — an
+    uncovered row (default 1-flop/elem model) would poison the ranking
+    the kernel campaign walks, so --check fails on any."""
+    r = _run_cli("--gap-rank", "--check")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "CHECK OK" in r.stdout and "zero uncovered" in r.stdout
+    # the campaign's own top targets from GAP_RANK.md stay in the table
+    assert "matmul" in r.stdout and "op_type" in r.stdout
+
+
+def test_cli_gap_rank_scales_by_bench_and_writes_artifact(tmp_path):
+    """--bench supplies the measured side: op times scale by each model's
+    predicted/measured MFU ratio, the scaling is disclosed in the render,
+    and --out writes the committed artifact."""
+    p = tmp_path / "bench.json"
+    p.write_text(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_per_chip", "value": 2704.0,
+        "mfu_bf16_analytic": 0.168, "mfu_predicted_roofline": 0.196}))
+    out = tmp_path / "gap_rank.md"
+    r = _run_cli("--gap-rank", "--program", "resnet50", "--bench", str(p),
+                 "--out", str(out), timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "time scaling (predicted/measured MFU)" in r.stdout
+    assert "resnet50=" in r.stdout
+    text = out.read_text()
+    assert text.startswith("# roofline gap ranking")
+    assert "scaled by bench.json" in text
+
+
+def test_cli_gap_rank_zero_rows_fails(tmp_path):
+    """Zero-evidence precedent: a ranking rendered from zero cost rows
+    (nothing planned) must FAIL --check, not gate green."""
+    r = _run_cli("--gap-rank", "--check", "--program", "no_such_model",
+                 timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "zero cost rows" in r.stdout
+
+
+def test_cli_gap_rank_bench_without_mfu_warns_unscaled(tmp_path):
+    """A bench file with no usable measured MFU must not silently render
+    as if it were evidence-scaled."""
+    p = tmp_path / "no_mfu.json"
+    p.write_text(json.dumps({"metric": "x", "value": 1.0}))
+    r = _run_cli("--gap-rank", "--program", "mnist", "--bench", str(p),
+                 timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "no usable measured MFU" in r.stdout
+
+
 def test_perf_report_check_bench_names_roofline_gap(tmp_path):
     """perf_report --check-bench prints the predicted-MFU column and
     --min-roofline-frac turns a deep gap into a hard failure."""

@@ -469,6 +469,115 @@ def test_publish_golden_drift_rejected(tmp_path, mon):
         srv.stop()
 
 
+def _save_quant_model(dirname, w_scale=1.0, serve_dtype="bfloat16",
+                      weight_bits=8):
+    """The quantized twin of _save_model: same deterministic weights, int8
+    payloads on disk, dequantized into `serve_dtype` at load time."""
+    main, startup, out = _build_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    startup.random_seed = 3
+    exe.run(startup, scope=scope)
+    for v in main.list_vars():
+        if v.persistable:
+            scope.set_var(v.name, np.full(
+                np.asarray(scope.find_var(v.name)).shape, w_scale,
+                dtype="float32"))
+    fluid.io.save_quantized_inference_model(
+        dirname, ["x"], [out], exe, main, scope,
+        weight_bits=weight_bits, serve_dtype=serve_dtype)
+    return dirname
+
+
+def test_publish_quant_parity_pass_and_precision(tmp_path, mon):
+    """ISSUE 17 fast path, happy case: an int8/bf16 snapshot of the SAME
+    weights publishes through the full ladder — the parity rung compares
+    it against the serving fp32 parent and records a `quant_parity`
+    event; the swapped version serves at half the weight HBM with its
+    precision labelled end to end (models(), publish event)."""
+    srv, _ = _server(tmp_path, buckets=(2,))
+    try:
+        fp32_bytes = srv.registry.models()["m"]["bytes"]
+        assert srv.registry.models()["m"]["precision"] == "float32"
+        qd = _save_quant_model(str(tmp_path / "quant_ok"))
+        xv = np.ones((1, D_IN), "f4")
+        before = srv.infer("m", {"x": xv})[0]
+        srv.publish("m", qd)
+        info = srv.registry.models()["m"]
+        assert info["precision"] == "int8->bfloat16"
+        # bf16 residency: roughly half the fp32 parent's weight bytes
+        assert info["bytes"] < fp32_bytes
+        # all-1.0 weights sit exactly on the int8 grid AND in bf16, so the
+        # quantized snapshot serves the parent's outputs unchanged
+        np.testing.assert_allclose(srv.infer("m", {"x": xv})[0], before,
+                                   rtol=1e-5)
+        evs = [r for r in monitor.step_records()
+               if r.get("kind") == "serving_event"]
+        parity = [r for r in evs if r.get("action") == "quant_parity"]
+        assert len(parity) == 1 and parity[0]["model"] == "m"
+        assert parity[0]["max_abs_diff"] <= parity[0]["atol"]
+        pub = [r for r in evs if r.get("action") == "publish"]
+        assert pub and pub[-1]["precision"] == "int8->bfloat16"
+    finally:
+        srv.stop()
+
+
+def test_publish_drifted_quant_rejected_and_quarantined(tmp_path, mon):
+    """A quantized snapshot whose scales rotted (bad calibration, torn
+    sidecar) dequantizes to finite-but-wrong weights — only the parity
+    rung can catch it.  It must reject, quarantine, and leave the fp32
+    parent serving bit-for-bit."""
+    srv, _ = _server(tmp_path, buckets=(2,))
+    try:
+        bad = _save_quant_model(str(tmp_path / "quant_drift"))
+        qpath = os.path.join(bad, fluid.io.QUANT_MANIFEST)
+        with open(qpath) as f:
+            qman = json.load(f)
+        for rec in qman["weights"].values():
+            rec["scale"] = (np.asarray(rec["scale"], "f4") * 37.0).tolist()
+        with open(qpath, "w") as f:
+            json.dump(qman, f)
+        _assert_rejected_and_old_serves(srv, bad, mon, "quant parity")
+        # quarantine: a repeat publish of the same snapshot rejects fast
+        with pytest.raises(ServingError) as ei:
+            srv.publish("m", bad)
+        assert ei.value.reason == "publish_rejected"
+        assert "quarantined" in str(ei.value)
+    finally:
+        srv.stop()
+
+
+def test_quant_load_event_precision_and_hbm_narrowing(tmp_path, mon):
+    """HBM budget plumbing for ISSUE 17: both admission estimators
+    (planner-based and manifest fallback) price the narrowed quant
+    weights below the fp32 twin, and the load event is precision-
+    labelled so the serving ledger shows what dtype went live."""
+    fp32 = _save_model(str(tmp_path / "fp32"))
+    quant = _save_quant_model(str(tmp_path / "quant"))
+    assert serving.model_precision(fp32) == "float32"
+    assert serving.model_precision(quant) == "int8->bfloat16"
+    assert serving.quant_manifest(fp32) is None
+    assert serving.quant_manifest(quant)["weights"]
+    assert (serving.manifest_weight_bytes(quant)
+            < serving.manifest_weight_bytes(fp32))
+    assert (serving.plan_model_bytes(quant, 8)
+            < serving.plan_model_bytes(fp32, 8))
+    reg = serving.ModelRegistry(place=fluid.CPUPlace())
+    srv = serving.Server(reg, buckets=(2,))
+    try:
+        srv.load_model("q", quant)
+        loads = [r for r in monitor.step_records()
+                 if r.get("kind") == "serving_event"
+                 and r.get("action") == "load"]
+        assert loads and loads[-1]["precision"] == "int8->bfloat16"
+        # the loaded version's MEASURED bytes confirm the bf16 residency
+        # the estimators promised
+        assert reg.models()["q"]["bytes"] < serving.manifest_weight_bytes(
+            fp32) + 64
+    finally:
+        srv.stop()
+
+
 def test_publish_from_committed_checkpoint(tmp_path, mon):
     """A training gang's CheckpointManager COMMITTED output publishes
     weights-only into the live server; a torn (uncommitted distributed)
@@ -807,3 +916,65 @@ def test_bench_serve_smoke_and_gate(tmp_path):
     assert trace_check(rec["metrics_path"], max_queue_wait_frac=0.999,
                        max_pad_frac=0.9) == 0
     assert trace_check(ov["metrics_path"]) == 0
+
+
+def test_perf_report_require_quant_parity_gate(tmp_path):
+    """The ISSUE 17 CI gate: --require-quant-parity fails on zero
+    evidence, on a quant-parity rejection, and on a recorded diff past
+    its own atol; passes only on a clean parity ledger."""
+    from tools.perf_report import check
+
+    def write(name, records):
+        p = str(tmp_path / name)
+        with open(p, "w") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+        return p
+
+    ev = {"kind": "serving_event", "action": "quant_parity", "model": "m",
+          "src": "/s", "max_abs_diff": 1e-4, "atol": 0.05}
+    assert check(write("ok.jsonl", [ev]), require_quant_parity=True) == 0
+    # zero evidence must not gate green
+    assert check(write("none.jsonl", [{"kind": "snapshot", "counters": {},
+                                       "gauges": {}}]),
+                 require_quant_parity=True) == 1
+    # a parity event whose diff exceeded its own atol (gate was armed at
+    # 0 / event recorded by a different policy) still fails
+    drift = dict(ev, max_abs_diff=0.1)
+    assert check(write("drift.jsonl", [drift]),
+                 require_quant_parity=True) == 1
+    # a quant-parity publish rejection in the window fails even next to a
+    # clean event from another publish
+    rej = {"kind": "serving_event", "action": "publish_rejected",
+           "model": "m", "detail": "quant parity: output 'y' drifted "
+           "max|diff|=2.1e-01 past FLAGS_serving_quant_atol=0.05"}
+    assert check(write("rej.jsonl", [ev, rej]),
+                 require_quant_parity=True) == 1
+
+
+def test_bench_serve_quant_smoke_and_gate(tmp_path):
+    """Tier-1 CPU smoke of `bench.py --serve --quant`: the A/B record
+    lands with the parity ledger clean, the publish ladder's quant_parity
+    event in the stream, HBM narrowed, an honest off-device throughput
+    claim — and the stream passes the documented gate recipe."""
+    import bench
+    from tools.perf_report import check
+
+    rec = bench.bench_serve_quant(
+        requests=60, clients=3, buckets=(1, 2, 4),
+        metrics_path=str(tmp_path / "quant.jsonl"), min_window_s=0)
+    assert rec["metric"] == "serving_quant_ab_rps" and rec["value"] > 0
+    assert rec["quant"]["precision"] == "int8->bfloat16"
+    assert rec["fp32"]["precision"] == "float32"
+    assert rec["quant"]["hbm_bytes"] < rec["fp32"]["hbm_bytes"]
+    assert rec["hbm_savings_frac"] > 0.3
+    assert rec["parity"]["within_atol"]
+    assert rec["parity"]["gate_event_recorded"]
+    assert rec["parity"]["gate_max_abs_diff"] <= rec["parity"]["atol"]
+    assert rec["recompiles_steady"] == 0
+    # honesty contract: CPU CI must never claim chip throughput
+    assert rec["device"] != "tpu"
+    assert rec["throughput_claim"] == "parity_only_off_device"
+    # the one-file gate recipe from the bench docstring
+    assert check(rec["metrics_path"], steady_after=rec["gate_steady_after"],
+                 require_quant_parity=True) == 0

@@ -262,15 +262,42 @@ def run_fused_ab(kernels=None, dtypes=("float32",), interpret=False,
             dispatches, tol = build_fused_dispatches(
                 kernel, dtype, interpret=interpret, grad=grad)
             stats = interleave(dispatches, rounds=rounds, iters=iters)
-            recs.append({
+            rec = {
                 "kernel": kernel, "dtype": dtype, "grad": grad,
                 "interpret": interpret, "parity_tol": tol,
                 "pallas": stats["pallas"], "xla": stats["xla"],
                 "speedup": round(stats["xla"]["best_ms"]
                                  / stats["pallas"]["best_ms"], 4)
                 if stats["pallas"]["best_ms"] else None,
-            })
+            }
+            rec.update(_roofline_frac(kernel, dtype, grad, stats))
+            recs.append(rec)
     return recs
+
+
+def _roofline_frac(kernel, dtype, grad, stats):
+    """roofline_ms + per-arm roofline_frac from the registry's analytic
+    (flops, bytes) for the example shapes — the kernel's cost-rule-units
+    roofline time divided by measured time, so an A/B win is stated in the
+    same units the MFU floors ratchet in (ISSUE-17).  Forward arm only:
+    the analytic model prices one fwd pass, and a fwd/bwd window would
+    flatter the frac by ~the grad factor."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.resource_plan import (CHIP_HBM_BANDWIDTH,
+                                               CHIP_PEAK_FLOPS)
+    from paddle_tpu.ops.pallas_kernels import FUSED_KERNELS
+
+    ana = FUSED_KERNELS[kernel].get("analytic")
+    if ana is None or grad:
+        return {}
+    flops, bts = ana(FUSED_KERNELS[kernel]["example"](jnp.dtype(dtype)))
+    t_ms = max(flops / CHIP_PEAK_FLOPS, bts / CHIP_HBM_BANDWIDTH) * 1e3
+    out = {"roofline_ms": round(t_ms, 6), "roofline_frac": {}}
+    for arm in ("pallas", "xla"):
+        best = stats[arm]["best_ms"]
+        out["roofline_frac"][arm] = round(t_ms / best, 4) if best else None
+    return out
 
 
 # --------------------------------------------------------------------------

@@ -86,6 +86,15 @@
         `bench.py --serve` must hold WITH shedding active — bounded-queue
         admission is what keeps it flat while load climbs.
 
+    python tools/perf_report.py --check metrics.jsonl --require-quant-parity
+        Gate a quantized-serving round (bench.py --serve --quant): the
+        file must carry at least one `quant_parity` serving_event — the
+        publish ladder's accuracy gate over a quantized snapshot
+        (FLAGS_serving_quant_atol vs the fp32 parent's outputs) — with
+        max_abs_diff within its recorded atol, and no quant-parity
+        publish rejection.  A file with no quant evidence FAILS (zero
+        evidence must not gate green).
+
     python tools/perf_report.py --check metrics.jsonl --max-lock-wait-frac 0.2
         Gate named-lock contention (paddle_tpu/core/locks.py, recorded
         when the run sets FLAGS_lock_telemetry=1): of all time threads
@@ -593,6 +602,17 @@ def pad_fraction(lines):
     return pad / (rows + pad) if rows + pad else 0.0
 
 
+def quant_parity_events(lines):
+    """The publisher's `quant_parity` serving_event records: one per
+    quantized snapshot that PASSED the accuracy-parity gate
+    (FLAGS_serving_quant_atol vs the serving fp32 parent's outputs,
+    paddle_tpu/serving/publisher.py).  A drifted snapshot never emits
+    one — it rejects with a publish_rejected event whose detail names
+    'quant parity' instead."""
+    return [r for r in lines if r.get("kind") == "serving_event"
+            and r.get("action") == "quant_parity"]
+
+
 def _has_integrity_evidence(lines):
     """True when the file carries ANY integrity signal: integrity_event
     records or integrity.* counters/gauges in a snapshot.  The integrity
@@ -715,7 +735,8 @@ def check(path: str, steady_after: int = 2,
           max_integrity_mismatches: int = None,
           max_ckpt_lag_steps: float = None,
           max_queue_wait_frac: float = None,
-          max_pad_frac: float = None) -> int:
+          max_pad_frac: float = None,
+          require_quant_parity: bool = False) -> int:
     """Return 0 when the metrics file is healthy, 1 otherwise (printed
     diagnosis either way).  Made for CI/bench scripts:
 
@@ -751,7 +772,8 @@ def check(path: str, steady_after: int = 2,
                        or max_integrity_mismatches is not None
                        or max_ckpt_lag_steps is not None
                        or max_queue_wait_frac is not None
-                       or max_pad_frac is not None) \
+                       or max_pad_frac is not None
+                       or require_quant_parity) \
         and max_host_blocked_frac is None and max_retry_frac is None
     if not steps and not dist_gates_only:
         print(f"perf_report --check: {path} contains no step records "
@@ -967,6 +989,43 @@ def check(path: str, steady_after: int = 2,
             else:
                 print(f"perf_report --check: serving pad fraction "
                       f"{frac:.4f} <= {max_pad_frac}")
+    if require_quant_parity:
+        qevs = quant_parity_events(lines)
+        qrej = [r for r in lines if r.get("kind") == "serving_event"
+                and r.get("action") == "publish_rejected"
+                and "quant parity" in str(r.get("detail", ""))]
+        if qrej:
+            failures.append(
+                f"{len(qrej)} quantized publish(es) REJECTED on the "
+                f"accuracy-parity gate "
+                f"({qrej[0].get('detail', '')!r}) — the int8 snapshot "
+                f"drifted past FLAGS_serving_quant_atol from its fp32 "
+                f"parent; re-quantize (check the scales) rather than "
+                f"raising the tolerance")
+        elif not qevs:
+            failures.append(
+                f"--require-quant-parity given but {path} carries no "
+                f"quant_parity serving_event — no quantized snapshot "
+                f"went through the publish ladder's parity gate (was "
+                f"`bench.py --serve --quant` the producer, with the "
+                f"monitor enabled?); zero evidence must not gate green")
+        else:
+            worst = max(float(r.get("max_abs_diff", 0.0) or 0.0)
+                        for r in qevs)
+            drifted = [r for r in qevs
+                       if float(r.get("max_abs_diff", 0.0) or 0.0)
+                       > float(r.get("atol", 0.0) or 0.0)]
+            if drifted:
+                failures.append(
+                    f"quant parity event carries max_abs_diff "
+                    f"{drifted[0].get('max_abs_diff')} past its own atol "
+                    f"{drifted[0].get('atol')} — the gate recorded drift "
+                    f"it should have rejected; the publisher's parity "
+                    f"rung is broken")
+            else:
+                print(f"perf_report --check: quant parity held across "
+                      f"{len(qevs)} quantized publish(es) "
+                      f"(worst max|diff| {worst:.3e})")
     if max_lock_wait_frac is not None:
         if not _has_lock_evidence(lines):
             failures.append(
@@ -1115,10 +1174,12 @@ def _bench_records(path):
     if doc.get("metric", "").startswith("resnet50"):
         out["resnet50"] = {**doc, **{k: v for k, v in extra.items()
                                      if k != "models"}}
+    elif "metric" in doc:
+        # a non-resnet50 anchor (e.g. a serving round's quant A/B) keys
+        # itself in alongside any records riding its extra.models
+        out[doc["metric"].split("_")[0]] = doc
     for name, rec in extra.get("models", {}).items():
         out[name] = rec
-    if not out and "metric" in doc:  # a --per-model single-record file
-        out[doc["metric"].split("_")[0]] = doc
     return out
 
 
@@ -1134,7 +1195,14 @@ def check_bench(path, floors=None, max_spread_pct=None,
     0 healthy / 1 failed, diagnosis printed either way.  `require_overlap`
     fails rounds that do not embed a dp_grad_overlap record (fresh-round
     acceptance; historical rounds predate the overlap path and check
-    without it)."""
+    without it).
+
+    A serving-only round (every record metric starts with "serving", e.g.
+    BENCH_r06) skips the training MFU floors with a loud NOTE; the
+    measured-vs-predicted roofline line, the off-device honesty contract
+    (`throughput_claim`), and the quant parity ledger still gate it —
+    a dirty ledger or a quant A/B whose publish ladder never recorded
+    its `quant_parity` event FAILS."""
     floors = MFU_FLOORS if floors is None else floors
     max_spread = MAX_SPREAD_PCT if max_spread_pct is None else max_spread_pct
     try:
@@ -1146,7 +1214,18 @@ def check_bench(path, floors=None, max_spread_pct=None,
         print(f"perf_report --check-bench: no model records in {path}")
         return 1
     failures = []
-    for model, gate in floors.items():
+    # a serving round carries no training records for the floors to hold
+    # against — skipping them silently would look like a green training
+    # gate, so say it; the serving-specific gates below still apply
+    serving_only = all(
+        isinstance(r, dict)
+        and str(r.get("metric", "")).startswith("serving")
+        for r in recs.values())
+    if serving_only:
+        print("perf_report --check-bench: serving-only round — training "
+              "MFU floors skipped (roofline line, throughput-claim "
+              "honesty, and the quant parity ledger still gate it)")
+    for model, gate in ([] if serving_only else floors.items()):
         rec = recs.get(model)
         if rec is None or "error" in rec:
             failures.append(f"{model}: no bench record to hold its MFU "
@@ -1226,6 +1305,34 @@ def check_bench(path, floors=None, max_spread_pct=None,
         elif sk is not None:
             print(f"perf_report --check-bench: {model} gang skew frac "
                   f"{sk} <= {MAX_BENCH_STEP_SKEW_FRAC}")
+        if rec.get("throughput_claim") == "parity_only_off_device":
+            print(f"perf_report --check-bench: NOTE: {model} ran "
+                  f"off-device (device={rec.get('device')}) — parity "
+                  f"evidence only; no throughput or MFU floor may "
+                  f"ratchet from this record")
+        par = rec.get("parity")
+        if isinstance(par, dict) and "within_atol" in par:
+            # a quant A/B is a speedup claim with no accuracy evidence
+            # unless both halves of its ledger hold: the publish ladder's
+            # own gate event ran, and the recorded drift sits inside atol
+            if not par.get("gate_event_recorded", True):
+                failures.append(
+                    f"{model}: quant A/B but the publish ladder recorded "
+                    f"no quant_parity event — the accuracy gate never ran "
+                    f"on this snapshot (FLAGS_serving_quant_atol=0 "
+                    f"disables it); an ungated quant round cannot land")
+            if not par["within_atol"]:
+                failures.append(
+                    f"{model}: quant parity ledger DIRTY — max|diff| "
+                    f"{par.get('max_abs_diff')} past atol "
+                    f"{par.get('atol')}; the quantized snapshot drifted "
+                    f"from its fp32 parent and the A/B's throughput is "
+                    f"not evidence")
+            elif par.get("gate_event_recorded", True):
+                print(f"perf_report --check-bench: {model} quant parity "
+                      f"ledger clean (max|diff| "
+                      f"{par.get('max_abs_diff'):.2e} <= atol "
+                      f"{par.get('atol'):g}, gate event recorded)")
     ov = next((r for r in recs.values() if isinstance(r, dict)
                and r.get("metric", "").startswith("dp_grad_overlap")), None)
     if ov is None:
@@ -1462,6 +1569,16 @@ def main(argv=None):
                          "(serving.p99_ms gauge, serving_batch "
                          "lat_ms_max fallback) at <= MS — the tail SLO "
                          "shedding must hold under overload")
+    ap.add_argument("--require-quant-parity", action="store_true",
+                    help="require the file to carry at least one "
+                         "quant_parity serving_event (the publish "
+                         "ladder's accuracy gate over a quantized "
+                         "snapshot, paddle_tpu/serving/publisher.py) "
+                         "with max_abs_diff within its atol, and no "
+                         "quant-parity publish rejection — the "
+                         "`bench.py --serve --quant` round's metrics "
+                         "gate.  Fails on a file with no quant evidence "
+                         "at all (zero evidence must not gate green)")
     ap.add_argument("--max-lock-wait-frac", type=float, default=None,
                     metavar="FRAC",
                     help="gate named-lock contention at <= FRAC: "
@@ -1543,7 +1660,8 @@ def main(argv=None):
                      args.max_integrity_mismatches,
                      args.max_ckpt_lag_steps,
                      max_queue_wait_frac=args.max_queue_wait_frac,
-                     max_pad_frac=args.max_pad_frac)
+                     max_pad_frac=args.max_pad_frac,
+                     require_quant_parity=args.require_quant_parity)
     if args.diff:
         print(diff(*args.diff))
         return 0
