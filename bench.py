@@ -902,6 +902,173 @@ def bench_serve_quant(requests=4000, clients=4, buckets=(1, 2, 4, 8),
             "metrics_path": metrics_path}
 
 
+def bench_serve_fleet(requests=1000, clients=6, replica_counts=(1, 2, 4),
+                      buckets=(1, 2, 4, 8), hb_interval_s=0.2):
+    """Fleet serving bench (ISSUE 18): closed-loop rps/p99 through the
+    health-aware router at 1/2/4 replicas, plus a CHAOS arm that
+    SIGKILLs a replica mid-window at n=2 and prices the failover.
+
+    Each arm spawns a REAL multi-process fleet (replica Server processes
+    under the supervisor, per-request TCP through the router), drives
+    `requests` closed-loop requests from `clients` threads, and records
+    client-observed rps/p50/p99 — the wire + routing overhead is the
+    point, so latency is measured at the caller, not inside the replica.
+
+    The chaos arm re-runs the n=2 shape, kills rank 0 a third of the way
+    in, and reports survivor-carried rps, the exact shed ledger (every
+    loss must be a classified `replica_down` — the router's exactly-once
+    accounting is part of what's priced), and the post-run
+    `serve_trace --fleet --check` / `perf_report --check-roll-convergence`
+    verdicts over the fleet's own telemetry.
+
+    On a CPU container the absolute rps is plumbing evidence only
+    (`throughput_claim="parity_only_off_device"`, same contract as
+    BENCH_r06's serving round); the replica-scaling ratios and the
+    chaos-arm loss bound are platform-independent."""
+    import os
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+
+    import jax as _jax
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.errors import ServingError
+    from paddle_tpu.serving import ServingFleet
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = layers.data("x", [64], dtype="float32")
+        h = layers.fc(x, 128, act="relu")
+        out = layers.fc(h, 10, act="softmax")
+    startup.random_seed = 7
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    exe.run(startup, scope=scope)
+    work = tempfile.mkdtemp(prefix="pt-serve-fleet-bench-")
+    model_dir = os.path.join(work, "model")
+    fluid.io.save_inference_model(model_dir, ["x"], [out], exe, main_p,
+                                  scope)
+    device = _jax.default_backend()
+
+    def run_arm(n, chaos=False):
+        root = os.path.join(work, f"fleet{n}{'.chaos' if chaos else ''}")
+        fleet = ServingFleet({"m": model_dir}, n_replicas=n, root=root,
+                             buckets=buckets, hb_interval_s=hb_interval_s)
+        lat_ms, errs, lock = [], [], threading.Lock()
+        issued = [0]
+        try:
+            fleet.wait_healthy(timeout=180)
+
+            def client(seed):
+                r = np.random.RandomState(seed)
+                while True:
+                    with lock:
+                        if issued[0] >= requests:
+                            return
+                        issued[0] += 1
+                    rows = int(r.randint(1, 5))
+                    feeds = {"x": r.rand(rows, 64).astype("f4")}
+                    t0 = _time.perf_counter()
+                    try:
+                        fleet.infer("m", feeds)
+                        ms = (_time.perf_counter() - t0) * 1e3
+                        with lock:
+                            lat_ms.append(ms)
+                    except ServingError as e:
+                        with lock:
+                            errs.append(e.reason)
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(clients)]
+            with _gc_quiesced():
+                t0 = _time.perf_counter()
+                for t in threads:
+                    t.start()
+                if chaos:
+                    # let ~1/3 of the window elapse, then kill rank 0;
+                    # the supervisor restarts it inside the window
+                    while True:
+                        with lock:
+                            if issued[0] >= requests // 3:
+                                break
+                        _time.sleep(0.005)
+                    with fleet._lock:
+                        fleet._replicas[0]["proc"].send_signal(
+                            signal.SIGKILL)
+                for t in threads:
+                    t.join()
+                wall = _time.perf_counter() - t0
+            ledger = fleet.stats()
+            if chaos:
+                # the arm also prices recovery: the supervisor must
+                # restore full capacity before the fleet shuts down (the
+                # --min-healthy-replicas gate below reads the final
+                # snapshot)
+                fleet.wait_healthy(timeout=180)
+        finally:
+            fleet.stop()
+        arr = np.asarray(lat_ms) if lat_ms else np.asarray([0.0])
+        rec = {"replicas": n, "rps": round(len(lat_ms) / wall, 1),
+               "wall_s": round(wall, 3),
+               "p50_ms": round(float(np.percentile(arr, 50)), 2),
+               "p99_ms": round(float(np.percentile(arr, 99)), 2),
+               "completed": len(lat_ms), "lost": len(errs),
+               "loss_reasons": sorted(set(errs)),
+               "ledger_exact": bool(
+                   ledger["requests"] == ledger["completed"]
+                   + ledger["errors"])}
+        if chaos:
+            # every loss classified, bounded by one replica's in-flight
+            rec["losses_all_classified"] = all(
+                r == "replica_down" for r in errs)
+            rec["loss_bound"] = fleet.router.inflight_cap + 1
+            tools = os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "tools")
+            rec["fleet_check_rc"] = subprocess.call(
+                [sys.executable, os.path.join(tools, "serve_trace.py"),
+                 "--fleet", "--check", root],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            rec["perf_gate_rc"] = subprocess.call(
+                [sys.executable, os.path.join(tools, "perf_report.py"),
+                 "--check", os.path.join(root, "telemetry",
+                                         "router.jsonl"),
+                 "--min-healthy-replicas", str(n),
+                 "--check-roll-convergence"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        return rec
+
+    arms = {n: run_arm(n) for n in replica_counts}
+    chaos = run_arm(2, chaos=True)
+    base2 = arms.get(2, arms[max(arms)])
+    overhead = (round(1.0 - chaos["rps"] / base2["rps"], 4)
+                if base2["rps"] else None)
+    for n, a in sorted(arms.items()):
+        print(f"serve-fleet n={n}: {a['rps']} req/s p50 {a['p50_ms']} ms "
+              f"p99 {a['p99_ms']} ms (lost {a['lost']})", file=sys.stderr)
+    print(f"serve-fleet chaos n=2 (SIGKILL rank0 mid-window): "
+          f"{chaos['rps']} req/s, lost {chaos['lost']} "
+          f"(all classified: {chaos['losses_all_classified']}, "
+          f"bound {chaos['loss_bound']}), rps overhead "
+          f"{overhead if overhead is not None else 'n/a'}; "
+          f"fleet_check rc={chaos['fleet_check_rc']} "
+          f"perf_gate rc={chaos['perf_gate_rc']}", file=sys.stderr)
+    return {"metric": "serve_fleet_rps", "value": base2["rps"],
+            "unit": "req/sec", "device": device,
+            "throughput_claim": ("measured" if device == "tpu"
+                                 else "parity_only_off_device"),
+            "replica_curve": {str(n): a for n, a in sorted(arms.items())},
+            "chaos_arm": chaos, "chaos_rps_overhead_frac": overhead,
+            "scaling_note": (
+                "single-host replicas contend for the same cores, so the "
+                "off-device replica curve prices wire+routing overhead "
+                "and failover correctness, NOT horizontal scaling"
+                if device != "tpu" else "per-chip replicas"),
+            "requests_per_arm": requests, "clients": clients,
+            "buckets": list(buckets)}
+
+
 def bench_chaos(steps=48, batch_size=256, max_inflight=3,
                 fault_spec="bad_batch@5;nan@13;device@21:UNAVAILABLE;"
                            "device@29:RESOURCE_EXHAUSTED"):
@@ -1522,6 +1689,9 @@ def main():
         return
     if "--overlap" in sys.argv:
         print(json.dumps(bench_overlap()))
+        return
+    if "--serve-fleet" in sys.argv:
+        print(json.dumps(bench_serve_fleet()))
         return
     if "--serve" in sys.argv:
         if "--quant" in sys.argv:

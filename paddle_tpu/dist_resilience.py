@@ -58,7 +58,7 @@ from __future__ import annotations
 __all__ = ["HeartbeatConfig", "Heartbeat", "CollectiveWatchdog",
            "init_health", "shutdown_health", "active_watchdog",
            "active_heartbeat", "guard_blocking", "dump_stacks",
-           "local_telemetry",
+           "local_telemetry", "ReplicaBeat", "FleetHealth",
            "EXIT_PEER_FAILURE", "EXIT_COLLECTIVE_TIMEOUT",
            "EXIT_INTEGRITY"]
 
@@ -628,6 +628,184 @@ class Heartbeat:
                               "peer": r, "rank": self.rank})
         _MON.gauge("dist.alive_workers").set(self.world - len(dead))
         return dead
+
+
+# ---- serving-fleet replica liveness (ISSUE 18) ------------------------------
+#
+# The serving fleet (paddle_tpu/serving/fleet.py) reuses the gang
+# heartbeat's FILE transport for replica liveness, but the topology is
+# different: replicas do not watch each other — ONE observer (the
+# supervisor, which also feeds the router) watches N beating replicas.
+# ReplicaBeat is the replica's end (a beat thread whose payload carries
+# serving vitals); FleetHealth is the observe-only end (no beat of its
+# own, same local-clock staleness rule as Heartbeat.observe).
+
+
+class ReplicaBeat:
+    """One daemon thread writing `hb-<rank>` beats whose payload carries
+    a serving replica's vitals — queue depth, inflight, p99, shed count,
+    the draining flag, the serving port, active model versions
+    (`payload_fn` provides the dict).  The router dispatches on this
+    payload; the supervisor's FleetHealth reads liveness from the
+    sequence advancing.  `beat_now()` pushes an out-of-band beat so a
+    state flip (draining on SIGTERM) reaches the router within one
+    health poll instead of one beat interval.  Beats ride the io.py
+    atomic choke point and are exempt from INJECTED storage faults for
+    the same reason gang beats are (timing-dependent stream)."""
+
+    def __init__(self, hb_dir: str, rank: int, world: int,
+                 interval_s: float = 0.5,
+                 payload_fn: Optional[Callable[[], dict]] = None):
+        self.rank = rank
+        self.interval_s = float(interval_s)
+        self.transport = _FileTransport(hb_dir, rank, world)
+        self.payload_fn = payload_fn
+        # seq is advanced by the beat thread AND beat_now callers (signal-
+        # triggered drain thread): lost updates would stall the observed
+        # sequence and fake this replica's death
+        self._lock = locks.named_lock("dist.replica_beat", rank=38)
+        self._seq = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _beat(self):
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        try:
+            payload = self.payload_fn() if self.payload_fn else None
+        except Exception:
+            payload = None
+        try:
+            self.transport.send(seq, payload)
+        except OSError:
+            # same contract as the gang beat loop: loud, never fatal
+            _MON.counter("dist.heartbeat.send_errors").inc()
+            return
+        _MON.counter("dist.heartbeat.sent").inc()
+
+    def start(self) -> "ReplicaBeat":
+        if self._thread is not None:
+            return self
+        self._beat()  # beat 0 lands before model load/warm blocks
+        self._thread = threading.Thread(target=self._loop,
+                                        name="pt-replica-beat", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            self._beat()
+
+    def beat_now(self):
+        """Out-of-band beat carrying the CURRENT payload immediately."""
+        self._beat()
+
+    def stop(self, mark_down: bool = False):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s * 4)
+            self._thread = None
+        if mark_down:
+            self.transport.mark_down()
+        self.transport.close()
+
+
+class FleetHealth:
+    """Observe-only replica liveness for the fleet supervisor/router.
+
+    Polls every replica's `hb-<rank>` file and classifies each rank:
+
+        "booting"   never observed, within `startup_grace_s` (replicas
+                    pay imports + model load + bucket warm before beat 0
+                    in degenerate cases; absence at t=0 is not death)
+        "alive"     sequence advanced within `deadline_s` of LOCAL
+                    monotonic time (never the writer's clock)
+        "draining"  alive AND its payload carries draining=True — the
+                    router must stop dispatching to it, but its
+                    in-flight requests are still being served out
+        "dead"      stale past deadline_s, never seen past the grace, or
+                    an explicit DOWN tombstone
+
+    `poll()` returns the full table; `alive()` / `dispatchable()` are
+    the supervisor's and router's views of it."""
+
+    def __init__(self, hb_dir: str, world: int, interval_s: float = 0.5,
+                 miss_factor: float = 5.0, startup_grace_s: float = 60.0):
+        self.world = world
+        self.deadline_s = float(interval_s) * float(miss_factor)
+        self.startup_grace_s = float(startup_grace_s)
+        # rank=-1: a pure observer is nobody's peer, so poll() reads
+        # every replica's file and send() is simply never called
+        self.transport = _FileTransport(hb_dir, -1, world)
+        self._start_mono = time.monotonic()
+        # rank -> (last seq, monotonic time the seq last ADVANCED, tel)
+        self._observed: Dict[int, tuple] = {}
+        self._lock = locks.named_lock("dist.fleet_health", rank=39)
+
+    def note_restart(self, rank: int):
+        """Forget a rank's observation history (its incarnation was just
+        relaunched by the supervisor): the fresh process gets the full
+        startup grace again instead of inheriting the corpse's staleness,
+        and a DOWN tombstone left by a draining predecessor is cleared.
+        The corpse's hb file goes too — its sequence is higher than the
+        fresh incarnation's first beats, which would otherwise never
+        register as advances."""
+        for stale in (f"DOWN-{rank}", f"hb-{rank}"):
+            try:
+                os.remove(os.path.join(self.transport.root, stale))
+            except OSError:
+                pass
+        with self._lock:
+            self._observed.pop(rank, None)
+            self._restart_at = dict(getattr(self, "_restart_at", {}))
+            self._restart_at[rank] = time.monotonic()
+
+    def poll(self) -> Dict[int, dict]:
+        polled = self.transport.poll()
+        now = time.monotonic()
+        out = {}
+        with self._lock:
+            restarts = getattr(self, "_restart_at", {})
+            for r, (seq, tel) in polled.items():
+                prev = self._observed.get(r)
+                if seq == -1:
+                    self._observed[r] = (-1, now, None)
+                elif prev is None or seq > prev[0]:
+                    self._observed[r] = (seq, now, tel if isinstance(tel, dict)
+                                         else (prev[2] if prev else None))
+            for r in range(self.world):
+                obs = self._observed.get(r)
+                born = restarts.get(r, self._start_mono)
+                if obs is None:
+                    grace = now - born <= self.startup_grace_s
+                    out[r] = {"rank": r, "seq": None, "age_s": None,
+                              "status": "booting" if grace else "dead",
+                              "tel": None}
+                    continue
+                seq, at, tel = obs
+                age = now - at
+                if seq == -1:
+                    status = "dead"
+                elif age > self.deadline_s:
+                    status = "dead"
+                elif isinstance(tel, dict) and tel.get("draining"):
+                    status = "draining"
+                else:
+                    status = "alive"
+                out[r] = {"rank": r, "seq": seq, "age_s": round(age, 3),
+                          "status": status, "tel": tel}
+        return out
+
+    def alive(self) -> List[int]:
+        """Ranks serving OR draining (their process is live)."""
+        return [r for r, info in self.poll().items()
+                if info["status"] in ("alive", "draining")]
+
+    def dispatchable(self) -> List[int]:
+        """Ranks the router may send NEW traffic to."""
+        return [r for r, info in self.poll().items()
+                if info["status"] == "alive"]
 
 
 def dump_stacks(reason: str, file=None) -> str:

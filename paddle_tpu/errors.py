@@ -359,6 +359,20 @@ class ServingError(TrainingError):
         reason="model_missing"     no model under that name (never loaded,
                                    unloaded, or evicted)
         reason="shutdown"          the server is draining/stopped
+        reason="replica_down"      fleet routing (serving/router.py): the
+                                   replica carrying this in-flight request
+                                   died mid-request, or — for NEW traffic —
+                                   no healthy replica remains to dispatch
+                                   to.  New traffic only sees this when the
+                                   whole fleet is down; a single replica
+                                   death costs exactly its own in-flight
+                                   requests and redistributes the rest
+                                   within one heartbeat miss window
+        reason="roll_halted"       a fleet rolling publish halted (a verify
+                                   rung failed on some replica, or a
+                                   replica lost mid-roll could not be
+                                   recovered) and the fleet was converged
+                                   back onto the last good version
 
     Never retried blindly: "overload"/"timeout" are backpressure the
     CLIENT routes on (retry elsewhere, degrade, drop); the rest are

@@ -65,7 +65,7 @@ with straggler attribution.  See docs/observability.md §Debugging a gang.
 from __future__ import annotations
 
 __all__ = ["allocate_port_block", "worker_env", "Gang", "GangResult",
-           "run_gang", "main"]
+           "run_gang", "run_serving_fleet", "main"]
 
 import argparse
 import errno
@@ -592,7 +592,97 @@ def run_gang(argv: Sequence[str], n_procs: int, *,
     return result
 
 
+def run_serving_fleet(models: Dict[str, str], n_replicas: int = 2,
+                      root: Optional[str] = None,
+                      until=None, poll_s: float = 0.5, **fleet_kw) -> dict:
+    """Serving-mode supervision (ISSUE 18): run a `ServingFleet` of
+    `n_replicas` replica servers until SIGTERM/SIGINT (or the optional
+    `until()` predicate turns true), then DRAIN — each replica gets
+    SIGTERM, flips its beat to draining so the router stops dispatching,
+    serves out its in-flight requests and exits 0.  An interrupted
+    rolling publish found persisted in the fleet root is resumed (or
+    converged back) before traffic supervision begins — the serving
+    analogue of run_gang's restart-from-checkpoint recovery.
+
+    Returns the final router ledger (`Router.stats()`)."""
+    import signal as _signal
+    import threading as _threading
+
+    from .serving.fleet import ServingFleet
+
+    stop = _threading.Event()
+    prev = {}
+
+    def _handler(sig, _frm):
+        stop.set()
+
+    for sig in (_signal.SIGTERM, _signal.SIGINT):
+        try:
+            prev[sig] = _signal.signal(sig, _handler)
+        except ValueError:
+            pass  # not the main thread: caller owns signal wiring
+    fleet = ServingFleet(models, n_replicas=n_replicas, root=root,
+                         **fleet_kw)
+    try:
+        fleet.resume_roll()
+        fleet.wait_healthy(min_replicas=1)
+        while not stop.wait(poll_s):
+            if until is not None and until():
+                break
+    finally:
+        fleet.stop()
+        for sig, h in prev.items():
+            try:
+                _signal.signal(sig, h)
+            except ValueError:
+                pass
+    return fleet.stats()
+
+
+def _serve_main(argv: List[str]) -> int:
+    """`python -m paddle_tpu.launch --serve` — fleet CLI."""
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.launch --serve",
+        description="Run a supervised serving fleet (replica servers + "
+                    "health-aware router + rolling publish) until "
+                    "SIGTERM, then drain.")
+    ap.add_argument("--serve", action="store_true", help=argparse.SUPPRESS)
+    ap.add_argument("--model", action="append", default=[],
+                    metavar="NAME=DIR", required=True,
+                    help="model to serve (repeatable)")
+    ap.add_argument("--nproc", type=int, default=2,
+                    help="replica processes in the fleet")
+    ap.add_argument("--fleet-root", default=None,
+                    help="fleet state root (hb/, telemetry/, ACTIVE.json, "
+                         "ROLL.json; default: a temp dir)")
+    ap.add_argument("--buckets", default="1,4,8")
+    ap.add_argument("--max-restarts", type=int, default=3,
+                    help="per-replica restart budget")
+    ap.add_argument("--hb-interval", type=float, default=0.5)
+    ns = ap.parse_args(argv)
+
+    models = {}
+    for spec in ns.model:
+        name, sep, path = spec.partition("=")
+        if not sep or not name or not path:
+            ap.error(f"--model wants NAME=DIR, got {spec!r}")
+        models[name] = path
+    from .serving.batcher import parse_buckets
+
+    ledger = run_serving_fleet(
+        models, n_replicas=ns.nproc, root=ns.fleet_root,
+        buckets=parse_buckets(ns.buckets),
+        max_restarts=ns.max_restarts, hb_interval_s=ns.hb_interval)
+    print(f"paddle_tpu.launch --serve: drained; "
+          f"{ledger['completed']}/{ledger['requests']} completed, "
+          f"{ledger['errors']} classified errors", file=sys.stderr)
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if "--serve" in args:
+        return _serve_main(args)
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.launch",
         description=__doc__,
@@ -626,7 +716,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "--max-gang-restarts input)")
     ap.add_argument("script", help="worker script")
     ap.add_argument("args", nargs=argparse.REMAINDER)
-    ns = ap.parse_args(argv)
+    ns = ap.parse_args(args)
 
     logger = None
     if ns.metrics:

@@ -28,6 +28,8 @@ See docs/observability.md.
 """
 from __future__ import annotations
 
+import time
+
 from .core import (Counter, EXEMPLAR_CAP, FLIGHT_RECORDER_CAP,  # noqa: F401
                    Gauge, Monitor, NULL_SPAN, Span, TRACE_RING_CAP)
 from . import exporters as _exp
@@ -40,7 +42,8 @@ __all__ = [
     "FLIGHT_RECORDER_CAP", "TRACE_RING_CAP", "EXEMPLAR_CAP", "MONITOR",
     "get_monitor", "enable", "disable",
     "is_enabled", "reset", "span", "observe", "counter", "gauge",
-    "record_step", "step_records", "record_trace", "request_traces",
+    "record_step", "step_records", "record_trace", "record_fleet_event",
+    "request_traces",
     "record_exemplar", "exemplars", "set_lane", "attach_logger",
     "detach_logger", "export_prometheus", "export_json", "json_snapshot",
     "export_chrome_trace", "merge_chrome_traces", "summary",
@@ -101,6 +104,19 @@ def record_trace(record: dict):
     """Append a closed per-request span tree (serving/tracing.py) to the
     bounded trace ring + the step/JSONL streams (ISSUE 16)."""
     return MONITOR.record_trace(record)
+
+
+def record_fleet_event(action: str, **fields):
+    """One serving-fleet lifecycle transition (replica_dead /
+    replica_restarted / roll_started / roll_halted / roll_converged /
+    ...) as a `kind="fleet_event"` step record plus a per-action
+    counter — the stream `serve_trace --fleet` renders as roll episodes
+    and `perf_report --check` gates for roll convergence (ISSUE 18)."""
+    rec = {"kind": "fleet_event", "action": action, "ts": time.time(),
+           **fields}
+    MONITOR.counter(f"serving.fleet.events[{action}]").inc()
+    MONITOR.record_step(rec)
+    return rec
 
 
 def request_traces():

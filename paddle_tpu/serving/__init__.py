@@ -25,13 +25,26 @@ enabled every request additionally carries a flight trace
 (`serving_trace` records; tracing.py) inspectable live with
 `tools/serve_trace.py`, plus SLO burn-rate and pad/queue attribution
 gauges (ISSUE 16).  See docs/serving.md and docs/observability.md.
+
+Fleet mode (ISSUE 18): `ServingFleet` supervises N replica Server
+processes behind a health-aware `Router` (heartbeat membership,
+least-inflight dispatch, exactly-once `replica_down` accounting) with
+zero-downtime `rolling_publish` — verify everywhere via
+`publish(stage_only=True)`, activate only after all acks, halt and
+converge back on the last good version when a replica rejects or the
+store faults mid-roll (reason codes replica_down / roll_halted; CLI
+`python -m paddle_tpu.launch --serve`; merged fleet view
+`tools/serve_trace.py --fleet`).
 """
 from __future__ import annotations
 
 from .batcher import (DEFAULT_BUCKETS, bucket_for, build_batch,  # noqa: F401
                       coalesce, concat_feeds, pad_feeds, parse_buckets,
                       split_rows, validate_feeds)
-from .publisher import publish, rollback, verify_snapshot_dir  # noqa: F401
+from .fleet import ServingFleet  # noqa: F401
+from .publisher import (QUARANTINE_MARKER, publish,  # noqa: F401
+                        quarantine_marker, rollback, verify_snapshot_dir)
+from .router import Router  # noqa: F401
 from .registry import (ModelRegistry, ModelVersion,  # noqa: F401
                        manifest_weight_bytes, model_precision,
                        plan_model_bytes, quant_manifest, synthetic_feeds)
@@ -47,7 +60,9 @@ __all__ = [
     "manifest_weight_bytes", "plan_model_bytes",
     "quant_manifest", "model_precision",
     "publish", "rollback", "verify_snapshot_dir",
+    "QUARANTINE_MARKER", "quarantine_marker",
     "Server", "Future",
+    "ServingFleet", "Router",
     "RequestTrace", "NULL_TRACE", "maybe_trace", "control_trace_id",
     "TRACE_PHASES",
 ]

@@ -59,6 +59,7 @@ publish attempt of the same source starts clean.
 """
 from __future__ import annotations
 
+import json
 import os
 import time
 from typing import Dict, Optional, Sequence
@@ -78,7 +79,17 @@ from . import tracing as _tr
 from .registry import (ModelRegistry, ModelVersion, quant_manifest,
                        synthetic_feeds)
 
-__all__ = ["publish", "rollback", "verify_snapshot_dir"]
+__all__ = ["publish", "rollback", "verify_snapshot_dir",
+           "QUARANTINE_MARKER", "quarantine_marker"]
+
+# Persisted quarantine (ISSUE 18): a content rejection also drops a
+# marker file NEXT TO the snapshot (shared model store), so every OTHER
+# replica of a serving fleet fast-rejects the same version without
+# re-paying the stage/compile/smoke ladder N times — and without any
+# channel beyond the store itself.  Written through the io.py atomic
+# choke point; best-effort (a read-only store cannot take the marker,
+# and the in-memory set still protects this process).
+QUARANTINE_MARKER = "__quarantined__.json"
 
 # transient-store-I/O retry budget per publish() call (the ladder is
 # idempotent up to the swap, so re-running it whole is safe and keeps
@@ -123,9 +134,43 @@ def _fail_publish_io(name: str, src: str, cause, attempts: int,
         reason="publish_io", model=name, trace_id=trace_id) from cause
 
 
+def quarantine_marker(src: str) -> Optional[dict]:
+    """The persisted quarantine verdict next to snapshot `src`, or None.
+    Tolerates a torn/garbage marker (it still quarantines — the verdict
+    is the file's existence; the payload is advisory detail)."""
+    path = os.path.join(src, QUARANTINE_MARKER)
+    if not os.path.exists(path):
+        return None
+    try:
+        doc = _io.read_json(path)
+        return doc if isinstance(doc, dict) else {}
+    except Exception:
+        return {}
+
+
+def _write_quarantine_marker(src: str, name: str, detail: str, trace_id):
+    """Best-effort persisted verdict (see QUARANTINE_MARKER).  Exempt
+    from INJECTED io faults: the marker is the fleet-wide record OF a
+    content rejection — a chaos spec aimed at the snapshot's data path
+    must not eat the verdict it just provoked.  Real OSErrors (read-only
+    store, full disk) are counted, not fatal: the in-memory set still
+    protects this process."""
+    doc = {"model": name, "detail": detail, "trace_id": trace_id,
+           "ts": time.time(), "pid": os.getpid(),
+           "rank": os.environ.get("PADDLE_TRAINER_ID")}
+    try:
+        with _io.fault_exempt(src):
+            _io.atomic_write(os.path.join(src, QUARANTINE_MARKER),
+                             json.dumps(doc, default=str))
+    except OSError:
+        _MON.counter("serving.quarantine_marker_errors").inc()
+
+
 def _reject(registry: ModelRegistry, name: str, src: str, trace_id,
-            detail: str):
+            detail: str, marker: bool = True):
     registry.quarantined.add(os.path.realpath(src))
+    if marker and os.path.isdir(src):
+        _write_quarantine_marker(src, name, detail, trace_id)
     _MON.counter("serving.publish_rejected").inc()
     _MON.record_step({"kind": "serving_event", "action": "publish_rejected",
                       "model": name, "src": src, "detail": detail,
@@ -194,17 +239,27 @@ def publish(registry: ModelRegistry, name: str, src,
             golden_feeds: Optional[Dict[str, np.ndarray]] = None,
             golden_expect: Optional[Sequence[np.ndarray]] = None,
             golden_rtol: float = 1e-4, golden_atol: float = 1e-5,
-            warm_buckets: Optional[Sequence[int]] = None) -> ModelVersion:
+            warm_buckets: Optional[Sequence[int]] = None,
+            stage_only: bool = False) -> ModelVersion:
     """Verify `src` and atomically swap it in as model `name`'s served
     version (old version retained for rollback()).  See the module
     docstring for the verification ladder; every failure raises a
     classified ServingError(reason="publish_rejected") with the old
-    version still serving."""
+    version still serving.
+
+    `stage_only=True` runs the ENTIRE ladder (verification rungs AND the
+    pre-swap bucket warm) but holds the verified version in the
+    registry's staged slot instead of swapping — phase one of the
+    fleet's two-phase rolling publish (serving/fleet.py); activate with
+    `registry.activate_staged(name)` once every replica has acked."""
     if isinstance(src, CheckpointManager):
         latest = src.latest()
         if latest is None:
+            # no marker: "nothing committed YET" is a verdict about the
+            # manager's state, not about any snapshot's content
             _reject(registry, name, src.root,
-                    "CheckpointManager has no committed checkpoint")
+                    "CheckpointManager has no committed checkpoint",
+                    marker=False)
         src = latest
     src = str(src)
     # One publish ladder at a time per model: a concurrent publish into
@@ -230,7 +285,8 @@ def publish(registry: ModelRegistry, name: str, src,
             try:
                 return _publish_ladder(registry, name, src, golden_feeds,
                                        golden_expect, golden_rtol,
-                                       golden_atol, warm_buckets, ctl)
+                                       golden_atol, warm_buckets, ctl,
+                                       stage_only=stage_only)
             except _RetryableStoreIO as e:
                 cause = e.__cause__
                 attempt += 1
@@ -252,7 +308,8 @@ def publish(registry: ModelRegistry, name: str, src,
 
 
 def _publish_ladder(registry, name, src, golden_feeds, golden_expect,
-                    golden_rtol, golden_atol, warm_buckets, ctl=None):
+                    golden_rtol, golden_atol, warm_buckets, ctl=None,
+                    stage_only=False):
     with _MON.span("serving.publish", model=name, trace_id=ctl):
         # publish reloads an EXISTING model (use registry.load for new
         # names); a missing target is the caller's error, not the
@@ -262,6 +319,18 @@ def _publish_ladder(registry, name, src, golden_feeds, golden_expect,
             _reject(registry, name, src, ctl,
                     "source already quarantined by an earlier rejected "
                     "publish")
+        # fleet-wide fast-reject (ISSUE 18): a marker persisted next to
+        # the snapshot by ANY replica's rejection spares this one the
+        # whole stage/compile/smoke ladder
+        mk = quarantine_marker(src)
+        if mk is not None:
+            registry.quarantined.add(os.path.realpath(src))
+            who = mk.get("rank")
+            _reject(registry, name, src, ctl,
+                    f"source carries a persisted quarantine marker"
+                    f"{f' (rejected by replica {who})' if who is not None else ''}"
+                    f": {mk.get('detail', 'no detail recorded')}",
+                    marker=False)
         try:
             kind = verify_snapshot_dir(src)
         except ValueError as e:
@@ -396,6 +465,16 @@ def _publish_ladder(registry, name, src, golden_feeds, golden_expect,
             _reject(registry, name, src, ctl,
                     f"pre-swap bucket warm failed "
                     f"({type(e).__name__}: {e})")
+        if stage_only:
+            # two-phase fleet roll: the version is verified and warm but
+            # traffic stays on the old one until activate_staged
+            registry.stage_version(name, version)
+            _MON.record_step({"kind": "serving_event",
+                              "action": "publish_staged", "model": name,
+                              "src": src, "version": version.version,
+                              "precision": version.precision,
+                              "trace_id": ctl})
+            return version
         prev = registry.publish_version(name, version)
         _MON.counter("serving.reloads").inc()
         _MON.record_step({"kind": "serving_event", "action": "publish",

@@ -303,6 +303,10 @@ class ModelRegistry:
         # lock-free
         self._publishing: set = set()
         self._publish_cv = locks.named_condition("serving.publish", rank=10)
+        # verified-but-not-yet-active versions (two-phase fleet rolling
+        # publish): {name: ModelVersion} held between the verify ladder
+        # and the fleet-wide activate ack (serving/fleet.py)
+        self._staged: Dict[str, ModelVersion] = {}
         # publish-rejected source dirs: repeated publishes of a snapshot
         # that already failed verification reject fast (publisher.py)
         self.quarantined: set = set()
@@ -504,6 +508,54 @@ class ModelRegistry:
             if len(m.versions) > self.keep_versions:
                 m.versions = m.versions[-self.keep_versions:]
             return prev
+
+    # -- two-phase staged swap (fleet rolling publish, serving/fleet.py) ---
+    def stage_version(self, name: str, version: ModelVersion) -> ModelVersion:
+        """Hold a fully verified/warmed version WITHOUT swapping it in —
+        phase one of the fleet's two-phase rolling publish: every replica
+        verifies and warms the staged snapshot while the old version keeps
+        serving, and nothing touches traffic until the fleet-wide
+        `activate_staged` phase.  One staged slot per model; re-staging
+        replaces the held version."""
+        with self._lock:
+            if name not in self._models:
+                raise ServingError(f"no model {name!r} to stage into",
+                                   reason="model_missing", model=name)
+            self._staged[name] = version
+        self._event("stage", model=name, version=version.version,
+                    src=version.src)
+        return version
+
+    def staged(self, name: str) -> Optional[ModelVersion]:
+        with self._lock:
+            return self._staged.get(name)
+
+    def activate_staged(self, name: str) -> ModelVersion:
+        """Atomically swap the held staged version in as the served one
+        (phase two).  The previous active is retained for rollback()."""
+        with self._lock:
+            version = self._staged.pop(name, None)
+        if version is None:
+            raise ServingError(
+                f"model {name!r} has no staged version to activate — "
+                f"stage_version/publish(stage_only=True) first",
+                reason="model_missing", model=name)
+        prev = self.publish_version(name, version)
+        _MON.counter("serving.reloads").inc()
+        self._event("activate_staged", model=name, version=version.version,
+                    prev_version=prev.version, src=version.src)
+        return version
+
+    def discard_staged(self, name: str) -> bool:
+        """Drop a held staged version without ever serving it (a halted
+        fleet roll converging back on the last good version).  Returns
+        whether anything was held."""
+        with self._lock:
+            version = self._staged.pop(name, None)
+        if version is not None:
+            self._event("discard_staged", model=name,
+                        version=version.version, src=version.src)
+        return version is not None
 
     def rollback(self, name: str) -> ModelVersion:
         """Re-activate the retained previous version (instant: it is

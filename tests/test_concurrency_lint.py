@@ -538,7 +538,7 @@ def test_publish_ladders_serialize_per_model(monkeypatch):
     events = []
     ev_lock = threading.Lock()
 
-    def fake_ladder(registry, name, src, *a):
+    def fake_ladder(registry, name, src, *a, **kw):
         with ev_lock:
             events.append(("start", name))
         time.sleep(0.05)

@@ -1,0 +1,580 @@
+"""Serving-fleet chaos suite (ISSUE 18 acceptance): real multi-process
+replica fleets under kill / store-fault / drain chaos.
+
+The properties under test:
+
+  1. a replica SIGKILLed under load loses ONLY its own in-flight
+     requests (classified `reason="replica_down"`), new traffic
+     redistributes to the survivor, the supervisor restarts the corpse,
+     and the router's ledger reconciles exactly
+     (requests == completed + classified errors);
+  2. a store fault mid-rolling-publish — rotted content (NaN weights)
+     or persistent EIO — HALTS the roll on the failing rung, the fleet
+     converges back on the last good version on every replica (zero
+     requests ever served by the bad version), and the halt/convergence
+     is visible in `serve_trace --fleet --check` and gated by
+     `perf_report --check --check-roll-convergence`;
+  3. one replica's rejection persists a quarantine marker next to the
+     snapshot, so the next roll over the same source fast-rejects
+     fleet-wide without re-running the ladder;
+  4. SIGTERM drains: the beat flips to draining, the router stops
+     dispatching to that replica, in-flight requests serve out, and
+     NOTHING is shed by the shutdown (exit 0 = retired, not restarted);
+  5. a roll interrupted supervisor-side resumes from the persisted
+     ROLL.json state (`resume_roll`).
+
+In-process units ride along: ReplicaBeat/FleetHealth status machine,
+router dispatch policy (inflight caps, suspicion, classified
+no-replica refusals), registry staging API, and the fleet gates of
+perf_report / serve_trace over crafted streams.
+"""
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu import monitor
+from paddle_tpu.errors import ServingError
+from paddle_tpu.serving import ServingFleet
+
+from test_serving import D_IN, _expected, _save_model
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(HERE), "tools"))
+import perf_report  # noqa: E402
+import serve_trace  # noqa: E402
+
+FLEET_KW = dict(buckets=(2, 4), hb_interval_s=0.15, miss_factor=4.0)
+
+
+@pytest.fixture
+def mon():
+    monitor.reset()
+    monitor.enable()
+    yield monitor
+    monitor.disable()
+    monitor.reset()
+
+
+def _router_events(fleet, action=None):
+    path = os.path.join(fleet.root, "telemetry", "router.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        recs = [json.loads(ln) for ln in f if ln.strip()]
+    evs = [r for r in recs if r.get("kind") == "fleet_event"]
+    return [e for e in evs if e.get("action") == action] if action else evs
+
+
+def _wait_event(fleet, action, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        hits = _router_events(fleet, action)
+        if hits:
+            return hits
+        time.sleep(0.1)
+    raise AssertionError(
+        f"no {action!r} fleet_event within {timeout}s; have "
+        f"{[e['action'] for e in _router_events(fleet)]}")
+
+
+# --------------------------------------------------------------------------
+# in-process units
+# --------------------------------------------------------------------------
+
+def test_replica_beat_and_fleet_health_status_machine(tmp_path):
+    from paddle_tpu.dist_resilience import FleetHealth, ReplicaBeat
+
+    hb = str(tmp_path / "hb")
+    os.makedirs(hb)
+    payload = {"q": 1, "draining": False, "port": 7}
+    beat = ReplicaBeat(hb, 0, 2, interval_s=0.05,
+                       payload_fn=lambda: dict(payload)).start()
+    health = FleetHealth(hb, 2, interval_s=0.05, miss_factor=4.0,
+                         startup_grace_s=0.4)
+    try:
+        time.sleep(0.15)
+        table = health.poll()
+        assert table[0]["status"] == "alive"
+        assert table[0]["tel"]["port"] == 7
+        assert table[1]["status"] == "booting"  # absent, within grace
+        assert health.dispatchable() == [0]
+        payload["draining"] = True
+        beat.beat_now()
+        time.sleep(0.1)
+        assert health.poll()[0]["status"] == "draining"
+        assert health.dispatchable() == []
+        assert health.alive() == [0]  # process still live while draining
+    finally:
+        beat.stop(mark_down=True)
+    # tombstone is immediate death; grace expiry kills the never-seen
+    time.sleep(0.45)
+    table = health.poll()
+    assert table[0]["status"] == "dead" and table[1]["status"] == "dead"
+    # restart clears the corpse's files: fresh grace, fresh seq space
+    health.note_restart(0)
+    assert health.poll()[0]["status"] == "booting"
+    beat2 = ReplicaBeat(hb, 0, 2, interval_s=0.05,
+                        payload_fn=lambda: dict(payload)).start()
+    try:
+        time.sleep(0.15)
+        assert health.poll()[0]["status"] == "draining"
+    finally:
+        beat2.stop()
+
+
+class _FakeHealth:
+    def __init__(self, table):
+        self.table = table
+        self.world = len(table)
+
+    def poll(self):
+        return {r: dict(info) for r, info in self.table.items()}
+
+
+def test_router_dispatch_policy_classified(mon):
+    from paddle_tpu.serving.router import Router
+
+    alive = {"status": "alive", "seq": 5, "age_s": 0.0,
+             "tel": {"port": 1, "q": 0, "p99": 1.0}}
+    # no live replica at all -> replica_down
+    r = Router(_FakeHealth({0: {**alive, "status": "dead", "tel": None}}))
+    with pytest.raises(ServingError) as ei:
+        r.infer("m", {"x": np.ones((1, D_IN), "f4")})
+    assert ei.value.reason == "replica_down"
+    # draining replicas take no new traffic either
+    r = Router(_FakeHealth({0: {**alive, "status": "draining"}}))
+    with pytest.raises(ServingError) as ei:
+        r.infer("m", {"x": np.ones((1, D_IN), "f4")})
+    assert ei.value.reason == "replica_down"
+    # every candidate at its inflight cap -> overload (backpressure)
+    r = Router(_FakeHealth({0: dict(alive)}), inflight_cap=1)
+    with r._lock:
+        r._inflight[0] = 1
+    with pytest.raises(ServingError) as ei:
+        r.infer("m", {"x": np.ones((1, D_IN), "f4")})
+    assert ei.value.reason == "overload"
+    # a suspect is skipped until its beat seq advances past suspicion
+    r = Router(_FakeHealth({0: dict(alive)}))
+    r._mark_suspect(0, 5)
+    with pytest.raises(ServingError) as ei:
+        r.infer("m", {"x": np.ones((1, D_IN), "f4")})
+    assert ei.value.reason == "replica_down"
+    r.health.table[0]["seq"] = 6  # beat advanced: forgiven
+    pick = r._pick(r.health.poll())
+    assert pick["rank"] == 0
+    # ledger counted every classified refusal
+    s = r.stats()
+    assert s["by_reason"]["replica_down"] >= 1
+    assert s["requests"] == s["completed"] + s["errors"]
+
+
+def test_router_least_loaded_pick():
+    from paddle_tpu.serving.router import Router
+
+    def info(port, q, p99):
+        return {"status": "alive", "seq": 3, "age_s": 0.0,
+                "tel": {"port": port, "q": q, "p99": p99}}
+
+    r = Router(_FakeHealth({0: info(1, 5, 9.0), 1: info(2, 0, 1.0)}))
+    assert r._pick(r.health.poll())["rank"] == 1  # shallower queue wins
+    # router-side inflight outranks the (stale-able) beat telemetry
+    with r._lock:
+        r._inflight[1] = 3
+    assert r._pick(r.health.poll())["rank"] == 0
+
+
+def test_registry_staging_api(tmp_path, mon):
+    import paddle_tpu as fluid
+    from paddle_tpu.serving import ModelRegistry, publish
+
+    v1 = _save_model(str(tmp_path / "v1"), 1.0)
+    v2 = _save_model(str(tmp_path / "v2"), 2.0)
+    reg = ModelRegistry(place=fluid.CPUPlace())
+    reg.load("m", v1)
+    xv = np.ones((2, D_IN), "f4")
+    with pytest.raises(ServingError) as ei:
+        reg.activate_staged("m")  # nothing staged
+    assert ei.value.reason == "model_missing"
+    # stage_only runs the FULL ladder but keeps the old version serving
+    ver = publish(reg, "m", v2, stage_only=True, warm_buckets=(2,))
+    assert reg.staged("m") is ver
+    assert reg.models()["m"]["src"] == v1
+    reg.activate_staged("m")
+    assert reg.models()["m"]["src"] == v2
+    assert reg.staged("m") is None
+    # discard: never served, old version untouched
+    publish(reg, "m", v1, stage_only=True, warm_buckets=(2,))
+    assert reg.discard_staged("m") is True
+    assert reg.discard_staged("m") is False
+    assert reg.models()["m"]["src"] == v2
+
+
+def test_quarantine_marker_persists_fleet_wide(tmp_path, mon):
+    """Satellite: one replica's rejection fast-rejects everywhere.  A
+    FRESH registry (a different replica process in fleet terms) must
+    refuse the marked snapshot without re-running the ladder."""
+    import paddle_tpu as fluid
+    from paddle_tpu.serving import (ModelRegistry, QUARANTINE_MARKER,
+                                    publish, quarantine_marker)
+
+    v1 = _save_model(str(tmp_path / "v1"), 1.0)
+    bad = _save_model(str(tmp_path / "bad"), 2.0, poison_nan=True)
+    reg_a = ModelRegistry(place=fluid.CPUPlace())
+    reg_a.load("m", v1)
+    with pytest.raises(ServingError) as ei:
+        publish(reg_a, "m", bad, warm_buckets=(2,))
+    assert ei.value.reason == "publish_rejected"
+    mk = quarantine_marker(bad)
+    assert mk is not None and mk["model"] == "m" and mk["detail"]
+    assert os.path.exists(os.path.join(bad, QUARANTINE_MARKER))
+    # fresh process (registry B): fast-reject on the persisted marker —
+    # the marker message (not the NaN detail a re-run ladder would
+    # produce) proves the stage/compile/smoke rungs were skipped
+    reg_b = ModelRegistry(place=fluid.CPUPlace())
+    reg_b.load("m", v1)
+    with pytest.raises(ServingError) as ei:
+        publish(reg_b, "m", bad, warm_buckets=(2,))
+    assert ei.value.reason == "publish_rejected"
+    assert "persisted quarantine marker" in str(ei.value)
+    assert reg_b.models()["m"]["src"] == v1
+
+
+def test_perf_report_fleet_gates(tmp_path):
+    """--min-healthy-replicas and --check-roll-convergence over crafted
+    streams: healthy passes, sick fails, counters-only OK, zero-evidence
+    fails."""
+    def write(name, recs):
+        p = str(tmp_path / name)
+        with open(p, "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        return p
+
+    snap = {"kind": "snapshot", "ts": 1.0,
+            "counters": {"serving.fleet.requests": 10,
+                         "serving.fleet.completed": 10,
+                         "serving.fleet.errors": 0},
+            "gauges": {"serving.fleet.healthy_replicas": 2.0,
+                       "serving.fleet.size": 2.0}}
+    ok = write("ok.jsonl", [snap])
+    assert perf_report.check(ok, min_healthy_replicas=2,
+                             check_roll_convergence=True) == 0
+    assert perf_report.check(ok, min_healthy_replicas=3) == 1
+    # roll halted with no convergence -> fail; with rolled_back -> pass
+    halted = write("halted.jsonl", [
+        snap,
+        {"kind": "fleet_event", "action": "roll_started", "ctl": "roll-1"},
+        {"kind": "fleet_event", "action": "roll_halted", "ctl": "roll-1"},
+    ])
+    assert perf_report.check(halted, check_roll_convergence=True) == 1
+    converged = write("converged.jsonl", [
+        snap,
+        {"kind": "fleet_event", "action": "roll_started", "ctl": "roll-1"},
+        {"kind": "fleet_event", "action": "roll_halted", "ctl": "roll-1"},
+        {"kind": "fleet_event", "action": "roll_rolled_back",
+         "ctl": "roll-1"},
+    ])
+    assert perf_report.check(converged, check_roll_convergence=True) == 0
+    # counters-only file (no fleet_event records): the events[*] balance
+    counters_ok = write("counters_ok.jsonl", [{
+        "kind": "snapshot", "ts": 1.0,
+        "counters": {"serving.fleet.events[roll_halted]": 1,
+                     "serving.fleet.events[roll_rolled_back]": 1},
+        "gauges": {}}])
+    assert perf_report.check(counters_ok, check_roll_convergence=True) == 0
+    counters_bad = write("counters_bad.jsonl", [{
+        "kind": "snapshot", "ts": 1.0,
+        "counters": {"serving.fleet.events[roll_halted]": 2,
+                     "serving.fleet.events[roll_rolled_back]": 1},
+        "gauges": {}}])
+    assert perf_report.check(counters_bad, check_roll_convergence=True) == 1
+    # zero evidence must not gate green
+    empty = write("empty.jsonl", [])
+    assert perf_report.check(empty, min_healthy_replicas=1) == 1
+    assert perf_report.check(empty, check_roll_convergence=True) == 1
+
+
+def test_serve_trace_fleet_check_crafted(tmp_path):
+    """Fleet reconciliation over crafted dirs: a router ledger that the
+    replica ledgers contradict fails; an empty dir fails."""
+    def fleet_dir(name, router_recs, replica_counters):
+        root = tmp_path / name / "telemetry"
+        os.makedirs(root / "i1")
+        with open(root / "router.jsonl", "w") as f:
+            for r in router_recs:
+                f.write(json.dumps(r) + "\n")
+        for rank, counters in replica_counters.items():
+            with open(root / "i1" / f"metrics.p{rank}.jsonl", "w") as f:
+                f.write(json.dumps({"kind": "snapshot",
+                                    "counters": counters,
+                                    "gauges": {}}) + "\n")
+        return str(tmp_path / name)
+
+    rsnap = {"kind": "snapshot",
+             "counters": {"serving.fleet.requests": 4,
+                          "serving.fleet.completed": 4,
+                          "serving.fleet.errors": 0}, "gauges": {}}
+    good = fleet_dir("good", [rsnap],
+                     {0: {"serving.completed": 2},
+                      1: {"serving.completed": 2}})
+    assert serve_trace.fleet_check(good) == 0
+    # replicas claim MORE completions than the router saw, with no
+    # replica_down losses to explain them -> overcount, fail
+    over = fleet_dir("over", [rsnap],
+                     {0: {"serving.completed": 9},
+                      1: {"serving.completed": 2}})
+    assert serve_trace.fleet_check(over) == 1
+    # replicas claim fewer with NO death on record -> undercount, fail
+    under = fleet_dir("under", [rsnap],
+                      {0: {"serving.completed": 1},
+                       1: {"serving.completed": 2}})
+    assert serve_trace.fleet_check(under) == 1
+    # same undercount WITH a replica death on record -> allowed (the
+    # corpse's final snapshot is legitimately stale)
+    dead = fleet_dir("dead", [
+        rsnap, {"kind": "fleet_event", "action": "replica_dead",
+                "rank": 0}],
+        {0: {"serving.completed": 1}, 1: {"serving.completed": 2}})
+    assert serve_trace.fleet_check(dead) == 0
+    empty = str(tmp_path / "empty")
+    os.makedirs(os.path.join(empty, "telemetry"))
+    assert serve_trace.fleet_check(empty) == 1
+
+
+# --------------------------------------------------------------------------
+# multi-process chaos
+# --------------------------------------------------------------------------
+
+def test_fleet_kill_replica_under_load(tmp_path, mon):
+    """SIGKILL one of two replicas mid-load: only its in-flight requests
+    fail (classified replica_down), traffic redistributes, the
+    supervisor restarts it, and every ledger reconciles."""
+    v1 = _save_model(str(tmp_path / "m_v1"), 1.0)
+    fleet = ServingFleet({"m": v1}, n_replicas=2,
+                         root=str(tmp_path / "fleet"),
+                         max_restarts=2, **FLEET_KW)
+    try:
+        fleet.wait_healthy(timeout=120)
+        oks, errs = [], []
+
+        def load(n):
+            for _ in range(n):
+                xv = np.random.rand(2, D_IN).astype("f4")
+                try:
+                    (out,) = fleet.infer("m", {"x": xv})
+                    np.testing.assert_allclose(out, _expected(xv),
+                                               rtol=1e-5)
+                    oks.append(1)
+                except ServingError as e:
+                    errs.append(e.reason)
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=load, args=(40,))
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.15)
+        with fleet._lock:
+            victim = fleet._replicas[0]["proc"]
+        victim.send_signal(signal.SIGKILL)
+        for t in threads:
+            t.join()
+        # only the victim's in-flight requests were lost, all classified
+        assert all(r == "replica_down" for r in errs), errs
+        assert len(errs) <= fleet.router.inflight_cap + 1, \
+            f"lost {len(errs)} requests to one replica death"
+        assert len(oks) >= 100  # the survivor carried the load
+        s = fleet.stats()
+        assert s["requests"] == s["completed"] + s["errors"]  # exact
+        assert s["completed"] == len(oks) and s["errors"] == len(errs)
+        assert s["routed"].get(1, 0) > 0  # traffic reached the survivor
+        # the supervisor noticed and restarted the corpse
+        _wait_event(fleet, "replica_dead")
+        _wait_event(fleet, "replica_restarted")
+        fleet.wait_healthy(timeout=120)
+        (out,) = fleet.infer("m", {"x": np.ones((2, D_IN), "f4")})
+    finally:
+        fleet.stop()
+    # post-run: the merged fleet view reconciles and the health gate holds
+    assert serve_trace.fleet_check(fleet.root) == 0
+    router_log = os.path.join(fleet.root, "telemetry", "router.jsonl")
+    assert perf_report.check(router_log, min_healthy_replicas=2,
+                             check_roll_convergence=True) == 0
+
+
+def test_fleet_roll_halts_on_store_faults(tmp_path, mon):
+    """Rolling publish vs a sick store: rotted content (NaN weights) and
+    persistent EIO both halt the roll mid-fleet, the fleet converges
+    back on last good EVERYWHERE, zero requests are served by the bad
+    version, and a second attempt at the rotted source fast-rejects on
+    the persisted quarantine marker."""
+    from paddle_tpu.serving.publisher import PUBLISH_IO_ATTEMPTS
+
+    v1 = _save_model(str(tmp_path / "m_v1"), 1.0)
+    bad_rot = _save_model(str(tmp_path / "m_rot"), 3.0, poison_nan=True)
+    bad_eio = _save_model(str(tmp_path / "m_eio"), 4.0)
+    v2 = _save_model(str(tmp_path / "m_v2"), 2.0)
+    # rank 1's store access to the eio snapshot fails persistently: each
+    # entry fires on its Nth matching op, and each failed attempt aborts
+    # after one matching read, so indices 0..N cover every retry the
+    # publish budget allows
+    eio_spec = ";".join(f"eio@{i}:*m_eio*"
+                        for i in range(PUBLISH_IO_ATTEMPTS + 3))
+    fleet = ServingFleet(
+        {"m": v1}, n_replicas=2, root=str(tmp_path / "fleet"),
+        per_rank_env={1: {"FLAGS_fault_spec": eio_spec}}, **FLEET_KW)
+    try:
+        fleet.wait_healthy(timeout=120)
+        xv = np.random.rand(2, D_IN).astype("f4")
+
+        # arm 1: rotted content -> publish_rejected on the NaN rung
+        with pytest.raises(ServingError) as ei:
+            fleet.rolling_publish("m", bad_rot)
+        assert ei.value.reason == "roll_halted"
+        assert ei.value.__cause__.reason == "publish_rejected"
+        # arm 1b: the rejection persisted a marker next to the snapshot.
+        # Restart rank 0 (fresh process: empty in-memory quarantine set)
+        # and retry — the NEW process fast-rejects on the PERSISTED
+        # marker, proving the verdict survives the replica that made it
+        with fleet._lock:
+            victim = fleet._replicas[0]["proc"]
+        victim.send_signal(signal.SIGKILL)
+        _wait_event(fleet, "replica_restarted")
+        fleet.wait_healthy(timeout=120)
+        with pytest.raises(ServingError) as ei:
+            fleet.rolling_publish("m", bad_rot)
+        assert ei.value.reason == "roll_halted"
+        assert "persisted quarantine marker" in str(ei.value.__cause__)
+
+        # arm 2: persistent EIO on rank 1 -> halts AFTER rank 0 staged;
+        # convergence must discard rank 0's staged slot too
+        with pytest.raises(ServingError) as ei:
+            fleet.rolling_publish("m", bad_eio)
+        assert ei.value.reason == "roll_halted"
+        assert ei.value.__cause__.reason == "publish_io"
+
+        # the fleet converged on last good everywhere: every replica
+        # still serves v1, bit-identically
+        actives = fleet.active_versions("m")
+        assert len(actives) == 2
+        assert all(a["src"] == v1 for a in actives.values()), actives
+        for _ in range(6):
+            (out,) = fleet.infer("m", {"x": xv})
+            np.testing.assert_allclose(out, _expected(xv), rtol=1e-5)
+        # a CLEAN roll still goes through after both halts
+        fleet.rolling_publish("m", v2)
+        (out,) = fleet.infer("m", {"x": xv})
+        np.testing.assert_allclose(out, _expected(xv, 2.0), rtol=1e-5)
+        actives = fleet.active_versions("m")
+        assert all(a["src"] == v2 for a in actives.values()), actives
+        # roll episodes on the wire: 3 halted+rolled_back, 1 converged
+        assert len(_router_events(fleet, "roll_halted")) == 3
+        assert len(_router_events(fleet, "roll_rolled_back")) == 3
+        assert len(_router_events(fleet, "roll_converged")) == 1
+    finally:
+        fleet.stop()
+    assert serve_trace.fleet_check(fleet.root) == 0
+    router_log = os.path.join(fleet.root, "telemetry", "router.jsonl")
+    assert perf_report.check(router_log, min_healthy_replicas=2,
+                             check_roll_convergence=True) == 0
+
+
+def test_fleet_sigterm_drains_without_shedding(tmp_path, mon):
+    """SIGTERM one replica under load: it drains (in-flight served out,
+    exit 0, retired — not restarted), the router stops dispatching to it
+    before the shutdown could shed anything, and no request fails."""
+    v1 = _save_model(str(tmp_path / "m_v1"), 1.0)
+    fleet = ServingFleet({"m": v1}, n_replicas=2,
+                         root=str(tmp_path / "fleet"), **FLEET_KW)
+    try:
+        fleet.wait_healthy(timeout=120)
+        failures = []
+        done = threading.Event()
+
+        def load():
+            while not done.is_set():
+                xv = np.random.rand(2, D_IN).astype("f4")
+                try:
+                    (out,) = fleet.infer("m", {"x": xv})
+                    np.testing.assert_allclose(out, _expected(xv),
+                                               rtol=1e-5)
+                except ServingError as e:
+                    failures.append(e.reason)
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=load) for _ in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        with fleet._lock:
+            victim = fleet._replicas[0]["proc"]
+        victim.send_signal(signal.SIGTERM)
+        victim.wait(timeout=60)
+        assert victim.returncode == 0  # deliberate drain, clean exit
+        time.sleep(0.3)  # a few more load iterations on the shrunk fleet
+        done.set()
+        for t in threads:
+            t.join()
+        # the drain shed NOTHING: every request completed
+        assert failures == [], failures
+        s = fleet.stats()
+        assert s["requests"] == s["completed"] and s["errors"] == 0
+        _wait_event(fleet, "replica_retired")
+        # retired is final: no restart of a deliberately drained replica
+        assert not _router_events(fleet, "replica_restarted")
+    finally:
+        fleet.stop()
+    # the drained replica's own final on-disk ledger agrees nothing was
+    # shed or dropped at shutdown
+    tel = os.path.join(fleet.root, "telemetry")
+    victim_counters = {}
+    for dirpath, _, names in os.walk(tel):
+        for n in names:
+            if n != "metrics.p0.jsonl":
+                continue
+            with open(os.path.join(dirpath, n)) as f:
+                for ln in f:
+                    rec = json.loads(ln)
+                    if rec.get("kind") == "snapshot":
+                        victim_counters = rec.get("counters", {})
+    assert victim_counters.get("serving.shed", 0) == 0
+    assert victim_counters.get("serving.shutdowns", 0) == 0
+    assert victim_counters.get("serving.completed", 0) > 0
+    assert serve_trace.fleet_check(fleet.root) == 0
+
+
+def test_fleet_roll_resumes_from_persisted_state(tmp_path, mon):
+    """Supervisor crash mid-roll: a fresh supervisor (same fleet root)
+    finishes the roll from ROLL.json — verified ranks are not re-staged,
+    the activate phase completes, ACTIVE.json moves."""
+    v1 = _save_model(str(tmp_path / "m_v1"), 1.0)
+    v2 = _save_model(str(tmp_path / "m_v2"), 2.0)
+    fleet = ServingFleet({"m": v1}, n_replicas=1,
+                         root=str(tmp_path / "fleet"), **FLEET_KW)
+    try:
+        fleet.wait_healthy(timeout=120)
+        # stage phase ran, then the supervisor "crashed" before activate
+        reply = fleet._control_rpc(0, {"op": "stage", "model": "m",
+                                       "src": v2})
+        assert reply.get("ok"), reply
+        fleet._persist_roll({"model": "m", "src": v2, "ctl": "roll-x",
+                             "phase": "activate", "verified": [0],
+                             "acked": [], "last_good": v1})
+        roll = fleet.resume_roll()
+        assert roll["phase"] == "done" and roll["acked"] == [0]
+        xv = np.ones((2, D_IN), "f4")
+        (out,) = fleet.infer("m", {"x": xv})
+        np.testing.assert_allclose(out, _expected(xv, 2.0), rtol=1e-5)
+        active = json.load(open(os.path.join(fleet.root, "ACTIVE.json")))
+        assert active["models"]["m"]["src"] == v2
+        assert _router_events(fleet, "roll_resumed")
+        # nothing left to resume now
+        assert fleet.resume_roll() is None
+    finally:
+        fleet.stop()

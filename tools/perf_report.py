@@ -480,6 +480,48 @@ def _has_serving_evidence(lines):
                 or _latest_gauges(lines, "serving."))
 
 
+def _has_fleet_evidence(lines):
+    """True when the file carries ANY serving-fleet signal (fleet_event
+    records, serving.fleet.* counters or gauges) — the ISSUE-18 fleet
+    gates fail without one (zero evidence must not gate green)."""
+    if any(r.get("kind") == "fleet_event" for r in lines):
+        return True
+    return bool(_latest_counters(lines, "serving.fleet.")
+                or _latest_gauges(lines, "serving.fleet."))
+
+
+def fleet_healthy_replicas(lines):
+    """Newest `serving.fleet.healthy_replicas` gauge, or None when no
+    snapshot in the file carries it."""
+    return _latest_gauges(lines, "serving.fleet.").get(
+        "serving.fleet.healthy_replicas")
+
+
+def roll_convergence_failures(lines):
+    """Rolling publishes that HALTED without converging.  Exact from
+    fleet_event records (per roll ctl id: a `roll_halted` with no
+    `roll_rolled_back`/`roll_converged` after it); counters-only files
+    fall back to the events[*] counter balance."""
+    events = [r for r in lines if r.get("kind") == "fleet_event"]
+    if events:
+        rolls = {}
+        for e in events:
+            if e.get("ctl"):
+                rolls.setdefault(e["ctl"], []).append(e.get("action"))
+        return [ctl for ctl, actions in rolls.items()
+                if "roll_halted" in actions
+                and "roll_rolled_back" not in actions
+                and "roll_converged" not in actions]
+    c = _latest_counters(lines, "serving.fleet.")
+    halted = c.get("serving.fleet.events[roll_halted]", 0)
+    settled = (c.get("serving.fleet.events[roll_rolled_back]", 0)
+               + c.get("serving.fleet.events[roll_converged]", 0))
+    if halted > settled:
+        return [f"{halted:g} roll_halted vs {settled:g} "
+                f"rolled_back+converged (counters)"]
+    return []
+
+
 def shed_fraction(lines):
     """Requests shed by serving admission control per request offered
     (paddle_tpu.serving.Server), from the newest counter snapshot
@@ -736,7 +778,9 @@ def check(path: str, steady_after: int = 2,
           max_ckpt_lag_steps: float = None,
           max_queue_wait_frac: float = None,
           max_pad_frac: float = None,
-          require_quant_parity: bool = False) -> int:
+          require_quant_parity: bool = False,
+          min_healthy_replicas: float = None,
+          check_roll_convergence: bool = False) -> int:
     """Return 0 when the metrics file is healthy, 1 otherwise (printed
     diagnosis either way).  Made for CI/bench scripts:
 
@@ -773,7 +817,9 @@ def check(path: str, steady_after: int = 2,
                        or max_ckpt_lag_steps is not None
                        or max_queue_wait_frac is not None
                        or max_pad_frac is not None
-                       or require_quant_parity) \
+                       or require_quant_parity
+                       or min_healthy_replicas is not None
+                       or check_roll_convergence) \
         and max_host_blocked_frac is None and max_retry_frac is None
     if not steps and not dist_gates_only:
         print(f"perf_report --check: {path} contains no step records "
@@ -1026,6 +1072,57 @@ def check(path: str, steady_after: int = 2,
                 print(f"perf_report --check: quant parity held across "
                       f"{len(qevs)} quantized publish(es) "
                       f"(worst max|diff| {worst:.3e})")
+    if min_healthy_replicas is not None:
+        if not _has_fleet_evidence(lines):
+            failures.append(
+                f"--min-healthy-replicas given but {path} carries no "
+                f"serving-fleet evidence (no fleet_event records and no "
+                f"serving.fleet.* counters/gauges in any snapshot) — was "
+                f"this a fleet router.jsonl (ServingFleet telemetry)?  "
+                f"(zero evidence must not gate green)")
+        else:
+            n = fleet_healthy_replicas(lines)
+            if n is None:
+                failures.append(
+                    f"--min-healthy-replicas given but no snapshot in "
+                    f"{path} carries the serving.fleet.healthy_replicas "
+                    f"gauge — the fleet supervisor's snapshot loop never "
+                    f"wrote one (zero evidence must not gate green)")
+            elif n < min_healthy_replicas:
+                failures.append(
+                    f"fleet ended with {n:g} healthy replica(s), below "
+                    f"the --min-healthy-replicas={min_healthy_replicas:g} "
+                    f"gate — replicas died past their restart budget or "
+                    f"never came up; see the replica_dead / "
+                    f"replica_abandoned fleet_events and the replica "
+                    f"stderr spools in the fleet's logs/ dir")
+            else:
+                print(f"perf_report --check: healthy replicas {n:g} >= "
+                      f"{min_healthy_replicas:g}")
+    if check_roll_convergence:
+        if not _has_fleet_evidence(lines):
+            failures.append(
+                f"--check-roll-convergence given but {path} carries no "
+                f"serving-fleet evidence (no fleet_event records and no "
+                f"serving.fleet.* counters/gauges in any snapshot) — "
+                f"(zero evidence must not gate green)")
+        else:
+            unconverged = roll_convergence_failures(lines)
+            if unconverged:
+                failures.append(
+                    f"{len(unconverged)} rolling publish(es) halted "
+                    f"WITHOUT converging ({unconverged[:3]}) — no "
+                    f"roll_rolled_back/roll_converged followed the "
+                    f"roll_halted, so replicas may be split between "
+                    f"versions; `serve_trace --fleet` renders the "
+                    f"episode, and ROLL.json in the fleet root holds "
+                    f"the persisted state to resume_roll() from")
+            else:
+                n_rolls = sum(1 for r in lines
+                              if r.get("kind") == "fleet_event"
+                              and r.get("action") == "roll_started")
+                print(f"perf_report --check: roll convergence holds "
+                      f"({n_rolls} roll(s) on record)")
     if max_lock_wait_frac is not None:
         if not _has_lock_evidence(lines):
             failures.append(
@@ -1629,6 +1726,20 @@ def main(argv=None):
                          "spend on rows no client asked for.  Fails on a "
                          "file with no pad evidence at all — zero "
                          "evidence must not gate green")
+    ap.add_argument("--min-healthy-replicas", type=float, default=None,
+                    metavar="N",
+                    help="gate the serving fleet's final health: the "
+                         "newest serving.fleet.healthy_replicas gauge "
+                         "(ServingFleet router.jsonl snapshots) must be "
+                         ">= N.  Fails on a file with no fleet evidence "
+                         "at all — zero evidence must not gate green")
+    ap.add_argument("--check-roll-convergence", action="store_true",
+                    help="require every halted rolling publish to have "
+                         "converged: a roll_halted fleet_event with no "
+                         "matching roll_rolled_back/roll_converged "
+                         "fails (per roll ctl id; counters-only files "
+                         "fall back to the events[*] counter balance).  "
+                         "Fails on a file with no fleet evidence at all")
     ap.add_argument("--max-step-skew-frac", type=float, default=None,
                     metavar="FRAC",
                     help="gate the MAX sustained straggler lag, in step "
@@ -1661,7 +1772,9 @@ def main(argv=None):
                      args.max_ckpt_lag_steps,
                      max_queue_wait_frac=args.max_queue_wait_frac,
                      max_pad_frac=args.max_pad_frac,
-                     require_quant_parity=args.require_quant_parity)
+                     require_quant_parity=args.require_quant_parity,
+                     min_healthy_replicas=args.min_healthy_replicas,
+                     check_roll_convergence=args.check_roll_convergence)
     if args.diff:
         print(diff(*args.diff))
         return 0

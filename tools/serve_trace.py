@@ -33,6 +33,18 @@ the shed/timeout/error/shutdown/rejected early closes), `serving_batch`
         math as perf_report --check; both FAIL on a file with no
         evidence — the zero-evidence-fails convention).
 
+    python tools/serve_trace.py --fleet FLEET_DIR [--check]
+        Fleet view (ISSUE 18): merge the router's ledger stream
+        (`telemetry/router.jsonl`) with every replica's per-incarnation
+        `metrics.p<rank>.jsonl` (trace_merge's rank-lane pattern) into
+        fleet-wide outcome/reason tables, per-replica lanes, and the
+        roll episodes (one block per rolling-publish ctl id).  With
+        `--check`: the router ledger must reconcile against the SUM of
+        the replica ledgers (exact when no replica died; bounded by the
+        classified replica_down losses otherwise), every roll_halted
+        must have converged (roll_converged or roll_rolled_back), and a
+        directory with no evidence at all fails.
+
 `perf_report --check` gates the same stream on counters; this tool is
 the per-request view: a failed gate there names a trace id to read here.
 """
@@ -45,6 +57,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import perf_report as _pr  # noqa: E402  (stdlib-only; shares gate math)
+import trace_merge as _tm  # noqa: E402  (rank-lane file discovery)
 
 TERMINAL_OUTCOMES = ("completed", "shed", "timeout", "error", "shutdown",
                      "rejected")
@@ -276,12 +289,187 @@ def check(path, max_queue_wait_frac=None, max_pad_frac=None):
     return 0
 
 
+# ---- fleet view (ISSUE 18) --------------------------------------------------
+
+def _fleet_telemetry_dir(path):
+    """Accept the fleet root or its telemetry dir interchangeably."""
+    if os.path.isdir(os.path.join(path, "telemetry")):
+        return os.path.join(path, "telemetry")
+    return path
+
+
+def load_fleet(path):
+    """Collect the fleet's streams: router ledger lines + per-replica
+    metrics files (every incarnation, rank-keyed)."""
+    tel = _fleet_telemetry_dir(path)
+    router_path = os.path.join(tel, "router.jsonl")
+    router_lines = []
+    if os.path.exists(router_path):
+        router_lines = _tm.load_records([router_path])
+    ranks = _tm.find_rank_files(tel)["metrics"]
+    replicas = {r: [(p, _tm.load_records([p])) for p in paths]
+                for r, paths in sorted(ranks.items())}
+    return {"dir": tel, "router": router_lines, "replicas": replicas}
+
+
+def _fleet_events(router_lines):
+    return [r for r in router_lines if r.get("kind") == "fleet_event"]
+
+
+def _router_counters(router_lines):
+    return _pr._latest_counters(router_lines, "serving.fleet.")
+
+
+def _replica_ledgers(replicas):
+    """Newest serving.* counter snapshot per metrics FILE (one file = one
+    process incarnation; counters reset at restart, so summing the
+    newest snapshot of every file is the fleet-wide total)."""
+    out = {}
+    for rank, files in replicas.items():
+        rows = []
+        for path, lines in files:
+            c = _pr._latest_counters(lines, "serving.")
+            rows.append((path, c, len(traces_of(lines))))
+        out[rank] = rows
+    return out
+
+
+def _roll_episodes(events):
+    """Group fleet_event records by roll ctl id, in stream order."""
+    rolls = {}
+    for e in events:
+        ctl = e.get("ctl")
+        if not ctl:
+            continue
+        rolls.setdefault(ctl, []).append(e)
+    return rolls
+
+
+def fleet_summary(fl, last_n=10):
+    out = [f"serve_trace --fleet: {fl['dir']}"]
+    c = _router_counters(fl["router"])
+    if c:
+        out.append(
+            f"  router ledger: {c.get('serving.fleet.requests', 0):g} "
+            f"requests = {c.get('serving.fleet.completed', 0):g} completed "
+            f"+ {c.get('serving.fleet.errors', 0):g} classified errors "
+            f"({c.get('serving.fleet.retries', 0):g} transparent retries)")
+        reasons = sorted((k[len("serving.fleet.errors["):-1], v)
+                         for k, v in c.items()
+                         if k.startswith("serving.fleet.errors[") and v)
+        for reason, n in reasons:
+            out.append(f"    reason {reason:<18} {n:g}")
+    else:
+        out.append("  router ledger: no serving.fleet.* snapshot")
+    # per-replica lanes: one line per incarnation, newest ledger each
+    out.append("  replica lanes:")
+    for rank, rows in _replica_ledgers(fl["replicas"]).items():
+        for path, counters, n_traces in rows:
+            inc = _tm._incarnation_of(path)
+            out.append(
+                f"    rank {rank} i{inc}: "
+                f"{counters.get('serving.requests', 0):g} requests, "
+                f"{counters.get('serving.completed', 0):g} completed, "
+                f"{counters.get('serving.shed', 0):g} shed, "
+                f"{counters.get('serving.errors', 0):g} errors, "
+                f"{n_traces} trace(s)")
+    if not fl["replicas"]:
+        out.append("    (no replica metrics files)")
+    events = _fleet_events(fl["router"])
+    rolls = _roll_episodes(events)
+    if rolls:
+        out.append("  roll episodes:")
+        for ctl, evs in rolls.items():
+            steps = " -> ".join(
+                e["action"] + (f"(r{e['rank']})" if "rank" in e else "")
+                for e in evs)
+            out.append(f"    {ctl}: {steps}")
+    life = [e for e in events if not e.get("ctl")]
+    if life:
+        out.append(f"  lifecycle (last {min(last_n, len(life))}):")
+        for e in life[-last_n:]:
+            extra = {k: v for k, v in e.items()
+                     if k not in ("kind", "action", "ts")}
+            out.append(f"    {e['action']:<20} {extra}")
+    return "\n".join(out)
+
+
+def fleet_check(path):
+    """Exit 0 when the fleet's ledgers reconcile and every halted roll
+    converged; 1 otherwise (zero evidence fails)."""
+    fl = load_fleet(path)
+    failures = []
+    c = _router_counters(fl["router"])
+    events = _fleet_events(fl["router"])
+    if not c and not fl["replicas"]:
+        failures.append(
+            f"{fl['dir']} carries no router snapshot and no replica "
+            f"metrics — was this a fleet telemetry dir?  (zero evidence "
+            f"must not gate green)")
+    if not c and fl["router"]:
+        failures.append(
+            "router.jsonl carries records but no serving.fleet.* counter "
+            "snapshot — the supervisor's snapshot loop never ran")
+    deaths = [e for e in events if e.get("action") == "replica_dead"]
+    if c:
+        req = c.get("serving.fleet.requests", 0)
+        comp = c.get("serving.fleet.completed", 0)
+        errs = c.get("serving.fleet.errors", 0)
+        if comp + errs > req:
+            failures.append(
+                f"router ledger does not reconcile: completed+errors = "
+                f"{comp + errs:g} exceeds requests = {req:g}")
+        down = c.get("serving.fleet.errors[replica_down]", 0)
+        led = _replica_ledgers(fl["replicas"])
+        rep_comp = sum(counters.get("serving.completed", 0)
+                       for rows in led.values()
+                       for _p, counters, _t in rows)
+        # a replica can complete a request whose reply the router lost
+        # (counted replica_down router-side) but never the reverse
+        if rep_comp > comp + down:
+            failures.append(
+                f"replica ledgers overcount: sum(replica completed) = "
+                f"{rep_comp:g} exceeds router completed + replica_down "
+                f"losses = {comp + down:g}")
+        if not deaths and rep_comp < comp:
+            failures.append(
+                f"replica ledgers undercount with no replica death on "
+                f"record: sum(replica completed) = {rep_comp:g} < router "
+                f"completed = {comp:g} — a replica's final snapshot is "
+                f"missing")
+        print(f"serve_trace --fleet --check: router {req:g} requests = "
+              f"{comp:g} completed + {errs:g} errors; replicas sum "
+              f"{rep_comp:g} completed across "
+              f"{sum(len(r) for r in led.values())} incarnation ledger(s)"
+              f"{f'; {len(deaths)} replica death(s)' if deaths else ''}")
+    # every halted roll must converge (same invariant perf_report gates)
+    for ctl, evs in _roll_episodes(events).items():
+        actions = [e["action"] for e in evs]
+        if "roll_halted" in actions and not (
+                "roll_rolled_back" in actions or "roll_converged" in actions):
+            failures.append(
+                f"roll {ctl} halted without converging (no "
+                f"roll_rolled_back/roll_converged event) — the fleet may "
+                f"be split-brained between versions")
+    if failures:
+        for f_ in failures:
+            print(f"serve_trace --fleet --check: {f_}")
+        return 1
+    print("serve_trace --fleet --check: OK")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="inspect serving request-flight traces "
                     "(serving_trace records in a monitor JSONL stream)")
     ap.add_argument("path", help="metrics JSONL stream (MonitorLogger "
-                                 "output) from a serving run")
+                                 "output) from a serving run; with "
+                                 "--fleet, a fleet root or telemetry dir")
+    ap.add_argument("--fleet", action="store_true",
+                    help="treat PATH as a fleet telemetry dir: merged "
+                         "router + per-replica view (with --check: "
+                         "ledger reconciliation + roll convergence)")
     ap.add_argument("--request", metavar="TRACE_ID",
                     help="render one request's span tree")
     ap.add_argument("--top", action="store_true",
@@ -302,6 +490,14 @@ def main(argv=None):
                     help="with --check: gate pad rows per padded row at "
                          "<= FRAC")
     args = ap.parse_args(argv)
+    if args.fleet:
+        if not os.path.isdir(args.path):
+            print(f"serve_trace --fleet: {args.path} is not a directory")
+            return 1
+        if args.check:
+            return fleet_check(args.path)
+        print(fleet_summary(load_fleet(args.path), last_n=args.last))
+        return 0
     if args.check:
         return check(args.path, args.max_queue_wait_frac, args.max_pad_frac)
     try:
