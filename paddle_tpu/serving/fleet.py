@@ -10,9 +10,11 @@ restarts replicas INDEPENDENTLY because requests are not:
     publisher ladder + monitor plane) beating `ReplicaBeat` files under
     `<root>/hb/`;
   * the supervisor watches `FleetHealth` + process exit codes: exit 0
-    is a deliberate drain (retired, never restarted), anything else is
-    a death — restarted with a fresh telemetry incarnation until the
-    per-replica restart budget is spent;
+    (a completed drain) or death BY SIGTERM (the drain signal caught a
+    replica mid-boot, before its handler existed) is deliberate
+    retirement — never restarted; anything else is a death — restarted
+    with a fresh telemetry incarnation until the per-replica restart
+    budget is spent;
   * traffic rides `serving.router.Router` over the same health table:
     a dead replica loses only its own in-flight requests (classified
     `reason="replica_down"`), new traffic redistributes within one
@@ -27,7 +29,10 @@ restarts replicas INDEPENDENTLY because requests are not:
     on the last good version (staged slots discarded everywhere, zero
     requests ever served by the bad version).  No split-brain: the
     fleet-active pointer (`ACTIVE.json`, what a restarted replica boots
-    from) moves only after EVERY replica acked the activate.  The roll
+    from) moves only after EVERY replica acked the activate AND a final
+    reconcile pass re-verified each ack against the replica's live
+    active version (an acked replica that died and rebooted on last
+    good is re-staged + re-activated, not trusted).  The roll
     itself is crash-safe: progress persists in `ROLL.json` (io.py
     atomic write) and a replica death mid-roll is waited out — the
     restarted replica boots on last good and is re-staged.
@@ -238,10 +243,13 @@ class ServingFleet:
                 if rc is None or rep["retired"]:
                     continue
                 self._close_spool(rep)
-                if rc == 0:
-                    # deliberate drain: the replica announced its own
-                    # retirement; restarting it would undo an operator's
-                    # scale-down or SIGTERM
+                if rc == 0 or rc == -signal.SIGTERM:
+                    # deliberate drain: exit 0 is the replica announcing
+                    # its own retirement; -SIGTERM means the drain signal
+                    # landed before the replica's handler was even
+                    # installed (interpreter/package import is the slow
+                    # part of boot) — the INTENT was still retirement, and
+                    # restarting would undo an operator's scale-down
                     with self._lock:
                         rep["retired"] = True
                     self._event("replica_retired", rank=rank, exit_code=rc)
@@ -256,6 +264,10 @@ class ServingFleet:
                                 restarts=rep["restarts"])
                     continue
                 self.health.note_restart(rank)
+                # router suspicion was pinned to the DEAD incarnation's
+                # beat seq; the fresh process counts from 1 and would
+                # otherwise stay benched until it outran the corpse
+                self.router.note_restart(rank)
                 fresh = self._spawn(rank, rep["restarts"] + 1)
                 with self._lock:
                     self._replicas[rank] = fresh
@@ -488,8 +500,14 @@ class ServingFleet:
                 self._persist_roll(roll)
                 self._event("replica_acked", ctl=ctl, model=name,
                             rank=rank, version=reply.get("version"))
-            # every replica acked: the version becomes FLEET-active —
-            # this pointer is what replica restarts boot from
+            # every replica acked — but an ack is not proof the replica
+            # is still serving the new version: one that died AFTER
+            # acking was restarted from ACTIVE.json (still last good)
+            # and the loop above skips acked ranks.  Re-verify before
+            # the pointer moves, or that replica split-brains forever.
+            self._reconcile_acked(roll, recover_timeout)
+            # the version becomes FLEET-active — this pointer is what
+            # replica restarts boot from
             self.config["models"][name] = {"src": src}
             _io.atomic_write(
                 os.path.join(self.root, _ACTIVE_FILE),
@@ -537,6 +555,39 @@ class ServingFleet:
                 reason=reply.get("reason") or "publish_rejected",
                 model=name))
         raise AssertionError("unreachable")  # _halt_roll always raises
+
+    def _reconcile_acked(self, roll: dict, recover_timeout: float):
+        """Close the ack-then-die window before the roll finalizes: ask
+        every acked replica what it is ACTUALLY serving (op=active_src)
+        and re-run stage+activate on any that silently reverted — a
+        replica restarted after its ack boots from ACTIVE.json, which is
+        still the last good version until this pass comes back clean.
+        Repeats until one full pass verifies, so a death during the
+        reconcile itself is caught by the next pass."""
+        name, src, ctl = roll["model"], roll["src"], roll["ctl"]
+        for _ in range(self.max_restarts + 2):
+            reverted = []
+            for rank in list(roll["acked"]):
+                try:
+                    reply = self._control_rpc(
+                        rank, {"op": "active_src", "model": name},
+                        recover_timeout=recover_timeout)
+                except ServingError as e:
+                    self._halt_roll(roll, rank, e)
+                if not (reply.get("ok") and reply.get("src") == src):
+                    reverted.append(rank)
+            if not reverted:
+                return
+            for rank in reverted:
+                # boots on last good with an empty staged slot, so
+                # _activate_one's model_missing path re-runs the ladder
+                reply = self._activate_one(roll, rank, recover_timeout)
+                self._event("replica_reactivated", ctl=ctl, model=name,
+                            rank=rank, version=reply.get("version"))
+        self._halt_roll(roll, reverted[0], ServingError(
+            f"replica rank {reverted[0]} kept reverting to the last "
+            f"good version while finalizing the roll (restart loop?)",
+            reason="publish_rejected", model=name))
 
     def _halt_roll(self, roll: dict, rank: int, cause: ServingError):
         """A rung failed: halt, converge the fleet back on last good,

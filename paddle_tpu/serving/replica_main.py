@@ -27,7 +27,12 @@ supervisor-only roll plane `stage` / `activate` / `discard` /
 `rollback` / `active_src`.  Every reply is `{"ok": true, ...}` or
 `{"ok": false, "reason": <classified>, "error": <message>}`.
 
-Shutdown: SIGTERM starts a drain — the beat payload flips
+Shutdown: SIGTERM starts a drain (the handler is installed at the top
+of main(), so a SIGTERM landing mid-model-load still drains and exits
+0; one landing even earlier — during interpreter/package import — kills
+the process with -SIGTERM, which the supervisor ALSO treats as
+deliberate retirement, never a restartable death) — the beat payload
+flips
 `draining=true` immediately (one `beat_now`, so the router stops
 dispatching within one health poll), dispatched-but-unfinished requests
 are served out, the final ledger snapshot is written, and the process
@@ -143,6 +148,20 @@ def main() -> int:
     rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     port = int(port)
 
+    # the drain handler goes in BEFORE the slow part of boot (model
+    # load, bucket warm): a SIGTERM racing a booting replica must still
+    # be a deliberate drain (exit 0), not the default handler's
+    # non-zero death that the supervisor would dutifully restart —
+    # undoing an operator scale-down or fleet.stop()
+    draining = threading.Event()
+    done = threading.Event()
+
+    def _sigterm(_sig, _frm):
+        draining.set()
+        done.set()
+
+    signal.signal(signal.SIGTERM, _sigterm)
+
     from .. import io as _io
     from .. import monitor
     from ..dist_resilience import ReplicaBeat
@@ -189,8 +208,6 @@ def main() -> int:
         src = spec["src"] if isinstance(spec, dict) else spec
         srv.load_model(name, src)
 
-    draining = threading.Event()
-    done = threading.Event()
     ctx = {"srv": srv, "rank": rank, "buckets": buckets,
            "draining": draining}
 
@@ -224,12 +241,6 @@ def main() -> int:
 
     beat = ReplicaBeat(os.path.join(fleet_dir, "hb"), rank, world,
                        interval_s=hb_interval, payload_fn=_payload).start()
-
-    def _sigterm(_sig, _frm):
-        draining.set()
-        done.set()
-
-    signal.signal(signal.SIGTERM, _sigterm)
 
     monitor.record_step({"kind": "serving_event", "action": "replica_up",
                          "rank": rank, "port": port, "pid": os.getpid()})

@@ -22,8 +22,12 @@ Suspicion closes the heartbeat-staleness window: a TCP connect/request
 failure marks the replica suspect IMMEDIATELY (with the beat seq it was
 suspected at), so new traffic redistributes on the very next request
 instead of waiting out `interval * miss_factor`.  The mark clears when
-the beat sequence advances past the suspicion point — a live replica
-that dropped one connection gets traffic back within one beat.
+the beat sequence moves off the suspicion point — ADVANCED past it (a
+live replica that dropped one connection gets traffic back within one
+beat) or restarted BELOW it (a supervisor relaunch begins a fresh seq
+space at 1; the dead incarnation's high-water mark must not bench the
+new process).  The supervisor also clears the mark explicitly via
+`note_restart` the moment it relaunches a rank.
 
 Failure semantics per request:
 
@@ -151,6 +155,13 @@ class Router:
             self._suspect[rank] = seq
         _MON.counter("serving.fleet.suspects").inc()
 
+    def note_restart(self, rank: int):
+        """The supervisor relaunched this rank: suspicion was held
+        against the DEAD incarnation's beat seq and does not transfer
+        to the fresh process (whose seq space restarts at 1)."""
+        with self._lock:
+            self._suspect.pop(rank, None)
+
     def _pick(self, table: Dict[int, dict]) -> Optional[dict]:
         """Least-loaded live candidate, or a classified refusal.  `table`
         is a FleetHealth.poll() result (polled OUTSIDE the lock)."""
@@ -163,8 +174,13 @@ class Router:
                 seq = info["seq"]
                 if r in self._suspect:
                     at = self._suspect[r]
-                    if seq is not None and at is not None and seq > at:
-                        del self._suspect[r]  # beats advanced: forgiven
+                    # forgiven when the beats advanced past the suspicion
+                    # point — OR restarted BELOW it: a seq lower than the
+                    # one we suspected at can only be a fresh incarnation
+                    # (note_restart wiped the corpse's hb file and the
+                    # new process counts from 1 again)
+                    if seq is not None and (at is None or seq != at):
+                        del self._suspect[r]
                     else:
                         continue
                 tel = info.get("tel") or {}
@@ -228,7 +244,6 @@ class Router:
                         raise err from e
                     with self._lock:
                         self._stats["retries"] += 1
-                    last_refused = rank
                     continue
                 except OSError as e:
                     # the connection died with the request possibly

@@ -189,6 +189,103 @@ def test_router_least_loaded_pick():
     assert r._pick(r.health.poll())["rank"] == 0
 
 
+def test_router_suspicion_clears_on_replica_restart(mon):
+    """A crash-restarted replica must not stay benched: the fresh
+    incarnation's beat seq restarts at 1, far BELOW the dead
+    incarnation's suspicion seq, so `seq > at` alone would keep it
+    suspect for the old incarnation's lifetime worth of beats — a total
+    outage at n_replicas=1."""
+    from paddle_tpu.serving.router import Router
+
+    alive = {"status": "alive", "seq": 1, "age_s": 0.0,
+             "tel": {"port": 1, "q": 0, "p99": 1.0}}
+    # long-lived incarnation died at seq 50_000; fresh one beats seq=1
+    r = Router(_FakeHealth({0: dict(alive)}))
+    r._mark_suspect(0, 50_000)
+    pick = r._pick(r.health.poll())
+    assert pick["rank"] == 0  # seq below suspicion point => forgiven
+    with r._lock:
+        assert 0 not in r._suspect
+    # the supervisor also clears suspicion explicitly on relaunch
+    r = Router(_FakeHealth({0: dict(alive)}))
+    r._mark_suspect(0, 50_000)
+    r.note_restart(0)
+    assert r._pick(r.health.poll())["rank"] == 0
+    # unchanged: seq stuck AT the suspicion point stays suspect
+    r = Router(_FakeHealth({0: {**alive, "seq": 7}}))
+    r._mark_suspect(0, 7)
+    with pytest.raises(ServingError) as ei:
+        r._pick(r.health.poll())
+    assert ei.value.reason == "replica_down"
+
+
+def test_roll_reconciles_replica_that_died_after_acking(tmp_path, mon):
+    """Split-brain window: a replica that dies AFTER acking its
+    activate reboots from ACTIVE.json — still the last good version —
+    and the activate loop skips acked ranks.  The pre-finalize
+    reconcile pass must catch the revert and re-stage + re-activate."""
+    fleet = ServingFleet({"m": "/old"}, n_replicas=2,
+                         root=str(tmp_path / "fleet"), start=False)
+    # rank 1 acked, then died and rebooted on last good (empty staged slot)
+    active = {0: "/new", 1: "/old"}
+    staged = {0: False, 1: False}
+    ops = []
+
+    def fake_rpc(rank, msg, recover_timeout=60.0):
+        op = msg["op"]
+        ops.append((rank, op))
+        if op == "active_src":
+            return {"ok": True, "src": active[rank], "version": 1}
+        if op == "stage":
+            staged[rank] = True
+            return {"ok": True, "version": 2, "src": msg["src"]}
+        if op == "activate":
+            if not staged[rank]:
+                return {"ok": False, "reason": "model_missing",
+                        "error": "nothing staged"}
+            active[rank] = "/new"
+            staged[rank] = False
+            return {"ok": True, "version": 2}
+        raise AssertionError(f"unexpected op {op!r}")
+
+    fleet._control_rpc = fake_rpc
+    roll = {"model": "m", "src": "/new", "ctl": "roll-t",
+            "phase": "activate", "verified": [0, 1], "acked": [0, 1],
+            "last_good": "/old"}
+    fleet._reconcile_acked(roll, recover_timeout=1.0)
+    assert active == {0: "/new", 1: "/new"}
+    # rank 1 went through the full ladder again, rank 0 was only probed
+    assert (1, "stage") in ops and (1, "activate") in ops
+    assert (0, "stage") not in ops
+    assert _router_events(fleet, "replica_reactivated")
+
+
+def test_sigterm_racing_boot_retires_instead_of_restarting(tmp_path, mon):
+    """A SIGTERM that lands while the replica is still importing (before
+    main() installs the drain handler) kills it with -SIGTERM.  The
+    supervisor must treat that as deliberate retirement — restarting
+    would undo an operator scale-down racing a slow boot."""
+    v1 = _save_model(str(tmp_path / "m_v1"), 1.0)
+    fleet = ServingFleet({"m": v1}, n_replicas=2,
+                         root=str(tmp_path / "fleet"), **FLEET_KW)
+    try:
+        victim = fleet._replicas[1]["proc"]
+        victim.send_signal(signal.SIGTERM)  # immediately: mid-import
+        rc = victim.wait(timeout=120)
+        assert rc in (0, -signal.SIGTERM), rc
+        _wait_event(fleet, "replica_retired", timeout=30)
+        assert fleet._replicas[1]["retired"]
+        assert fleet._replicas[1]["proc"] is victim, "rank 1 was restarted"
+        assert not _router_events(fleet, "replica_restarted")
+        # the survivor still serves
+        fleet.wait_healthy(min_replicas=1, timeout=120)
+        xv = np.ones((2, D_IN), "f4")
+        (out,) = fleet.infer("m", {"x": xv})
+        np.testing.assert_allclose(out, _expected(xv, 1.0), rtol=1e-5)
+    finally:
+        fleet.stop()
+
+
 def test_registry_staging_api(tmp_path, mon):
     import paddle_tpu as fluid
     from paddle_tpu.serving import ModelRegistry, publish
