@@ -29,6 +29,7 @@ from .core.program import (  # noqa: F401
 )
 from .core.scope import Scope, global_scope, scope_guard  # noqa: F401
 from . import parallel  # noqa: F401
+from . import param_server  # noqa: F401
 from .parallel import BuildStrategy, CompiledProgram, ExecutionStrategy, ParallelExecutor  # noqa: F401
 from . import parallel as compiler  # reference exposes fluid.compiler.CompiledProgram  # noqa: F401
 from . import clip  # noqa: F401

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.tree_util import register_pytree_node_class
 
 
@@ -64,6 +65,13 @@ class SelectedRows:
     def to_dense(self):
         d = jnp.zeros(self.shape, self.values.dtype)
         return d.at[self.rows].add(self.values, mode="drop")
+
+    def __array__(self, dtype=None):
+        # dense view for np.asarray consumers (the executor's scope
+        # materialization, save_vars): a published full-coverage sparse
+        # table serves through the same lookup program as a dense one
+        a = np.asarray(self.to_dense())
+        return a.astype(dtype) if dtype is not None else a
 
     def __repr__(self):
         return f"SelectedRows(height={self.height}, nnz={self.rows.shape[0]}, d={self.values.shape[1:]})"

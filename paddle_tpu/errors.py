@@ -86,8 +86,10 @@ __all__ = ["TrainingError", "DataError", "NumericError",
            "CheckpointError", "ServingError", "ResourceError",
            "LockTimeoutError", "IntegrityError", "StorageError",
            "DistributedError", "PeerFailureError", "CollectiveTimeoutError",
+           "ParamServerError",
            "classify", "attach_context", "get_context",
-           "TRANSIENT_STORAGE_ERRNOS", "TERMINAL_STORAGE_ERRNOS"]
+           "TRANSIENT_STORAGE_ERRNOS", "TERMINAL_STORAGE_ERRNOS",
+           "TRANSIENT_PS_ERRNOS"]
 
 import errno as _errno
 from typing import Optional
@@ -100,6 +102,17 @@ from typing import Optional
 TRANSIENT_STORAGE_ERRNOS = (_errno.ENOSPC, _errno.EIO, _errno.EAGAIN,
                             _errno.ETIMEDOUT)
 TERMINAL_STORAGE_ERRNOS = (_errno.EROFS, _errno.EACCES)
+
+# The pserver-failure split (ISSUE 19).  Transient: the socket died
+# because the pserver process did (its supervisor is restarting it) or
+# the network flapped — reconnect + retry is the answer.  A socket
+# TimeoutError maps transient too (KVClient checks the type, not just
+# the errno).  Anything else on the wire — protocol violations above
+# all — is terminal.
+TRANSIENT_PS_ERRNOS = (_errno.ECONNREFUSED, _errno.ECONNRESET,
+                       _errno.ECONNABORTED, _errno.EPIPE,
+                       _errno.ETIMEDOUT, _errno.EAGAIN,
+                       _errno.EHOSTUNREACH)
 
 
 class TrainingError(RuntimeError):
@@ -273,6 +286,55 @@ class StorageError(TrainingError):
         ctx.append("transient" if self.transient else "terminal")
         if self.path:
             ctx.append(f"path={self.path}")
+        return f"{base} [{', '.join(ctx)}]"
+
+
+class ParamServerError(TrainingError):
+    """The host sparse-table tier (paddle_tpu/param_server.py) failed an
+    RPC — the parameter-server mirror of `StorageError`, with the same
+    transient/terminal split the resilience tier keys on:
+
+      * transient (connection refused/reset, broken pipe, socket
+        timeout, host unreachable): the pserver died or is being
+        crash-restarted by its supervisor; the KVClient retries with
+        reconnect + seeded backoff (`FLAGS_ps_retries`) and — because
+        every push carries a per-client sequence number the server
+        dedups — a retried sparse push applies EXACTLY once.  When the
+        retry budget is exhausted, training enters bounded degraded
+        mode (hot-shard-only steps, `sparse.host_lag_steps` gauge)
+        instead of wedging;
+      * terminal (protocol violation: bad magic, frame past
+        `FLAGS_ps_max_frame_mb`, exhausted degraded-mode budget past
+        `FLAGS_max_host_lag_steps`): retrying cannot help — the wire is
+        corrupt or the contract is broken.
+
+    `op` is the protocol op ("pull"/"push"/"create"/"fetch"/...),
+    `endpoint` the pserver address, `errno` the OS code when an OSError
+    is behind it."""
+
+    def __init__(self, message: str, *, op: Optional[str] = None,
+                 endpoint: Optional[str] = None,
+                 errno: Optional[int] = None,
+                 transient: Optional[bool] = None, **kw):
+        kw.setdefault("phase", "pserver")
+        super().__init__(message, **kw)
+        self.op = op
+        self.endpoint = endpoint
+        self.errno = errno
+        if transient is None:
+            transient = errno in TRANSIENT_PS_ERRNOS
+        self.transient = bool(transient)
+
+    def __str__(self):
+        base = super().__str__()
+        ctx = []
+        if self.op:
+            ctx.append(f"op={self.op}")
+        if self.errno is not None:
+            ctx.append(f"errno={_errno.errorcode.get(self.errno, self.errno)}")
+        ctx.append("transient" if self.transient else "terminal")
+        if self.endpoint:
+            ctx.append(f"endpoint={self.endpoint}")
         return f"{base} [{', '.join(ctx)}]"
 
 
@@ -481,6 +543,10 @@ def get_context(exc: BaseException) -> dict:
     return ctx
 
 
+def _eno_of(exc: BaseException) -> Optional[int]:
+    return getattr(exc, "errno", None) if isinstance(exc, OSError) else None
+
+
 def classify(exc: BaseException, wrap_unknown: bool = False) -> BaseException:
     """Map an exception onto the taxonomy.
 
@@ -515,6 +581,20 @@ def classify(exc: BaseException, wrap_unknown: bool = False) -> BaseException:
             if code in msg:
                 kw.pop("phase", None)
                 return _wrap(TransientDeviceError, code=code, phase="device")
+    # Parameter-server failures (ISSUE 19): an exception that crossed the
+    # KVClient seam carries phase="pserver" and maps onto the pserver
+    # transient/terminal split.  Checked BEFORE storage: a socket
+    # ETIMEDOUT shares an errno with the transient STORAGE set, but the
+    # verdict (retry the RPC / enter degraded sparse mode) belongs to the
+    # pserver tier, not the checkpoint store.
+    if ctx.get("phase") == "pserver" and isinstance(
+            exc, (OSError, TimeoutError)):
+        kw.pop("phase", None)
+        transient = (isinstance(exc, TimeoutError)
+                     or _eno_of(exc) in TRANSIENT_PS_ERRNOS
+                     or isinstance(exc, ConnectionError))
+        return _wrap(ParamServerError, errno=_eno_of(exc),
+                     transient=transient, phase="pserver")
     # Storage-layer failures (ISSUE 15): an OSError that crossed the io.py
     # choke point carries phase="storage" and maps by errno onto the
     # transient/terminal split.  Checked BEFORE the loader breadcrumb so a

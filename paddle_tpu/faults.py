@@ -111,6 +111,24 @@ mode; real heartbeat-store failures still go loud via
                           milliseconds first (storage latency spike),
                           then proceeds
 
+host-tier entries (ISSUE 19) target the supervised parameter server of
+an online-learning run (`set_pserver(supervisor)` registers the live
+handle; entries stay pending without one):
+
+    kill_pserver@S        SIGKILL the pserver CHILD PROCESS at dispatch
+                          of train step S — the supervisor must respawn
+                          it (journal recovery, bit-identical) and
+                          KVClient's retry loop must ride out the gap
+    stall_pserver@S:SECS  SIGSTOP the pserver child for SECS at dispatch
+                          of step S: beats stop, FleetHealth declares it
+                          dead past the deadline, the supervisor
+                          kill+respawns (the wedged-not-dead mode)
+    rot_row@N             flip a payload byte of a SelectedRows VALUES
+                          shard of the Nth COMMITTED snapshot
+                          (`on_commit`, like rot_shard) — the flipped
+                          row is finite and silent; the publish ladder's
+                          sparse digest rung must quarantine it
+
     e.g.  FLAGS_fault_spec="bad_batch@2;nan@5;device@7:RESOURCE_EXHAUSTED;preempt@11"
           FLAGS_fault_spec="kill_worker@3:1;stall_worker@6:0:0.2"
           FLAGS_fault_spec="flip_bit@5:1;rot_shard@0"
@@ -144,7 +162,8 @@ _KINDS = ("bad_batch", "nan", "device", "preempt",
           "kill_worker", "stall_worker",
           "corrupt_chunk", "truncated_file",
           "flip_bit", "rot_shard",
-          "enospc", "eio", "slow_io", "ro_fs")
+          "enospc", "eio", "slow_io", "ro_fs",
+          "kill_pserver", "stall_pserver", "rot_row")
 # entries that only fire in the worker whose rank matches their arg
 # (flip_bit is rank-gated too, but its rank is OPTIONAL — handled via
 # target_rank, which answers None for the rankless single-process form)
@@ -173,7 +192,17 @@ _FILE_KINDS = ("corrupt_chunk", "truncated_file")
 # whose failed save triggered the restart, and a fault that re-fires
 # forever would starve the run of checkpoints
 _LEDGER_KINDS = _RANKED_KINDS + _FILE_KINDS \
-    + ("flip_bit", "rot_shard") + _STORAGE_KINDS
+    + ("flip_bit", "rot_shard", "rot_row",
+       "kill_pserver", "stall_pserver") + _STORAGE_KINDS
+# host-tier chaos (ISSUE 19): these need a live handle on the pserver's
+# supervisor (`set_pserver`) — kill_pserver@S SIGKILLs the pserver child
+# at dispatch of step S (the supervisor must respawn it and KVClient's
+# retry loop must ride the gap out); stall_pserver@S:SECS SIGSTOPs it
+# for SECS (beats stop, FleetHealth declares it dead, the supervisor
+# kill+respawns); rot_row@N flips a byte inside a SelectedRows VALUES
+# shard of the Nth committed snapshot (on_commit, like rot_shard) — the
+# publish ladder's sparse rung must quarantine it
+_PSERVER_KINDS = ("kill_pserver", "stall_pserver")
 
 
 @dataclass
@@ -211,6 +240,11 @@ class Fault:
     @property
     def slow_ms(self) -> float:
         assert self.kind == "slow_io"
+        return float(self.arg)
+
+    @property
+    def pserver_stall_s(self) -> float:
+        assert self.kind == "stall_pserver"
         return float(self.arg)
 
 
@@ -269,6 +303,18 @@ def parse_fault_spec(spec: str) -> List[Fault]:
             if not ok:
                 raise ValueError(f"fault spec entry {entry!r}: want "
                                  f"slow_io@OP_INDEX:MILLISECONDS")
+        elif kind in ("kill_pserver", "rot_row"):
+            if arg is not None:
+                raise ValueError(f"fault spec entry {entry!r}: want "
+                                 f"{kind}@{'STEP' if kind == 'kill_pserver' else 'COMMIT_INDEX'} (no extra arg)")
+        elif kind == "stall_pserver":
+            try:
+                ok = arg is not None and float(arg) > 0
+            except ValueError:
+                ok = False
+            if not ok:
+                raise ValueError(f"fault spec entry {entry!r}: want "
+                                 f"stall_pserver@STEP:SECONDS")
         faults.append(f)
     return faults
 
@@ -325,8 +371,12 @@ class FaultInjector:
             os.environ.get("PADDLE_TRAINER_ID", "0"))
         # once-per-gang ledger for ranked entries (survives gang restarts)
         self.state_dir = os.environ.get("PADDLE_FAULT_STATE_DIR")
-        # rot_shard@N counts COMMITTED checkpoints this injector observed
+        # rot_shard@N / rot_row@N count COMMITTED checkpoints/snapshots
+        # this injector observed
         self._commits = 0
+        # kill_pserver/stall_pserver need a live supervisor handle
+        # (set_pserver); entries stay pending until one is registered
+        self._pserver = None
         # storage faults: the train step the loop is currently inside
         # (on_dispatch/set_step maintain it; -1 = no step dispatched yet,
         # so step-window entries stay dormant outside a training loop
@@ -524,7 +574,8 @@ class FaultInjector:
         idx = self._commits
         self._commits += 1
         for f in self.faults:
-            if f.kind != "rot_shard" or f.at != idx or f.fired:
+            if f.kind not in ("rot_shard", "rot_row") or f.at != idx \
+                    or f.fired:
                 continue
             marker = self._ranked_marker(f)
             if marker is not None and os.path.exists(marker):
@@ -546,13 +597,18 @@ class FaultInjector:
                     continue
             if self._rot_one_shard(ckpt_dir, f):
                 f.fired = True
-                _MON.counter("faults.rot_shard").inc()
+                _MON.counter(f"faults.{f.kind}").inc()
         return ckpt_dir
 
     def _rot_one_shard(self, ckpt_dir: str, f: Fault) -> bool:
-        """Flip one payload byte of the first shard file (sorted order)."""
+        """Flip one payload byte of the first shard file (sorted order).
+        rot_shard takes any .npy; rot_row targets a SelectedRows VALUES
+        shard (`*.vals.*.npy` — the embedding rows of the sparse tier),
+        the silent flipped-row the publish ladder's sparse rung must
+        catch by digest."""
         shards = sorted(n for n in os.listdir(ckpt_dir)
-                        if n.endswith(".npy"))
+                        if n.endswith(".npy")
+                        and (f.kind != "rot_row" or ".vals." in n))
         if not shards:
             return False
         path = os.path.join(ckpt_dir, shards[0])
@@ -722,3 +778,24 @@ class FaultInjector:
         if f is not None:
             _MON.counter("faults.stall_seconds").inc(int(f.stall_s))
             time.sleep(f.stall_s)
+        # host-tier chaos (ISSUE 19): only claimable once a supervisor is
+        # registered — without one the entries stay pending, same contract
+        # as a step index never reached
+        if self._pserver is not None:
+            f = self._take("kill_pserver", step)
+            if f is not None:
+                print(f"faults: kill_pserver@{step} firing (SIGKILL on the "
+                      f"pserver child)", file=sys.stderr, flush=True)
+                self._pserver.kill()
+            f = self._take("stall_pserver", step)
+            if f is not None:
+                print(f"faults: stall_pserver@{step} firing (SIGSTOP "
+                      f"{f.pserver_stall_s}s)", file=sys.stderr, flush=True)
+                self._pserver.stall(f.pserver_stall_s)
+
+    def set_pserver(self, supervisor) -> "FaultInjector":
+        """Register the PServerSupervisor the kill_pserver/stall_pserver
+        entries act on (anything with .kill() / .stall(seconds) works).
+        Returns self for chaining."""
+        self._pserver = supervisor
+        return self

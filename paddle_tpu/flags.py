@@ -320,6 +320,55 @@ DEFINE_float("FLAGS_lock_timeout_s", 0.0,
              "AND every lock the thread holds (with declared ranks) "
              "instead of hanging the worker forever — a deadlock dies "
              "loudly and attributable.  0 (default) = no deadline")
+DEFINE_float("FLAGS_ps_timeout_s", 10.0,
+             "socket deadline on every parameter-server RPC "
+             "(paddle_tpu/param_server.py): connect/send/recv past it "
+             "raise a classified TRANSIENT errors.ParamServerError the "
+             "KVClient retries with reconnect + backoff instead of "
+             "wedging training on a dead pserver forever.  0 = no "
+             "deadline (the pre-hardening behavior)")
+DEFINE_int("FLAGS_ps_retries", 5,
+           "KVClient retry budget per RPC (paddle_tpu/param_server.py): "
+           "transient ParamServerErrors (timeout, connection refused/"
+           "reset while the supervisor restarts the pserver) retry with "
+           "seeded exponential backoff up to this many attempts; pushes "
+           "carry per-client sequence numbers so a retried push applies "
+           "EXACTLY once server-side.  Exhausting the budget raises the "
+           "last error terminal")
+DEFINE_int("FLAGS_ps_max_frame_mb", 256,
+           "frame-size cap on the pserver wire protocol "
+           "(paddle_tpu/param_server.py): a length prefix past the cap "
+           "is a corrupt/hostile frame and raises a terminal classified "
+           "ParamServerError instead of mallocing unbounded on either "
+           "end of the socket")
+DEFINE_int("FLAGS_ps_snapshot_every_ops", 256,
+           "pserver durability cadence (paddle_tpu/param_server.py): a "
+           "full table snapshot commits through the io.py atomic choke "
+           "point every N journaled mutating ops; between snapshots the "
+           "write-ahead op journal alone replays a crash-restarted "
+           "pserver back to bit-identical tables.  0 = journal-only "
+           "(snapshot only at stop())")
+DEFINE_int("FLAGS_max_host_lag_steps", 0,
+           "degraded-mode bound for the host sparse tier "
+           "(paddle_tpu/parallel/embedding.py): the maximum number of "
+           "consecutive steps training may run hot-shard-only (zero "
+           "cold-tail rows, stale host tables) while the pserver is "
+           "down.  Past the bound the next lookup raises a TERMINAL "
+           "classified errors.ParamServerError — online learning cannot "
+           "silently diverge from its cold tail forever.  0 (default) = "
+           "unbounded degraded mode (the sparse.host_lag_steps gauge "
+           "and host_tier_degraded events still go loud; gate them with "
+           "perf_report --check --max-host-lag-steps)")
+DEFINE_int("FLAGS_publish_period_steps", 0,
+           "online-learning publish cadence (paddle_tpu/resilience.py): "
+           "resilient_train_loop calls its publish hook every N steps, "
+           "maintaining the serving.publish_staleness_steps gauge "
+           "(trained step minus last successfully published step).  A "
+           "transient storage failure inside the hook is absorbed "
+           "(staleness grows, cadence resumes at the next period); "
+           "content failures (quarantined snapshot) propagate.  0 "
+           "(default) = no publish hook; gate the staleness with "
+           "perf_report --check --max-publish-staleness-steps")
 DEFINE_bool("FLAGS_cudnn_deterministic", True,
             "accepted no-op: XLA TPU lowerings are deterministic by default")
 DEFINE_float("FLAGS_fraction_of_gpu_memory_to_use", 1.0,

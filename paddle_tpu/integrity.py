@@ -54,7 +54,8 @@ file_mismatches / divergences / ckpt_rejected / rollbacks` counters,
 """
 from __future__ import annotations
 
-__all__ = ["StateDigester", "state_digest", "file_sha256",
+__all__ = ["StateDigester", "state_digest", "sparse_state_digest",
+           "check_selected_rows", "file_sha256",
            "verify_file_entry", "verify_manifest_digests",
            "scan_snapshot_dir", "observe_gang", "current_payload",
            "flag_divergence", "arm_live_digests", "disarm_live_digests",
@@ -325,6 +326,56 @@ def state_digest(scope, var_names: Optional[Sequence[str]] = None) -> str:
         if v is not None:
             _digest_var(h, name, v)
     return h.hexdigest()
+
+
+def sparse_state_digest(scope, var_names: Optional[Sequence[str]] = None):
+    """Content digest over ONLY the SelectedRows vars of a scope (name
+    order), or None when it holds no sparse state.  This is the sparse
+    tier's identity across the publish/load boundary (ISSUE 19): the
+    publisher stamps it on publish events, every loader that
+    rematerializes the snapshot recomputes it, and serve_trace's fleet
+    check reconciles the two — a torn or rotted sparse snapshot shows up
+    as ranks disagreeing about a digest, exactly like dense SDC."""
+    from .core.selected_rows import SelectedRows
+
+    names = sorted(var_names if var_names is not None
+                   else scope.local_var_names())
+    h = hashlib.sha256()
+    found = False
+    for name in names:
+        v = scope.find_var(name)
+        if isinstance(v, SelectedRows):
+            found = True
+            _digest_var(h, name, v)
+    return h.hexdigest() if found else None
+
+
+def check_selected_rows(name: str, sr) -> Optional[str]:
+    """Structural + numeric validation of one SelectedRows — the publish
+    ladder's sparse rung (ISSUE 19).  Returns a human-readable defect
+    description, or None when the shard is sound: row ids must be
+    integral, strictly increasing (the consolidated-snapshot invariant
+    `consolidate_selected_rows` establishes — a duplicate or disordered
+    id means a torn merge), in [0, height), and every value finite."""
+    rows = np.asarray(sr.rows)
+    values = np.asarray(sr.values)
+    if rows.dtype.kind not in "iu":
+        return f"{name}: row ids have non-integer dtype {rows.dtype}"
+    if rows.ndim != 1 or rows.shape[0] != values.shape[0]:
+        return (f"{name}: {rows.shape[0] if rows.ndim == 1 else rows.shape} "
+                f"row ids for {values.shape[0]} value rows")
+    if rows.size:
+        if int(rows.min()) < 0 or int(rows.max()) >= int(sr.height):
+            return (f"{name}: row id range [{rows.min()}, {rows.max()}] "
+                    f"outside [0, {sr.height})")
+        if rows.size > 1 and not bool(np.all(np.diff(rows) > 0)):
+            return f"{name}: row ids not strictly increasing (torn merge?)"
+    if values.dtype.kind == "f" and values.size \
+            and not bool(np.isfinite(np.asarray(values, np.float64)).all()):
+        bad = int(np.size(values) - np.isfinite(
+            np.asarray(values, np.float64)).sum())
+        return f"{name}: {bad} non-finite value element(s)"
+    return None
 
 
 class StateDigester:

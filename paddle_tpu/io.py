@@ -129,6 +129,45 @@ def atomic_write(path: str, data, *, fsync: bool = True):
     _atomic_commit(path, mode, lambda f: f.write(data), fsync=fsync)
 
 
+def append_record(path: str, data: bytes, *, fsync: bool = True):
+    """Append one length-prefixed record to a write-ahead journal file
+    through the choke point (the pserver op journal, ISSUE 19).  The
+    record is framed (u32 LE length + payload) and fsynced before the
+    caller may apply the op it describes, so a crash leaves at most one
+    torn TAIL record — which `read_journal` detects by its length prefix
+    and drops, never replaying garbage.  Appends are NOT atomic renames
+    (a journal's whole point is cheap incremental durability); the
+    framing is what makes a torn append recoverable."""
+    import struct
+
+    _gate("write", path)
+    try:
+        with open(path, "ab") as f:
+            f.write(struct.pack("<I", len(data)) + data)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+    except OSError as e:
+        raise _storage_ctx(e)
+
+
+def read_journal(path: str):
+    """Yield each intact record `append_record` wrote to `path`, in
+    order; a torn tail (crash mid-append) is dropped silently — every
+    record BEFORE it was fsynced whole."""
+    import struct
+
+    with open_for_read(path, "rb") as f:
+        buf = f.read()
+    off = 0
+    while off + 4 <= len(buf):
+        (n,) = struct.unpack_from("<I", buf, off)
+        if off + 4 + n > len(buf):
+            break  # torn tail: the crash interrupted this append
+        yield buf[off + 4:off + 4 + n]
+        off += 4 + n
+
+
 def save_array(path: str, arr) -> Optional[str]:
     """Atomic .npy write through the choke point; returns the `stored_as`
     tag (bfloat16 and other ml_dtypes don't round-trip through np.load's

@@ -12,3 +12,4 @@ from .mesh import make_mesh  # noqa: F401
 from . import distributed  # noqa: F401
 from .distributed import init_distributed  # noqa: F401
 from .sharding import shard_parameters  # noqa: F401
+from .embedding import TieredEmbedding  # noqa: F401

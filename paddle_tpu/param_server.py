@@ -13,23 +13,74 @@ a length-prefixed binary protocol over TCP sockets (no gRPC in the image);
 the server applies the optimizer row-update itself (SGD/Adagrad), which is
 exactly the listen_and_serv optimize-block role.
 
+Fault hardening (ISSUE 19) — the tier is a supervised, survivable,
+integrity-checked service:
+
+  * every socket carries a deadline (`FLAGS_ps_timeout_s`) and every
+    failure classifies onto `errors.ParamServerError` with the same
+    transient/terminal split `StorageError` has;
+  * `KVClient` retries transient failures with reconnect + seeded
+    backoff (`FLAGS_ps_retries`); pushes carry a per-client sequence
+    number the server dedups, so a retried push — the reply was lost,
+    not the apply — lands EXACTLY once;
+  * frames are capped (`FLAGS_ps_max_frame_mb`): a corrupt length
+    prefix raises terminal instead of mallocing unbounded;
+  * with a `snapshot_dir` the server is DURABLE: every mutating op is
+    write-ahead journaled (`io.append_record`, fsynced before apply)
+    and tables snapshot through the io.py atomic choke point every
+    `FLAGS_ps_snapshot_every_ops` ops; a crash-restarted server
+    recovers tables, accumulators, and the dedup map bit-identical;
+  * `PServerSupervisor` crash-restarts the server process under a
+    restart budget, reusing the PR-18 `ReplicaBeat`/`FleetHealth`
+    liveness plane — a SIGKILLed or wedged pserver comes back inside
+    one health deadline, and the client's retry loop rides it out.
+
 Use with the SelectedRows machinery: run the device program with the
 pulled rows as a feed, read the lookup's SelectedRows gradient, push it.
-`HostTableEmbedding` below packages that loop.
+`HostTableEmbedding` below packages that loop (and its bounded degraded
+mode while the tier is down).
 """
 from __future__ import annotations
 
+import hashlib
+import json
+import os
+import signal
 import socket
 import socketserver
 import struct
+import subprocess
+import sys
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
 
 from .core import locks
+from .errors import (ParamServerError, StorageError, TRANSIENT_PS_ERRNOS,
+                     attach_context, classify)
+from .flags import flag as _flag
+from .monitor import MONITOR as _MON, record_fleet_event
+
+__all__ = ["ParameterServer", "KVClient", "PServerSupervisor",
+           "HostTableEmbedding", "AsyncCommunicator"]
 
 _MAGIC = b"PTPS"
+
+# snapshot/journal layout inside a server's snapshot_dir
+PS_MANIFEST = "__ps_manifest__.json"
+PS_COMMITTED = "PS_COMMITTED"
+
+
+def _max_frame_bytes() -> int:
+    mb = _flag("FLAGS_ps_max_frame_mb")
+    return int(float(mb or 256) * (1 << 20))
+
+
+def _timeout_s() -> Optional[float]:
+    t = float(_flag("FLAGS_ps_timeout_s") or 0.0)
+    return t if t > 0 else None
 
 
 def _merge_rows(ids: np.ndarray, grads: np.ndarray):
@@ -42,6 +93,12 @@ def _merge_rows(ids: np.ndarray, grads: np.ndarray):
 
 
 def _send_msg(sock, op: bytes, payload: bytes):
+    cap = _max_frame_bytes()
+    if len(payload) > cap:
+        raise ParamServerError(
+            f"refusing to send a {len(payload)}-byte frame past the "
+            f"FLAGS_ps_max_frame_mb cap ({cap} bytes) — split the push "
+            f"or raise the cap", transient=False)
     sock.sendall(_MAGIC + op + struct.pack("<Q", len(payload)) + payload)
 
 
@@ -58,9 +115,20 @@ def _recv_exact(sock, n: int) -> bytes:
 def _recv_msg(sock) -> Tuple[bytes, bytes]:
     head = _recv_exact(sock, 13)
     if head[:4] != _MAGIC:
-        raise ValueError("parameter server: bad magic")
+        raise ParamServerError(
+            "parameter server: bad magic — the stream is corrupt or "
+            "something other than a pserver peer wrote to this socket",
+            transient=False)
     op = head[4:5]
     (n,) = struct.unpack("<Q", head[5:13])
+    cap = _max_frame_bytes()
+    if n > cap:
+        # a corrupt length prefix must never malloc unbounded; past this
+        # point the stream is unsynchronized, so the connection dies too
+        raise ParamServerError(
+            f"parameter server: frame length {n} exceeds the "
+            f"FLAGS_ps_max_frame_mb cap ({cap} bytes) — corrupt length "
+            f"prefix", transient=False)
     return op, _recv_exact(sock, n)
 
 
@@ -88,24 +156,62 @@ def _unpack_arr(b: bytes, off: int = 0):
 class ParameterServer:
     """Row-sharded host table server (one shard per server process/port).
 
-    Protocol ops: b"P" pull(name, ids) -> rows; b"G" push(name, ids, grads)
-    applying the configured row update; b"C" create(name, array);
-    b"F" fetch full table (checkpointing); b"Q" shutdown."""
+    Protocol ops: b"P" pull(name, ids) -> rows; b"G" push(name, ids,
+    grads) applying the configured row update; b"S" sequenced push
+    (client id + seq prefix, deduped server-side for exactly-once);
+    b"C" create(name, array); b"F" fetch full table (checkpointing);
+    b"D" content digest of a table (+ accumulator); b"Q" shutdown.
+
+    With `snapshot_dir`, mutating ops (C/G/S) are write-ahead journaled
+    and the tables snapshot every `snapshot_every_ops` mutations; a
+    fresh server over the same dir recovers bit-identical state."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 optimizer: str = "sgd", lr: float = 0.1):
+                 optimizer: str = "sgd", lr: float = 0.1,
+                 snapshot_dir: Optional[str] = None,
+                 snapshot_every_ops: Optional[int] = None):
         self.tables: Dict[str, np.ndarray] = {}
         self.accums: Dict[str, np.ndarray] = {}
         self.optimizer = optimizer
         self.lr = lr
         self._lock = locks.named_lock("ps.tables", rank=34)
+        # durability state (all mutated under ps.tables): the WAL the
+        # choke point fsyncs before each apply, the total mutating-op
+        # count (snapshot cadence + journal file naming), and the
+        # per-client last-applied sequence map (exactly-once)
+        self.snapshot_dir = snapshot_dir
+        self._snap_every = (int(_flag("FLAGS_ps_snapshot_every_ops") or 0)
+                            if snapshot_every_ops is None
+                            else int(snapshot_every_ops))
+        self.op_count = 0
+        self.applied: Dict[str, int] = {}
+        self._journal_path: Optional[str] = None
+        if snapshot_dir:
+            os.makedirs(snapshot_dir, exist_ok=True)
+            self._recover()
+            if self._journal_path is None:
+                self._journal_path = os.path.join(
+                    snapshot_dir, f"journal-{self.op_count}.log")
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 try:
                     while True:
-                        op, payload = _recv_msg(self.request)
+                        try:
+                            op, payload = _recv_msg(self.request)
+                        except ParamServerError as e:
+                            # protocol violation: the stream is
+                            # unsynchronized — reply best-effort, drop
+                            # the connection (never malloc the frame)
+                            _MON.counter("ps.frame_rejects").inc()
+                            try:
+                                _send_msg(self.request, b"e",
+                                          f"{type(e).__name__}: {e}"
+                                          .encode())
+                            except OSError:
+                                pass
+                            return
                         if op == b"Q":
                             _send_msg(self.request, b"q", b"")
                             outer._srv.shutdown()
@@ -128,40 +234,225 @@ class ParameterServer:
         self.endpoint = f"{self._srv.server_address[0]}:{self._srv.server_address[1]}"
         self._thread: Optional[threading.Thread] = None
 
+    # -- durability --------------------------------------------------------
+    def _journal(self, op: bytes, payload: bytes):
+        """Write-ahead: the op is durable BEFORE it applies, so replay
+        after a crash reproduces exactly the applies that happened (plus
+        at most the one the crash interrupted — whose client never got a
+        reply and will retry, deduped by its sequence number).  A failing
+        journal write (injected ENOSPC, full disk) degrades durability,
+        never availability: counted + recorded, the op still applies."""
+        if self._journal_path is None:
+            return
+        from . import io as _io
+
+        try:
+            _io.append_record(self._journal_path, op + payload)
+        except (OSError, StorageError) as e:
+            _MON.counter("ps.journal_errors").inc()
+            _MON.record_step({"kind": "sparse_event",
+                              "action": "ps_journal_degraded",
+                              "detail": f"{type(e).__name__}: {e}"})
+
+    def _snapshot_locked(self):
+        """Commit a full table snapshot through the io.py atomic choke
+        point: per-table .npy payloads, a digest-stamped manifest, and a
+        COMMITTED marker last — torn snapshots are invisible to
+        recovery.  Old snapshots/journals are pruned after commit."""
+        from . import integrity as _integrity
+        from . import io as _io
+
+        snap = os.path.join(self.snapshot_dir, f"snap-{self.op_count}")
+        os.makedirs(snap, exist_ok=True)
+        entries = []
+        for name in sorted(self.tables):
+            safe = name.replace("/", "%2F")
+            tf, af = f"{safe}.table.npy", f"{safe}.accum.npy"
+            _io.save_array(os.path.join(snap, tf), self.tables[name])
+            _io.save_array(os.path.join(snap, af), self.accums[name])
+            entries.append({
+                "name": name, "table_file": tf, "accum_file": af,
+                "table_stamp": _integrity.stamp_file(os.path.join(snap, tf)),
+                "accum_stamp": _integrity.stamp_file(os.path.join(snap, af)),
+            })
+        _io.atomic_write(os.path.join(snap, PS_MANIFEST), json.dumps({
+            "op_count": self.op_count, "optimizer": self.optimizer,
+            "lr": self.lr, "applied": dict(self.applied),
+            "tables": entries}, indent=1))
+        _io.atomic_write(os.path.join(snap, PS_COMMITTED), "")
+        _MON.counter("ps.snapshots").inc()
+        # prune: everything older than the snapshot just committed is
+        # re-derivable from it (best-effort — a failed unlink costs disk,
+        # not correctness)
+        self._journal_path = os.path.join(
+            self.snapshot_dir, f"journal-{self.op_count}.log")
+        import glob as _glob
+        import shutil
+
+        for jp in _glob.glob(os.path.join(self.snapshot_dir, "journal-*.log")):
+            try:
+                if int(os.path.basename(jp)[8:-4]) < self.op_count:
+                    os.remove(jp)
+            except (ValueError, OSError):
+                pass
+        for sp in _glob.glob(os.path.join(self.snapshot_dir, "snap-*")):
+            try:
+                if int(os.path.basename(sp)[5:]) < self.op_count:
+                    shutil.rmtree(sp, ignore_errors=True)
+            except ValueError:
+                pass
+
+    def snapshot(self):
+        """Force a snapshot commit now (stop() does this; tests too)."""
+        if not self.snapshot_dir:
+            return
+        with self._lock:  # lock-ok: the stop-the-world snapshot IS the consistency cut — mutating ops must not interleave with table serialization, and pruning the superseded snap rides the same cut
+            try:
+                self._snapshot_locked()
+            except (OSError, StorageError) as e:
+                _MON.counter("ps.snapshot_errors").inc()
+                _MON.record_step({"kind": "sparse_event",
+                                  "action": "ps_snapshot_failed",
+                                  "detail": f"{type(e).__name__}: {e}"})
+
+    def _recover(self):
+        """Rebuild tables/accums/dedup map from the newest COMMITTED
+        snapshot plus every journaled op after it — bit-identical to the
+        state the dead server had applied."""
+        from . import integrity as _integrity
+        from . import io as _io
+        import glob as _glob
+
+        snaps = []
+        for sp in _glob.glob(os.path.join(self.snapshot_dir, "snap-*")):
+            if os.path.exists(os.path.join(sp, PS_COMMITTED)):
+                try:
+                    snaps.append((int(os.path.basename(sp)[5:]), sp))
+                except ValueError:
+                    pass
+        base = 0
+        if snaps:
+            base, snap = max(snaps)
+            man = _io.read_json(os.path.join(snap, PS_MANIFEST))
+            for e in man["tables"]:
+                # a flipped byte in a host-tier table at rest must fail
+                # the recovery, never serve (same contract as checkpoint
+                # shards): verify the manifest stamps before use
+                _integrity.verify_file_entry(
+                    snap, e["table_file"], e["table_stamp"]["sha256"],
+                    e["table_stamp"]["bytes"])
+                _integrity.verify_file_entry(
+                    snap, e["accum_file"], e["accum_stamp"]["sha256"],
+                    e["accum_stamp"]["bytes"])
+                self.tables[e["name"]] = np.array(
+                    _io.load_array(os.path.join(snap, e["table_file"])))
+                self.accums[e["name"]] = np.array(
+                    _io.load_array(os.path.join(snap, e["accum_file"])))
+            self.applied = {str(k): int(v)
+                            for k, v in man.get("applied", {}).items()}
+            self.op_count = int(man["op_count"])
+        journals = []
+        for jp in _glob.glob(os.path.join(self.snapshot_dir, "journal-*.log")):
+            try:
+                start = int(os.path.basename(jp)[8:-4])
+            except ValueError:
+                continue
+            if start >= base:
+                journals.append((start, jp))
+        replayed = 0
+        for _start, jp in sorted(journals):
+            self._journal_path = jp
+            for rec in _io.read_journal(jp):
+                self._apply(rec[:1], rec[1:], journal=False)
+                replayed += 1
+        if snaps or replayed:
+            _MON.counter("ps.recoveries").inc()
+            _MON.record_step({"kind": "sparse_event",
+                              "action": "ps_recovered",
+                              "snapshot_ops": base, "replayed": replayed,
+                              "op_count": self.op_count})
+
+    def table_digest(self, name: str) -> str:
+        """sha256 over the table + accumulator bytes (+ shape/dtype) —
+        the host-tier content digest the integrity story compares across
+        a crash-restart or against a snapshot."""
+        with self._lock:
+            t, a = self.tables[name], self.accums[name]
+            h = hashlib.sha256()
+            for arr in (t, a):
+                h.update(str(arr.dtype).encode())
+                h.update(str(arr.shape).encode())
+                h.update(np.ascontiguousarray(arr).tobytes())
+            return h.hexdigest()
+
     # -- server-side ops ---------------------------------------------------
+    def _apply(self, op: bytes, payload: bytes, journal: bool = True) -> bytes:
+        """One mutating op, under ps.tables: journal (write-ahead), then
+        apply, then bump the op count / dedup map.  `journal=False` is
+        the recovery replay (the record is already durable)."""
+        (nl,) = struct.unpack_from("<I", payload, 0)
+        name = payload[4:4 + nl].decode()
+        off = 4 + nl
+        cid = seq = None
+        if op == b"S":
+            cid_raw, seq = struct.unpack_from("<QQ", payload, off)
+            cid = f"{cid_raw:016x}"
+            off += 16
+            if seq <= self.applied.get(cid, -1):
+                # the apply happened; the REPLY died with the old socket.
+                # Exactly-once is this branch.
+                _MON.counter("ps.push_dedup").inc()
+                return b""
+        if journal:
+            self._journal(op, payload)
+        if op == b"C":
+            arr, _ = _unpack_arr(payload, off)
+            self.tables[name] = np.array(arr)
+            self.accums[name] = np.zeros_like(self.tables[name])
+        else:  # b"G" / b"S": sparse row-gradient push
+            ids, off2 = _unpack_arr(payload, off)
+            grads, _ = _unpack_arr(payload, off2)
+            t = self.tables[name]
+            # MergeAdd first: duplicate rows sum BEFORE the accumulator
+            # update, or adagrad drifts
+            uniq, merged = _merge_rows(ids, grads)
+            if self.optimizer == "adagrad":
+                acc = self.accums[name]
+                acc[uniq] += merged * merged
+                t[uniq] += -self.lr * merged / (np.sqrt(acc[uniq]) + 1e-6)
+            else:  # sgd
+                t[uniq] += -self.lr * merged
+        if cid is not None:
+            self.applied[cid] = int(seq)
+        self.op_count += 1
+        if (journal and self.snapshot_dir and self._snap_every
+                and self.op_count % self._snap_every == 0):
+            try:
+                self._snapshot_locked()
+            except (OSError, StorageError) as e:
+                _MON.counter("ps.snapshot_errors").inc()
+                _MON.record_step({"kind": "sparse_event",
+                                  "action": "ps_snapshot_failed",
+                                  "detail": f"{type(e).__name__}: {e}"})
+        return b""
+
     def _dispatch(self, op: bytes, payload: bytes) -> bytes:
         (nl,) = struct.unpack_from("<I", payload, 0)
         name = payload[4:4 + nl].decode()
         off = 4 + nl
-        if op == b"C":
-            arr, _ = _unpack_arr(payload, off)
-            with self._lock:
-                self.tables[name] = np.array(arr)
-                self.accums[name] = np.zeros_like(self.tables[name])
-            return b""
+        if op in (b"C", b"G", b"S"):
+            with self._lock:  # lock-ok: the op-cadence snapshot inside _apply must commit AT the op_count boundary it names — releasing between apply and snapshot would let another mutation slip into the named cut
+                return self._apply(op, payload)
         if op == b"P":
             ids, _ = _unpack_arr(payload, off)
             with self._lock:
                 rows = self.tables[name][ids.astype(np.int64)]
             return _pack_arr(rows)
-        if op == b"G":
-            ids, off2 = _unpack_arr(payload, off)
-            grads, _ = _unpack_arr(payload, off2)
-            with self._lock:
-                t = self.tables[name]
-                # MergeAdd first: duplicate rows sum BEFORE the accumulator
-                # update, or adagrad drifts
-                uniq, merged = _merge_rows(ids, grads)
-                if self.optimizer == "adagrad":
-                    acc = self.accums[name]
-                    acc[uniq] += merged * merged
-                    t[uniq] += -self.lr * merged / (np.sqrt(acc[uniq]) + 1e-6)
-                else:  # sgd
-                    t[uniq] += -self.lr * merged
-            return b""
         if op == b"F":
             with self._lock:
                 return _pack_arr(self.tables[name])
+        if op == b"D":
+            return self.table_digest(name).encode()
         raise ValueError(f"parameter server: unknown op {op!r}")
 
     # -- lifecycle ---------------------------------------------------------
@@ -173,25 +464,110 @@ class ParameterServer:
     def stop(self):
         self._srv.shutdown()
         self._srv.server_close()
+        self.snapshot()
 
 
 class KVClient:
-    def __init__(self, endpoint: str):
-        host, port = endpoint.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)))
-        self._lock = locks.named_lock("ps.client", rank=36)
+    """Pserver RPC client with fault tolerance: socket deadlines
+    (`FLAGS_ps_timeout_s`), transparent reconnect + seeded-backoff retry
+    of transient failures (`FLAGS_ps_retries`), classified
+    `ParamServerError`s, and exactly-once pushes — every push carries
+    this client's id and a monotonically increasing sequence number the
+    server dedups, so a retry whose original APPLY landed (only the
+    reply died) is a no-op server-side."""
 
-    def _call(self, op: bytes, name: str, *arrays) -> bytes:
-        payload = struct.pack("<I", len(name)) + name.encode()
+    def __init__(self, endpoint: str, timeout_s: Optional[float] = None,
+                 retries: Optional[int] = None,
+                 backoff_base_s: float = 0.05, seed: int = 0):
+        host, port = endpoint.rsplit(":", 1)
+        self.endpoint = endpoint
+        self._addr = (host, int(port))
+        self._timeout = _timeout_s() if timeout_s is None else (
+            timeout_s if timeout_s > 0 else None)
+        self._retries = max(1, int(_flag("FLAGS_ps_retries") or 1)
+                            if retries is None else int(retries))
+        self._backoff = float(backoff_base_s)
+        self._rng = np.random.RandomState(seed)
+        # exactly-once identity: survives reconnects (same client object
+        # = same dedup stream); a NEW client is a new stream by design
+        self.client_id = int.from_bytes(os.urandom(8), "little")
+        self._seq = 0
+        self._lock = locks.named_lock("ps.client", rank=36)
+        self._sock: Optional[socket.socket] = None
+        with self._lock:  # lock-ok: connect is part of the serialized framed exchange (a second thread must not write frames to a half-connected socket); the FLAGS_ps_timeout_s deadline bounds the hold
+            self._connect_locked()
+
+    # -- wiring ------------------------------------------------------------
+    def _connect_locked(self):
+        self._sock = socket.create_connection(self._addr,
+                                              timeout=self._timeout)
+
+    def _close_locked(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _ps_error(self, exc: BaseException, op: str,
+                  attempts: int) -> ParamServerError:
+        attach_context(exc, phase="pserver")
+        e = classify(exc)
+        if not isinstance(e, ParamServerError):
+            e = ParamServerError(f"{type(exc).__name__}: {exc}")
+            e.__cause__ = exc
+        e.op = op
+        e.endpoint = self.endpoint
+        if attempts > 1 and e.transient:
+            e.args = (f"{e.args[0]} (after {attempts} attempts — is the "
+                      f"pserver's supervisor out of restart budget?)",)
+        return e
+
+    def _call(self, op: bytes, name: str, *arrays,
+              seq_prefix: bytes = b"") -> bytes:
+        opname = {b"P": "pull", b"G": "push", b"S": "push", b"C": "create",
+                  b"F": "fetch", b"D": "digest", b"Q": "shutdown"}.get(
+                      op, op.decode(errors="replace"))
+        payload = struct.pack("<I", len(name)) + name.encode() + seq_prefix
         for a in arrays:
             payload += _pack_arr(np.asarray(a))
-        with self._lock:  # lock-ok: one request/response exchange on one shared socket — serializing the framed protocol IS the lock's purpose (interleaved frames from two threads would corrupt the stream)
-            _send_msg(self._sock, op, payload)
-            rop, resp = _recv_msg(self._sock)
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                with self._lock:  # lock-ok: one request/response exchange on one shared socket — serializing the framed protocol IS the lock's purpose (interleaved frames from two threads would corrupt the stream)
+                    if self._sock is None:
+                        self._connect_locked()
+                    _send_msg(self._sock, op, payload)
+                    rop, resp = _recv_msg(self._sock)
+                break
+            except ParamServerError as e:
+                # protocol violation (bad magic / oversized frame): the
+                # stream is unsynchronized — terminal, connection dies
+                with self._lock:
+                    self._close_locked()
+                e.op, e.endpoint = opname, self.endpoint
+                raise
+            except (OSError, TimeoutError) as e:
+                with self._lock:
+                    self._close_locked()
+                pe = self._ps_error(e, opname, attempt)
+                if not pe.transient or attempt >= self._retries:
+                    raise pe from e
+                _MON.counter("ps.retries").inc()
+                # seeded exponential backoff with jitter, the
+                # RetryPolicy discipline: the supervisor needs a beat or
+                # two to notice the corpse and respawn
+                time.sleep(self._backoff * (2 ** (attempt - 1))
+                           * (0.5 + self._rng.rand()))
         if rop == b"e":
-            raise RuntimeError(f"parameter server error: {resp.decode()}")
+            raise ParamServerError(
+                f"parameter server error: {resp.decode()}", op=opname,
+                endpoint=self.endpoint, transient=False)
         return resp
 
+    # -- ops ---------------------------------------------------------------
     def create(self, name: str, array: np.ndarray):
         self._call(b"C", name, array)
 
@@ -200,13 +576,258 @@ class KVClient:
         return _unpack_arr(resp)[0]
 
     def push(self, name: str, ids: np.ndarray, grads: np.ndarray):
-        self._call(b"G", name, np.asarray(ids, np.int64), grads)
+        """Sequenced push: the sequence number is allocated ONCE per
+        logical push, before any wire attempt, so every retry of this
+        push carries the same one and the server applies it exactly
+        once no matter how many times the reply is lost."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        self._call(b"S", name, np.asarray(ids, np.int64), grads,
+                   seq_prefix=struct.pack("<QQ", self.client_id, seq))
 
     def fetch_table(self, name: str) -> np.ndarray:
         return _unpack_arr(self._call(b"F", name))[0]
 
+    def table_digest(self, name: str) -> str:
+        """Server-side content digest of table + accumulator — the
+        cross-restart / cross-snapshot integrity comparison point."""
+        return self._call(b"D", name).decode()
+
     def close(self):
-        self._sock.close()
+        with self._lock:
+            self._close_locked()
+
+
+# ---- supervised pserver process (ISSUE 19) ----------------------------------
+
+def _free_port(host: str) -> int:
+    s = socket.socket()
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class PServerSupervisor:
+    """Crash-restart supervision for a pserver PROCESS, the PR-18
+    replica-supervision pattern applied to the host tier: the server runs
+    as a child process (so a SIGKILL is survivable), writes `ReplicaBeat`
+    beats, and this supervisor's watch thread uses `FleetHealth` to
+    classify it — a dead OR wedged (beating stopped: SIGSTOP, hard hang)
+    child is killed and respawned under `max_restarts`, recovering its
+    tables from the journal.  The endpoint is FIXED across incarnations,
+    so `KVClient`'s reconnect-retry loop rides a restart out without any
+    coordination.  Past the budget the supervisor gives up loudly
+    (`pserver_give_up` fleet event) and clients fail into the embedding
+    tier's bounded degraded mode."""
+
+    def __init__(self, snapshot_dir: str, host: str = "127.0.0.1",
+                 port: int = 0, optimizer: str = "sgd", lr: float = 0.1,
+                 max_restarts: int = 3, poll_interval_s: float = 0.1,
+                 beat_interval_s: float = 0.2, miss_factor: float = 6.0,
+                 startup_grace_s: float = 60.0,
+                 snapshot_every_ops: Optional[int] = None):
+        from .dist_resilience import FleetHealth
+
+        self.snapshot_dir = snapshot_dir
+        os.makedirs(snapshot_dir, exist_ok=True)
+        self.host = host
+        self.port = port or _free_port(host)
+        self.endpoint = f"{host}:{self.port}"
+        self.optimizer, self.lr = optimizer, lr
+        self.max_restarts = int(max_restarts)
+        self.restarts = 0
+        self.failed = False
+        self._poll = float(poll_interval_s)
+        self._snap_every = snapshot_every_ops
+        self.hb_dir = os.path.join(snapshot_dir, "hb")
+        os.makedirs(self.hb_dir, exist_ok=True)
+        self._health = FleetHealth(self.hb_dir, world=1,
+                                   interval_s=beat_interval_s,
+                                   miss_factor=miss_factor,
+                                   startup_grace_s=startup_grace_s)
+        self._beat_interval = beat_interval_s
+        self._proc: Optional[subprocess.Popen] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = locks.named_lock("ps.supervisor", rank=28)
+
+    # -- child lifecycle ---------------------------------------------------
+    def _spawn_locked(self):
+        argv = [sys.executable, "-m", "paddle_tpu.param_server",
+                "--host", self.host, "--port", str(self.port),
+                "--optimizer", self.optimizer, "--lr", str(self.lr),
+                "--snapshot-dir", self.snapshot_dir,
+                "--hb-dir", self.hb_dir,
+                "--beat-interval-s", str(self._beat_interval)]
+        if self._snap_every is not None:
+            argv += ["--snapshot-every-ops", str(self._snap_every)]
+        env = dict(os.environ)
+        # the child is a host service: never let it grab a TPU, and keep
+        # any fault spec aimed at the TRAINING process out of it
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("FLAGS_fault_spec", None)
+        self._health.note_restart(0)
+        self._proc = subprocess.Popen(argv, env=env)
+        _MON.gauge("ps.supervisor_restarts").set(self.restarts)
+
+    def start(self) -> "PServerSupervisor":
+        with self._lock:  # lock-ok: child lifecycle transitions (spawn/kill/respawn) must serialize — that is this lock's whole purpose; nothing hot contends it
+            if self._proc is None:
+                self._spawn_locked()
+                record_fleet_event("pserver_started", endpoint=self.endpoint,
+                                   pid=self._proc.pid)
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._watch,
+                                            name="pt-ps-supervisor",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def wait_ready(self, timeout_s: float = 60.0):
+        """Block until the child's first beat lands (it is accepting
+        connections before beat 0 — the server binds before beating)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._health.poll()[0]["status"] in ("alive", "draining"):
+                return self
+            if self.failed:
+                break
+            time.sleep(self._poll)
+        raise ParamServerError(
+            f"pserver at {self.endpoint} never became ready within "
+            f"{timeout_s}s", endpoint=self.endpoint, transient=False)
+
+    def _watch(self):
+        while not self._stop.wait(self._poll):
+            with self._lock:  # lock-ok: the death-verdict + respawn sequence must be atomic against kill()/stop() (chaos hooks) or two incarnations could race for the fixed endpoint; the proc.wait is deadline-bounded
+                proc = self._proc
+                if proc is None or self.failed:
+                    continue
+                dead = proc.poll() is not None
+                stalled = (not dead
+                           and self._health.poll()[0]["status"] == "dead")
+                if not dead and not stalled:
+                    continue
+                reason = "exit" if dead else "stalled"
+                if stalled:
+                    # a wedged child (SIGSTOP, hard hang) is as gone as a
+                    # dead one: make the verdict physical, then respawn
+                    _MON.counter("ps.stall_kills").inc()
+                    try:
+                        proc.kill()
+                        proc.wait(timeout=10)
+                    except (OSError, subprocess.TimeoutExpired):
+                        pass
+                record_fleet_event("pserver_dead", endpoint=self.endpoint,
+                                   reason=reason, pid=proc.pid,
+                                   returncode=proc.returncode)
+                if self.restarts >= self.max_restarts:
+                    self.failed = True
+                    record_fleet_event("pserver_give_up",
+                                       endpoint=self.endpoint,
+                                       restarts=self.restarts)
+                    continue
+                self.restarts += 1
+                self._spawn_locked()
+                record_fleet_event("pserver_restarted",
+                                   endpoint=self.endpoint,
+                                   restarts=self.restarts,
+                                   pid=self._proc.pid)
+
+    # -- chaos hooks (paddle_tpu/faults.py kill_pserver / stall_pserver) ---
+    def kill(self, sig: int = signal.SIGKILL):
+        """SIGKILL the child (the kill_pserver chaos arm): the watch
+        thread notices the corpse within one poll and respawns it."""
+        with self._lock:
+            if self._proc is not None and self._proc.poll() is None:
+                try:
+                    os.kill(self._proc.pid, sig)
+                except OSError:
+                    pass
+
+    def stall(self, seconds: float):
+        """SIGSTOP the child for `seconds` (the stall_pserver chaos arm):
+        its beats stop, FleetHealth calls it dead past the deadline, and
+        the watch thread kill+respawns — a wedged pserver is not a
+        special case, it is a dead one that still holds a port."""
+        with self._lock:
+            proc = self._proc
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            os.kill(proc.pid, signal.SIGSTOP)
+        except OSError:
+            return
+
+        def _resume():
+            try:
+                os.kill(proc.pid, signal.SIGCONT)
+            except OSError:
+                pass
+
+        t = threading.Timer(seconds, _resume)
+        t.daemon = True
+        t.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        with self._lock:
+            proc, self._proc = self._proc, None
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+
+
+def _serve_main(argv=None) -> int:
+    """`python -m paddle_tpu.param_server`: the supervised child.  Runs a
+    ParameterServer (recovering from --snapshot-dir) plus a ReplicaBeat
+    the supervisor's FleetHealth watches; SIGTERM snapshots and exits."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="paddle_tpu.param_server")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--optimizer", default="sgd")
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--snapshot-dir", default=None)
+    ap.add_argument("--snapshot-every-ops", type=int, default=None)
+    ap.add_argument("--hb-dir", default=None)
+    ap.add_argument("--beat-interval-s", type=float, default=0.2)
+    args = ap.parse_args(argv)
+    srv = ParameterServer(args.host, args.port, args.optimizer, args.lr,
+                          snapshot_dir=args.snapshot_dir,
+                          snapshot_every_ops=args.snapshot_every_ops)
+    beat = None
+    if args.hb_dir:
+        from .dist_resilience import ReplicaBeat
+
+        beat = ReplicaBeat(
+            args.hb_dir, rank=0, world=1, interval_s=args.beat_interval_s,
+            payload_fn=lambda: {"ops": srv.op_count,
+                                "tables": sorted(srv.tables),
+                                "endpoint": srv.endpoint}).start()
+    done = threading.Event()
+
+    def _term(_sig, _frm):
+        done.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    srv.start()
+    done.wait()
+    if beat is not None:
+        beat.stop(mark_down=True)
+    srv.stop()
+    return 0
 
 
 class HostTableEmbedding:
@@ -216,20 +837,73 @@ class HostTableEmbedding:
     parameter_prefetch.cc flow).
 
     Per step: (unique_ids, local_ids) <- batch ids; rows <- pull;
-    run program with rows + local ids; push SelectedRows grad back."""
+    run program with rows + local ids; push SelectedRows grad back.
 
-    def __init__(self, client: KVClient, name: str, dim: int):
+    Degraded mode (ISSUE 19): with `degraded_ok=True`, a TRANSIENT
+    pserver failure (its supervisor is mid-restart, or out of budget)
+    does not wedge the step — `prepare_batch` serves ZERO rows for the
+    cold tail and `push_grad` drops the slab (counted), while the
+    `sparse.host_lag_steps` gauge tracks how many consecutive steps ran
+    degraded.  Past `FLAGS_max_host_lag_steps` (when set) the next
+    failure re-raises TERMINAL: online learning must not silently
+    diverge from its cold tail forever."""
+
+    def __init__(self, client: KVClient, name: str, dim: int,
+                 degraded_ok: bool = False):
         self.client = client
         self.name = name
         self.dim = dim
+        self.degraded_ok = bool(degraded_ok)
+        self.host_lag_steps = 0
+
+    def _degrade(self, e: ParamServerError, action: str):
+        if not (self.degraded_ok and e.transient):
+            raise e
+        self.host_lag_steps += 1
+        _MON.gauge("sparse.host_lag_steps").set(self.host_lag_steps)
+        _MON.counter("sparse.degraded_steps").inc()
+        _MON.record_step({"kind": "sparse_event",
+                          "action": "host_tier_degraded", "table": self.name,
+                          "during": action, "lag_steps": self.host_lag_steps,
+                          "detail": str(e)})
+        bound = int(_flag("FLAGS_max_host_lag_steps") or 0)
+        if bound and self.host_lag_steps > bound:
+            raise ParamServerError(
+                f"host table tier down for {self.host_lag_steps} "
+                f"consecutive degraded steps, past "
+                f"FLAGS_max_host_lag_steps={bound} — the cold tail of "
+                f"{self.name!r} has diverged too far to keep training",
+                op=action, endpoint=self.client.endpoint,
+                transient=False) from e
+
+    def _recovered(self):
+        if self.host_lag_steps:
+            _MON.record_step({"kind": "sparse_event",
+                              "action": "host_tier_recovered",
+                              "table": self.name,
+                              "lag_steps": self.host_lag_steps})
+        self.host_lag_steps = 0
+        _MON.gauge("sparse.host_lag_steps").set(0)
 
     def prepare_batch(self, ids: np.ndarray):
         uniq, local = np.unique(ids.reshape(-1), return_inverse=True)
-        rows = self.client.pull(self.name, uniq)
+        try:
+            rows = self.client.pull(self.name, uniq)
+            self._recovered()
+        except ParamServerError as e:
+            self._degrade(e, "pull")
+            rows = np.zeros((uniq.size, self.dim), np.float32)
         return uniq, local.reshape(ids.shape).astype(np.int64), rows
 
     def push_grad(self, uniq: np.ndarray, grad_rows: np.ndarray):
-        self.client.push(self.name, uniq, np.asarray(grad_rows))
+        try:
+            self.client.push(self.name, uniq, np.asarray(grad_rows))
+        except ParamServerError as e:
+            # a degraded step trains hot-shard-only: this slab is
+            # DROPPED, never queued — queueing would reorder against the
+            # sequenced stream and break the exactly-once story
+            self._degrade(e, "push")
+            _MON.counter("sparse.dropped_pushes").inc()
 
 
 class AsyncCommunicator:
@@ -310,3 +984,7 @@ class AsyncCommunicator:
         self._drain_one()
         if self._error is not None:
             raise RuntimeError("AsyncCommunicator sender died") from self._error
+
+
+if __name__ == "__main__":
+    sys.exit(_serve_main())

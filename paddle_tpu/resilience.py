@@ -166,6 +166,8 @@ class ResilienceStats:
     degraded_inflight: int = 0
     final_max_inflight: int = 0
     segments: int = 0
+    publishes: int = 0
+    publish_failures: int = 0
 
 
 def _snapshot_scope(scope) -> Dict[str, Any]:
@@ -248,6 +250,8 @@ def resilient_train_loop(
     on_logged: Optional[Callable[[int, List[np.ndarray]], Any]] = None,
     max_steps: Optional[int] = None,
     snapshot_state: bool = True,
+    publish_hook: Optional[Callable[[int], Any]] = None,
+    publish_period_steps: Optional[int] = None,
 ) -> ResilienceStats:
     """Drive `pipeline.train_loop` under a supervision loop that survives
     classified failures.
@@ -273,6 +277,22 @@ def resilient_train_loop(
     RNG key and a RESUME.json recording the data-stream position), and
     the preemption flush.  `resume=True` restores the newest valid
     checkpoint into `scope` and fast-forwards the loader before training.
+
+    `publish_hook` (ISSUE 19, the online-learning cadence contract) is
+    called at the dispatch boundary every `publish_period_steps` steps
+    (default `FLAGS_publish_period_steps`; 0 disables) with the step
+    number — typically it snapshots the model (dense + SelectedRows
+    tables) and pushes it through the serving publish ladder.  The hook
+    runs at the same consistent cut checkpoints use.  A FAILED publish
+    never kills training: the exception is counted
+    (`serving.publish_errors`), recorded (`publish_failed` event), and
+    the cadence resumes at the next period — the publisher's own
+    quarantine/rollback machinery already made the failure loud, and
+    the training timeline is not poisoned by a bad SNAPSHOT.  The
+    `serving.publish_staleness_steps` gauge tracks trained-step minus
+    last-published-step at every dispatch, so a silently stalled
+    cadence is visible (and gated by perf_report
+    --max-publish-staleness-steps).
 
     `injector` (paddle_tpu/faults.py) threads a deterministic fault
     schedule through the loop; defaults to `FaultInjector.from_flags()`
@@ -336,6 +356,15 @@ def resilient_train_loop(
             _rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
         digester = _integrity_mod.arm_live_digests(
             scope, period=_integrity_period, rank=_rank)
+
+    # publish cadence (ISSUE 19): period from the kwarg, else the flag;
+    # no hook (or period 0) disables the whole path at one `if`
+    _pub_period = (int(_flag("FLAGS_publish_period_steps") or 0)
+                   if publish_period_steps is None
+                   else int(publish_period_steps))
+    if publish_hook is None:
+        _pub_period = 0
+    pub = {"at": 0, "fired_at": -1}
 
     stats = ResilienceStats()
     eff_inflight = max_inflight
@@ -550,6 +579,33 @@ def resilient_train_loop(
         if (cm is not None and cm.save_every_steps and step > 0
                 and step % cm.save_every_steps == 0 and cm._step != step):
             _flush_checkpoint(step)
+        if _pub_period:
+            # publish cadence (ISSUE 19): fire at the same consistent cut
+            # the checkpoint flush uses.  A retried step must not publish
+            # twice (fired_at latch), and a FAILED publish must not kill
+            # training — count it, record it, resume the cadence next
+            # period; the publisher's quarantine already went loud.
+            if step > 0 and step % _pub_period == 0 \
+                    and pub["fired_at"] != step:
+                pub["fired_at"] = step
+                try:
+                    with _MON.span("serving.publish_hook", step=step):
+                        publish_hook(step)
+                    pub["at"] = step
+                    stats.publishes += 1
+                    _MON.counter("serving.publishes").inc()
+                    _event("publish", "Serving", step=step)
+                except Exception as pe:
+                    stats.publish_failures += 1
+                    _MON.counter("serving.publish_errors").inc()
+                    # staleness stamped on the event: the gauge reads 0
+                    # after the NEXT success, so failed periods are the
+                    # durable evidence --max-publish-staleness-steps gates
+                    _event("publish_failed", type(pe).__name__, step=step,
+                           staleness=step - pub["at"],
+                           detail=str(pe)[:300])
+            _MON.gauge("serving.publish_staleness_steps").set(
+                step - pub["at"])
         if injector is not None:
             # flip_bit strikes AFTER the flush: the classic silent-
             # corruption timeline is a clean committed checkpoint, then

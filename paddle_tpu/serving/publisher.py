@@ -24,7 +24,13 @@ verification ladder:
   4. program verification — `core/analysis.check_program` (structural)
      over the staged program with the model's feed/fetch targets;
   5. weight health — any non-finite value in a staged float weight
-     rejects (a NaN weight WILL poison every request);
+     rejects (a NaN weight WILL poison every request); SelectedRows
+     vars take the SPARSE rung instead (ISSUE 19): row ids must be
+     integral, strictly increasing, and inside [0, height), values must
+     be finite, and the staged sparse content digest
+     (`integrity.sparse_state_digest`) is stamped on the publish event
+     for `serve_trace --fleet --check` to reconcile against what each
+     replica loaded;
   6. golden-input smoke inference — the staged predictor must produce
      finite outputs on a golden batch (caller-provided, or synthesized
      from the program's feed specs), and match `golden_expect` when the
@@ -373,12 +379,28 @@ def _publish_ladder(registry, name, src, golden_feeds, golden_expect,
                           feed_names=feed_names, fetch_names=fetch_names)
         except Exception as e:
             _reject(registry, name, src, ctl, f"program verification: {e}")
-        # weight health: a non-finite weight poisons every request
+        # weight health: a non-finite weight poisons every request.
+        # SelectedRows vars take the SPARSE rung instead (ISSUE 19):
+        # structural validation (row-id monotonicity + range, shape
+        # agreement) plus the non-finite scan, and their content digest
+        # is stamped on the publish event so serve_trace --fleet --check
+        # can reconcile what was published against what every replica
+        # actually loaded (a torn publish shows up as disagreement)
+        from ..core.selected_rows import SelectedRows as _SR
+
         for vname in staged.local_var_names():
-            arr = np.asarray(staged.find_var(vname))
+            v = staged.find_var(vname)
+            if isinstance(v, _SR):
+                defect = _integrity.check_selected_rows(vname, v)
+                if defect is not None:
+                    _reject(registry, name, src, ctl,
+                            f"sparse table rung: {defect}")
+                continue
+            arr = np.asarray(v)
             if arr.dtype.kind == "f" and not np.isfinite(arr).all():
                 _reject(registry, name, src, ctl,
                         f"non-finite values in staged weight {vname!r}")
+        sparse_digest = _integrity.sparse_state_digest(staged)
         # golden-input smoke on the staged predictor (shared executor:
         # the smoke run is also the bucket-1-shaped compile)
         predictor = Predictor(active.predictor.config,
@@ -473,6 +495,7 @@ def _publish_ladder(registry, name, src, golden_feeds, golden_expect,
                               "action": "publish_staged", "model": name,
                               "src": src, "version": version.version,
                               "precision": version.precision,
+                              "sparse_digest": sparse_digest,
                               "trace_id": ctl})
             return version
         prev = registry.publish_version(name, version)
@@ -482,6 +505,7 @@ def _publish_ladder(registry, name, src, golden_feeds, golden_expect,
                           "version": version.version,
                           "prev_version": prev.version,
                           "precision": version.precision,
+                          "sparse_digest": sparse_digest,
                           "trace_id": ctl})
     return version
 

@@ -338,6 +338,21 @@ class ModelRegistry:
     def _event(self, action: str, **kw):
         _MON.record_step({"kind": "serving_event", "action": action, **kw})
 
+    @staticmethod
+    def _sparse_digest(version) -> Optional[str]:
+        """Content digest over the version's SelectedRows vars (None when
+        it holds no sparse state) — what this PROCESS actually loaded,
+        stamped on load/activate events so `serve_trace --fleet --check`
+        can reconcile it against the publisher's `sparse_digest` (ISSUE
+        19: a torn or rotted sparse snapshot shows up as replicas
+        disagreeing with the publish event)."""
+        from .. import integrity as _integrity
+
+        try:
+            return _integrity.sparse_state_digest(version.scope)
+        except Exception:
+            return None
+
     def _make_room(self, need: int, loading: str):
         """Evict cold models (LRU, never `loading`) until `need` more
         bytes fit under the budget; classified refusal when they can't."""
@@ -438,7 +453,8 @@ class ModelRegistry:
                 _MON.counter("serving.model_loads").inc()
                 self._event("load", model=name, version=version.version,
                             bytes=version.bytes, src=model_dir,
-                            precision=version.precision)
+                            precision=version.precision,
+                            sparse_digest=self._sparse_digest(version))
         try:
             if warm_buckets:
                 # outside the lock: warming compiles, and acquire() from
@@ -543,7 +559,8 @@ class ModelRegistry:
         prev = self.publish_version(name, version)
         _MON.counter("serving.reloads").inc()
         self._event("activate_staged", model=name, version=version.version,
-                    prev_version=prev.version, src=version.src)
+                    prev_version=prev.version, src=version.src,
+                    sparse_digest=self._sparse_digest(version))
         return version
 
     def discard_staged(self, name: str) -> bool:

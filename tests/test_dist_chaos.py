@@ -90,6 +90,21 @@ def _lost_to_bootstrap_load(res):
     return False
 
 
+def _survivor_report_raced(res, wall):
+    """True when the kill incident has rank 1's SIGKILL but no entry for
+    rank 0 yet: under machine load the incident snapshot can land before
+    the survivor's classified exit is reaped.  Only a FAST incarnation
+    qualifies — a genuine survivor hang rides to the 240s gang timeout
+    and must fail loudly, not retry."""
+    if wall >= 120:
+        return False
+    try:
+        inc = _kill_incident(res)
+    except AssertionError:
+        return False
+    return 0 not in {d["rank"] for d in inc["dead"]}
+
+
 def test_kill_worker_survivor_classifies_instead_of_hanging(tmp_path):
     res = None
     for attempt in range(3):  # bounded retries absorb pure load flakes
@@ -97,7 +112,7 @@ def test_kill_worker_survivor_classifies_instead_of_hanging(tmp_path):
         res, _root = _run(tmp_path, f"kill{attempt}",
                           fault_spec="kill_worker@3:1", max_restarts=0)
         wall = time.monotonic() - t0
-        if _lost_to_bootstrap_load(res):
+        if _lost_to_bootstrap_load(res) or _survivor_report_raced(res, wall):
             continue
         break
     assert not res.ok and res.incarnations == 1

@@ -167,3 +167,32 @@ def test_channel_wise_square_weight_axis(tmp_path):
     (got,) = pred.run({"x": xv})
     assert np.allclose(got, np.asarray(ref), atol=1e-3), \
         np.abs(np.asarray(got) - np.asarray(ref)).max()
+
+
+def test_quant_save_leaves_training_scope_bit_identical(tmp_path):
+    """Regression (ISSUE 19 satellite, fix from r17): the quant passes
+    snap weights to the int8 grid via scope.set_var while SAVING; the
+    live training scope must be restored bit-identically afterwards —
+    an online-learning loop keeps training this scope between publishes,
+    so a silent int8 snap would poison every step after the first save."""
+    main, startup, x, out = _build_net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    startup.random_seed = 11
+    exe.run(startup, scope=scope)
+    xv = np.random.RandomState(2).rand(4, 3, 8, 8).astype("f4")
+    (ref,) = exe.run(main, feed={"x": xv}, fetch_list=[out], scope=scope)
+    before = {n: np.asarray(scope.find_var(n)).copy()
+              for n in scope.local_var_names()}
+    fluid.io.save_quantized_inference_model(
+        str(tmp_path / "q"), ["x"], [out], exe, main, scope)
+    after_names = set(scope.local_var_names())
+    assert after_names == set(before), \
+        f"quant save changed the scope's var set: {after_names ^ set(before)}"
+    for n, b in before.items():
+        a = np.asarray(scope.find_var(n))
+        assert a.dtype == b.dtype, n
+        np.testing.assert_array_equal(a, b, err_msg=f"var {n!r} mutated")
+    # and the float forward pass still reproduces bit-identically
+    (again,) = exe.run(main, feed={"x": xv}, fetch_list=[out], scope=scope)
+    np.testing.assert_array_equal(np.asarray(again), np.asarray(ref))

@@ -309,6 +309,34 @@ def render(path: str) -> str:
                   r.get("lag_steps", ""), r.get("cause", r.get("dir", "")))
                  for r in sevs],
                 ["action", "at_step", "lag", "detail"]) if sevs else ""))
+
+    # sparse host tier + publish cadence (ISSUE 19)
+    spevs = [s for s in records if s.get("kind") == "sparse_event"]
+    pubevs = [s for s in records if s.get("kind") == "resilience_event"
+              and s.get("action") in ("publish", "publish_failed")]
+    pscnt = {n: v for n, v in snap.get("counters", {}).items()
+             if n.startswith("ps.") or n.startswith("sparse.")
+             or n in ("serving.publishes", "serving.publish_errors")}
+    if spevs or pubevs or any(pscnt.values()):
+        g = snap.get("gauges", {})
+        parts.append(
+            f"\n## sparse tier ({len(spevs)} host-tier events, "
+            f"publishes {pscnt.get('serving.publishes', 0)}, "
+            f"publish errors {pscnt.get('serving.publish_errors', 0)}, "
+            f"pserver retries {pscnt.get('ps.retries', 0)}, "
+            f"push dedups {pscnt.get('ps.push_dedup', 0)}, "
+            f"degraded steps {pscnt.get('sparse.degraded_steps', 0)}, "
+            f"host lag {g.get('sparse.host_lag_steps', 0)} steps, "
+            f"publish staleness "
+            f"{g.get('serving.publish_staleness_steps', 0)} steps)"
+            + ("\n" + _fmt_table(
+                [(r.get("action", "?"),
+                  r.get("at_step", r.get("step", "")),
+                  r.get("lag_steps", r.get("staleness", "")),
+                  str(r.get("detail", r.get("table", "")))[:60])
+                 for r in (spevs + pubevs)[:40]],
+                ["action", "at_step", "lag", "detail"])
+               if spevs or pubevs else ""))
     return "\n".join(parts)
 
 
@@ -350,6 +378,73 @@ def ckpt_lag_steps(lines):
     g = _latest_gauges(lines, "resilience.")
     try:
         return float(g.get("resilience.ckpt_lag_steps", 0.0) or 0.0)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def _has_publish_evidence(lines):
+    """True when the file carries ANY publish-cadence signal: publish /
+    publish_failed resilience events, serving.publishes/publish_errors
+    counters, or the serving.publish_staleness_steps gauge in a
+    snapshot.  The staleness gate fails on a file with none — a run
+    whose publish hook never armed (or never logged) must not gate
+    green (zero-evidence-fails, PR 8/10)."""
+    if any(r.get("kind") == "resilience_event"
+           and r.get("action") in ("publish", "publish_failed")
+           for r in lines):
+        return True
+    c = _latest_counters(lines, "serving.")
+    if c.get("serving.publishes") or c.get("serving.publish_errors"):
+        return True
+    g = _latest_gauges(lines, "serving.")
+    return "serving.publish_staleness_steps" in g
+
+
+def publish_staleness_steps(lines):
+    """The worst publish-to-serving staleness the run saw: max staleness
+    over publish_failed resilience events (each failed period stamps how
+    far training ran past the last served snapshot), with the newest
+    serving.publish_staleness_steps gauge as the end-of-run floor (it
+    reads the gap at the final dispatch, catching a cadence that stalled
+    silently at the tail)."""
+    vals = [float(r.get("staleness", 0) or 0) for r in lines
+            if r.get("kind") == "resilience_event"
+            and r.get("action") == "publish_failed"]
+    g = _latest_gauges(lines, "serving.")
+    try:
+        vals.append(float(g.get("serving.publish_staleness_steps", 0.0)
+                          or 0.0))
+    except (TypeError, ValueError):
+        pass
+    return max(vals) if vals else 0.0
+
+
+def _has_sparse_evidence(lines):
+    """True when the file carries ANY host-tier signal: sparse_event
+    records (host_tier_degraded/recovered, pserver recovery/journal
+    events), sparse.* or ps.* counters, or the sparse.host_lag_steps
+    gauge.  The host-lag gate fails on a file with none."""
+    if any(r.get("kind") == "sparse_event" for r in lines):
+        return True
+    if _latest_counters(lines, "sparse.") or _latest_counters(lines, "ps."):
+        return True
+    g = _latest_gauges(lines, "sparse.")
+    return "sparse.host_lag_steps" in g
+
+
+def host_lag_steps(lines):
+    """The worst host-tier outage the run saw, in consecutive degraded
+    steps: max lag_steps over host_tier_degraded sparse events, falling
+    back to the newest sparse.host_lag_steps gauge (which reads 0 after
+    the tier recovers — the events are the durable evidence)."""
+    lags = [float(r.get("lag_steps", 0) or 0) for r in lines
+            if r.get("kind") == "sparse_event"
+            and r.get("action") == "host_tier_degraded"]
+    if lags:
+        return max(lags)
+    g = _latest_gauges(lines, "sparse.")
+    try:
+        return float(g.get("sparse.host_lag_steps", 0.0) or 0.0)
     except (TypeError, ValueError):
         return 0.0
 
@@ -776,6 +871,8 @@ def check(path: str, steady_after: int = 2,
           max_lock_wait_frac: float = None,
           max_integrity_mismatches: int = None,
           max_ckpt_lag_steps: float = None,
+          max_publish_staleness_steps: float = None,
+          max_host_lag_steps: float = None,
           max_queue_wait_frac: float = None,
           max_pad_frac: float = None,
           require_quant_parity: bool = False,
@@ -815,6 +912,8 @@ def check(path: str, steady_after: int = 2,
                        or max_lock_wait_frac is not None
                        or max_integrity_mismatches is not None
                        or max_ckpt_lag_steps is not None
+                       or max_publish_staleness_steps is not None
+                       or max_host_lag_steps is not None
                        or max_queue_wait_frac is not None
                        or max_pad_frac is not None
                        or require_quant_parity
@@ -1206,6 +1305,60 @@ def check(path: str, steady_after: int = 2,
             else:
                 print(f"perf_report --check: checkpoint lag {lag:g} <= "
                       f"{max_ckpt_lag_steps} steps")
+    if max_publish_staleness_steps is not None:
+        if not _has_publish_evidence(lines):
+            failures.append(
+                f"--max-publish-staleness-steps given but {path} carries "
+                f"no publish-cadence evidence (no publish/publish_failed "
+                f"resilience events, no serving.publishes counter, no "
+                f"serving.publish_staleness_steps gauge in any snapshot) "
+                f"— was resilient_train_loop's publish_hook armed with "
+                f"FLAGS_publish_period_steps > 0?  (zero evidence must "
+                f"not gate green)")
+        else:
+            st = publish_staleness_steps(lines)
+            if st > max_publish_staleness_steps:
+                fails = sum(1 for r in lines
+                            if r.get("kind") == "resilience_event"
+                            and r.get("action") == "publish_failed")
+                failures.append(
+                    f"publish-to-serving staleness of {st:g} step(s) "
+                    f"exceeds the --max-publish-staleness-steps="
+                    f"{max_publish_staleness_steps} gate ({fails} failed "
+                    f"publish period(s)) — the serving fleet ran on a "
+                    f"snapshot further behind training than the cadence "
+                    f"SLO allows; check serving.publish_errors, the "
+                    f"publish_failed events' details, and the store / "
+                    f"publish ladder they name")
+            else:
+                print(f"perf_report --check: publish staleness {st:g} <= "
+                      f"{max_publish_staleness_steps} steps")
+    if max_host_lag_steps is not None:
+        if not _has_sparse_evidence(lines):
+            failures.append(
+                f"--max-host-lag-steps given but {path} carries no "
+                f"host-tier evidence (no sparse_event records, no "
+                f"sparse.*/ps.* counters, no sparse.host_lag_steps gauge "
+                f"in any snapshot) — did the run use HostTableEmbedding "
+                f"/ TieredEmbedding at all?  (zero evidence must not "
+                f"gate green)")
+        else:
+            lag = host_lag_steps(lines)
+            if lag > max_host_lag_steps:
+                n = sum(1 for r in lines
+                        if r.get("kind") == "sparse_event"
+                        and r.get("action") == "host_tier_degraded")
+                failures.append(
+                    f"host-tier lag of {lag:g} consecutive degraded "
+                    f"step(s) exceeds the --max-host-lag-steps="
+                    f"{max_host_lag_steps} gate ({n} degraded step "
+                    f"record(s)) — the cold embedding tail trained "
+                    f"hot-shard-only longer than the budget allows; "
+                    f"check the pserver supervisor's restart budget "
+                    f"(pserver_give_up fleet events) and ps.retries")
+            else:
+                print(f"perf_report --check: host-tier lag {lag:g} <= "
+                      f"{max_host_lag_steps} steps")
     if max_replay_batches is not None:
         n = replayed_batches(lines)
         if n > max_replay_batches:
@@ -1706,6 +1859,28 @@ def main(argv=None):
                          "committed.  Fails on a file with no "
                          "checkpoint-storage evidence at all — zero "
                          "evidence must not gate green")
+    ap.add_argument("--max-publish-staleness-steps", type=float,
+                    default=None, metavar="N",
+                    help="gate the worst publish-to-serving staleness — "
+                         "steps training ran past the last snapshot the "
+                         "serving tier had (publish_failed resilience "
+                         "events' staleness, "
+                         "serving.publish_staleness_steps gauge "
+                         "fallback; resilient_train_loop's publish hook, "
+                         "ISSUE 19) — at <= N.  Fails on a file with no "
+                         "publish-cadence evidence at all — zero "
+                         "evidence must not gate green")
+    ap.add_argument("--max-host-lag-steps", type=float, default=None,
+                    metavar="N",
+                    help="gate the worst host-tier outage — consecutive "
+                         "steps the sparse cold tail trained degraded "
+                         "(hot-shard-only) while the parameter server "
+                         "was down (host_tier_degraded sparse events, "
+                         "sparse.host_lag_steps gauge fallback; "
+                         "paddle_tpu/param_server.py degraded mode) — "
+                         "at <= N.  Fails on a file with no host-tier "
+                         "evidence at all — zero evidence must not gate "
+                         "green")
     ap.add_argument("--max-queue-wait-frac", type=float, default=None,
                     metavar="FRAC",
                     help="gate serving latency attribution: the fraction "
@@ -1770,6 +1945,9 @@ def main(argv=None):
                      args.max_lock_wait_frac,
                      args.max_integrity_mismatches,
                      args.max_ckpt_lag_steps,
+                     max_publish_staleness_steps=(
+                         args.max_publish_staleness_steps),
+                     max_host_lag_steps=args.max_host_lag_steps,
                      max_queue_wait_frac=args.max_queue_wait_frac,
                      max_pad_frac=args.max_pad_frac,
                      require_quant_parity=args.require_quant_parity,

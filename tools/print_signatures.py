@@ -50,6 +50,9 @@ MODULES = [
     # serving runtime (ISSUE 11): batching server, model registry,
     # verified hot reload
     "paddle_tpu.serving",
+    # fault-hardened host-tiered sparse tables (ISSUE 19): the pserver,
+    # its exactly-once client, the supervisor, and the tiered embedding
+    "paddle_tpu.param_server",
 ]
 
 

@@ -394,6 +394,23 @@ def fleet_summary(fl, last_n=10):
     return "\n".join(out)
 
 
+def _sparse_digest_events(fl):
+    """Every serving_event carrying a sparse_digest, across the router
+    stream and every replica incarnation: the publisher stamps the
+    digest it verified (`publish`/`publish_staged`), every loader stamps
+    what it actually materialized (`load`/`activate_staged`)."""
+    evs = []
+    streams = [("router", fl["router"])]
+    for rank, files in fl["replicas"].items():
+        for _path, lines in files:
+            streams.append((f"rank {rank}", lines))
+    for who, lines in streams:
+        for r in lines:
+            if r.get("kind") == "serving_event" and r.get("sparse_digest"):
+                evs.append((who, r))
+    return evs
+
+
 def fleet_check(path):
     """Exit 0 when the fleet's ledgers reconcile and every halted roll
     converged; 1 otherwise (zero evidence fails)."""
@@ -451,6 +468,33 @@ def fleet_check(path):
                 f"roll {ctl} halted without converging (no "
                 f"roll_rolled_back/roll_converged event) — the fleet may "
                 f"be split-brained between versions")
+    # sparse snapshot reconcile (ISSUE 19): every stream that touched a
+    # published sparse snapshot stamped a content digest — the publisher
+    # at verify time, every replica at load/activate time.  One src with
+    # two digests means some process served DIFFERENT sparse bytes than
+    # were verified: a torn publish, a rotted store copy, or a
+    # half-written snapshot a replica picked up mid-copy.
+    by_src = {}
+    for who, e in _sparse_digest_events(fl):
+        src = e.get("src")
+        if not src:
+            continue
+        by_src.setdefault(src, {}).setdefault(
+            e["sparse_digest"], []).append((who, e.get("action")))
+    for src, digs in sorted(by_src.items()):
+        if len(digs) > 1:
+            sides = "; ".join(
+                f"{d[:12]}… from " + ", ".join(
+                    sorted({f"{w}:{a}" for w, a in whos}))
+                for d, whos in sorted(digs.items()))
+            failures.append(
+                f"sparse snapshot digests disagree for {src}: {sides} — "
+                f"a replica loaded different sparse bytes than were "
+                f"published (torn publish / rotted store copy)")
+    if by_src:
+        print(f"serve_trace --fleet --check: {len(by_src)} sparse "
+              f"snapshot(s) digest-reconciled across publisher and "
+              f"loaders")
     if failures:
         for f_ in failures:
             print(f"serve_trace --fleet --check: {f_}")
