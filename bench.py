@@ -25,7 +25,13 @@ is gated by `perf_report --check --max-shed-frac/--max-p99-ms`.
 (ISSUE 17): the int8/bf16 snapshot goes live through the full publish
 ladder (accuracy-parity gate included) and the record carries both
 arms' rps/p99, the HBM narrowing, and the parity ledger — gated by
-`perf_report --check --require-quant-parity`.
+`perf_report --check --require-quant-parity`.  `--chaos-campaign`
+(ISSUE 20) runs the seeded multi-fault campaign engine
+(paddle_tpu/chaos.py) over the train / online / serving scenarios —
+pseudo-random compound schedules judged by the cross-subsystem
+invariant registry, failures shrunk to minimal repro specs — and the
+record carries the campaign ledger plus the `perf_report --check
+--max-chaos-violations 0` verdict on its own metrics stream.
 
 vs_baseline: the reference published no numbers (BASELINE.md), so the
 absolute series is tracked across rounds; vs_baseline = this round's
@@ -1910,6 +1916,56 @@ def bench_online(steps=48, publish_every=8, batch_size=512, feat=8,
             "batch_size": batch_size, "steps": steps}
 
 
+def bench_chaos_campaign(seed=7, per_scenario=3, max_faults=3):
+    """Chaos-campaign round (ISSUE 20): seeded multi-fault schedules
+    drawn over the train / online-learning / serving scenarios
+    (paddle_tpu/chaos.py), every run judged by the cross-subsystem
+    invariant registry, failures shrunk to minimal repro specs.  The
+    record carries the campaign ledger (schedules run, invariant checks,
+    violations — 0 is the pass bar), schedules/sec as the round's
+    number, and the `perf_report --check --max-chaos-violations 0`
+    verdict on the campaign's own metrics stream, so the gate gates the
+    gate."""
+    import os
+    import subprocess
+    import tempfile
+
+    from paddle_tpu import chaos
+
+    out = tempfile.mkdtemp(prefix="pt-bench-chaos-campaign-")
+    metrics = os.path.join(out, "chaos_metrics.jsonl")
+    t0 = _time.perf_counter()
+    res = chaos.run_campaign(scenarios=("train", "online", "serving"),
+                             seed=seed, per_scenario=per_scenario,
+                             out_dir=out, metrics_path=metrics,
+                             max_faults=max_faults)
+    wall = _time.perf_counter() - t0
+    tools = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "tools")
+    gate_rc = subprocess.call(
+        [sys.executable, os.path.join(tools, "perf_report.py"),
+         "--check", metrics, "--max-chaos-violations", "0"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    print(f"chaos-campaign: {res.schedules_run} schedule(s), "
+          f"{res.invariants_checked} invariant check(s), "
+          f"{len(res.violations)} violation(s) in {wall:.1f}s "
+          f"(gate rc={gate_rc})", file=sys.stderr)
+    for v in res.violations:
+        print(f"  VIOLATION {v['invariant']} [{v['class']}] on "
+              f"{v['scenario']} {v['spec']!r} -> "
+              f"{v.get('shrunk_spec', '(unshrunk)')}", file=sys.stderr)
+    return {"metric": "chaos_campaign_schedules_per_sec",
+            "value": round(res.schedules_run / wall, 3),
+            "unit": "schedules/sec", "seed": seed,
+            "schedules_run": res.schedules_run,
+            "invariants_checked": res.invariants_checked,
+            "violations": len(res.violations),
+            "repro_specs": [v.get("shrunk_spec", v["spec"])
+                            for v in res.violations],
+            "perf_gate_rc": gate_rc, "wall_s": round(wall, 1),
+            "survived": bool(not res.violations and gate_rc == 0)}
+
+
 _DIST_FAULT_KINDS = ("kill_worker", "stall_worker")
 _DATA_FAULT_KINDS = ("corrupt_chunk", "truncated_file")
 _INTEGRITY_FAULT_KINDS = ("flip_bit", "rot_shard")
@@ -1952,6 +2008,15 @@ def main():
             print(json.dumps(bench_serve_quant()))
         else:
             print(json.dumps(bench_serve()))
+        return
+    if "--chaos-campaign" in sys.argv:
+        seed = 7
+        for i, a in enumerate(sys.argv):
+            if a == "--seed" and i + 1 < len(sys.argv):
+                seed = int(sys.argv[i + 1])
+            elif a.startswith("--seed="):
+                seed = int(a.split("=", 1)[1])
+        print(json.dumps(bench_chaos_campaign(seed=seed)))
         return
     if "--chaos" in sys.argv:
         # distributed entries route to the multi-worker gang bench, data

@@ -75,6 +75,7 @@ from .resilience import (RetryPolicy, ResilienceStats,  # noqa: F401
 from . import dist_resilience  # noqa: F401  (heartbeats + collective watchdog)
 from . import integrity  # noqa: F401  (silent-corruption sentinel)
 from . import serving  # noqa: F401  (continuous-batching model server)
+from . import chaos  # noqa: F401  (seeded multi-fault campaign engine)
 # paddle_tpu.launch (the gang launcher) is deliberately NOT imported here:
 # `python -m paddle_tpu.launch` would re-execute an already-imported module
 # (runpy RuntimeWarning); import it explicitly where needed.

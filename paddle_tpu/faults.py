@@ -138,10 +138,24 @@ handle; entries stay pending without one):
 The hooks (`on_batch`, `on_feed`, `on_dispatch`) are called by
 `resilient_train_loop`'s feed path and dispatch callback; they are cheap
 no-ops once every entry has fired.
+
+Compound schedules (ISSUE 20): `KIND_INFO` publishes per-kind
+compatibility metadata (what the index counts, which runtime hooks the
+kind needs, whether its firing is ledgered across gang restarts) so the
+chaos campaign generator (paddle_tpu/chaos.py) can draw only schedules
+every entry of which can actually fire in the chosen scenario;
+`validate_schedule` rejects specs with exact-duplicate entries,
+capability mismatches, or unreachable pairings (an enospc window
+shadowed by an earlier ro_fs).  `sweep_stale_ledgers` reclaims
+`PADDLE_FAULT_STATE_DIR` markers left by dead gangs — call it only at
+run START (run_gang / campaign entry), never between incarnations of a
+live gang: a SIGKILLed child's marker has a dead PID by design and must
+keep suppressing its entry until the whole run is over.
 """
 from __future__ import annotations
 
-__all__ = ["Fault", "FaultInjector", "parse_fault_spec"]
+__all__ = ["Fault", "FaultInjector", "parse_fault_spec", "KIND_INFO",
+           "validate_schedule", "sweep_stale_ledgers"]
 
 import errno as _errno
 import fnmatch
@@ -203,6 +217,69 @@ _LEDGER_KINDS = _RANKED_KINDS + _FILE_KINDS \
 # shard of the Nth committed snapshot (on_commit, like rot_shard) — the
 # publish ladder's sparse rung must quarantine it
 _PSERVER_KINDS = ("kill_pserver", "stall_pserver")
+
+# Per-kind compatibility metadata (ISSUE 20).  The chaos campaign
+# generator draws schedules from this table; scenarios declare the
+# capabilities they provide and only kinds whose `needs` are covered are
+# eligible.  Fields:
+#   grammar  — the spec-grammar line, verbatim from the docstring table
+#              (the self-consistency test asserts it appears there)
+#   scope    — what the entry's index counts: "batch" (raw loader
+#              batch), "step" (train step), "chunk" (global RecordIO
+#              chunk), "commit" (committed checkpoint ordinal), "op"
+#              (choke-point I/O operation)
+#   needs    — runtime hooks/capabilities the kind requires to fire:
+#              "loader" (on_batch), "feed" (on_feed), "dispatch"
+#              (on_dispatch), "scope" (on_state with a live scope),
+#              "commit" (on_commit), "files" (on_files with RecordIO
+#              paths), "io" (arm_io around real io.py traffic), "gang"
+#              (a multi-worker gang whose supervisor restarts the
+#              victim), "pserver" (a registered PServerSupervisor)
+#   ledgered — firing survives gang restarts via the
+#              PADDLE_FAULT_STATE_DIR marker ledger
+#   example  — one valid spec entry (parse_fault_spec must accept it)
+KIND_INFO = {
+    "bad_batch": dict(grammar="bad_batch@B", scope="batch",
+                      needs=("loader",), example="bad_batch@2"),
+    "nan": dict(grammar="nan@S", scope="step",
+                needs=("feed",), example="nan@3"),
+    "device": dict(grammar="device@S[:CODE]", scope="step",
+                   needs=("dispatch",), example="device@4:UNAVAILABLE"),
+    "preempt": dict(grammar="preempt@S", scope="step",
+                    needs=("dispatch",), example="preempt@5"),
+    "kill_worker": dict(grammar="kill_worker@S:RANK", scope="step",
+                        needs=("dispatch", "gang"),
+                        example="kill_worker@3:1"),
+    "stall_worker": dict(grammar="stall_worker@S:RANK:SECS", scope="step",
+                         needs=("dispatch", "gang"),
+                         example="stall_worker@6:0:0.2"),
+    "corrupt_chunk": dict(grammar="corrupt_chunk@N", scope="chunk",
+                          needs=("files",), example="corrupt_chunk@1"),
+    "truncated_file": dict(grammar="truncated_file@N", scope="chunk",
+                           needs=("files",), example="truncated_file@1"),
+    "flip_bit": dict(grammar="flip_bit@S[:RANK]", scope="step",
+                     needs=("scope",), example="flip_bit@5:1"),
+    "rot_shard": dict(grammar="rot_shard@N", scope="commit",
+                      needs=("commit",), example="rot_shard@0"),
+    "enospc": dict(grammar="enospc@S[:RANK]", scope="step",
+                   needs=("io",), example="enospc@4"),
+    "ro_fs": dict(grammar="ro_fs@S[:RANK]", scope="step",
+                  needs=("io",), example="ro_fs@6"),
+    "eio": dict(grammar="eio@N[:PATH_GLOB]", scope="op",
+                needs=("io",), example="eio@0"),
+    "slow_io": dict(grammar="slow_io@N:MS", scope="op",
+                    needs=("io",), example="slow_io@2:250"),
+    "kill_pserver": dict(grammar="kill_pserver@S", scope="step",
+                         needs=("dispatch", "pserver"),
+                         example="kill_pserver@3"),
+    "stall_pserver": dict(grammar="stall_pserver@S:SECS", scope="step",
+                          needs=("dispatch", "pserver"),
+                          example="stall_pserver@3:0.5"),
+    "rot_row": dict(grammar="rot_row@N", scope="commit",
+                    needs=("commit", "pserver"), example="rot_row@0"),
+}
+for _k, _info in KIND_INFO.items():
+    _info["ledgered"] = _k in _LEDGER_KINDS
 
 
 @dataclass
@@ -317,6 +394,166 @@ def parse_fault_spec(spec: str) -> List[Fault]:
                                  f"stall_pserver@STEP:SECONDS")
         faults.append(f)
     return faults
+
+
+def validate_schedule(spec, capabilities=None) -> List[Fault]:
+    """Compound-schedule validation (ISSUE 20): parse `spec` (a
+    FLAGS_fault_spec string or an already-parsed fault list) and reject
+    schedules that cannot behave deterministically as a compound:
+
+      * exact-duplicate entries — the second copy could never fire (the
+        ledger marker or the one-shot latch suppresses it), so the spec
+        would silently mean less than it says;
+      * entries whose `needs` (KIND_INFO) exceed `capabilities` — when a
+        capability set is given, every entry must be able to fire in the
+        scenario providing it;
+      * an enospc window at/after a ro_fs window targeting the same rank
+        — ro_fs fails every later write first, so the enospc entry is
+        unreachable dead weight.
+
+    Returns the parsed fault list on success; raises ValueError naming
+    the offending entries otherwise."""
+    faults = parse_fault_spec(spec) if isinstance(spec, str) else list(spec)
+    seen: set = set()
+    for f in faults:
+        key = (f.kind, f.at, f.arg)
+        if key in seen:
+            raise ValueError(
+                f"fault schedule {spec!r}: duplicate entry {f} — the "
+                f"second copy can never fire (one-shot latch / ledger "
+                f"marker suppresses it)")
+        seen.add(key)
+    if capabilities is not None:
+        caps = frozenset(capabilities)
+        for f in faults:
+            missing = [n for n in KIND_INFO[f.kind]["needs"]
+                       if n not in caps]
+            if missing:
+                raise ValueError(
+                    f"fault schedule {spec!r}: entry {f} needs "
+                    f"{missing} but the scenario only provides "
+                    f"{sorted(caps)}")
+    ro = [f for f in faults if f.kind == "ro_fs"]
+    for f in faults:
+        if f.kind != "enospc":
+            continue
+        for r in ro:
+            same_rank = (r.target_rank is None
+                         or f.target_rank is None
+                         or r.target_rank == f.target_rank)
+            if same_rank and f.at >= r.at:
+                raise ValueError(
+                    f"fault schedule {spec!r}: {f} is unreachable — "
+                    f"{r} already fails every write from step {r.at} "
+                    f"onward")
+    return faults
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except (PermissionError, OSError):
+        return True  # exists but not ours — definitely alive
+    return True
+
+
+def sweep_stale_ledgers(state_dir: Optional[str] = None,
+                        scan_tmp: bool = True,
+                        min_age_s: float = 3600.0) -> Dict[str, int]:
+    """Reclaim fault-ledger state left by DEAD gangs (ISSUE 20): every
+    `fired-*` marker records the writing PID, so a marker whose PID is
+    gone belongs to a finished (or aborted) run and would wrongly
+    suppress the same fault in the next run that reuses the directory.
+    Additionally sweeps leaked `pt-fault-state-*` tempdirs (run_gang
+    mints one per run with no checkpoint_root; an aborted chaos run
+    leaks it).
+
+    Call ONLY at run start (run_gang / campaign entry), never between
+    incarnations of a live gang: a SIGKILLed child's marker has a dead
+    PID by design and must keep suppressing its entry until the whole
+    run is over.
+
+    Empty tempdirs are only removed past `min_age_s` (a concurrent
+    run_gang may have just minted one it has not written to yet).
+    Returns {"markers": removed_marker_count, "dirs": removed_dirs}."""
+    removed = {"markers": 0, "dirs": 0}
+
+    def _sweep_markers(d: str) -> int:
+        n = 0
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return 0
+        for name in names:
+            if not name.startswith("fired-"):
+                continue
+            path = os.path.join(d, name)
+            try:
+                with open(path) as fh:
+                    pid = int(fh.read().strip() or "0")
+            except (OSError, ValueError):
+                pid = 0  # unreadable/unparseable: treat as dead
+            if pid <= 0 or not _pid_alive(pid):
+                try:
+                    os.unlink(path)
+                    n += 1
+                except OSError:
+                    pass
+        return n
+
+    if state_dir is None:
+        state_dir = os.environ.get("PADDLE_FAULT_STATE_DIR")
+    if state_dir and os.path.isdir(state_dir):
+        removed["markers"] += _sweep_markers(state_dir)
+    if scan_tmp:
+        import shutil
+        import tempfile
+
+        tmp = tempfile.gettempdir()
+        try:
+            entries = os.listdir(tmp)
+        except OSError:
+            entries = []
+        for name in entries:
+            if not name.startswith("pt-fault-state-"):
+                continue
+            d = os.path.join(tmp, name)
+            if not os.path.isdir(d) \
+                    or os.path.abspath(d) == os.path.abspath(state_dir or ""):
+                continue
+            try:
+                markers = [m for m in os.listdir(d)
+                           if m.startswith("fired-")]
+            except OSError:
+                continue
+            if not markers:
+                # just-minted dir of a concurrent gang?  only reclaim
+                # once it is old enough that no live run still owns it
+                try:
+                    age = time.time() - os.path.getmtime(d)
+                except OSError:
+                    continue
+                if age < min_age_s:
+                    continue
+                removed["dirs"] += 1
+                shutil.rmtree(d, ignore_errors=True)
+                continue
+            live = False
+            for m in markers:
+                try:
+                    with open(os.path.join(d, m)) as fh:
+                        pid = int(fh.read().strip() or "0")
+                except (OSError, ValueError):
+                    pid = 0
+                if pid > 0 and _pid_alive(pid):
+                    live = True
+                    break
+            if not live:
+                removed["dirs"] += 1
+                shutil.rmtree(d, ignore_errors=True)
+    return removed
 
 
 def _mutate_chunk(paths, chunk_at: int, truncate: bool) -> bool:

@@ -80,6 +80,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
+from . import faults
 from .monitor import MONITOR as _MON
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -402,6 +403,13 @@ def run_gang(argv: Sequence[str], n_procs: int, *,
             os.path.join(checkpoint_root, "fault-state") if checkpoint_root
             else tempfile.mkdtemp(prefix="pt-fault-state-"))
     os.makedirs(base_env["PADDLE_FAULT_STATE_DIR"], exist_ok=True)
+    # ledger hygiene (ISSUE 20): a reused checkpoint_root keeps the
+    # previous (now dead) gang's fired-* markers, which would wrongly
+    # suppress this run's faults; aborted runs also leak one
+    # pt-fault-state-* tempdir each.  Sweep dead-PID state here, at run
+    # START only — between incarnations a SIGKILLed child's marker has a
+    # dead PID by design and must keep suppressing its entry.
+    faults.sweep_stale_ledgers(base_env["PADDLE_FAULT_STATE_DIR"])
     # telemetry plane (ISSUE 8): one rank-shared directory per incarnation;
     # workers (fleet.init -> monitor.init_worker_telemetry) stream their
     # rank-stamped metrics there and dump BLACKBOX.p<rank>.json on death.

@@ -174,15 +174,25 @@ def train_loop(
             while len(inflight) >= max_inflight:
                 drain_one()
             step_i = step_offset + stats.steps
-            if on_dispatch is not None:
-                on_dispatch(step_i, feed)
             try:
+                if on_dispatch is not None:
+                    on_dispatch(step_i, feed)
                 handles = exe.run_async(program, feed=feed,
                                         fetch_list=fetch_list, scope=scope)
             except BaseException as e:
-                # a synchronous dispatch failure (compile/enqueue path)
-                # belongs to this step, same as a resolution failure
-                raise _errors.attach_context(e, step=step_i)
+                # a synchronous dispatch failure (hook, compile/enqueue
+                # path) belongs to this step — but OLDER steps still in
+                # flight have unresolved guards (sticky NaN check,
+                # deferred host work).  Drain them FIRST: if one fails,
+                # ITS error propagates and supersedes this one, because
+                # recovery must rewind to the OLDEST failure — keying
+                # recovery on the newer step would restore a snapshot
+                # that already embeds the older step's unguarded update
+                # and silently commit it.
+                err = _errors.attach_context(e, step=step_i)
+                while inflight:
+                    drain_one()
+                raise err
             inflight.append((step_i, handles))
             stats.steps += 1
             stats.max_inflight_seen = max(stats.max_inflight_seen,

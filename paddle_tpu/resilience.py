@@ -378,6 +378,11 @@ def resilient_train_loop(
     src_box: Dict[str, Any] = {"src": None, "stateful": False}
     consumed = 0                     # raw batches pulled from the source
     replay: "OrderedDict[int, dict]" = OrderedDict()    # batch idx -> feed
+    # batch idx -> the RAW pull, kept only where the fault injector mutated
+    # the feed: `replay` holds the batch AS DISPATCHED (so a device retry
+    # re-presents corrupt data instead of healing it), but loader-
+    # determinism verification must compare against what the source yielded
+    raw_overlay: Dict[int, dict] = {}
     pending: deque = deque()         # (batch idx, feed) queued for re-feed
     skipped_raw: set = set()         # raw batch indices dropped as bad
     stream = {"suspect": False}      # a producer-side error likely killed it
@@ -493,9 +498,20 @@ def resilient_train_loop(
                         _MON.counter("resilience.stream_died").inc()
                         _event("stream_died", "DataError", batch=consumed)
                     return
+            if injector is not None:
+                # inject BEFORE the replay window stores the feed: the
+                # window must hold the batch AS DISPATCHED, or a device
+                # retry at the same step replays a corrupt batch clean
+                # (the once-only latch is already spent) and trains the
+                # sample the uninterrupted run would have dropped
+                raw = feed
+                feed = injector.on_feed(step, feed)
+                if feed is not raw:
+                    raw_overlay[bi] = raw
             replay[bi] = feed
             while len(replay) > window:
-                replay.popitem(last=False)
+                evicted, _ = replay.popitem(last=False)
+                raw_overlay.pop(evicted, None)
             step_batch[step] = bi
             if len(step_batch) > 8 * window:
                 # only entries near the in-flight window are read at
@@ -503,8 +519,6 @@ def resilient_train_loop(
                 # prune so a long run doesn't leak one entry per step
                 for s in [s for s in step_batch if s < step - 2 * window]:
                     del step_batch[s]
-            if injector is not None:
-                feed = injector.on_feed(step, feed)
             yield feed
             step += 1
 
@@ -661,8 +675,12 @@ def resilient_train_loop(
                 f"batch {batch_idx}, but `loader` is a bare iterable — "
                 "pass a zero-arg factory")
         pending.clear()
-        old_replay = dict(replay)
+        # the verification refs must be what the SOURCE yielded: undo the
+        # injector's mutations so a poisoned batch doesn't read as a
+        # non-deterministic factory when the rebuilt loader re-pulls it
+        old_replay = {bi: raw_overlay.get(bi, f) for bi, f in replay.items()}
         replay.clear()
+        raw_overlay.clear()
         state_at.clear()
         verify_replay.clear()
         if stream_state is not None:

@@ -659,6 +659,23 @@ class Server:
                 "p99": round(self._pct(99.0), 3),
                 "samples": len(self._lat_ms)}
 
+    def ledger(self) -> dict:
+        """The exact request ledger plus its at-rest identity verdict —
+        the chaos-campaign invariant probe (ISSUE 20).  `requests ==
+        completed + shed + timeouts + errors + shutdowns` holds whenever
+        no request is in flight (`rejected` counts admission-door
+        refusals that never enter `requests`); `balanced` evaluates it
+        so callers need not re-derive the identity."""
+        with self._cv:
+            s = dict(self._stats)
+        out = {k: s[k] for k in ("requests", "completed", "shed",
+                                 "timeouts", "errors", "shutdowns",
+                                 "rejected")}
+        out["balanced"] = (
+            out["requests"] == out["completed"] + out["shed"]
+            + out["timeouts"] + out["errors"] + out["shutdowns"])
+        return out
+
     def stats(self) -> dict:
         with self._cv:
             s = dict(self._stats)

@@ -1,0 +1,158 @@
+"""Chaos campaign engine (ISSUE 20): tier-1 wiring + the acceptance
+contracts.
+
+The module-scoped fixture runs `tools/chaos_campaign.py --check --smoke`
+ONCE as a subprocess — exactly the invocation CI runs — and the tests
+unpack its guarantees: every seeded compound schedule leaves the
+cross-subsystem invariants intact, the planted defect
+(PADDLE_CHAOS_PLANTED_BUG) is caught by a seeded campaign and shrunk to
+a <=2-fault spec that still fails, the emitted metrics stream passes
+`perf_report --check --max-chaos-violations 0`, and replaying any
+emitted spec through the ordinary single-run path reproduces the same
+invariant verdict."""
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def smoke(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("chaos-smoke"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PADDLE_CHAOS_PLANTED_BUG", None)  # the CLI plants its own
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_campaign.py"),
+         "--check", "--smoke", "--per-scenario", "1", "--out", out],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    return {"rc": p.returncode, "out": p.stdout, "err": p.stderr,
+            "dir": out, "metrics": os.path.join(out, "chaos_metrics.jsonl")}
+
+
+def test_smoke_gate_is_green(smoke):
+    assert smoke["rc"] == 0, \
+        f"--check --smoke failed:\n{smoke['out']}\n{smoke['err']}"
+    assert "OK" in smoke["out"]
+
+
+def test_planted_bug_caught_and_shrunk_to_two_faults(smoke):
+    """The engine's own proof of power: a defect that only a COMPOUND
+    schedule exposes (post-recovery corruption gated on nan AND device
+    both firing) must be caught by the seeded campaign, and the shrinker
+    must strip it to a spec of at most 2 faults that still fails."""
+    m = re.search(r"planted bug caught by '([^']+)', shrunk to '([^']+)'",
+                  smoke["out"])
+    assert m, f"planted-bug arm left no trace in:\n{smoke['out']}"
+    original, shrunk = m.group(1), m.group(2)
+    n_orig = len([e for e in original.split(";") if e.strip()])
+    n_shrunk = len([e for e in shrunk.split(";") if e.strip()])
+    assert n_shrunk <= 2, f"shrinker stalled at {shrunk!r}"
+    assert n_shrunk <= n_orig
+    kinds = {e.split("@")[0].strip() for e in shrunk.split(";")}
+    assert kinds == {"nan", "device"}, \
+        f"shrinker dropped a load-bearing fault: {shrunk!r} (the " \
+        f"planted defect needs nan AND device to manifest)"
+
+
+def test_shrunk_spec_still_fails_with_bug_and_passes_without(smoke):
+    """Replaying the shrunk spec through run_one (the ordinary
+    single-run path) reproduces the violation with the bug planted and
+    a clean verdict without — the repro names the defect, not the
+    harness."""
+    from paddle_tpu import chaos
+
+    m = re.search(r"shrunk to '([^']+)'", smoke["out"])
+    shrunk = m.group(1)
+    os.environ[chaos.PLANTED_BUG_ENV] = "1"
+    try:
+        run = chaos.run_one("train", shrunk, seed=8)
+        vs = chaos.evaluate(run)
+    finally:
+        os.environ.pop(chaos.PLANTED_BUG_ENV, None)
+    assert any(v.invariant == "bit_identical_recovery" for v in vs), \
+        f"shrunk spec {shrunk!r} no longer reproduces the planted defect"
+
+
+def test_replay_reproduces_every_campaign_verdict(smoke):
+    """Acceptance contract: any spec the campaign emitted, replayed
+    through the ordinary single-run path with the recorded seed, yields
+    the SAME invariant verdict."""
+    from paddle_tpu import chaos
+
+    with open(os.path.join(smoke["dir"], "CAMPAIGN.json")) as fh:
+        campaign = json.load(fh)
+    assert campaign["schedules"], "smoke campaign drew no schedules"
+    for s in campaign["schedules"]:
+        run = chaos.run_one(s["scenario"], s["spec"], seed=s["seed"])
+        verdict = "fail" if chaos.evaluate(run) else "pass"
+        assert verdict == s["verdict"], \
+            f"replay of {s['scenario']} {s['spec']!r} seed={s['seed']} " \
+            f"gave {verdict}, campaign recorded {s['verdict']} — the " \
+            f"single-run path drifted from the campaign path"
+
+
+def test_metrics_stream_carries_chaos_evidence(smoke):
+    with open(smoke["metrics"]) as fh:
+        lines = [json.loads(ln) for ln in fh if ln.strip()]
+    events = [r for r in lines if r.get("kind") == "chaos_event"]
+    assert len([r for r in events if r.get("event") == "schedule"]) \
+        == len(set((r["scenario"], r["spec"]) for r in events
+                   if r.get("event") == "schedule")), \
+        "duplicate schedule events"
+    assert events, "campaign wrote no chaos_event records"
+    snaps = [r for r in lines if isinstance(r.get("counters"), dict)]
+    assert snaps and snaps[-1]["counters"].get("chaos.schedules_run"), \
+        "no final counter snapshot with chaos.* evidence"
+    # the campaign's own runs must NOT leak executor step records into
+    # the stream — they would trip the recompile gate on churn the
+    # campaign caused on purpose
+    assert not any(r.get("kind") == "step" for r in lines)
+
+
+def test_perf_gate_passes_on_smoke_output_and_fails_on_silence(
+        smoke, tmp_path, capsys):
+    from tools.perf_report import check
+
+    assert check(smoke["metrics"], max_chaos_violations=0) == 0
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert check(str(empty), max_chaos_violations=0) == 1
+    capsys.readouterr()
+
+
+def test_generate_schedule_is_seeded_and_validated():
+    """Same seed -> same draw, every draw passes compound validation
+    against the scenario's declared capabilities, and the avoid set is
+    honored (no schedule drawn twice in one campaign)."""
+    import random
+
+    from paddle_tpu import chaos
+    from paddle_tpu.faults import validate_schedule
+
+    for sname, sc in chaos.SCENARIOS.items():
+        a = [chaos.generate_schedule(sname, random.Random(3))
+             for _ in range(4)]
+        b = [chaos.generate_schedule(sname, random.Random(3))
+             for _ in range(4)]
+        assert a == b, f"{sname}: schedule generation is not seeded"
+        drawn = set()
+        rng = random.Random(5)
+        for _ in range(6):
+            spec = chaos.generate_schedule(sname, rng, avoid=drawn)
+            fs = validate_schedule(spec, capabilities=sc.capabilities)
+            assert all(f.kind in sc.kinds for f in fs)
+            drawn.add(spec)
+
+
+def test_run_one_rejects_bad_specs():
+    from paddle_tpu import chaos
+
+    with pytest.raises(ValueError):
+        chaos.run_one("train", "not_a_kind@3", seed=0)
+    with pytest.raises(KeyError):
+        chaos.run_one("no_such_scenario", "nan@1", seed=0)

@@ -1,0 +1,144 @@
+"""Self-consistency of the fault-injection surface (ISSUE 20).
+
+Three descriptions of the fault grammar exist and must agree forever:
+the module docstring's human-readable table, the `_KINDS` tuples the
+parser enforces, and the `KIND_INFO` metadata the chaos-campaign
+generator draws schedules from.  Drift between them is how a campaign
+quietly stops covering a kind — these tests pin them together, plus the
+compound-validation and ledger-hygiene helpers KIND_INFO ships with."""
+import os
+
+import pytest
+
+from paddle_tpu import faults
+from paddle_tpu.faults import (KIND_INFO, parse_fault_spec,
+                               sweep_stale_ledgers, validate_schedule)
+
+
+def test_kind_info_covers_exactly_the_parser_kinds():
+    assert set(KIND_INFO) == set(faults._KINDS), \
+        "KIND_INFO and _KINDS drifted — the campaign generator and the " \
+        "parser disagree about what faults exist"
+
+
+def test_groupings_are_subsets_of_kinds():
+    for name in ("_RANKED_KINDS", "_STORAGE_KINDS", "_FILE_KINDS",
+                 "_PSERVER_KINDS", "_LEDGER_KINDS"):
+        group = getattr(faults, name)
+        assert set(group) <= set(faults._KINDS), \
+            f"{name} names kinds the parser does not know"
+
+
+def test_ledgered_flag_matches_ledger_kinds():
+    for kind, info in KIND_INFO.items():
+        assert info["ledgered"] == (kind in faults._LEDGER_KINDS), \
+            f"{kind}: KIND_INFO.ledgered disagrees with _LEDGER_KINDS"
+
+
+def test_every_grammar_line_appears_in_the_docstring():
+    doc = faults.__doc__
+    for kind, info in KIND_INFO.items():
+        assert info["grammar"] in doc, \
+            f"{kind}: grammar {info['grammar']!r} is not in the module " \
+            f"docstring table — the human-readable spec drifted"
+
+
+def test_every_example_parses_and_round_trips():
+    for kind, info in KIND_INFO.items():
+        parsed = parse_fault_spec(info["example"])
+        assert len(parsed) == 1 and parsed[0].kind == kind, \
+            f"{kind}: example {info['example']!r} does not parse to " \
+            f"itself"
+        # grammar's kind prefix must match the key it documents
+        assert info["grammar"].split("@", 1)[0] == kind
+
+
+def test_every_needs_token_is_a_known_capability():
+    known = {"loader", "feed", "dispatch", "scope", "commit", "files",
+             "io", "gang", "pserver"}
+    for kind, info in KIND_INFO.items():
+        extra = set(info["needs"]) - known
+        assert not extra, \
+            f"{kind}: needs {sorted(extra)} name no documented capability"
+
+
+def test_every_scope_token_is_documented():
+    for kind, info in KIND_INFO.items():
+        assert info["scope"] in ("batch", "step", "chunk", "commit",
+                                 "op"), f"{kind}: unknown scope"
+
+
+def test_docstring_examples_parse():
+    """The `e.g.` spec lines in the docstring must stay valid specs."""
+    for line in faults.__doc__.splitlines():
+        line = line.strip()
+        if 'FLAGS_fault_spec="' not in line:
+            continue
+        spec = line.split('"')[1]
+        assert parse_fault_spec(spec), f"docstring example {spec!r} " \
+                                       f"no longer parses"
+
+
+def test_validate_schedule_accepts_a_compound():
+    fs = validate_schedule("nan@2;device@5:UNAVAILABLE;enospc@7",
+                           capabilities=("feed", "dispatch", "io"))
+    assert [f.kind for f in fs] == ["nan", "device", "enospc"]
+
+
+def test_validate_schedule_rejects_exact_duplicates():
+    with pytest.raises(ValueError, match="duplicate"):
+        validate_schedule("nan@2;nan@2")
+    # same kind at a DIFFERENT index is a legitimate compound
+    assert len(validate_schedule("nan@2;nan@4")) == 2
+
+
+def test_validate_schedule_rejects_capability_mismatch():
+    with pytest.raises(ValueError, match="needs"):
+        validate_schedule("kill_pserver@3", capabilities=("dispatch",))
+    # without a capability set, needs are not checked (parse-only mode)
+    assert validate_schedule("kill_pserver@3")
+
+
+def test_validate_schedule_rejects_enospc_shadowed_by_ro_fs():
+    with pytest.raises(ValueError, match="unreachable|ro_fs"):
+        validate_schedule("ro_fs@3;enospc@5")
+    # an enospc window BEFORE the mount goes read-only is reachable
+    assert validate_schedule("enospc@2;ro_fs@5")
+    # different explicit ranks never shadow each other
+    assert validate_schedule("ro_fs@3:0;enospc@5:1")
+
+
+def test_sweep_reclaims_dead_markers_and_keeps_live_ones(tmp_path):
+    d = str(tmp_path)
+    # a marker from this (alive) process must survive the sweep
+    with open(os.path.join(d, "fired-kill_worker@3-1"), "w") as fh:
+        fh.write(str(os.getpid()))
+    # a marker from a dead PID must be reclaimed (PID 1 is init — alive —
+    # so synthesize a guaranteed-dead one by spawning and reaping)
+    import subprocess
+
+    p = subprocess.Popen(["true"])
+    p.wait()
+    with open(os.path.join(d, "fired-enospc@4-"), "w") as fh:
+        fh.write(str(p.pid))
+    # unreadable marker: treated as dead
+    with open(os.path.join(d, "fired-eio@0-"), "w") as fh:
+        fh.write("not-a-pid")
+    # non-marker files are never touched
+    with open(os.path.join(d, "RESULT.json"), "w") as fh:
+        fh.write("{}")
+    out = sweep_stale_ledgers(state_dir=d, scan_tmp=False)
+    assert out["markers"] == 2
+    left = sorted(os.listdir(d))
+    assert "fired-kill_worker@3-1" in left, \
+        "sweep reclaimed a LIVE gang's marker — it would re-fire a " \
+        "spent kill on the next incarnation"
+    assert "RESULT.json" in left
+    assert not any(n.startswith("fired-enospc") for n in left)
+    assert not any(n.startswith("fired-eio") for n in left)
+
+
+def test_sweep_without_state_dir_is_safe(monkeypatch):
+    monkeypatch.delenv("PADDLE_FAULT_STATE_DIR", raising=False)
+    out = sweep_stale_ledgers(state_dir=None, scan_tmp=False)
+    assert out == {"markers": 0, "dirs": 0}

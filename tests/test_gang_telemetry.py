@@ -94,8 +94,17 @@ def _retry(tmp_path, tag, fault_spec, extra=None, attempts=3, fired=None):
 
 
 def test_kill_worker_blackbox_on_every_rank_and_postmortem(tmp_path, capsys):
-    res, tel = _retry(tmp_path, "kill", "kill_worker@3:1",
-                      fired=lambda r: "firing (SIGKILL)" in _worker_stderr(r))
+    # `fired` also requires the survivor's CLASSIFIED exit: under heavy
+    # machine load the gloo collective can abort (XlaRuntimeError) before
+    # the heartbeat detector marks the peer dead, so the dump rides the
+    # crash excepthook instead of the peer-failure path — a pure timing
+    # race the PR-4 chaos suite absorbs the same way (bounded retries;
+    # a genuine classification regression fails all attempts)
+    res, tel = _retry(
+        tmp_path, "kill", "kill_worker@3:1",
+        fired=lambda r: ("firing (SIGKILL)" in _worker_stderr(r)
+                         and "DIST_FAILURE PeerFailureError"
+                         in _worker_stderr(r)))
     assert not res.ok
     assert res.telemetry_dir and os.path.isdir(res.telemetry_dir)
 

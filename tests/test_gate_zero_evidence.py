@@ -1,0 +1,212 @@
+"""Zero-evidence audit of EVERY `perf_report --check` gate (ISSUE 20).
+
+Each gate documents a contract for a metrics file that carries no
+evidence for it:
+
+  * fail-class gates ("zero evidence must not gate green") must return
+    rc 1 on an empty file AND on a file whose only content is evidence
+    for OTHER subsystems;
+  * count-class gates (dist restart/resize/corrupt/replay/skew counts,
+    heartbeat fractions) read 0 from silence by design — a gang that
+    never restarted writes no gang_restart event — and must return rc 0;
+  * the base --check (recompile gate) needs step records, so the two
+    step-coupled gates (--max-retry-frac, --max-host-blocked-frac) fail
+    on an empty file via the "no step records" diagnosis.
+
+This file pins every flag to its documented verdict across three
+shapes of starvation: empty, counters-only (one snapshot line, evidence
+present and healthy), events-only (record lines, evidence present and
+healthy).  A gate whose evidence can only travel one modality keeps its
+zero-evidence verdict on the other — that asymmetry is part of the
+contract (e.g. lock telemetry is counters-only; quant parity is
+events-only)."""
+import json
+
+import pytest
+
+from tools.perf_report import check
+
+# one entry per --check gate flag:
+#   (flag kwargs for check(),
+#    counters-only snapshot ({"counters":..., "gauges":...}) carrying
+#      HEALTHY evidence, or None when counters cannot carry it,
+#    events-only records carrying HEALTHY evidence, or None,
+#    rc expected on an evidence-free file)
+GATES = [
+    ("max_retry_frac", dict(max_retry_frac=0.5),
+     None,
+     [{"kind": "step", "recompiles_total": 0}] * 4,
+     1),   # step-coupled: empty file fails the base "no step records"
+    ("max_host_blocked_frac", dict(max_host_blocked_frac=0.9),
+     None,
+     [{"kind": "step", "recompiles_total": 0}] * 4
+     + [{"kind": "pipeline_step", "t_host_blocked_s": 0.01,
+         "t_step_wall_s": 1.0}] * 4,
+     1),
+    ("max_heartbeat_miss_frac", dict(max_heartbeat_miss_frac=0.1),
+     {"counters": {"dist.heartbeat.sent": 100,
+                   "dist.heartbeat.missed": 0}},
+     [{"kind": "dist_event", "action": "heartbeat_resumed"}],
+     0),   # count-class: silence reads as 0
+    ("max_gang_restarts", dict(max_gang_restarts=1),
+     {"counters": {"dist.gang_restarts": 1}},
+     [{"kind": "dist_event", "action": "gang_restart"}],
+     0),
+    ("max_gang_resizes", dict(max_gang_resizes=1),
+     {"counters": {"dist.gang_resizes": 1}},
+     [{"kind": "dist_event", "action": "gang_resize",
+       "direction": "shrink"}],
+     0),
+    ("max_data_corrupt_frac", dict(max_data_corrupt_frac=0.1),
+     {"counters": {"data.chunks_scanned": 100, "data.corrupt_chunks": 0}},
+     None,
+     0),
+    ("max_replay_batches", dict(max_replay_batches=0),
+     {"counters": {"resilience.replayed_batches": 0}},
+     [{"kind": "resilience_event", "action": "replay_fast_forward",
+       "batches": 0}],
+     0),
+    ("max_step_skew_frac", dict(max_step_skew_frac=1.0),
+     {"gauges": {"dist.step_skew_frac": 0.0}},
+     [{"kind": "dist_event", "action": "straggler", "skew_frac": 0.5}],
+     0),
+    ("max_shed_frac", dict(max_shed_frac=0.5),
+     {"counters": {"serving.requests": 100, "serving.shed": 0}},
+     [{"kind": "serving_batch", "requests": 8, "rows": 8, "bucket": 8}],
+     1),   # fail-class from here down
+    ("max_p99_ms", dict(max_p99_ms=1000.0),
+     {"counters": {"serving.requests": 100},
+      "gauges": {"serving.p99_ms": 5.0}},
+     [{"kind": "serving_batch", "requests": 8, "lat_ms_max": 5.0}],
+     1),
+    ("max_queue_wait_frac", dict(max_queue_wait_frac=0.5),
+     {"gauges": {"serving.queue_wait_frac": 0.1}},
+     [{"kind": "serving_trace", "outcome": "completed", "total_ms": 10.0,
+       "spans": [{"name": "queue", "dur_ms": 1.0}]}],
+     1),
+    ("max_pad_frac", dict(max_pad_frac=0.9),
+     {"counters": {"serving.pad_rows": 0, "serving.rows": 100}},
+     [{"kind": "serving_batch", "requests": 4, "rows": 4, "bucket": 4}],
+     1),
+    ("require_quant_parity", dict(require_quant_parity=True),
+     None,   # parity travels as serving_event records only
+     [{"kind": "serving_event", "action": "quant_parity",
+       "max_abs_diff": 0.0, "atol": 0.1}],
+     1),
+    ("min_healthy_replicas", dict(min_healthy_replicas=1),
+     {"gauges": {"serving.fleet.healthy_replicas": 2}},
+     None,   # fleet_events alone carry no healthy-count gauge -> still 1
+     1),
+    ("check_roll_convergence", dict(check_roll_convergence=True),
+     {"counters": {"serving.fleet.events[roll_halted]": 0,
+                   "serving.fleet.events[roll_converged]": 0}},
+     [{"kind": "fleet_event", "action": "roll_started", "ctl": "r1"},
+      {"kind": "fleet_event", "action": "roll_converged", "ctl": "r1"}],
+     1),
+    ("max_lock_wait_frac", dict(max_lock_wait_frac=0.5),
+     {"counters": {"lock.monitor.wait_us": 1,
+                   "lock.monitor.hold_us": 99}},
+     None,   # lock telemetry is counters-only by construction
+     1),
+    ("max_integrity_mismatches", dict(max_integrity_mismatches=0),
+     {"counters": {"integrity.digests": 3, "integrity.divergences": 0,
+                   "integrity.file_mismatches": 0}},
+     [{"kind": "integrity_event", "action": "ckpt_rejected"}],
+     1),
+    ("max_ckpt_lag_steps", dict(max_ckpt_lag_steps=5.0),
+     {"counters": {"checkpoint.saves": 3}},
+     [{"kind": "resilience_event", "action": "storage_recovered",
+       "lag_steps": 0}],
+     1),
+    ("max_publish_staleness_steps", dict(max_publish_staleness_steps=5.0),
+     {"counters": {"serving.publishes": 3}},
+     [{"kind": "resilience_event", "action": "publish", "at_step": 3}],
+     1),
+    ("max_host_lag_steps", dict(max_host_lag_steps=5.0),
+     {"counters": {"ps.retries": 0}},
+     [{"kind": "sparse_event", "action": "host_tier_recovered"}],
+     1),
+    ("max_chaos_violations", dict(max_chaos_violations=0),
+     {"counters": {"chaos.schedules_run": 3,
+                   "chaos.invariants_checked": 12}},
+     [{"kind": "chaos_event", "event": "schedule", "scenario": "train",
+       "spec": "nan@1", "verdict": "pass"}],
+     1),
+]
+
+
+def _write(tmp_path, name, records):
+    p = tmp_path / name
+    p.write_text("".join(json.dumps(r) + "\n" for r in records))
+    return str(p)
+
+
+@pytest.mark.parametrize(
+    "name,kwargs,counters_rec,event_recs,empty_rc",
+    GATES, ids=[g[0] for g in GATES])
+def test_gate_contract(tmp_path, capsys, name, kwargs, counters_rec,
+                       event_recs, empty_rc):
+    # empty file: the documented zero-evidence verdict
+    empty = _write(tmp_path, "empty.jsonl", [])
+    assert check(empty, **kwargs) == empty_rc, \
+        f"{name}: empty-file verdict drifted from documented contract"
+
+    # counters-only file with HEALTHY evidence for this gate -> rc 0;
+    # when the gate's evidence cannot travel as counters, a counters-only
+    # file (other subsystems' counters) keeps the zero-evidence verdict
+    if counters_rec is not None:
+        p = _write(tmp_path, "counters.jsonl", [counters_rec])
+        assert check(p, **kwargs) == 0, \
+            f"{name}: healthy counters-only evidence must gate green"
+    else:
+        p = _write(tmp_path, "counters.jsonl",
+                   [{"counters": {"unrelated.subsystem": 7}}])
+        assert check(p, **kwargs) == empty_rc, \
+            f"{name}: unrelated counters are still zero evidence"
+
+    # events-only file with HEALTHY evidence -> rc 0; a gate whose
+    # evidence never travels as events keeps the zero-evidence verdict
+    if event_recs is not None:
+        p = _write(tmp_path, "events.jsonl", event_recs)
+        assert check(p, **kwargs) == 0, \
+            f"{name}: healthy events-only evidence must gate green"
+    else:
+        p = _write(tmp_path, "events.jsonl",
+                   [{"kind": "unrelated_event", "action": "noop"}])
+        assert check(p, **kwargs) == empty_rc, \
+            f"{name}: unrelated events are still zero evidence"
+    capsys.readouterr()  # keep the per-gate prints out of pytest noise
+
+
+def test_fail_class_gates_name_the_starvation(tmp_path, capsys):
+    """Every fail-class gate's zero-evidence diagnosis must SAY it is a
+    zero-evidence failure, so CI logs distinguish 'never measured' from
+    'measured and bad'."""
+    empty = _write(tmp_path, "empty.jsonl", [])
+    for name, kwargs, _c, _e, empty_rc in GATES:
+        if empty_rc != 1 or name in ("max_retry_frac",
+                                     "max_host_blocked_frac"):
+            continue  # step-coupled gates diagnose "no step records"
+        rc = check(empty, **kwargs)
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "evidence" in out, \
+            f"{name}: zero-evidence failure does not name the starvation"
+
+
+def test_chaos_gate_fires_on_violations(tmp_path, capsys):
+    """The --max-chaos-violations gate must fire on BOTH evidence
+    modalities: failed-schedule chaos_event records and the
+    chaos.invariant_violations counter."""
+    by_events = _write(tmp_path, "viol_events.jsonl", [
+        {"kind": "chaos_event", "event": "schedule", "scenario": "train",
+         "spec": "nan@1;device@2:UNAVAILABLE", "verdict": "fail",
+         "invariant": "bit_identical_recovery"}])
+    assert check(by_events, max_chaos_violations=0) == 1
+    assert check(by_events, max_chaos_violations=1) == 0
+    by_counters = _write(tmp_path, "viol_counters.jsonl", [
+        {"counters": {"chaos.schedules_run": 4,
+                      "chaos.invariant_violations": 2}}])
+    assert check(by_counters, max_chaos_violations=1) == 1
+    assert check(by_counters, max_chaos_violations=2) == 0
+    capsys.readouterr()

@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Chaos campaign CLI (paddle_tpu/chaos.py — ISSUE 20).
+
+    python tools/chaos_campaign.py --campaign --seed 7 --per-scenario 4
+        Generate and run seeded multi-fault schedules against each
+        scenario (train / online / serving; add gang with
+        --scenarios ...,gang and PADDLE_CHAOS_GANG_WORKER pointing at a
+        gang worker script), evaluate the invariant registry after every
+        run, shrink any failing schedule to a minimal still-failing
+        FLAGS_fault_spec, and write CHAOS_REPRO.json artifacts + a
+        CAMPAIGN.json summary under --out.  --metrics writes the
+        chaos_event / counter JSONL that `perf_report --check
+        --max-chaos-violations` gates on.
+
+    python tools/chaos_campaign.py --check --smoke [--out DIR]
+        The tier-1 gate: a few seeded compound schedules per scenario,
+        every invariant must hold, PLUS the planted-bug arm —
+        PADDLE_CHAOS_PLANTED_BUG re-enables a simulated stale-restore
+        race that only a nan+device compound exposes, and the gate
+        asserts a seeded campaign catches it and the shrinker converges
+        to a <=2-fault spec that STILL fails (and passes again with the
+        bug unplanted).  Fixed seeds, CPU, time-budgeted.  Exit 1 on any
+        unexpected violation, a missed planted bug, or a non-minimal
+        shrink.
+
+    python tools/chaos_campaign.py --replay --scenario train \
+        --spec 'preempt@4;enospc@6' --seed 7
+        Replay one schedule through the ordinary single-run path (the
+        same path the campaign used — seeded determinism makes the
+        verdict reproduce) and print the invariant verdict.  Exit 1 on
+        violation.  This is how a CHAOS_REPRO.json is replayed.
+
+Exit codes: 0 green, 1 violations / planted-bug escape, 2 usage.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+SMOKE_SEED = 20          # the smoke's campaign seed (fixed: tier-1 replays)
+PLANTED_SEED = 8         # first train draw is the nan@S;device@T pairing
+
+
+def _campaign(args) -> int:
+    from paddle_tpu import chaos
+
+    scenarios = tuple(s for s in args.scenarios.split(",") if s)
+    res = chaos.run_campaign(
+        scenarios=scenarios, seed=args.seed,
+        per_scenario=args.per_scenario, out_dir=args.out,
+        metrics_path=args.metrics, do_shrink=not args.no_shrink,
+        max_faults=args.max_faults)
+    for s in res.schedules:
+        print(f"{s['verdict']:4s}  {s['scenario']:8s} {s['spec']}")
+    print(f"chaos campaign: {res.schedules_run} schedule(s), "
+          f"{res.invariants_checked} invariant check(s), "
+          f"{len(res.violations)} violation(s) -> {res.out_dir}")
+    for v in res.violations:
+        print(f"  VIOLATION [{v['class']}] {v['invariant']} "
+              f"({v['scenario']}): {v['message']}")
+        if "shrunk_spec" in v:
+            print(f"    shrunk to: {v['shrunk_spec']} "
+                  f"({v['shrink_runs']} probe runs)")
+    for p in res.repro_paths:
+        print(f"  repro: {p}")
+    return 1 if res.violations else 0
+
+
+def _replay(args) -> int:
+    from paddle_tpu import chaos
+
+    run = chaos.run_one(args.scenario, args.spec, seed=args.seed)
+    vs = chaos.evaluate(run)
+    checked = len(chaos.invariants_for(args.scenario)) if run.ok else 1
+    print(f"replay {args.scenario} seed={args.seed} "
+          f"spec={args.spec!r}: {checked} invariant(s) checked, "
+          f"fired={run.fired}")
+    for v in vs:
+        print(f"  VIOLATION [{v.cls}] {v.invariant}: {v.message}")
+    if not vs:
+        print("  all invariants hold")
+    return 1 if vs else 0
+
+
+def _smoke(args) -> int:
+    """The tier-1 smoke: green campaign + planted-bug convergence."""
+    from paddle_tpu import chaos
+
+    t0 = time.monotonic()
+    out = args.out or tempfile.mkdtemp(prefix="pt-chaos-smoke-")
+    metrics = args.metrics or os.path.join(out, "chaos_metrics.jsonl")
+    failures = []
+
+    # arm 1: the seeded compound campaign — every invariant must hold
+    res = chaos.run_campaign(
+        scenarios=("train", "online", "serving"), seed=SMOKE_SEED,
+        per_scenario=args.per_scenario, out_dir=out, metrics_path=metrics)
+    for s in res.schedules:
+        print(f"{s['verdict']:4s}  {s['scenario']:8s} {s['spec']}")
+    if res.violations:
+        for v in res.violations:
+            failures.append(
+                f"smoke campaign violated {v['invariant']} "
+                f"[{v['class']}] on {v['scenario']} {v['spec']!r}: "
+                f"{v['message']}")
+
+    # arm 2: the planted defect — a seeded campaign must CATCH it and
+    # the shrinker must converge to a <=2-fault spec that still fails
+    os.environ[chaos.PLANTED_BUG_ENV] = "1"
+    try:
+        planted = chaos.run_campaign(
+            scenarios=("train",), seed=PLANTED_SEED, per_scenario=1,
+            out_dir=os.path.join(out, "planted"), metrics_path=None)
+        caught = [v for v in planted.violations
+                  if v["invariant"] == "bit_identical_recovery"]
+        if not caught:
+            failures.append(
+                "planted-bug arm: the seeded campaign did NOT catch the "
+                f"planted stale-restore race (seed {PLANTED_SEED})")
+        else:
+            v = caught[0]
+            shrunk = v.get("shrunk_spec", v["spec"])
+            n = len([e for e in shrunk.split(";") if e.strip()])
+            print(f"planted bug caught by {v['spec']!r}, shrunk to "
+                  f"{shrunk!r} ({v.get('shrink_runs', 0)} probe runs)")
+            if n > 2:
+                failures.append(
+                    f"shrinker did not converge: {shrunk!r} still has "
+                    f"{n} faults (want <=2)")
+            # the shrunk spec must still fail with the bug planted...
+            r = chaos.run_one("train", shrunk, seed=PLANTED_SEED)
+            if not any(x.invariant == "bit_identical_recovery"
+                       for x in chaos.evaluate(r)):
+                failures.append(
+                    f"shrunk spec {shrunk!r} no longer reproduces the "
+                    f"violation (shrinker verdict drifted)")
+    finally:
+        os.environ.pop(chaos.PLANTED_BUG_ENV, None)
+    # ...and pass again with the bug unplanted (the defect, not the
+    # harness, is what the schedule detects)
+    if not failures:
+        r = chaos.run_one("train", shrunk, seed=PLANTED_SEED)
+        if chaos.evaluate(r):
+            failures.append(
+                f"shrunk spec {shrunk!r} fails even without the planted "
+                f"bug — the repro names the wrong culprit")
+
+    wall = time.monotonic() - t0
+    print(f"chaos smoke: {res.schedules_run} schedule(s), "
+          f"{res.invariants_checked} invariant check(s), planted-bug arm "
+          f"{'ok' if not failures else 'FAILED'}, {wall:.1f}s")
+    print(f"metrics: {metrics}")
+    if failures:
+        for f in failures:
+            print(f"FAIL: {f}")
+        return 1
+    print("OK")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--campaign", action="store_true",
+                    help="run a full seeded campaign")
+    ap.add_argument("--check", action="store_true",
+                    help="gate mode: exit 1 on any violation")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 smoke campaign + planted-bug arm")
+    ap.add_argument("--replay", action="store_true",
+                    help="replay one schedule through the single-run path")
+    ap.add_argument("--scenario", default="train",
+                    help="scenario for --replay")
+    ap.add_argument("--spec", default=None,
+                    help="FLAGS_fault_spec string for --replay")
+    ap.add_argument("--scenarios", default="train,online,serving",
+                    help="comma list for --campaign")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--per-scenario", type=int, default=2)
+    ap.add_argument("--max-faults", type=int, default=3)
+    ap.add_argument("--out", default=None,
+                    help="artifact dir (CHAOS_REPRO.json, CAMPAIGN.json)")
+    ap.add_argument("--metrics", default=None,
+                    help="metrics JSONL path (perf_report gates on it)")
+    ap.add_argument("--no-shrink", action="store_true",
+                    help="skip shrinking failing schedules")
+    args = ap.parse_args(argv)
+
+    if args.replay:
+        if not args.spec:
+            ap.error("--replay needs --spec")
+        return _replay(args)
+    if args.smoke or (args.check and not args.campaign):
+        return _smoke(args)
+    if args.campaign:
+        return _campaign(args)
+    ap.error("pick one of --campaign / --check --smoke / --replay")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,6 +116,16 @@
         integrity evidence FAILS the gate — zero evidence must not gate
         green.
 
+    python tools/perf_report.py --check metrics.jsonl --max-chaos-violations 0
+        Gate the chaos campaign's verdict (paddle_tpu/chaos.py, ISSUE
+        20): invariant violations recorded by seeded multi-fault
+        schedules (chaos.invariant_violations counter, failed-schedule
+        chaos_event records as the floor).  0 asserts every schedule the
+        campaign drew left the cross-subsystem invariants intact; any
+        failure's minimal repro lives in the campaign's
+        CHAOS_REPRO.json.  A file with no chaos evidence at all FAILS
+        the gate — zero evidence must not gate green.
+
     python tools/perf_report.py --check-bench BENCH_rNN.json
         Ratcheted bench-round gate (ISSUE 7): analytic MFU must clear the
         MFU_FLOORS landed with the last accepted round (resnet50's floor
@@ -337,6 +347,24 @@ def render(path: str) -> str:
                  for r in (spevs + pubevs)[:40]],
                 ["action", "at_step", "lag", "detail"])
                if spevs or pubevs else ""))
+
+    # chaos campaign (ISSUE 20)
+    cevs = [s for s in records if s.get("kind") == "chaos_event"]
+    ccnt = {n: v for n, v in snap.get("counters", {}).items()
+            if n.startswith("chaos.")}
+    if cevs or any(ccnt.values()):
+        lines = records + [snap]
+        parts.append(
+            f"\n## chaos campaign ({len(cevs)} events, "
+            f"schedules {ccnt.get('chaos.schedules_run', 0)}, "
+            f"invariant checks {ccnt.get('chaos.invariants_checked', 0)}, "
+            f"violations {chaos_violation_count(lines)})"
+            + ("\n" + _fmt_table(
+                [(r.get("event", "?"), r.get("scenario", ""),
+                  r.get("verdict", ""),
+                  str(r.get("shrunk_spec", r.get("spec", "")))[:50])
+                 for r in cevs[:40]],
+                ["event", "scenario", "verdict", "spec"]) if cevs else ""))
     return "\n".join(parts)
 
 
@@ -417,6 +445,33 @@ def publish_staleness_steps(lines):
     except (TypeError, ValueError):
         pass
     return max(vals) if vals else 0.0
+
+
+def _has_chaos_evidence(lines):
+    """True when the file carries ANY chaos-campaign signal: chaos_event
+    records (one per schedule run, plus one per shrink) or chaos.*
+    counters in a snapshot.  The --max-chaos-violations gate fails on a
+    file with none — a campaign that never ran (or ran with the monitor
+    muted) must not gate green (zero-evidence-fails, PR 8/10)."""
+    if any(r.get("kind") == "chaos_event" for r in lines):
+        return True
+    return bool(_latest_counters(lines, "chaos."))
+
+
+def chaos_violation_count(lines):
+    """Invariant violations the chaos campaign saw: the newest
+    chaos.invariant_violations counter, with a recount of failed
+    schedule chaos_event records as the floor (the events survive even
+    when no final counter snapshot was written)."""
+    n_events = sum(1 for r in lines if r.get("kind") == "chaos_event"
+                   and r.get("event") == "schedule"
+                   and r.get("verdict") == "fail")
+    c = _latest_counters(lines, "chaos.")
+    try:
+        n_counter = int(c.get("chaos.invariant_violations", 0) or 0)
+    except (TypeError, ValueError):
+        n_counter = 0
+    return max(n_events, n_counter)
 
 
 def _has_sparse_evidence(lines):
@@ -877,7 +932,8 @@ def check(path: str, steady_after: int = 2,
           max_pad_frac: float = None,
           require_quant_parity: bool = False,
           min_healthy_replicas: float = None,
-          check_roll_convergence: bool = False) -> int:
+          check_roll_convergence: bool = False,
+          max_chaos_violations: int = None) -> int:
     """Return 0 when the metrics file is healthy, 1 otherwise (printed
     diagnosis either way).  Made for CI/bench scripts:
 
@@ -918,7 +974,8 @@ def check(path: str, steady_after: int = 2,
                        or max_pad_frac is not None
                        or require_quant_parity
                        or min_healthy_replicas is not None
-                       or check_roll_convergence) \
+                       or check_roll_convergence
+                       or max_chaos_violations is not None) \
         and max_host_blocked_frac is None and max_retry_frac is None
     if not steps and not dist_gates_only:
         print(f"perf_report --check: {path} contains no step records "
@@ -1371,6 +1428,32 @@ def check(path: str, steady_after: int = 2,
         else:
             print(f"perf_report --check: replayed batches {n} <= "
                   f"{max_replay_batches}")
+    if max_chaos_violations is not None:
+        if not _has_chaos_evidence(lines):
+            failures.append(
+                f"--max-chaos-violations given but {path} carries no "
+                f"chaos-campaign evidence (no chaos_event records, no "
+                f"chaos.* counters in any snapshot) — was "
+                f"tools/chaos_campaign.py run with --metrics pointed at "
+                f"this file?  (zero evidence must not gate green)")
+        else:
+            n = chaos_violation_count(lines)
+            if n > max_chaos_violations:
+                sched = sum(1 for r in lines
+                            if r.get("kind") == "chaos_event"
+                            and r.get("event") == "schedule")
+                failures.append(
+                    f"{n} chaos invariant violation(s) over {sched} "
+                    f"schedule(s) exceed the --max-chaos-violations="
+                    f"{max_chaos_violations} gate — a seeded multi-fault "
+                    f"schedule broke a cross-subsystem invariant; the "
+                    f"failing chaos_event records name the spec, and the "
+                    f"campaign's CHAOS_REPRO.json carries the shrunk "
+                    f"minimal repro (replay it with tools/"
+                    f"chaos_campaign.py --replay)")
+            else:
+                print(f"perf_report --check: chaos violations {n} <= "
+                      f"{max_chaos_violations}")
     if failures:
         for f_ in failures:
             print(f"perf_report --check: {f_}")
@@ -1927,6 +2010,13 @@ def main(argv=None):
                          "episode at all'; tools/trace_merge.py --check "
                          "shares the flag name but gates the MEAN "
                          "arrival skew per correlated step instead")
+    ap.add_argument("--max-chaos-violations", type=int, default=None,
+                    metavar="N",
+                    help="gate the chaos campaign's invariant violations "
+                         "(chaos.invariant_violations counter, failed "
+                         "schedule chaos_event records) at <= N.  Fails "
+                         "on a file with no chaos evidence at all — zero "
+                         "evidence must not gate green")
     args = ap.parse_args(argv)
     if args.postmortem:
         return postmortem(args.postmortem, last_n=args.postmortem_last_n)
@@ -1952,7 +2042,8 @@ def main(argv=None):
                      max_pad_frac=args.max_pad_frac,
                      require_quant_parity=args.require_quant_parity,
                      min_healthy_replicas=args.min_healthy_replicas,
-                     check_roll_convergence=args.check_roll_convergence)
+                     check_roll_convergence=args.check_roll_convergence,
+                     max_chaos_violations=args.max_chaos_violations)
     if args.diff:
         print(diff(*args.diff))
         return 0
